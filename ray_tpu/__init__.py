@@ -1,0 +1,49 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+Capability-equivalent to the surveyed reference (see SURVEY.md): tasks,
+actors, a shared-memory object store, placement groups and a two-level
+scheduler on the runtime side; mesh-based XLA collectives, data-parallel
+training, hyperparameter tuning, datasets and serving on the library side —
+all designed for TPU (JAX/XLA/Pallas) from the start.
+"""
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._version import __version__  # noqa: F401
+
+# Runtime API symbols re-exported lazily so that pure-compute subpackages
+# (ray_tpu.parallel, ray_tpu.models, ray_tpu.ops) can be imported without
+# dragging in the runtime (and vice versa).
+_API_NAMES = (
+    "ObjectRef",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "get",
+    "get_actor",
+    "get_gpu_ids",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "timeline",
+    "wait",
+)
+
+
+def __getattr__(name):
+    if name in _API_NAMES:
+        from ray_tpu._private import api
+
+        return getattr(api, name)
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_API_NAMES))
+
+
+__all__ = ["__version__", "exceptions", *_API_NAMES]

@@ -1,0 +1,92 @@
+"""Central config table with env-var overrides.
+
+TPU-native analog of the reference's RAY_CONFIG macro table
+(/root/reference/src/ray/common/ray_config_def.h:32 — 179 entries,
+each overridable via a `RAY_<name>` env var and propagable cluster-wide).
+Here each entry is declared once in _CONFIG_DEFS and overridable via
+`RAY_TPU_<NAME>`; `system_config` overrides passed to `init()` win over env.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_CONFIG_DEFS: Dict[str, Any] = {
+    # --- scheduling ---
+    "worker_lease_timeout_ms": 30_000,
+    "worker_pool_min_size": 0,
+    "worker_pool_idle_timeout_s": 120.0,
+    "max_tasks_in_flight_per_worker": 10,  # lease pipelining depth
+    "scheduler_spread_threshold": 0.5,  # hybrid policy pack→spread knob
+    "scheduler_top_k_fraction": 0.2,
+    # --- object store ---
+    "object_store_memory_default": 256 * 1024 * 1024,
+    "object_store_full_delay_ms": 10,
+    "object_store_full_max_retries": 500,
+    "object_spilling_threshold": 0.8,
+    "min_spilling_size_bytes": 1024 * 1024,
+    "max_io_workers": 2,
+    "inline_object_max_size_bytes": 100 * 1024,  # small results ride the RPC reply
+    "object_transfer_chunk_bytes": 4 * 1024 * 1024,
+    # --- fault tolerance ---
+    "task_max_retries_default": 3,
+    "actor_max_restarts_default": 0,
+    "health_check_period_ms": 1_000,
+    "health_check_failure_threshold": 5,
+    "gcs_rpc_timeout_s": 30.0,
+    "actor_creation_timeout_s": 60.0,
+    # --- memory monitor ---
+    "memory_monitor_refresh_ms": 250,
+    "memory_usage_threshold": 0.95,
+    # --- collective / mesh ---
+    "collective_default_backend": "xla",
+    "mesh_ici_axis_order": "dp,pp,ep,sp,tp",  # slowest→fastest varying axes
+    # --- misc ---
+    "rpc_max_message_bytes": 512 * 1024 * 1024,
+    "pubsub_poll_timeout_s": 30.0,
+    "event_log_max_bytes": 16 * 1024 * 1024,
+    "metrics_report_interval_ms": 2_000,
+    "log_to_driver": True,
+}
+
+
+class _Config:
+    def __init__(self):
+        self._values = dict(_CONFIG_DEFS)
+        for name, default in _CONFIG_DEFS.items():
+            env = os.environ.get("RAY_TPU_" + name.upper())
+            if env is not None:
+                self._values[name] = _parse(env, default)
+
+    def __getattr__(self, name: str):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def apply_system_config(self, overrides: Dict[str, Any] | None):
+        if not overrides:
+            return
+        for k, v in overrides.items():
+            if k not in self._values:
+                raise ValueError(f"Unknown system config key: {k}")
+            self._values[k] = v
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+def _parse(env: str, default: Any):
+    if isinstance(default, bool):
+        return env.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(env)
+    if isinstance(default, float):
+        return float(env)
+    if isinstance(default, (dict, list)):
+        return json.loads(env)
+    return env
+
+
+GlobalConfig = _Config()

@@ -1,0 +1,75 @@
+"""Public exception types.
+
+Mirrors the reference's error taxonomy (python/ray/exceptions.py in the
+reference tree): user-code errors wrap the original traceback, system
+errors describe which component died.
+"""
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at `get()` with the remote
+    traceback attached."""
+
+    def __init__(self, cause_cls_name: str, traceback_str: str):
+        self.cause_cls_name = cause_cls_name
+        self.traceback_str = traceback_str
+        super().__init__(f"{cause_cls_name} raised in remote task:\n{traceback_str}")
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died unexpectedly (analog of the
+    reference's WORKER_DIED error type, common.proto ErrorType)."""
+
+
+class ActorDiedError(RayTpuError):
+    def __init__(self, actor_id_hex: str = "", reason: str = ""):
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        super().__init__(f"Actor {actor_id_hex} died: {reason or 'unknown cause'}")
+
+
+class ActorUnavailableError(RayTpuError):
+    """Actor is restarting; the call may be retried."""
+
+
+class ObjectLostError(RayTpuError):
+    """All copies of an object were lost and reconstruction failed/disabled
+    (reference: object_recovery_manager.h)."""
+
+    def __init__(self, object_id_hex: str):
+        self.object_id_hex = object_id_hex
+        super().__init__(f"Object {object_id_hex} lost and could not be reconstructed")
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """Raised when the memory monitor kills a task to protect the node
+    (reference: memory_monitor.h:88, worker_killing_policy.h:30)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    pass
+
+
+class CrossLanguageError(RayTpuError):
+    pass

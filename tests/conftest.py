@@ -1,0 +1,45 @@
+"""Global test configuration.
+
+All tests run on a virtual 8-device CPU mesh (the TPU analog of the
+reference's single-node gloo collective tests — see
+/root/reference/python/ray/util/collective/tests/single_node_cpu_tests/):
+sharding/collective code paths compile and execute exactly as they would on
+an 8-chip slice, but on host CPU devices.
+"""
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("RAY_TPU_TESTING", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Start a fresh single-node runtime for a test, shut down after.
+
+    Mirrors the reference fixture of the same name
+    (python/ray/tests/conftest.py:245-360).
+    """
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """A multi-node in-process cluster, the reference's central test trick
+    (python/ray/cluster_utils.py:99)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    yield cluster
+    cluster.shutdown()
