@@ -1,0 +1,287 @@
+"""GPT-2 family — the flagship model (BASELINE.md north star:
+GPT-2-1.5B ≥40% MFU on v5e-64).
+
+Pure-JAX pytree model, TPU-first: bf16 compute / f32 params, einsum-only
+(MXU), `lax.scan` over layers (one compiled block), optional remat,
+sharding by logical axes (parallel/sharding.py) so the same forward runs
+dp/tp/sp/ep on any mesh; pipeline-parallel forward via parallel/pipeline.py.
+
+Equivalent reference workload: Ray Train GPT-2 fine-tune
+(/root/reference/release/train_tests/, BASELINE.json configs); the model
+itself is new — the reference contains no model implementations, it wraps
+torch. Architecture follows the public GPT-2 description (learned
+positional embeddings, pre-LN blocks, GELU MLP, tied LM head).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.models import layers as L
+from ray_tpu.parallel import sharding as sh
+from ray_tpu.parallel.pipeline import gpipe, microbatch, unmicrobatch
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304  # 50257 rounded up to a 128 multiple (MXU tiling)
+    max_seq: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 0  # 0 -> 4 * d_model
+    moe: Optional[L.MoEConfig] = None  # if set, every block's MLP is routed
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    attention: str = "auto"  # auto | flash | reference | ring
+    aux_loss_weight: float = 0.01
+
+    @property
+    def ff(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    @property
+    def n_params(self) -> int:
+        """Parameter count (for MFU math)."""
+        d, f, l, v = self.d_model, self.ff, self.n_layer, self.vocab_size
+        per_block = 4 * d * d + (2 * d * f + d + f) + 4 * d  # attn + mlp + lns
+        if self.moe:
+            per_block += self.moe.n_experts * 2 * d * f - (2 * d * f + d + f)
+        return v * d + self.max_seq * d + l * per_block + 2 * d
+
+
+# Presets (public GPT-2 sizes).
+def gpt2_small():
+    return GPT2Config(n_layer=12, n_head=12, d_model=768)
+
+
+def gpt2_medium():
+    return GPT2Config(n_layer=24, n_head=16, d_model=1024)
+
+
+def gpt2_large():
+    return GPT2Config(n_layer=36, n_head=20, d_model=1280)
+
+
+def gpt2_xl():
+    """The 1.5B north-star config."""
+    return GPT2Config(n_layer=48, n_head=25, d_model=1600)
+
+
+def gpt2_tiny():
+    """Test-sized config."""
+    return GPT2Config(
+        vocab_size=256, max_seq=128, n_layer=2, n_head=4, d_model=64, remat=False
+    )
+
+
+# ------------------------------------------------------------------ params
+def _init_block(key, cfg: GPT2Config):
+    k1, k2 = jax.random.split(key)
+    block = {
+        "ln1": {
+            "scale": jnp.ones((cfg.d_model,), cfg.param_dtype),
+            "bias": jnp.zeros((cfg.d_model,), cfg.param_dtype),
+        },
+        "attn": L.init_attention(k1, cfg.d_model, cfg.n_head, cfg.param_dtype),
+        "ln2": {
+            "scale": jnp.ones((cfg.d_model,), cfg.param_dtype),
+            "bias": jnp.zeros((cfg.d_model,), cfg.param_dtype),
+        },
+    }
+    if cfg.moe:
+        block["moe"] = L.init_moe(k2, cfg.d_model, cfg.ff, cfg.moe, cfg.param_dtype)
+    else:
+        block["mlp"] = L.init_mlp(k2, cfg.d_model, cfg.ff, cfg.param_dtype)
+    return block
+
+
+def init(key, cfg: GPT2Config):
+    ke, kp, kb = jax.random.split(key, 3)
+    blocks = jax.vmap(lambda k: _init_block(k, cfg))(jax.random.split(kb, cfg.n_layer))
+    return {
+        "wte": (jax.random.normal(ke, (cfg.vocab_size, cfg.d_model)) * 0.02).astype(
+            cfg.param_dtype
+        ),
+        "wpe": (jax.random.normal(kp, (cfg.max_seq, cfg.d_model)) * 0.01).astype(
+            cfg.param_dtype
+        ),
+        "blocks": blocks,
+        "ln_f": {
+            "scale": jnp.ones((cfg.d_model,), cfg.param_dtype),
+            "bias": jnp.zeros((cfg.d_model,), cfg.param_dtype),
+        },
+    }
+
+
+def logical_axes(cfg: GPT2Config):
+    """Pytree of logical-axis names matching init()'s structure. Stacked
+    block leaves get a leading 'layers' axis (mapped to pp only by the
+    pipelined path, which re-chunks explicitly)."""
+    ln = {"scale": ("embed",), "bias": ("embed",)}
+    block = {
+        "ln1": ln,
+        "attn": dict(L.ATTENTION_LOGICAL),
+        "ln2": ln,
+    }
+    if cfg.moe:
+        block["moe"] = dict(L.MOE_LOGICAL)
+    else:
+        block["mlp"] = dict(L.MLP_LOGICAL)
+    block = jax.tree_util.tree_map(
+        lambda names: ("layers",) + tuple(names),
+        block,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": block,
+        "ln_f": ln,
+    }
+
+
+def partition_specs(cfg: GPT2Config, rules=None):
+    return jax.tree_util.tree_map(
+        lambda names: sh.spec(*names, rules=rules),
+        logical_axes(cfg),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+# ----------------------------------------------------------------- forward
+def _resolve_attention(cfg: GPT2Config, mesh: Optional[Mesh]) -> str:
+    if cfg.attention != "auto":
+        return cfg.attention
+    if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
+        return "ring"
+    if jax.default_backend() == "tpu":
+        return "flash"
+    return "reference"
+
+
+def _block_apply(block, x, cfg: GPT2Config, impl: str):
+    cd = cfg.dtype
+    h = L.layer_norm(x, block["ln1"]["scale"], block["ln1"]["bias"])
+    x = x + L.apply_attention(block["attn"], h, causal=True, impl=impl, compute_dtype=cd)
+    h = L.layer_norm(x, block["ln2"]["scale"], block["ln2"]["bias"])
+    if cfg.moe:
+        m, aux = L.apply_moe(block["moe"], h, cfg.moe, compute_dtype=cd)
+    else:
+        m, aux = L.apply_mlp(block["mlp"], h, compute_dtype=cd), jnp.float32(0)
+    return x + m, aux
+
+
+def embed(params, tokens, cfg: GPT2Config):
+    S = tokens.shape[1]
+    x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][:S]
+    return x.astype(cfg.dtype)
+
+
+def unembed(params, x, cfg: GPT2Config):
+    x = L.layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return jnp.einsum(
+        "bsd,vd->bsv", x.astype(jnp.float32), params["wte"].astype(jnp.float32)
+    )
+
+
+def forward(params, tokens, cfg: GPT2Config, mesh: Optional[Mesh] = None):
+    """tokens [B, S] -> (logits [B, S, V] f32, moe aux loss scalar)."""
+    impl = _resolve_attention(cfg, mesh)
+    x = embed(params, tokens, cfg)
+    if mesh is not None:
+        x = sh.constrain(x, mesh, "batch", "seq", "embed")
+
+    def body(carry, block):
+        x, aux = carry
+        x, a = _block_apply(block, x, cfg, impl)
+        if mesh is not None:
+            x = sh.constrain(x, mesh, "batch", "seq", "embed")
+        return (x, aux + a), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.float32(0)), params["blocks"])
+    logits = unembed(params, x, cfg)
+    if mesh is not None:
+        logits = sh.constrain(logits, mesh, "batch", "seq", "vocab")
+    return logits, aux / cfg.n_layer
+
+
+def forward_pipelined(
+    params,
+    tokens,
+    cfg: GPT2Config,
+    mesh: Mesh,
+    *,
+    n_microbatches: int = 4,
+):
+    """Pipeline-parallel forward: block stack split into pp stages,
+    embedding/unembedding outside the pipeline (they are cheap and
+    tp/dp-sharded). Attention inside stages is flash/reference (see
+    pipeline.py for the sp+pp limitation)."""
+    n_pp = dict(mesh.shape).get("pp", 1)
+    if cfg.n_layer % n_pp:
+        raise ValueError(f"n_layer={cfg.n_layer} not divisible by pp={n_pp}")
+    if cfg.moe is not None:
+        # The GPipe carry is activations-only; the MoE aux loss would be
+        # silently dropped (router collapse with no signal). Refuse loudly
+        # until aux is threaded through the pipeline carry.
+        raise NotImplementedError(
+            "pipelined forward does not yet propagate the MoE aux loss; "
+            "use pp=1 with MoE or a dense (non-MoE) config with pp>1"
+        )
+    impl = "flash" if jax.default_backend() == "tpu" else "reference"
+    per_stage = cfg.n_layer // n_pp
+    staged = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((n_pp, per_stage) + leaf.shape[1:]),
+        params["blocks"],
+    )
+
+    def stage_fn(stage_blocks, x):
+        def body(x, block):
+            y, _ = _block_apply(block, x, cfg, impl)
+            return y, None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, stage_blocks)
+        return x
+
+    x = embed(params, tokens, cfg)
+    x = sh.constrain(x, mesh, "batch", "seq", "embed")
+    mb = microbatch(x, n_microbatches)
+    y = gpipe(stage_fn, staged, mb, mesh)
+    x = unmicrobatch(y)
+    logits = unembed(params, x, cfg)
+    return sh.constrain(logits, mesh, "batch", "seq", "vocab"), jnp.float32(0)
+
+
+def loss_fn(
+    params,
+    batch,
+    cfg: GPT2Config,
+    mesh: Optional[Mesh] = None,
+    *,
+    pipelined: bool = False,
+    n_microbatches: int = 4,
+) -> Tuple[jnp.ndarray, dict]:
+    """batch: {"tokens" [B,S+1] int32}. Next-token cross-entropy."""
+    tokens = batch["tokens"][:, :-1]
+    targets = batch["tokens"][:, 1:]
+    if pipelined:
+        logits, aux = forward_pipelined(
+            params, tokens, cfg, mesh, n_microbatches=n_microbatches
+        )
+    else:
+        logits, aux = forward(params, tokens, cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = -jnp.mean(ll)
+    total = loss + cfg.aux_loss_weight * aux
+    return total, {"loss": loss, "aux_loss": aux, "total_loss": total}

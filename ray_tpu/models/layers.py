@@ -1,0 +1,192 @@
+"""Transformer building blocks, pure-JAX pytree style.
+
+Every layer is a (init_fn, apply_fn) pair over plain dict pytrees; sharding
+comes from logical-axis annotations resolved by ray_tpu.parallel.sharding.
+Compute is bf16 by default with f32 params/accumulators (MXU-native mix).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.ring_attention import reference_attention, ring_attention_local
+
+Params = Dict[str, Any]
+
+
+def _init_dense(key, shape, scale=0.02, dtype=jnp.float32):
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+# ---------------------------------------------------------------- attention
+def init_attention(key, d_model, n_head, dtype=jnp.float32):
+    head_dim = d_model // n_head
+    ks = jax.random.split(key, 4)
+    return {
+        "wq": _init_dense(ks[0], (d_model, n_head, head_dim), dtype=dtype),
+        "wk": _init_dense(ks[1], (d_model, n_head, head_dim), dtype=dtype),
+        "wv": _init_dense(ks[2], (d_model, n_head, head_dim), dtype=dtype),
+        "wo": _init_dense(ks[3], (n_head, head_dim, d_model), dtype=dtype),
+    }
+
+
+ATTENTION_LOGICAL = {
+    "wq": ("embed", "heads", "head_dim"),
+    "wk": ("embed", "heads", "head_dim"),
+    "wv": ("embed", "heads", "head_dim"),
+    "wo": ("heads", "head_dim", "embed"),
+}
+
+
+def apply_attention(
+    params: Params,
+    x: jnp.ndarray,
+    *,
+    causal: bool = True,
+    impl: str = "reference",
+    sp_axis: str = "sp",
+    compute_dtype=jnp.bfloat16,
+):
+    """x: [B, S, D] -> [B, S, D].
+
+    impl: "reference" (plain jnp), "flash" (Pallas TPU kernel),
+    "ring" (context-parallel over the ambient mesh's `sp_axis` — callable
+    from inside jit with global arrays), "ring_local" (per-shard body;
+    requires already running inside shard_map with sp_axis manual).
+    """
+    cd = compute_dtype
+    q = jnp.einsum("bsd,dhk->bshk", x.astype(cd), params["wq"].astype(cd))
+    k = jnp.einsum("bsd,dhk->bshk", x.astype(cd), params["wk"].astype(cd))
+    v = jnp.einsum("bsd,dhk->bshk", x.astype(cd), params["wv"].astype(cd))
+    if impl == "ring":
+        from ray_tpu.parallel.ring_attention import ring_attention
+
+        o = ring_attention(q, k, v, None, causal=causal, seq_axis=sp_axis)
+    elif impl == "ring_local":
+        o = ring_attention_local(q, k, v, axis_name=sp_axis, causal=causal)
+    elif impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        o = flash_attention(q, k, v, causal=causal)
+    else:
+        o = reference_attention(q, k, v, causal=causal)
+    out = jnp.einsum("bshk,hkd->bsd", o.astype(cd), params["wo"].astype(cd))
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------- dense MLP
+def init_mlp(key, d_model, d_ff, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": _init_dense(k1, (d_model, d_ff), dtype=dtype),
+        "b1": jnp.zeros((d_ff,), dtype),
+        "w2": _init_dense(k2, (d_ff, d_model), dtype=dtype),
+        "b2": jnp.zeros((d_model,), dtype),
+    }
+
+
+MLP_LOGICAL = {
+    "w1": ("embed", "mlp"),
+    "b1": ("mlp",),
+    "w2": ("mlp", "embed"),
+    "b2": ("embed",),
+}
+
+
+def apply_mlp(params: Params, x, compute_dtype=jnp.bfloat16):
+    cd = compute_dtype
+    h = jnp.einsum("bsd,df->bsf", x.astype(cd), params["w1"].astype(cd))
+    h = jax.nn.gelu(h + params["b1"].astype(cd))
+    o = jnp.einsum("bsf,fd->bsd", h, params["w2"].astype(cd))
+    return (o + params["b2"].astype(cd)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------- MoE (EP)
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+
+def init_moe(key, d_model, d_ff, cfg: MoEConfig, dtype=jnp.float32):
+    kg, k1, k2 = jax.random.split(key, 3)
+    E = cfg.n_experts
+    return {
+        "wg": _init_dense(kg, (d_model, E), dtype=dtype),
+        "w1": _init_dense(k1, (E, d_model, d_ff), dtype=dtype),
+        "w2": _init_dense(k2, (E, d_ff, d_model), dtype=dtype),
+    }
+
+
+MOE_LOGICAL = {
+    "wg": ("embed", None),
+    "w1": ("experts", "embed", "expert_mlp"),
+    "w2": ("experts", "expert_mlp", "embed"),
+}
+
+
+def apply_moe(params: Params, x, cfg: MoEConfig, compute_dtype=jnp.bfloat16):
+    """GShard-style top-k routed MoE with capacity, dense-dispatch einsums.
+
+    Experts (leading E dim of w1/w2) are sharded over the `ep` mesh axis;
+    the dispatch/combine einsums below are exactly the contractions XLA
+    turns into all_to_all over `ep` when tokens and experts live on
+    different devices — expert parallelism without hand-written comms.
+    Returns (output [B,S,D], aux_loss scalar).
+    """
+    cd = compute_dtype
+    B, S, D = x.shape
+    E, K = cfg.n_experts, cfg.top_k
+    C = max(1, int(cfg.capacity_factor * K * B * S / E))
+
+    logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), params["wg"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # [B,S,E]
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [B,S,K]
+    # Renormalize the chosen gates.
+    gate_vals = gate_vals / jnp.maximum(jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+
+    # Load-balancing auxiliary loss (Switch-style): fraction of tokens per
+    # expert × mean router prob per expert.
+    me = jnp.mean(probs, axis=(0, 1))  # [E]
+    ce = jnp.mean(
+        jnp.sum(jax.nn.one_hot(gate_idx[..., 0], E), axis=1) / S, axis=0
+    )  # top-1 token fraction per expert
+    aux_loss = E * jnp.sum(me * ce)
+
+    # Position of each (token, k) within its expert's capacity buffer.
+    # Positions are assigned over the WHOLE token stream (B*S*K flattened):
+    # the dispatch einsum below sums over both b and s, so a slot (e, c)
+    # must be unique across the entire batch, not per row.
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [B,S,K,E]
+    flat = onehot.reshape(B * S * K, E)
+    pos = jnp.cumsum(flat, axis=0) - 1  # [B*S*K, E]
+    pos = pos.reshape(B, S, K, E)
+    in_cap = (pos < C) & (onehot > 0)
+    # dispatch [B,S,E,C]: 1 where token (b,s) occupies slot c of expert e.
+    disp = jnp.sum(
+        jax.nn.one_hot(jnp.where(in_cap, pos, -1), C, dtype=cd)
+        * onehot.astype(cd)[..., None],
+        axis=2,
+    )  # sum over K -> [B,S,E,C]
+    gates_per_e = jnp.sum(
+        gate_vals[..., None].astype(cd) * onehot.astype(cd), axis=2
+    )  # [B,S,E]
+    combine = disp * gates_per_e[..., None]  # weight by gate prob
+
+    expert_in = jnp.einsum("bsec,bsd->ecd", disp, x.astype(cd))  # a2a over ep
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"].astype(cd)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(cd))
+    out = jnp.einsum("bsec,ecd->bsd", combine, expert_out)  # a2a back
+    return out.astype(x.dtype), aux_loss

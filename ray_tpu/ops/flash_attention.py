@@ -1,0 +1,206 @@
+"""Fused flash attention (Pallas TPU kernel).
+
+The hot op of the flagship models. Forward is a Pallas kernel: grid over
+(batch*heads, Q blocks, KV blocks), online-softmax accumulators held in
+VMEM scratch across the sequential KV grid dimension, causal blocks
+skipped at block granularity. Backward is a custom VJP that recomputes
+probabilities from the saved logsumexp (flash-style rematerialisation;
+a Pallas backward kernel is tracked as a follow-up).
+
+On non-TPU backends the kernel runs in Pallas interpret mode (tests) or
+callers use parallel.ring_attention.reference_attention.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, scale, causal,
+    block_q, block_k, seq_len, padded,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    def _compute():
+        q = q_ref[0]  # [block_q, D]
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale  # [block_q, block_k]
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        if padded:
+            # Mask KV padding columns (inputs padded up to the block size).
+            s = jnp.where(cols < seq_len, s, NEG_INF)
+        m_prev = m_ref[:, 0]  # [block_q]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    if causal:
+        # Skip KV blocks entirely in the future of this Q block.
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_ref[:, 0]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+        # lse is materialized as [BH, 8, S] (8 broadcast sublanes) to satisfy
+        # the TPU (8, 128) block-tiling constraint; callers slice [:, 0, :].
+        lse = m_ref[:, 0] + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
+
+
+def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    """q,k,v: [BH, S, D] -> (o [BH,S,D], lse [BH,S]).
+
+    Sequence lengths that don't divide the block size are zero-padded up to
+    the next block multiple; padded KV columns are masked inside the kernel
+    and padded Q rows sliced off the output.
+    """
+    BH, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    S_pad = -(-S // block_q) * block_q
+    S_pad = -(-S_pad // block_k) * block_k
+    if S_pad != S:
+        pad = [(0, 0), (0, S_pad - S), (0, 0)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+    grid = (BH, S_pad // block_q, S_pad // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        seq_len=S, padded=S_pad != S,
+    )
+    try:
+        cparams = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    except Exception:  # older/newer param name drift
+        cparams = None
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S_pad, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 8, S_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        **({"compiler_params": cparams} if cparams is not None else {}),
+        interpret=interpret,
+    )(q, k, v)
+    return o[:, :S], lse[:, 0, :S]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(
+        q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(
+        q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    # Recompute P from lse (no O(S^2) residual was saved), then the standard
+    # flash gradient identities.
+    qf, kf, vf, of, dof = (x.astype(jnp.float32) for x in (q, k, v, o, do))
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jnp.exp(s - lse[:, :, None])  # [BH, Sq, Sk]
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    delta = jnp.sum(dof * of, axis=-1, keepdims=True)  # [BH, Sq, 1]
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash attention over [B, S, H, D] (heads layout matching
+    models/layers.apply_attention). Differentiable via custom VJP."""
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D**0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    o = _flash(
+        to_bh(q), to_bh(k), to_bh(v), scale, causal, block_q, block_k, interpret
+    )
+    return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,147 @@
+"""Device-mesh construction for every parallelism axis the framework knows.
+
+This replaces the reference's NCCL/Gloo communicator world (its
+python/ray/util/collective/collective_group/) with the TPU-native
+equivalent: a named `jax.sharding.Mesh` whose axes are the parallelism
+strategies themselves. All collectives then compile to ICI/DCN collectives
+inside XLA programs instead of being library calls.
+
+Axis vocabulary (sizes multiply to the device count):
+
+  dp — data parallel: gradients psum'd over it; typically the outermost
+       (slowest-varying) axis so it lands on DCN between slices.
+  pp — pipeline parallel: stages; activations move via ppermute.
+  ep — expert parallel: MoE experts sharded; tokens move via all_to_all.
+  sp — sequence/context parallel: the sequence dimension of activations is
+       sharded; ring attention rotates KV blocks around this axis.
+  tp — tensor parallel: attention heads / MLP hidden sharded; innermost
+       (fastest-varying) so its collectives ride nearest-neighbor ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order, slowest- to fastest-varying. Matches
+# GlobalConfig.mesh_ici_axis_order.
+AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """How many ways to shard along each parallelism axis.
+
+    Any axis left at -1 absorbs the remaining devices (at most one -1).
+    """
+
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolved(self, n_devices: int) -> "MeshConfig":
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError("At most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"Mesh {sizes} needs {fixed} devices but {n_devices} present"
+            )
+        return MeshConfig(**sizes)
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+
+def create_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axes: Optional[Dict[str, int]] = None,
+) -> Mesh:
+    """Build a Mesh over `devices` (default: all).
+
+    On real TPU slices we delegate the physical layout to
+    `mesh_utils.create_device_mesh`, which maps the logical axes onto the
+    ICI torus so that the fastest-varying axes are nearest-neighbor; on CPU
+    (tests) a plain reshape is used.
+    """
+    if config is None:
+        config = MeshConfig(**(axes or {"dp": -1}))
+    devices = list(devices if devices is not None else jax.devices())
+    config = config.resolved(len(devices))
+    sizes = config.axis_sizes()
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    if devices[0].platform == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=np.asarray(devices, dtype=object)
+            )
+            return Mesh(dev_array, AXIS_ORDER)
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "mesh_utils.create_device_mesh failed (%s: %s); falling back "
+                "to a naive device layout — collectives may cross non-neighbor "
+                "ICI links", type(e).__name__, e,
+            )
+    dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    device = device or jax.devices()[0]
+    return create_mesh(MeshConfig(), devices=[device])
+
+
+def balanced_factorization(n: int, axes: Sequence[str]) -> Dict[str, int]:
+    """Split n devices over `axes` as evenly as possible (used by the
+    multi-chip dry run to make every requested axis non-degenerate when the
+    device count allows)."""
+    sizes = {a: 1 for a in axes}
+    remaining = n
+    # Greedily assign factors of 2 (TPU slice sizes are powers of two),
+    # round-robin over the requested axes.
+    i = 0
+    axes = list(axes)
+    while remaining % 2 == 0 and remaining > 1:
+        sizes[axes[i % len(axes)]] *= 2
+        remaining //= 2
+        i += 1
+    if remaining > 1:  # non-power-of-two leftover goes to the first axis
+        sizes[axes[0]] *= remaining
+    return sizes
+
+
+def mesh_shape_summary(mesh: Mesh) -> str:
+    return "x".join(f"{k}={v}" for k, v in mesh.shape.items())
+
+
+def validate_mesh_for_model(mesh: Mesh, *, n_heads: int, n_layers: int) -> List[str]:
+    """Sanity checks mirroring the reference's option validation layer
+    (python/ray/_private/ray_option_utils.py): returns human-readable
+    problems instead of letting XLA fail deep inside compilation."""
+    problems = []
+    shape = dict(mesh.shape)
+    if n_heads % (shape.get("tp", 1)) != 0:
+        problems.append(f"n_heads={n_heads} not divisible by tp={shape.get('tp')}")
+    if n_layers % (shape.get("pp", 1)) != 0:
+        problems.append(f"n_layers={n_layers} not divisible by pp={shape.get('pp')}")
+    return problems

@@ -1,0 +1,148 @@
+"""Pipeline parallelism over the `pp` mesh axis (SPMD GPipe).
+
+The reference delegates intra-model parallelism to user frameworks
+(SURVEY.md §2.4 — TP/PP absent); here pipelining is a first-class mesh
+axis. Unlike the reference's would-be MPMD (one process per stage over
+NCCL p2p), the TPU-native design keeps a single SPMD program: every device
+runs the same `lax.scan` schedule, stage parameters are sharded over `pp`,
+and activations hop stages via `jax.lax.ppermute` (which XLA lowers to ICI
+neighbor transfers). See PAPERS.md "Scaling Deep Learning Training with
+MPMD Pipeline Parallelism" for the design space; this is the simpler SPMD
+point in it.
+
+Composability: the shard_map here is manual ONLY over `pp` — inside a
+stage, arrays keep their global dp/sp/tp shardings and GSPMD still inserts
+tensor-parallel collectives; ring attention (manual over `sp`) nests in the
+FORWARD pass. Known limitation (jax 0.9): differentiating a nested
+sp-shard_map inside the pp scan trips a DuplicateSpecError in transpose, so
+training steps combine pp with flash/dense attention (sp=1) or ring
+attention without pp; pp+sp joint training is tracked for a manual-SPMD
+block implementation.
+
+Schedule: GPipe with M microbatches over P stages — T = M + P - 1 ticks;
+stage s works on microbatch t - s at tick t. Bubble fraction (P-1)/T.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def gpipe_local(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    microbatches: jnp.ndarray,
+    *,
+    axis_name: str = "pp",
+    carry_dtype=None,
+):
+    """Per-shard GPipe schedule. MUST run inside shard_map with `axis_name`
+    manual.
+
+    stage_fn(params, x) -> y applies THIS device's stage; y must have x's
+    shape/dtype (transformer-block invariant).
+    stage_params: this stage's parameter pytree (stage dim already sliced
+        away by shard_map in_specs).
+    microbatches: [M, B_mb, ...] — every stage sees the stream; only stage 0
+        consumes it.
+
+    Returns [M, B_mb, ...]: the last stage's outputs, psum-replicated over
+    `axis_name` (zeros contributed by other stages).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    T = M + n_stages - 1
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    compute_dtype = microbatches.dtype
+    if carry_dtype is None and jax.default_backend() != "tpu":
+        # XLA:CPU miscompiles bf16 select/ppermute chains in this schedule
+        # ("Invalid binary instruction opcode copy" check-fail); carry f32
+        # off-TPU. On TPU the native dtype rides ICI (half the bytes).
+        if compute_dtype == jnp.bfloat16:
+            carry_dtype = jnp.float32
+    if carry_dtype is not None:
+        microbatches = microbatches.astype(carry_dtype)
+        inner_stage_fn = stage_fn
+        stage_fn = lambda p, x: inner_stage_fn(p, x.astype(compute_dtype)).astype(
+            carry_dtype
+        )
+    # Mark the stream as varying over pp: stages read different elements.
+    microbatches = jax.lax.pcast(microbatches, axis_name, to="varying")
+
+    def tick(carry, t):
+        buf, carry_in = carry
+        # Stage 0 reads microbatch t from the stream; others read the
+        # activation forwarded by their predecessor last tick.
+        mb_idx = jnp.clip(t, 0, M - 1)
+        x_in = jnp.where(
+            stage == 0,
+            jax.lax.dynamic_index_in_dim(microbatches, mb_idx, keepdims=False),
+            carry_in,
+        )
+        y = stage_fn(stage_params, x_in)
+        # Last stage writes microbatch (t - n_stages + 1) to the output
+        # buffer once it's valid.
+        out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+        valid = (t >= n_stages - 1) & (stage == n_stages - 1)
+        cur = jax.lax.dynamic_index_in_dim(buf, out_idx, keepdims=False)
+        buf = jax.lax.dynamic_update_index_in_dim(
+            buf, jnp.where(valid, y, cur), out_idx, 0
+        )
+        carry_out = jax.lax.ppermute(y, axis_name, perm_fwd)
+        return (buf, carry_out), None
+
+    buf0 = microbatches * 0
+    carry0 = microbatches[0] * 0
+    (buf, _), _ = jax.lax.scan(tick, (buf0, carry0), jnp.arange(T))
+    # Zero every stage but the last, then psum -> replicated final outputs.
+    buf = jnp.where(stage == n_stages - 1, buf, jnp.zeros_like(buf))
+    return jax.lax.psum(buf, axis_name).astype(compute_dtype)
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    microbatches: jnp.ndarray,
+    mesh: Optional[Mesh] = None,
+    *,
+    axis_name: str = "pp",
+) -> jnp.ndarray:
+    """Global entry: params have a leading [n_stages] dim (sharded over
+    `axis_name`), microbatches [M, B, ...] (any dp/sp sharding — preserved).
+    Returns [M, B, ...] outputs of the final stage.
+    """
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+
+    def body(params, mb):
+        params = jax.tree_util.tree_map(lambda p: p[0], params)  # drop stage dim
+        return gpipe_local(stage_fn, params, mb, axis_name=axis_name)
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        axis_names={axis_name},
+    )
+    return mapped(stacked_params, microbatches)
+
+
+def microbatch(x: jnp.ndarray, n_microbatches: int) -> jnp.ndarray:
+    """[B, ...] -> [M, B/M, ...]."""
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
+    return x.reshape((n_microbatches, B // n_microbatches) + x.shape[1:])
+
+
+def unmicrobatch(x: jnp.ndarray) -> jnp.ndarray:
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """List of per-stage pytrees -> single pytree with leading stage dim."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)

@@ -1,0 +1,152 @@
+"""Ring attention: context parallelism over the `sp` mesh axis.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.4 / §5 —
+verified absent); this is a greenfield TPU capability. Design: the sequence
+dimension is sharded over the `sp` axis; each device holds a Q block and
+rotates the K/V blocks around the ICI ring with `jax.lax.ppermute`,
+accumulating attention with a numerically-stable online softmax (the
+flash-attention recurrence), so full attention over sequences of length
+`sp * S_local` is computed with only nearest-neighbor communication and
+O(S_local) memory.
+
+Composability: `ring_attention` is a PARTIAL-manual shard_map — manual only
+over `sp`, so `dp`/`tp` sharding of batch/heads stays in GSPMD (XLA) hands
+and the op nests inside the `pp` pipeline shard_map (pipeline.py).
+
+Reference pattern: Liu et al., "Ring Attention with Blockwise Transformers
+for Near-Infinite Context" (see PAPERS.md); implementation is original and
+jax-idiomatic (scan + ppermute, differentiable end-to-end).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, *, scale, mask):
+    """One (Q-block, KV-block) attention tile.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]; mask: [Sq, Sk] bool or None.
+    Returns (scores_max [B,H,Sq], exp_scores [B,H,Sq,Sk], pv [B,H,Sq,D]).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    e = jnp.exp(s - m[..., None])
+    # Fully-masked rows produce e≈0 everywhere; m is NEG_INF there, which the
+    # combine step handles (its correction factor underflows to 0).
+    pv = jnp.einsum("bhqk,bkhd->bhqd", e, v)
+    return m, e, pv
+
+
+def ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Per-shard ring attention body. MUST run inside shard_map with
+    `axis_name` manual.
+
+    q, k, v: [B, S_local, H, D] — the local sequence shard.
+    Returns [B, S_local, H, D].
+    """
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D**0.5)
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    # Online-softmax accumulators, derived from q so their varying-axes type
+    # matches the scan outputs under check_vma.
+    m0 = jnp.transpose(q[..., 0] * 0, (0, 2, 1)).astype(jnp.float32) + NEG_INF
+    l0 = jnp.transpose(q[..., 0] * 0, (0, 2, 1)).astype(jnp.float32)
+    acc0 = jnp.transpose(q * 0, (0, 2, 1, 3)).astype(jnp.float32)
+
+    tri = jnp.tril(jnp.ones((S, S), dtype=bool))  # intra-block causal mask
+
+    def step(carry, r):
+        m, l, acc, kv = carry
+        k_r, v_r = kv
+        # The block arriving at step r originated on device (my_idx - r) mod n.
+        kv_idx = (my_idx - r) % n
+        if causal:
+            # kv block strictly earlier: full attention; same block:
+            # triangular; later block: fully masked.
+            full = kv_idx < my_idx
+            same = kv_idx == my_idx
+            mask = jnp.where(same, tri, jnp.where(full, True, False))
+        else:
+            mask = jnp.ones((S, S), dtype=bool)
+        bm, be, bpv = _block_attend(
+            q, k_r.astype(q.dtype), v_r.astype(q.dtype), scale=scale, mask=mask
+        )
+        bm = bm.astype(jnp.float32)
+        m_new = jnp.maximum(m, bm)
+        # Correction factors; fully-masked tiles (bm == NEG_INF) contribute 0.
+        c_old = jnp.exp(m - m_new)
+        c_new = jnp.exp(bm - m_new)
+        l_new = l * c_old + jnp.sum(be, axis=-1).astype(jnp.float32) * c_new
+        acc_new = acc * c_old[..., None] + bpv.astype(jnp.float32) * c_new[..., None]
+        # Rotate KV one hop around the ring (device i -> i+1).
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_r, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_r, axis_name, perm)
+        return (m_new, l_new, acc_new, (k_nxt, v_nxt)), None
+
+    (m, l, acc, _), _ = jax.lax.scan(step, (m0, l0, acc0, (k, v)), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B,H,S,D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Optional[Mesh] = None,
+    *,
+    causal: bool = True,
+    seq_axis: str = "sp",
+) -> jnp.ndarray:
+    """Context-parallel attention over GLOBAL [B, S, H, D] arrays.
+
+    shard_map is manual over `seq_axis` ONLY: batch/head sharding (dp/tp)
+    remains visible to XLA/GSPMD, so this call composes with tensor
+    parallelism and can be nested inside the pipeline shard_map (which is
+    manual over `pp`). Pass mesh=None to use the ambient mesh (required when
+    nested inside another shard_map).
+    """
+    io_spec = P(None, seq_axis, None, None)
+    fn = functools.partial(ring_attention_local, axis_name=seq_axis, causal=causal)
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(io_spec, io_spec, io_spec),
+        out_specs=io_spec,
+        axis_names={seq_axis},
+    )
+    return mapped(q, k, v)
+
+
+def reference_attention(q, k, v, *, causal=True, scale=None):
+    """Plain full attention, the correctness oracle for tests."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), dtype=bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)

@@ -1,0 +1,78 @@
+"""Logical-axis sharding rules: model code names dimensions, this module
+maps them to mesh axes.
+
+This is the TPU-native replacement for the reference's per-framework
+process-group plumbing (train/torch/config.py): instead of wiring NCCL
+process groups, models annotate arrays with logical axis names and XLA
+inserts the collectives implied by the mapping.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AxisVal = Union[None, str, Tuple[str, ...]]
+
+# Default logical→mesh rules for transformer LMs. "seq" rides the sp axis
+# (sequence/context parallelism); "heads"/"mlp"/"vocab" ride tp; "experts"
+# ride ep; "layers" ride pp when pipelining is on; "batch" rides dp.
+DEFAULT_RULES: Dict[str, AxisVal] = {
+    "batch": "dp",
+    "seq": "sp",
+    "embed": None,
+    "heads": "tp",
+    "kv": None,
+    "head_dim": None,
+    "mlp": "tp",
+    "experts": "ep",
+    "expert_mlp": "tp",
+    "vocab": "tp",
+    "stage": "pp",
+    "layers": None,
+}
+
+
+def spec(*logical_axes: Optional[str], rules: Optional[Dict[str, AxisVal]] = None) -> P:
+    """PartitionSpec from logical axis names, e.g. spec("batch","seq","embed")."""
+    rules = rules or DEFAULT_RULES
+    out = []
+    for ax in logical_axes:
+        if ax is None:
+            out.append(None)
+        else:
+            if ax not in rules:
+                raise KeyError(f"No sharding rule for logical axis {ax!r}")
+            out.append(rules[ax])
+    return P(*out)
+
+
+def named_sharding(
+    mesh: Mesh, *logical_axes: Optional[str], rules: Optional[Dict[str, AxisVal]] = None
+) -> NamedSharding:
+    return NamedSharding(mesh, spec(*logical_axes, rules=rules))
+
+
+def tree_shard(tree, mesh: Mesh, spec_tree):
+    """Device-put a pytree with a matching pytree of PartitionSpecs."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, spec_tree
+    )
+
+
+def constrain(x, mesh: Mesh, *logical_axes: Optional[str], rules=None):
+    """In-jit sharding constraint by logical names."""
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec(*logical_axes, rules=rules))
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def axis_size(mesh: Mesh, axis: Optional[str]) -> int:
+    if axis is None:
+        return 1
+    return dict(mesh.shape).get(axis, 1)
