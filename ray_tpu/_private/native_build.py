@@ -1,0 +1,43 @@
+"""Build-on-demand for the native (C++) runtime components.
+
+The reference ships its native core prebuilt via bazel; here the store
+library is compiled once per checkout with g++ and cached under build/.
+Rebuilds happen automatically when the source is newer than the .so.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_BUILD_DIR = os.path.join(_REPO_ROOT, "build")
+_LOCK = threading.Lock()
+
+_LIBS = {
+    "raystore": ["src/store/store.cc"],
+}
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(_BUILD_DIR, f"lib{name}.so")
+
+
+def ensure_lib(name: str) -> str:
+    """Compile lib<name>.so if missing or stale; return its path."""
+    sources = [os.path.join(_REPO_ROOT, s) for s in _LIBS[name]]
+    out = lib_path(name)
+    with _LOCK:
+        if os.path.exists(out):
+            newest_src = max(os.path.getmtime(s) for s in sources)
+            if os.path.getmtime(out) >= newest_src:
+                return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = out + f".tmp.{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+            "-o", tmp, *sources, "-lpthread", "-lrt",
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+    return out

@@ -1,0 +1,337 @@
+"""Python client for the native shared-memory object store.
+
+Analog of the reference's PlasmaClient
+(/root/reference/src/ray/object_manager/plasma/client.h) — but because the
+store is a mapped library rather than a daemon (see src/store/store.cc),
+put/get are direct shared-memory calls with no socket round trip.
+
+Adds the policy layers plasma keeps in C++:
+- spill-to-disk when the segment is full (reference:
+  raylet/local_object_manager.h:110 SpillObjects) and transparent restore;
+- pinned-buffer lifetime tied to the returned memoryview.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from ray_tpu._private.native_build import ensure_lib
+
+_ERRORS = {
+    0: "OK",
+    -1: "NOT_FOUND",
+    -2: "EXISTS",
+    -3: "FULL",
+    -4: "TABLE_FULL",
+    -5: "NOT_SEALED",
+    -6: "IN_USE",
+    -7: "SYS",
+    -8: "BAD_SEGMENT",
+}
+
+
+class StoreError(Exception):
+    def __init__(self, code: int, op: str):
+        self.code = code
+        super().__init__(f"store {op} failed: {_ERRORS.get(code, code)}")
+
+
+def _load():
+    lib = ctypes.CDLL(ensure_lib("raystore"))
+    lib.store_create.restype = ctypes.c_void_p
+    lib.store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.store_connect.restype = ctypes.c_void_p
+    lib.store_connect.argtypes = [ctypes.c_char_p]
+    for fn in ("store_disconnect", "store_destroy"):
+        getattr(lib, fn).restype = None
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.store_create_object.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.store_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    for fn in ("store_seal", "store_abort", "store_release", "store_contains",
+               "store_delete"):
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.store_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64 * 4)]
+    return lib
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _get_lib():
+    global _lib
+    if _lib is None:
+        with _lib_lock:
+            if _lib is None:
+                _lib = _load()
+    return _lib
+
+
+class PinnedBuffer:
+    """A zero-copy view of a sealed object; releases its pin when closed or
+    garbage-collected."""
+
+    def __init__(self, client: "StoreClient", object_id: bytes,
+                 ptr: int, size: int):
+        self._client = client
+        self._id = object_id
+        self._view = (ctypes.c_char * size).from_address(ptr)
+        self._released = False
+
+    def memoryview(self) -> memoryview:
+        return memoryview(self._view)
+
+    def to_bytes(self) -> bytes:
+        return bytes(self._view)
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self._client._release(self._id)
+
+    def __len__(self):
+        return len(self._view)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+class StoreClient:
+    """Connects to (or creates) one node's shm segment. Thread-safe: the
+    native layer serializes via the in-segment robust mutex."""
+
+    def __init__(self, name: str, create: bool = False,
+                 size: int = 256 * 1024 * 1024, n_slots: int = 32768,
+                 spill_dir: str | None = None):
+        if create:
+            if n_slots & (n_slots - 1) or n_slots == 0:
+                raise ValueError("n_slots must be a power of two")
+            # Header + entry table + at least one allocatable block must fit.
+            min_size = 4096 + n_slots * 48 + 64 * 1024
+            if size < min_size:
+                raise ValueError(
+                    f"segment size {size} too small for {n_slots} slots "
+                    f"(need >= {min_size})"
+                )
+        self._libref = _get_lib()
+        self.name = name
+        self._owner = create
+        if create:
+            self._h = self._libref.store_create(name.encode(), size, n_slots)
+        else:
+            self._h = self._libref.store_connect(name.encode())
+        if not self._h:
+            raise StoreError(-8, "create" if create else "connect")
+        self.spill_dir = spill_dir
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+
+    # -- core ops -----------------------------------------------------------
+
+    @staticmethod
+    def _check_id(object_id: bytes):
+        if len(object_id) != 16:
+            raise ValueError(f"object id must be 16 bytes, got {len(object_id)}")
+
+    def put(self, object_id: bytes, data) -> bool:
+        """Store `data` (bytes-like). Returns False if the object already
+        exists (puts are idempotent — including objects that only exist
+        spilled on disk). Spills to disk if the segment can't fit it even
+        after eviction."""
+        self._check_id(object_id)
+        data = memoryview(data).cast("B")
+        size = len(data)
+        if self._spilled_path_if_exists(object_id) is not None:
+            return False  # immutable: the spilled copy is the object
+        ptr = ctypes.c_void_p()
+        rc = self._libref.store_create_object(self._h, object_id, size,
+                                              ctypes.byref(ptr))
+        if rc == -2:  # EXISTS
+            return False
+        if rc in (-3, -4):  # FULL / TABLE_FULL → spill
+            if self.spill_dir is None:
+                raise StoreError(rc, "put")
+            self._spill_write(object_id, data)
+            return True
+        if rc != 0:
+            raise StoreError(rc, "put")
+        try:
+            if size:
+                # single copy, straight into the mapped segment
+                dst = (ctypes.c_ubyte * size).from_address(ptr.value)
+                memoryview(dst).cast("B")[:] = data
+            rc = self._libref.store_seal(self._h, object_id)
+            if rc != 0:
+                raise StoreError(rc, "seal")
+        except Exception:
+            self._libref.store_abort(self._h, object_id)
+            raise
+        return True
+
+    def create(self, object_id: bytes, size: int):
+        """Reserve a writable buffer; caller fills it then calls seal().
+        Returns a ctypes array or None if the object exists."""
+        self._check_id(object_id)
+        ptr = ctypes.c_void_p()
+        rc = self._libref.store_create_object(self._h, object_id, size,
+                                              ctypes.byref(ptr))
+        if rc == -2:
+            return None
+        if rc != 0:
+            raise StoreError(rc, "create")
+        return (ctypes.c_ubyte * size).from_address(ptr.value)
+
+    def seal(self, object_id: bytes):
+        rc = self._libref.store_seal(self._h, object_id)
+        if rc != 0:
+            raise StoreError(rc, "seal")
+
+    def get(self, object_id: bytes) -> PinnedBuffer | None:
+        """Pin + return a sealed object, restoring from spill if needed.
+
+        Known limitation (vs the reference's plasma daemon, which cleans up
+        when a client socket drops): a pin held by a SIGKILLed process is
+        never reclaimed, so that object stays unevictable. Worker crashes
+        are followed by a store segment sweep at the raylet level.
+        """
+        self._check_id(object_id)
+        ptr = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        rc = self._libref.store_get(self._h, object_id, ctypes.byref(ptr),
+                                    ctypes.byref(size))
+        if rc == -1:
+            if self._spilled_path_if_exists(object_id) is None:
+                return None
+            fallback = self._spill_restore(object_id)
+            if fallback is not None:
+                # Couldn't fit back in shm — serve the spilled bytes directly.
+                return fallback
+            rc = self._libref.store_get(self._h, object_id, ctypes.byref(ptr),
+                                        ctypes.byref(size))
+            if rc == -1:
+                # Restored copy already evicted by a concurrent put; the
+                # spill file is still the source of truth.
+                with open(self._spill_path(object_id), "rb") as f:
+                    return _BytesBuffer(f.read())
+            if rc != 0:
+                raise StoreError(rc, "get")
+        elif rc != 0:
+            raise StoreError(rc, "get")
+        return PinnedBuffer(self, object_id, ptr.value, size.value)
+
+    def contains(self, object_id: bytes) -> bool:
+        self._check_id(object_id)
+        rc = self._libref.store_contains(self._h, object_id)
+        if rc == 1:
+            return True
+        if rc == 0:
+            return self._spilled_path_if_exists(object_id) is not None
+        raise StoreError(rc, "contains")
+
+    def delete(self, object_id: bytes):
+        self._check_id(object_id)
+        self._libref.store_delete(self._h, object_id)  # best-effort
+        p = self._spilled_path_if_exists(object_id)
+        if p:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 4)()
+        rc = self._libref.store_stats(self._h, ctypes.byref(out))
+        if rc != 0:
+            raise StoreError(rc, "stats")
+        return {
+            "num_objects": out[0],
+            "bytes_used": out[1],
+            "heap_size": out[2],
+            "evictions": out[3],
+        }
+
+    def _release(self, object_id: bytes):
+        if self._h:  # no-op once the client is closed
+            self._libref.store_release(self._h, object_id)
+
+    # -- spilling -----------------------------------------------------------
+
+    def _spill_path(self, object_id: bytes) -> str:
+        return os.path.join(self.spill_dir, object_id.hex())
+
+    def _spilled_path_if_exists(self, object_id: bytes) -> str | None:
+        if not self.spill_dir:
+            return None
+        p = self._spill_path(object_id)
+        return p if os.path.exists(p) else None
+
+    def _spill_write(self, object_id: bytes, data):
+        p = self._spill_path(object_id)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)
+
+    def _spill_restore(self, object_id: bytes):
+        """Try to reload a spilled object into shm; on shm pressure return a
+        bytes-backed stand-in buffer."""
+        p = self._spilled_path_if_exists(object_id)
+        if p is None:
+            return None
+        with open(p, "rb") as f:
+            data = f.read()
+        buf = None
+        try:
+            buf = self.create(object_id, len(data))
+        except StoreError:
+            pass  # segment still full → serve from host memory
+        if buf is None:
+            return _BytesBuffer(data)
+        memoryview(buf).cast("B")[:] = data
+        self.seal(object_id)
+        return None  # caller re-gets from shm (zero-copy)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self):
+        if self._h:
+            if self._owner:
+                self._libref.store_destroy(self._h)
+            else:
+                self._libref.store_disconnect(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _BytesBuffer:
+    """PinnedBuffer-compatible wrapper over plain bytes (spill fallback)."""
+
+    def __init__(self, data: bytes):
+        self._data = data
+
+    def memoryview(self) -> memoryview:
+        return memoryview(self._data)
+
+    def to_bytes(self) -> bytes:
+        return self._data
+
+    def release(self):
+        pass
+
+    def __len__(self):
+        return len(self._data)

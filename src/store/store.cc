@@ -1,0 +1,526 @@
+// raystore — shared-memory immutable object store (TPU-host analog of the
+// reference's plasma store: /root/reference/src/ray/object_manager/plasma/
+// store.cc, shared_memory.cc, eviction_policy.cc). Unlike plasma (a daemon
+// reached over a unix socket with fd-passing), this store is a *library*:
+// every process on the node maps the same POSIX shm segment and coordinates
+// through a robust process-shared mutex living inside the segment. That
+// removes the socket round-trip from the put/get hot path entirely — the
+// driver/worker hot loop touches only shared memory.
+//
+// Layout of the segment:
+//   [ Header | ObjectEntry table (n_slots) | data heap ... ]
+// All references inside the segment are offsets (processes map at different
+// addresses). Allocation is first-fit over an embedded free list with
+// coalescing on free. Eviction is LRU over sealed, refcount==0 objects.
+//
+// Exposed as a C ABI for ctypes (python binding:
+// ray_tpu/_private/store_client.py).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52415953544f5245ULL;  // "RAYSTORE"
+constexpr uint32_t kIdSize = 16;
+constexpr uint64_t kAlign = 64;  // cacheline-align object payloads
+
+enum ErrorCode : int {
+  OK = 0,
+  ERR_NOT_FOUND = -1,
+  ERR_EXISTS = -2,
+  ERR_FULL = -3,
+  ERR_TABLE_FULL = -4,
+  ERR_NOT_SEALED = -5,
+  ERR_IN_USE = -6,
+  ERR_SYS = -7,
+  ERR_BAD_SEGMENT = -8,
+};
+
+struct ObjectEntry {
+  uint8_t id[kIdSize];
+  uint64_t data_off;   // offset of payload from segment base
+  uint64_t data_size;  // payload bytes
+  uint64_t lru_tick;   // last-access logical clock
+  int32_t refcount;    // pinned readers/writers
+  uint8_t state;       // 0 free, 1 creating, 2 sealed
+  uint8_t _pad[3];
+};
+
+// Free-list node embedded in the heap itself.
+struct FreeBlock {
+  uint64_t size;      // bytes including this header
+  uint64_t next_off;  // offset of next free block (0 = end)
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t segment_size;
+  uint64_t heap_off;    // start of data heap
+  uint64_t heap_size;   // bytes in heap
+  uint64_t free_head;   // offset of first free block (0 = none)
+  uint64_t n_slots;     // object table capacity
+  uint64_t n_objects;   // live (creating+sealed) objects
+  uint64_t bytes_used;  // payload bytes allocated
+  uint64_t lru_clock;   // logical tick for LRU
+  uint64_t evictions;   // stat: objects evicted
+  pthread_mutex_t mutex;
+  // ObjectEntry table follows immediately.
+};
+
+struct Store {
+  void* base;
+  uint64_t size;
+  int fd;
+  char name[256];
+};
+
+inline Header* header(Store* s) { return reinterpret_cast<Header*>(s->base); }
+inline ObjectEntry* table(Store* s) {
+  return reinterpret_cast<ObjectEntry*>(static_cast<char*>(s->base) +
+                                        sizeof(Header));
+}
+inline char* at(Store* s, uint64_t off) {
+  return static_cast<char*>(s->base) + off;
+}
+
+uint64_t id_hash(const uint8_t* id) {
+  // FNV-1a over the 16-byte id.
+  uint64_t h = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < kIdSize; i++) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+// Lock with robust-mutex recovery: if a worker died holding the lock, take
+// ownership and mark state consistent (the table stays valid because all
+// mutations are idempotent-ordered: sizes are written before state flips).
+int lock(Store* s) {
+  int rc = pthread_mutex_lock(&header(s)->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&header(s)->mutex);
+    return 0;
+  }
+  return rc;
+}
+void unlock(Store* s) { pthread_mutex_unlock(&header(s)->mutex); }
+
+// Find the table slot for `id`, or the first free slot if absent
+// (linear probing; n_slots is a power of two).
+ObjectEntry* find_slot(Store* s, const uint8_t* id, bool want_free) {
+  Header* h = header(s);
+  ObjectEntry* t = table(s);
+  uint64_t mask = h->n_slots - 1;
+  uint64_t idx = id_hash(id) & mask;
+  ObjectEntry* first_free = nullptr;
+  for (uint64_t probe = 0; probe < h->n_slots; probe++) {
+    ObjectEntry* e = &t[(idx + probe) & mask];
+    if (e->state == 0) {
+      if (!want_free) return nullptr;   // empty slot ends the probe chain
+      return first_free ? first_free : e;
+    }
+    if (memcmp(e->id, id, kIdSize) == 0) return e;
+  }
+  return first_free;
+}
+
+// NOTE: deletion uses tombstone-free compaction via full probe; to keep the
+// implementation simple we never shrink chains — instead lookups stop at the
+// first state==0 slot, so delete re-inserts any chain successors. To avoid
+// that complexity entirely, deleted slots keep state==0 only when safe; we
+// simply rehash the successors of the deleted slot.
+void fixup_chain(Store* s, uint64_t hole_idx) {
+  Header* h = header(s);
+  ObjectEntry* t = table(s);
+  uint64_t mask = h->n_slots - 1;
+  uint64_t idx = (hole_idx + 1) & mask;
+  while (t[idx].state != 0) {
+    ObjectEntry moved = t[idx];
+    t[idx].state = 0;
+    ObjectEntry* dst = find_slot(s, moved.id, true);
+    *dst = moved;
+    idx = (idx + 1) & mask;
+  }
+}
+
+uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+// Each allocation is prefixed by a kAlign-byte header whose first 8 bytes
+// record the true block size (which may exceed the rounded request when a
+// free-list remainder too small to split is absorbed). Payloads thus stay
+// cacheline-aligned and frees are exact.
+//
+// First-fit allocate from the free list. Returns *payload* offset or 0.
+uint64_t heap_alloc(Store* s, uint64_t need) {
+  Header* h = header(s);
+  need = align_up(need < kAlign ? kAlign : need, kAlign) + kAlign;
+  uint64_t prev_off = 0;
+  uint64_t off = h->free_head;
+  while (off) {
+    FreeBlock* fb = reinterpret_cast<FreeBlock*>(at(s, off));
+    if (fb->size >= need) {
+      uint64_t remain = fb->size - need;
+      uint64_t got = need;
+      if (remain >= sizeof(FreeBlock) + 2 * kAlign) {
+        // split: tail remains free
+        uint64_t tail_off = off + need;
+        FreeBlock* tail = reinterpret_cast<FreeBlock*>(at(s, tail_off));
+        tail->size = remain;
+        tail->next_off = fb->next_off;
+        if (prev_off)
+          reinterpret_cast<FreeBlock*>(at(s, prev_off))->next_off = tail_off;
+        else
+          h->free_head = tail_off;
+      } else {
+        got = fb->size;  // absorb the remainder
+        if (prev_off)
+          reinterpret_cast<FreeBlock*>(at(s, prev_off))->next_off = fb->next_off;
+        else
+          h->free_head = fb->next_off;
+      }
+      h->bytes_used += got;
+      *reinterpret_cast<uint64_t*>(at(s, off)) = got;
+      return off + kAlign;
+    }
+    prev_off = off;
+    off = fb->next_off;
+  }
+  return 0;
+}
+
+// Free a payload offset returned by heap_alloc; exact size comes from the
+// allocation header. Address-ordered insert + coalescing.
+void heap_free(Store* s, uint64_t payload_off, uint64_t /*unused*/) {
+  Header* h = header(s);
+  uint64_t off = payload_off - kAlign;
+  uint64_t size = *reinterpret_cast<uint64_t*>(at(s, off));
+  h->bytes_used -= size;
+  uint64_t prev_off = 0, cur = h->free_head;
+  while (cur && cur < off) {
+    prev_off = cur;
+    cur = reinterpret_cast<FreeBlock*>(at(s, cur))->next_off;
+  }
+  FreeBlock* fb = reinterpret_cast<FreeBlock*>(at(s, off));
+  fb->size = size;
+  fb->next_off = cur;
+  if (prev_off) {
+    FreeBlock* prev = reinterpret_cast<FreeBlock*>(at(s, prev_off));
+    prev->next_off = off;
+    if (prev_off + prev->size == off) {  // coalesce with prev
+      prev->size += fb->size;
+      prev->next_off = fb->next_off;
+      fb = prev;
+      off = prev_off;
+    }
+  } else {
+    h->free_head = off;
+  }
+  if (cur && off + fb->size == cur) {  // coalesce with next
+    FreeBlock* next = reinterpret_cast<FreeBlock*>(at(s, cur));
+    fb->size += next->size;
+    fb->next_off = next->next_off;
+  }
+}
+
+// Evict up to `count` LRU sealed refcount-0 objects (used to relieve table
+// pressure). Returns number evicted. Caller holds the lock.
+int evict_n(Store* s, int count) {
+  Header* h = header(s);
+  ObjectEntry* t = table(s);
+  int evicted = 0;
+  for (int rounds = 0; rounds < count; rounds++) {
+    ObjectEntry* victim = nullptr;
+    for (uint64_t i = 0; i < h->n_slots; i++) {
+      ObjectEntry* e = &t[i];
+      if (e->state == 2 && e->refcount == 0) {
+        if (!victim || e->lru_tick < victim->lru_tick) victim = e;
+      }
+    }
+    if (!victim) return evicted;
+    heap_free(s, victim->data_off, victim->data_size);
+    uint64_t idx = victim - t;
+    victim->state = 0;
+    h->n_objects--;
+    h->evictions++;
+    evicted++;
+    fixup_chain(s, idx);
+  }
+  return evicted;
+}
+
+uint64_t table_bytes(uint64_t n_slots) { return n_slots * sizeof(ObjectEntry); }
+
+int init_segment(Store* s, uint64_t size, uint64_t n_slots) {
+  Header* h = header(s);
+  memset(h, 0, sizeof(Header));
+  h->magic = kMagic;
+  h->segment_size = size;
+  h->n_slots = n_slots;
+  memset(table(s), 0, table_bytes(n_slots));
+  uint64_t heap_off = align_up(sizeof(Header) + table_bytes(n_slots), kAlign);
+  h->heap_off = heap_off;
+  h->heap_size = size - heap_off;
+  FreeBlock* fb = reinterpret_cast<FreeBlock*>(at(s, heap_off));
+  fb->size = h->heap_size;
+  fb->next_off = 0;
+  h->free_head = heap_off;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  if (pthread_mutex_init(&h->mutex, &attr) != 0) return ERR_SYS;
+  pthread_mutexattr_destroy(&attr);
+  return OK;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a new store segment (unlinks any stale one first). n_slots must be
+// a power of two. Returns an opaque handle or nullptr.
+Store* store_create(const char* name, uint64_t size, uint64_t n_slots) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(size)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Store* s = new Store{base, size, fd, {0}};
+  strncpy(s->name, name, sizeof(s->name) - 1);
+  if (init_segment(s, size, n_slots) != OK) {
+    munmap(base, size);
+    close(fd);
+    shm_unlink(name);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+// Connect to an existing segment created by another process.
+Store* store_connect(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                    fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store{base, static_cast<uint64_t>(st.st_size), fd, {0}};
+  strncpy(s->name, name, sizeof(s->name) - 1);
+  if (header(s)->magic != kMagic) {
+    munmap(base, s->size);
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void store_disconnect(Store* s) {
+  if (!s) return;
+  munmap(s->base, s->size);
+  close(s->fd);
+  delete s;
+}
+
+// Destroy the segment (owner only).
+void store_destroy(Store* s) {
+  if (!s) return;
+  char name[256];
+  strncpy(name, s->name, sizeof(name));
+  store_disconnect(s);
+  shm_unlink(name);
+}
+
+// Begin creating an object: allocates space, returns a writable pointer via
+// *out_ptr (valid in this process). Object is invisible to get() until
+// sealed. Evicts LRU objects if needed.
+int store_create_object(Store* s, const uint8_t* id, uint64_t size,
+                        void** out_ptr) {
+  if (lock(s) != 0) return ERR_SYS;
+  Header* h = header(s);
+  ObjectEntry* existing = find_slot(s, id, false);
+  if (existing) {
+    unlock(s);
+    return ERR_EXISTS;
+  }
+  if (h->n_objects >= h->n_slots - (h->n_slots >> 2)) {  // keep table <75% full
+    evict_n(s, 16);
+    if (h->n_objects >= h->n_slots - (h->n_slots >> 2)) {
+      unlock(s);
+      return ERR_TABLE_FULL;
+    }
+  }
+  uint64_t off = heap_alloc(s, size);
+  while (!off) {
+    // Evict one LRU victim and retry.
+    ObjectEntry* t = table(s);
+    ObjectEntry* victim = nullptr;
+    for (uint64_t i = 0; i < h->n_slots; i++) {
+      ObjectEntry* e = &t[i];
+      if (e->state == 2 && e->refcount == 0)
+        if (!victim || e->lru_tick < victim->lru_tick) victim = e;
+    }
+    if (!victim) {
+      unlock(s);
+      return ERR_FULL;
+    }
+    heap_free(s, victim->data_off, victim->data_size);
+    uint64_t idx = victim - t;
+    victim->state = 0;
+    h->n_objects--;
+    h->evictions++;
+    fixup_chain(s, idx);
+    off = heap_alloc(s, size);
+  }
+  ObjectEntry* e = find_slot(s, id, true);
+  if (!e) {  // shouldn't happen after the capacity check
+    heap_free(s, off, size);
+    unlock(s);
+    return ERR_TABLE_FULL;
+  }
+  memcpy(e->id, id, kIdSize);
+  e->data_off = off;
+  e->data_size = size;
+  e->refcount = 1;  // creator holds a pin until seal/abort
+  e->state = 1;
+  e->lru_tick = ++h->lru_clock;
+  h->n_objects++;
+  *out_ptr = at(s, off);
+  unlock(s);
+  return OK;
+}
+
+// Seal: object becomes immutable + visible. Drops the creator pin.
+int store_seal(Store* s, const uint8_t* id) {
+  if (lock(s) != 0) return ERR_SYS;
+  ObjectEntry* e = find_slot(s, id, false);
+  if (!e) {
+    unlock(s);
+    return ERR_NOT_FOUND;
+  }
+  if (e->state != 1) {
+    unlock(s);
+    return ERR_NOT_SEALED;
+  }
+  e->state = 2;
+  e->refcount--;
+  unlock(s);
+  return OK;
+}
+
+// Abort an in-progress create.
+int store_abort(Store* s, const uint8_t* id) {
+  if (lock(s) != 0) return ERR_SYS;
+  ObjectEntry* e = find_slot(s, id, false);
+  if (!e || e->state != 1) {
+    unlock(s);
+    return ERR_NOT_FOUND;
+  }
+  heap_free(s, e->data_off, e->data_size);
+  uint64_t idx = e - table(s);
+  e->state = 0;
+  header(s)->n_objects--;
+  fixup_chain(s, idx);
+  unlock(s);
+  return OK;
+}
+
+// Get a sealed object: pins it (refcount++) and returns pointer + size.
+int store_get(Store* s, const uint8_t* id, void** out_ptr,
+              uint64_t* out_size) {
+  if (lock(s) != 0) return ERR_SYS;
+  ObjectEntry* e = find_slot(s, id, false);
+  if (!e || e->state != 2) {
+    unlock(s);
+    return ERR_NOT_FOUND;
+  }
+  e->refcount++;
+  e->lru_tick = ++header(s)->lru_clock;
+  *out_ptr = at(s, e->data_off);
+  *out_size = e->data_size;
+  unlock(s);
+  return OK;
+}
+
+// Release a pin taken by store_get.
+int store_release(Store* s, const uint8_t* id) {
+  if (lock(s) != 0) return ERR_SYS;
+  ObjectEntry* e = find_slot(s, id, false);
+  if (!e) {
+    unlock(s);
+    return ERR_NOT_FOUND;
+  }
+  if (e->refcount > 0) e->refcount--;
+  unlock(s);
+  return OK;
+}
+
+int store_contains(Store* s, const uint8_t* id) {
+  if (lock(s) != 0) return ERR_SYS;
+  ObjectEntry* e = find_slot(s, id, false);
+  int rc = (e && e->state == 2) ? 1 : 0;
+  unlock(s);
+  return rc;
+}
+
+// Delete a sealed object (fails with ERR_IN_USE if pinned).
+int store_delete(Store* s, const uint8_t* id) {
+  if (lock(s) != 0) return ERR_SYS;
+  ObjectEntry* e = find_slot(s, id, false);
+  if (!e || e->state != 2) {
+    unlock(s);
+    return ERR_NOT_FOUND;
+  }
+  if (e->refcount > 0) {
+    unlock(s);
+    return ERR_IN_USE;
+  }
+  heap_free(s, e->data_off, e->data_size);
+  uint64_t idx = e - table(s);
+  e->state = 0;
+  header(s)->n_objects--;
+  fixup_chain(s, idx);
+  unlock(s);
+  return OK;
+}
+
+// Stats: fills [n_objects, bytes_used, heap_size, evictions].
+int store_stats(Store* s, uint64_t* out4) {
+  if (lock(s) != 0) return ERR_SYS;
+  Header* h = header(s);
+  out4[0] = h->n_objects;
+  out4[1] = h->bytes_used;
+  out4[2] = h->heap_size;
+  out4[3] = h->evictions;
+  unlock(s);
+  return OK;
+}
+
+}  // extern "C"

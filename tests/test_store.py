@@ -1,0 +1,166 @@
+"""Native shared-memory object store tests.
+
+Analog of the reference's plasma tests
+(/root/reference/src/ray/object_manager/plasma/test/) — create/seal/get
+lifecycle, eviction under pressure, pinning, multi-process access, spilling.
+"""
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.store_client import StoreClient, StoreError
+
+
+@pytest.fixture
+def store(tmp_path):
+    name = f"/raystore_test_{os.getpid()}"
+    c = StoreClient(name, create=True, size=8 * 1024 * 1024, n_slots=256,
+                    spill_dir=str(tmp_path / "spill"))
+    yield c
+    c.close()
+
+
+def oid(i: int) -> bytes:
+    return i.to_bytes(16, "little")
+
+
+def test_put_get_roundtrip(store):
+    data = os.urandom(1000)
+    assert store.put(oid(1), data)
+    buf = store.get(oid(1))
+    assert buf.to_bytes() == data
+    buf.release()
+
+
+def test_put_idempotent(store):
+    assert store.put(oid(1), b"x")
+    assert not store.put(oid(1), b"y")
+    assert store.get(oid(1)).to_bytes() == b"x"
+
+
+def test_get_missing(store):
+    assert store.get(oid(99)) is None
+    assert not store.contains(oid(99))
+
+
+def test_numpy_zero_copy(store):
+    arr = np.arange(1024, dtype=np.float32)
+    store.put(oid(2), arr.tobytes())
+    buf = store.get(oid(2))
+    out = np.frombuffer(buf.memoryview(), dtype=np.float32)
+    np.testing.assert_array_equal(out, arr)
+    buf.release()
+
+
+def test_delete(store):
+    store.put(oid(3), b"abc")
+    store.delete(oid(3))
+    assert not store.contains(oid(3))
+
+
+def test_delete_pinned_object_refused(store):
+    store.put(oid(4), b"abc")
+    buf = store.get(oid(4))
+    store.delete(oid(4))  # best-effort; must NOT remove while pinned
+    assert store.contains(oid(4))
+    buf.release()
+    store.delete(oid(4))
+    assert not store.contains(oid(4))
+
+
+def test_lru_eviction_under_pressure(store):
+    # 8 MiB heap, 1 MiB objects: keep inserting; the store must evict old
+    # unpinned objects rather than fail.
+    blob = os.urandom(1024 * 1024)
+    for i in range(20):
+        assert store.put(oid(100 + i), blob)
+    stats = store.stats()
+    assert stats["evictions"] > 0
+    # newest object still resident
+    assert store.contains(oid(119))
+
+
+def test_pinned_objects_survive_eviction(store):
+    pinned = store.put(oid(5), b"keep me") and store.get(oid(5))
+    blob = os.urandom(1024 * 1024)
+    for i in range(20):
+        store.put(oid(200 + i), blob)
+    assert store.get(oid(5)).to_bytes() == b"keep me"
+    pinned.release()
+
+
+def test_spill_and_restore(tmp_path):
+    name = f"/raystore_spill_{os.getpid()}"
+    c = StoreClient(name, create=True, size=2 * 1024 * 1024, n_slots=64,
+                    spill_dir=str(tmp_path))
+    try:
+        big = os.urandom(1024 * 1024)
+        c.put(oid(1), big)
+        pin = c.get(oid(1))  # pin so it can't evict
+        # This can't fit next to the pinned 1MiB in a 2MiB heap → spills.
+        big2 = os.urandom(1500 * 1024)
+        c.put(oid(2), big2)
+        assert c.contains(oid(2))
+        pin.release()
+        got = c.get(oid(2))
+        assert got.to_bytes() == big2
+    finally:
+        c.close()
+
+
+def _child_reader(name, result_q):
+    c = StoreClient(name, create=False)
+    buf = c.get((42).to_bytes(16, "little"))
+    result_q.put(buf.to_bytes() if buf else None)
+    c.close()
+
+
+def test_multiprocess_access():
+    name = f"/raystore_mp_{os.getpid()}"
+    c = StoreClient(name, create=True, size=4 * 1024 * 1024, n_slots=64)
+    try:
+        data = os.urandom(5000)
+        c.put(oid(42), data)
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_reader, args=(name, q))
+        p.start()
+        got = q.get(timeout=30)
+        p.join(timeout=10)
+        assert got == data
+    finally:
+        c.close()
+
+
+def test_object_too_large_without_spill():
+    name = f"/raystore_big_{os.getpid()}"
+    c = StoreClient(name, create=True, size=1024 * 1024, n_slots=64)
+    try:
+        with pytest.raises(StoreError):
+            c.put(oid(1), os.urandom(4 * 1024 * 1024))
+    finally:
+        c.close()
+
+
+def test_zero_length_object(store):
+    assert store.put(oid(7), b"")
+    buf = store.get(oid(7))
+    assert buf is not None and buf.to_bytes() == b""
+
+
+def test_bad_id_rejected(store):
+    with pytest.raises(ValueError):
+        store.put(b"short", b"x")
+    with pytest.raises(ValueError):
+        store.get(b"short")
+
+
+def test_many_small_objects(store):
+    for i in range(150):
+        store.put(oid(1000 + i), f"value-{i}".encode())
+    for i in range(150):
+        buf = store.get(oid(1000 + i))
+        if buf is not None:  # some may be evicted under table pressure
+            assert buf.to_bytes() == f"value-{i}".encode()
