@@ -1,0 +1,542 @@
+"""Public API — ray_tpu.init / remote / get / put / wait / actors.
+
+Analog of the reference's python/ray/_private/worker.py (ray.init at :1031,
+get/put/wait at :2230,2329,2385, @ray.remote at :2709-2808),
+python/ray/remote_function.py and python/ray/actor.py, re-based on the
+TPU-native runtime: GCS + raylet run in-process for local mode (the
+single-node quickstart), workers are real OS processes sharing the node's
+shm object store.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import inspect
+import os
+import threading
+import time
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker_runtime import (
+    CoreWorker,
+    current_worker,
+    set_current_worker,
+)
+
+_global_lock = threading.RLock()
+_global_node = None     # _LocalNode for locally started clusters
+_namespace = "default"
+
+
+class _LocalNode:
+    """In-process head: GCS + raylet threads (the reference forks gcs_server
+    and raylet processes, node.py:1045; in-process keeps the local quickstart
+    fast — multi-node tests use cluster_utils.Cluster which adds more raylets,
+    and production uses the CLI to run them standalone)."""
+
+    def __init__(self, num_cpus=None, num_tpus=None, resources=None,
+                 object_store_memory=None, session_dir=None):
+        from ray_tpu._private.gcs import GcsServer
+        from ray_tpu._private.raylet import Raylet, detect_resources
+
+        self.session_dir = session_dir or os.path.join(
+            "/tmp/ray_tpu", f"session_{os.getpid()}_{int(time.time())}")
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.gcs = GcsServer(
+            snapshot_path=os.path.join(self.session_dir, "gcs_snapshot")
+        ).start()
+        self.raylet = Raylet(
+            self.gcs.addr,
+            resources=detect_resources(num_cpus, num_tpus, resources=resources),
+            store_size=object_store_memory or 256 * 1024 * 1024,
+            session_dir=self.session_dir,
+        )
+
+    def stop(self):
+        self.raylet.stop()
+        self.gcs.stop()
+
+
+def init(address=None, *, num_cpus=None, num_tpus=None, num_gpus=None,
+         resources=None, namespace=None, object_store_memory=None,
+         ignore_reinit_error=False, **kwargs):
+    """Start (or connect to) a cluster and connect this process as driver.
+
+    address=None starts a local head; address="host:port" connects to an
+    existing GCS; address="auto" reads RAY_TPU_ADDRESS.
+    `num_gpus` is accepted for reference-API compatibility and maps to TPU
+    chips.
+    """
+    global _global_node, _namespace
+    with _global_lock:
+        if current_worker() is not None:
+            if ignore_reinit_error:
+                return RayContext(current_worker())
+            raise RuntimeError("ray_tpu.init() called twice "
+                              "(pass ignore_reinit_error=True to allow)")
+        if namespace:
+            _namespace = namespace
+        if num_tpus is None and num_gpus is not None:
+            num_tpus = num_gpus
+        if address in (None, "local"):
+            _global_node = _LocalNode(num_cpus, num_tpus, resources,
+                                      object_store_memory)
+            gcs_addr = _global_node.gcs.addr
+            raylet_addr = _global_node.raylet.addr
+        else:
+            if address == "auto":
+                address = os.environ["RAY_TPU_ADDRESS"]
+            host, port = address.rsplit(":", 1)
+            gcs_addr = (host, int(port))
+            raylet_addr = _find_raylet(gcs_addr)
+        worker = CoreWorker(gcs_addr, raylet_addr, mode="driver")
+        set_current_worker(worker)
+        atexit.register(shutdown)
+        return RayContext(worker)
+
+
+def _find_raylet(gcs_addr):
+    """Pick this host's raylet from the GCS node table (or any alive one)."""
+    from ray_tpu._private.protocol import RpcClient
+
+    client = RpcClient(gcs_addr)
+    try:
+        nodes = [n for n in client.call("get_nodes") if n["Alive"]]
+    finally:
+        client.close()
+    if not nodes:
+        raise RuntimeError("no alive nodes in cluster")
+    hostname = os.uname().nodename
+    for n in nodes:
+        if n.get("hostname") == hostname:
+            return (n["NodeManagerAddress"], n["NodeManagerPort"])
+    return (nodes[0]["NodeManagerAddress"], nodes[0]["NodeManagerPort"])
+
+
+def shutdown():
+    global _global_node
+    with _global_lock:
+        worker = current_worker()
+        if worker is not None:
+            worker.shutdown()
+            set_current_worker(None)
+        if _global_node is not None:
+            _global_node.stop()
+            _global_node = None
+        try:
+            atexit.unregister(shutdown)
+        except Exception:
+            pass
+
+
+def is_initialized() -> bool:
+    return current_worker() is not None
+
+
+def _require_worker() -> CoreWorker:
+    worker = current_worker()
+    if worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized — call ray_tpu.init()")
+    return worker
+
+
+# --------------------------------------------------------------------- basics
+
+def put(value) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() on an ObjectRef is not allowed")
+    return _require_worker().put(value)
+
+
+def get(refs, *, timeout=None):
+    worker = _require_worker()
+    if isinstance(refs, list):
+        bad = [r for r in refs if not isinstance(r, ObjectRef)]
+        if bad:
+            raise TypeError(f"get() takes ObjectRefs, got {type(bad[0])}")
+    elif not isinstance(refs, ObjectRef):
+        raise TypeError(f"get() takes an ObjectRef or list, got {type(refs)}")
+    return worker.get(refs, timeout=timeout)
+
+
+def wait(refs, *, num_returns=1, timeout=None, fetch_local=True):
+    if not isinstance(refs, list):
+        raise TypeError("wait() takes a list of ObjectRefs")
+    return _require_worker().wait(refs, num_returns=num_returns,
+                                  timeout=timeout, fetch_local=fetch_local)
+
+
+def kill(actor, *, no_restart=True):
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() takes an ActorHandle")
+    worker = _require_worker()
+    info = worker.gcs.call("get_actor", actor_id=actor._actor_id)
+    if info is None:
+        return
+    node_id = None
+    # find the actor's raylet via its node
+    snap = worker.gcs.call("list_actors")
+    for a in snap:
+        if a["ActorID"] == actor._actor_id.hex():
+            node_id = a["NodeID"]
+            break
+    from ray_tpu._private.protocol import RpcClient
+
+    for n in worker.gcs.call("get_nodes"):
+        if n["NodeID"] == node_id and n["Alive"]:
+            c = RpcClient((n["NodeManagerAddress"], n["NodeManagerPort"]))
+            try:
+                c.call("kill_actor", actor_id=actor._actor_id,
+                       no_restart=no_restart)
+            finally:
+                c.close()
+            return
+
+
+def cancel(ref: ObjectRef, *, force=False, recursive=True):
+    """Best-effort cancellation of the task producing `ref`: a queued task
+    is dropped, a running one is flagged (force interrupts the executing
+    thread). get(ref) raises TaskCancelledError if the cancel won."""
+    if not isinstance(ref, ObjectRef):
+        raise TypeError("cancel() takes an ObjectRef")
+    _require_worker().cancel_task(ref, force=force)
+
+
+def get_actor(name: str, namespace: str | None = None) -> "ActorHandle":
+    worker = _require_worker()
+    info = worker.gcs.call("get_actor", name=name,
+                           namespace=namespace or _namespace)
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"actor {name!r} not found")
+    meta = info.get("spec_meta") or {}
+    return ActorHandle(info["actor_id"],
+                       max_task_retries=meta.get("max_task_retries", 0))
+
+
+def nodes():
+    return _require_worker().gcs.call("get_nodes")
+
+
+def cluster_resources():
+    return _require_worker().gcs.call("cluster_resources")
+
+
+def available_resources():
+    worker = _require_worker()
+    from ray_tpu._private.protocol import RpcClient
+
+    total = {}
+    for n in worker.gcs.call("get_nodes"):
+        if not n["Alive"]:
+            continue
+        try:
+            c = RpcClient((n["NodeManagerAddress"], n["NodeManagerPort"]),
+                          timeout=5.0)
+            try:
+                info = c.call("node_info")
+            finally:
+                c.close()
+            for k, v in info["resources_available"].items():
+                total[k] = total.get(k, 0) + v
+        except Exception:
+            continue
+    return total
+
+
+def get_gpu_ids():
+    return []   # compatibility shim; TPU chips are addressed via jax.devices
+
+
+def timeline(filename=None):
+    return []   # profiling timeline lands with the tracing subsystem
+
+
+class RayContext:
+    def __init__(self, worker):
+        self._worker = worker
+        self.address_info = {
+            "gcs_address": f"{worker.gcs.addr[0]}:{worker.gcs.addr[1]}",
+            "node_id": worker.node_id,
+        }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        shutdown()
+
+    def __getitem__(self, key):
+        return self.address_info[key]
+
+
+class RuntimeContext:
+    def __init__(self, worker: CoreWorker):
+        self._worker = worker
+
+    def get_node_id(self):
+        return self._worker.node_id
+
+    def get_job_id(self):
+        return self._worker.job_id
+
+    def get_worker_id(self):
+        return self._worker.worker_id
+
+    def get_actor_id(self):
+        return self._worker.actor_id.hex() if self._worker.actor_id else None
+
+    @property
+    def namespace(self):
+        return _namespace
+
+    @property
+    def was_current_actor_restarted(self):
+        return False
+
+    def get_actor_name(self):
+        spec = self._worker._actor_spec
+        return spec.get("name") if spec else None
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_require_worker())
+
+
+# ----------------------------------------------------------- options handling
+
+_TASK_DEFAULTS = dict(num_cpus=1.0, num_tpus=0.0, memory=None, resources=None,
+                      num_returns=1, max_retries=3, retry_exceptions=False,
+                      scheduling_strategy=None)
+_ACTOR_DEFAULTS = dict(num_cpus=1.0, num_tpus=0.0, memory=None, resources=None,
+                       max_restarts=0, max_task_retries=0, max_concurrency=1,
+                       name=None, namespace=None, lifetime=None,
+                       get_if_exists=False, scheduling_strategy=None)
+
+
+def _build_resources(opts: dict) -> dict:
+    """Pure: never mutates opts. Zero-valued entries are dropped, so
+    num_cpus=0 yields {} — which the submit path must treat as 'no resource
+    requirement', NOT as 'use defaults'."""
+    res = dict(opts.get("resources") or {})
+    if opts.get("num_cpus") is not None:
+        res["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_gpus"):   # compat alias
+        res["TPU"] = float(opts["num_gpus"])
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("memory"):
+        res["memory"] = float(opts["memory"])
+    return {k: v for k, v in res.items() if v}
+
+
+def _build_strategy(opts: dict) -> dict | None:
+    strategy = opts.get("scheduling_strategy")
+    if strategy is None or strategy == "DEFAULT":
+        pg = opts.get("placement_group")
+        if pg is not None:
+            return {"placement_group_id": pg.id,
+                    "bundle_index":
+                        opts.get("placement_group_bundle_index", -1)}
+        return None
+    if strategy == "SPREAD":
+        return {"spread": True}
+    # strategy objects (duck-typed; see ray_tpu.util.scheduling_strategies)
+    if hasattr(strategy, "node_id"):
+        return {"node_id": strategy.node_id,
+                "soft": getattr(strategy, "soft", False)}
+    if hasattr(strategy, "placement_group"):
+        pg = strategy.placement_group
+        return {"placement_group_id": pg.id,
+                "bundle_index":
+                    getattr(strategy, "placement_group_bundle_index", -1)}
+    raise ValueError(f"unknown scheduling strategy {strategy!r}")
+
+
+class RemoteFunction:
+    """@ray_tpu.remote function wrapper (reference: remote_function.py:35)."""
+
+    def __init__(self, fn, **options):
+        self._fn = fn
+        self._options = {**_TASK_DEFAULTS, **options}
+        self._func_hash = None
+        self._registered_with = None   # CoreWorker the hash was pushed via
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__}() cannot be called "
+            f"directly; use {self._fn.__name__}.remote()")
+
+    def options(self, **overrides):
+        return RemoteFunction(self._fn, **{**self._options, **overrides})
+
+    def remote(self, *args, **kwargs):
+        worker = _require_worker()
+        if self._registered_with is not worker:
+            # (re-)register against THIS runtime: a new init() means a fresh
+            # GCS function table that has no copy of the function
+            self._func_hash = worker.register_function(self._fn)
+            self._registered_with = worker
+        opts = self._options
+        refs = worker.submit_task(
+            self._func_hash, args, kwargs,
+            num_returns=opts["num_returns"],
+            resources=_build_resources(opts),
+            strategy=_build_strategy(opts),
+            max_retries=opts["max_retries"],
+            task_desc=f"task {self._fn.__name__}()",
+        )
+        if opts["num_returns"] == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def bind(self):
+        from ray_tpu.dag import FunctionNode
+
+        def _bind(*args, **kwargs):
+            return FunctionNode(self, args, kwargs)
+
+        return _bind
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns=1, **_):
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        worker = _require_worker()
+        refs = worker.submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs,
+            num_returns=self._num_returns,
+            max_task_retries=self._handle._max_task_retries,
+            task_desc=f"actor method {self._name}()",
+        )
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def bind(self):
+        from ray_tpu.dag import ClassMethodNode
+
+        def _bind(*args, **kwargs):
+            return ClassMethodNode(self, args, kwargs)
+
+        return _bind
+
+
+class ActorHandle:
+    def __init__(self, actor_id: bytes, max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._max_task_retries))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return (isinstance(other, ActorHandle)
+                and other._actor_id == self._actor_id)
+
+    @property
+    def __ray_terminate__(self):
+        return ActorMethod(self, "__ray_terminate__")
+
+
+class ActorClass:
+    """@ray_tpu.remote class wrapper (reference: actor.py:377)."""
+
+    def __init__(self, cls, **options):
+        self._cls = cls
+        self._options = {**_ACTOR_DEFAULTS, **options}
+        self._class_hash = None
+        self._registered_with = None
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote()")
+
+    def options(self, **overrides):
+        out = ActorClass(self._cls, **{**self._options, **overrides})
+        return out
+
+    def remote(self, *args, **kwargs):
+        worker = _require_worker()
+        if self._registered_with is not worker:
+            self._class_hash = worker.register_function(self._cls)
+            self._registered_with = worker
+        opts = dict(self._options)
+        resources = _build_resources(opts)   # {} = explicit zero request
+        actor_id, existed = worker.create_actor(
+            self._class_hash, args, kwargs,
+            options={
+                "class_name": self._cls.__name__,
+                "resources": resources,
+                "strategy": _build_strategy(opts),
+                "max_restarts": opts["max_restarts"],
+                "max_task_retries": opts["max_task_retries"],
+                "max_concurrency": opts["max_concurrency"],
+                "name": opts["name"],
+                "namespace": opts["namespace"] or _namespace,
+                "lifetime": opts["lifetime"],
+                "get_if_exists": opts["get_if_exists"],
+            })
+        return ActorHandle(actor_id,
+                           max_task_retries=opts["max_task_retries"])
+
+    @property
+    def bind(self):
+        from ray_tpu.dag import ClassNode
+
+        def _bind(*args, **kwargs):
+            return ClassNode(self, args, kwargs)
+
+        return _bind
+
+
+def remote(*args, **kwargs):
+    """@ray_tpu.remote / @ray_tpu.remote(num_cpus=..., num_tpus=...)."""
+    if len(args) == 1 and not kwargs and (
+            inspect.isfunction(args[0]) or inspect.isclass(args[0])):
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes keyword options only")
+
+    def decorator(target):
+        if inspect.isclass(target):
+            return ActorClass(target, **kwargs)
+        return RemoteFunction(target, **kwargs)
+
+    return decorator
+
+
+def method(**opts):
+    """@ray_tpu.method(num_returns=...) decorator for actor methods."""
+
+    def decorator(fn):
+        fn.__ray_num_returns__ = opts.get("num_returns", 1)
+        return fn
+
+    return decorator

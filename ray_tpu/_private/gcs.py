@@ -1,0 +1,605 @@
+"""GCS — the global control service.
+
+TPU-native analog of the reference's gcs_server
+(/root/reference/src/ray/gcs/gcs_server/gcs_server.cc:242-626): one process
+holding the cluster-global state machines —
+
+- node table + health (gcs_node_manager.h, gcs_health_check_manager.h):
+  nodes register, heartbeat over their persistent RPC connection; connection
+  loss marks the node dead and triggers actor/PG failover,
+- actor table (gcs_actor_manager.h:270): registration, name→actor resolution,
+  death notification, restart bookkeeping (ReconstructActor:495),
+- internal KV (gcs_kv_manager.h): function table, cluster metadata,
+- object directory: object id → node locations (the reference resolves via
+  owner workers, ownership_based_object_directory.h; centralizing in GCS is
+  the v1 simplification),
+- placement groups (gcs_placement_group_manager.h): bundle reservation with
+  PACK/SPREAD/STRICT_PACK/STRICT_SPREAD over the node table,
+- pubsub (pubsub_handler.h): actor state and node membership channels pushed
+  to subscribed connections.
+
+State is in-memory (the reference's default InMemoryStoreClient); a snapshot
+file provides GCS restart tolerance (the reference's Redis mode analog).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+import uuid
+
+from ray_tpu._private.protocol import RpcServer
+
+PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class NodeInfo:
+    def __init__(self, node_id: str, addr, resources: dict, meta: dict):
+        self.node_id = node_id
+        self.addr = tuple(addr)          # raylet RPC address
+        self.resources = dict(resources)  # total resources
+        self.meta = dict(meta)            # store name, spill dir, hostname...
+        self.alive = True
+        self.start_time = time.time()
+
+    def snapshot(self) -> dict:
+        return {
+            "NodeID": self.node_id,
+            "Alive": self.alive,
+            "NodeManagerAddress": self.addr[0],
+            "NodeManagerPort": self.addr[1],
+            "Resources": dict(self.resources),
+            "StartTime": self.start_time,
+            **{k: v for k, v in self.meta.items()},
+        }
+
+
+class ActorInfo:
+    def __init__(self, actor_id: bytes, spec: dict):
+        self.actor_id = actor_id
+        self.spec = spec                  # class blob, options, owner
+        self.state = "PENDING_CREATION"   # ALIVE / RESTARTING / DEAD
+        self.addr = None                  # worker rpc addr when alive
+        self.node_id = None
+        self.num_restarts = 0
+        self.death_cause = None
+        self.name = spec.get("name")
+        self.namespace = spec.get("namespace", "default")
+
+    def snapshot(self) -> dict:
+        return {
+            "ActorID": self.actor_id.hex(),
+            "State": self.state,
+            "Name": self.name or "",
+            "Namespace": self.namespace,
+            "NodeID": self.node_id,
+            "NumRestarts": self.num_restarts,
+            "ClassName": self.spec.get("class_name", ""),
+            "DeathCause": self.death_cause,
+        }
+
+
+class PlacementGroupInfo:
+    def __init__(self, pg_id: bytes, bundles: list[dict], strategy: str,
+                 name: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles            # list of resource dicts
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"            # CREATED / REMOVED / RESCHEDULING
+        self.bundle_nodes: list[str | None] = [None] * len(bundles)
+
+    def snapshot(self) -> dict:
+        return {
+            "PlacementGroupID": self.pg_id.hex(),
+            "Name": self.name,
+            "State": self.state,
+            "Strategy": self.strategy,
+            "Bundles": [dict(b) for b in self.bundles],
+            "BundleNodes": list(self.bundle_nodes),
+        }
+
+
+class GcsServer:
+    """RPC handler + state. One instance per cluster head."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 snapshot_path: str | None = None):
+        self._lock = threading.RLock()
+        self.nodes: dict[str, NodeInfo] = {}
+        self.actors: dict[bytes, ActorInfo] = {}
+        self.named_actors: dict[tuple[str, str], bytes] = {}
+        self.kv: dict[str, dict[bytes, bytes]] = {}
+        self.object_locations: dict[bytes, set[str]] = {}
+        self.object_sizes: dict[bytes, int] = {}
+        self.placement_groups: dict[bytes, PlacementGroupInfo] = {}
+        self.job_counter = 0
+        self.cluster_id = uuid.uuid4().hex
+        self._subscribers: dict[str, list] = {}   # channel -> [Connection]
+        self._node_conns: dict[str, str] = {}     # conn.id -> node_id
+        self._snapshot_path = snapshot_path
+        self._server = RpcServer(self, host, port)
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._load_snapshot()
+
+    def start(self):
+        self._server.start()
+        return self
+
+    @property
+    def addr(self):
+        return self._server.addr
+
+    def stop(self):
+        self._server.stop()
+
+    # ---- connection liveness → node failure detection ----------------------
+
+    def on_connect(self, conn):
+        pass
+
+    def on_disconnect(self, conn):
+        node_id = conn.meta.get("node_id")
+        if node_id:
+            self._mark_node_dead(node_id, "raylet connection lost")
+
+    def _mark_node_dead(self, node_id: str, reason: str):
+        to_restart: list[bytes] = []
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            # Objects whose only copies were there are gone.
+            for oid, locs in list(self.object_locations.items()):
+                locs.discard(node_id)
+            for actor in self.actors.values():
+                if actor.node_id != node_id:
+                    continue
+                if actor.state in ("ALIVE", "PENDING_CREATION"):
+                    decision = self._on_actor_failure(
+                        actor, f"node {node_id} died: {reason}")
+                    if decision.get("restart"):
+                        to_restart.append(actor.actor_id)
+                elif actor.state == "RESTARTING":
+                    # Its restart was being driven by the raylet that just
+                    # died — re-drive on a survivor without charging another
+                    # restart against the budget.
+                    to_restart.append(actor.actor_id)
+            for pg in self.placement_groups.values():
+                if node_id in pg.bundle_nodes:
+                    pg.state = "RESCHEDULING"
+        self._publish("nodes", {"event": "dead", "node_id": node_id,
+                                "reason": reason})
+        # The dead node's raylet can't re-create its actors — pick a
+        # surviving raylet to do it (reference: GcsActorScheduler re-leases
+        # from another node, gcs_actor_scheduler.h).
+        for actor_id in to_restart:
+            self._push_recreate(actor_id)
+
+    def _push_recreate(self, actor_id: bytes):
+        with self._lock:
+            alive_ids = {nid for nid, n in self.nodes.items() if n.alive}
+        for conn in self._server.connections():
+            if conn.meta.get("node_id") in alive_ids and conn.alive:
+                conn.push("recreate_actor", actor_id=actor_id)
+                return
+
+    # ---- nodes -------------------------------------------------------------
+
+    def rpc_register_node(self, conn, node_id: str, addr, resources: dict,
+                          meta: dict):
+        with self._lock:
+            self.nodes[node_id] = NodeInfo(node_id, addr, resources, meta)
+            conn.meta["node_id"] = node_id
+        self._publish("nodes", {"event": "alive", "node_id": node_id,
+                                "snapshot": self.nodes[node_id].snapshot()})
+        return {"cluster_id": self.cluster_id}
+
+    def rpc_drain_node(self, conn, node_id: str):
+        self._mark_node_dead(node_id, "drained")
+        return True
+
+    def rpc_get_nodes(self, conn):
+        with self._lock:
+            return [n.snapshot() for n in self.nodes.values()]
+
+    def rpc_cluster_resources(self, conn):
+        with self._lock:
+            total: dict[str, float] = {}
+            for n in self.nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.resources.items():
+                    total[k] = total.get(k, 0) + v
+            return total
+
+    def rpc_next_job_id(self, conn):
+        with self._lock:
+            self.job_counter += 1
+            return self.job_counter
+
+    # ---- KV (function table, metadata) -------------------------------------
+
+    def rpc_kv_put(self, conn, ns: str, key: bytes, value: bytes,
+                   overwrite: bool = True):
+        with self._lock:
+            table = self.kv.setdefault(ns, {})
+            if not overwrite and key in table:
+                return False
+            table[key] = value
+            return True
+
+    def rpc_kv_get(self, conn, ns: str, key: bytes):
+        with self._lock:
+            return self.kv.get(ns, {}).get(key)
+
+    def rpc_kv_del(self, conn, ns: str, key: bytes):
+        with self._lock:
+            return self.kv.get(ns, {}).pop(key, None) is not None
+
+    def rpc_kv_exists(self, conn, ns: str, key: bytes):
+        with self._lock:
+            return key in self.kv.get(ns, {})
+
+    def rpc_kv_keys(self, conn, ns: str, prefix: bytes = b""):
+        with self._lock:
+            return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
+
+    # ---- object directory --------------------------------------------------
+
+    def rpc_add_object_location(self, conn, object_id: bytes, node_id: str,
+                                size: int = 0):
+        with self._lock:
+            self.object_locations.setdefault(object_id, set()).add(node_id)
+            if size:
+                self.object_sizes[object_id] = size
+        return True
+
+    def rpc_remove_object_location(self, conn, object_id: bytes, node_id: str):
+        with self._lock:
+            locs = self.object_locations.get(object_id)
+            if locs:
+                locs.discard(node_id)
+        return True
+
+    def rpc_get_object_locations(self, conn, object_id: bytes):
+        with self._lock:
+            node_ids = [n for n in self.object_locations.get(object_id, ())
+                        if self.nodes.get(n) and self.nodes[n].alive]
+            return {
+                "nodes": [self.nodes[n].snapshot() for n in node_ids],
+                "size": self.object_sizes.get(object_id, 0),
+            }
+
+    def rpc_free_objects(self, conn, object_ids: list[bytes]):
+        """Broadcast deletion to every node holding a copy."""
+        with self._lock:
+            targets: dict[str, list[bytes]] = {}
+            for oid in object_ids:
+                for node_id in self.object_locations.pop(oid, ()):  # noqa: B909
+                    targets.setdefault(node_id, []).append(oid)
+                self.object_sizes.pop(oid, None)
+            conns = {c.meta.get("node_id"): c
+                     for c in self._server.connections()}
+        for node_id, oids in targets.items():
+            c = conns.get(node_id)
+            if c is not None:
+                c.push("free_objects", object_ids=oids)
+        return True
+
+    # ---- actors ------------------------------------------------------------
+
+    def rpc_register_actor(self, conn, actor_id: bytes, spec: dict):
+        with self._lock:
+            name = spec.get("name")
+            ns = spec.get("namespace", "default")
+            if name:
+                existing_id = self.named_actors.get((ns, name))
+                if existing_id is not None:
+                    existing = self.actors.get(existing_id)
+                    if existing and existing.state != "DEAD":
+                        if spec.get("get_if_exists"):
+                            return {"existing": existing.snapshot()}
+                        raise ValueError(
+                            f"actor name {name!r} already taken in "
+                            f"namespace {ns!r}")
+            info = ActorInfo(actor_id, spec)
+            self.actors[actor_id] = info
+            if name:
+                self.named_actors[(ns, name)] = actor_id
+        return {"existing": None}
+
+    def rpc_actor_started(self, conn, actor_id: bytes, addr, node_id: str):
+        with self._lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return False
+            actor.state = "ALIVE"
+            actor.addr = tuple(addr)
+            actor.node_id = node_id
+        self._publish("actors", {"event": "alive",
+                                 "actor_id": actor_id,
+                                 "addr": tuple(addr)})
+        return True
+
+    def rpc_actor_failed(self, conn, actor_id: bytes, reason: str):
+        with self._lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return None
+            return self._on_actor_failure(actor, reason)
+
+    def rpc_actor_exited(self, conn, actor_id: bytes):
+        """Graceful termination (__ray_terminate__ / kill(no_restart))."""
+        with self._lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return False
+            actor.state = "DEAD"
+            actor.death_cause = "exited"
+            self._drop_name(actor)
+        self._publish("actors", {"event": "dead", "actor_id": actor_id,
+                                 "reason": "exited"})
+        return True
+
+    def _drop_name(self, actor: ActorInfo):
+        if actor.name and self.named_actors.get(
+                (actor.namespace, actor.name)) == actor.actor_id:
+            del self.named_actors[(actor.namespace, actor.name)]
+
+    def _on_actor_failure(self, actor: ActorInfo, reason: str):
+        """Returns restart decision; caller-side raylet re-creates. Mirrors
+        GcsActorManager::ReconstructActor (gcs_actor_manager.h:495)."""
+        max_restarts = actor.spec.get("max_restarts", 0)
+        if actor.state == "DEAD":
+            return {"restart": False}
+        if max_restarts == -1 or actor.num_restarts < max_restarts:
+            actor.num_restarts += 1
+            actor.state = "RESTARTING"
+            actor.addr = None
+            self._publish("actors", {"event": "restarting",
+                                     "actor_id": actor.actor_id})
+            return {"restart": True, "num_restarts": actor.num_restarts}
+        actor.state = "DEAD"
+        actor.death_cause = reason
+        self._drop_name(actor)
+        self._publish("actors", {"event": "dead",
+                                 "actor_id": actor.actor_id,
+                                 "reason": reason})
+        return {"restart": False}
+
+    def rpc_get_actor(self, conn, actor_id: bytes = None, name: str = None,
+                      namespace: str = "default"):
+        with self._lock:
+            if actor_id is None:
+                actor_id = self.named_actors.get((namespace, name))
+                if actor_id is None:
+                    return None
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return None
+            return {"actor_id": actor.actor_id, "state": actor.state,
+                    "addr": actor.addr, "spec_meta": {
+                        k: actor.spec.get(k)
+                        for k in ("class_name", "max_task_retries",
+                                  "max_restarts", "name", "namespace")},
+                    "num_restarts": actor.num_restarts,
+                    "death_cause": actor.death_cause}
+
+    def rpc_list_actors(self, conn):
+        with self._lock:
+            return [a.snapshot() for a in self.actors.values()]
+
+    def rpc_list_named_actors(self, conn, all_namespaces: bool = False,
+                              namespace: str = "default"):
+        with self._lock:
+            out = []
+            for (ns, name), aid in self.named_actors.items():
+                actor = self.actors.get(aid)
+                if actor is None or actor.state == "DEAD":
+                    continue
+                if all_namespaces or ns == namespace:
+                    out.append({"name": name, "namespace": ns})
+            return out
+
+    # ---- placement groups ---------------------------------------------------
+
+    def rpc_create_placement_group(self, conn, pg_id: bytes,
+                                   bundles: list[dict], strategy: str,
+                                   name: str = ""):
+        if strategy not in PG_STRATEGIES:
+            raise ValueError(f"unknown strategy {strategy}")
+        with self._lock:
+            pg = PlacementGroupInfo(pg_id, bundles, strategy, name)
+            self.placement_groups[pg_id] = pg
+            self._try_schedule_pg(pg)
+            return pg.snapshot()
+
+    def _try_schedule_pg(self, pg: PlacementGroupInfo):
+        """Bundle→node assignment over the live node table. The 2-phase
+        prepare/commit of gcs_placement_group_scheduler.h degenerates to a
+        single atomic pass because GCS owns the resource view (v1: resources
+        are reserved here, raylets enforce)."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return
+        avail = {n.node_id: self._node_available_for_pg(n) for n in alive}
+
+        def fits(node_id, bundle):
+            a = avail[node_id]
+            return all(a.get(k, 0) >= v for k, v in bundle.items())
+
+        def take(node_id, bundle):
+            for k, v in bundle.items():
+                avail[node_id][k] = avail[node_id].get(k, 0) - v
+
+        assignment: list[str | None] = [None] * len(pg.bundles)
+        order = sorted(avail, key=lambda n: -sum(avail[n].values()))
+        if pg.strategy in ("PACK", "STRICT_PACK"):
+            for i, bundle in enumerate(pg.bundles):
+                for node_id in order:
+                    if fits(node_id, bundle):
+                        assignment[i] = node_id
+                        take(node_id, bundle)
+                        break
+            if pg.strategy == "STRICT_PACK" and len(
+                    {a for a in assignment if a}) > 1:
+                assignment = [None] * len(pg.bundles)
+                # retry all on one node
+                for node_id in order:
+                    trial = {k: dict(v) for k, v in avail.items()}
+                    ok = True
+                    for bundle in pg.bundles:
+                        a = trial[node_id]
+                        if all(a.get(k, 0) >= v for k, v in bundle.items()):
+                            for k, v in bundle.items():
+                                a[k] = a.get(k, 0) - v
+                        else:
+                            ok = False
+                            break
+                    if ok:
+                        assignment = [node_id] * len(pg.bundles)
+                        break
+        else:  # SPREAD / STRICT_SPREAD round-robin distinct nodes
+            used: set[str] = set()
+            for i, bundle in enumerate(pg.bundles):
+                candidates = [n for n in order
+                              if fits(n, bundle) and (n not in used or
+                                 pg.strategy == "SPREAD")]
+                prefer = [n for n in candidates if n not in used]
+                pick = (prefer or candidates)[:1]
+                if pick:
+                    assignment[i] = pick[0]
+                    take(pick[0], bundle)
+                    used.add(pick[0])
+        if all(a is not None for a in assignment):
+            pg.bundle_nodes = assignment
+            pg.state = "CREATED"
+            self._publish("placement_groups",
+                          {"event": "created", "pg_id": pg.pg_id,
+                           "bundle_nodes": assignment})
+
+    def _node_available_for_pg(self, node: NodeInfo) -> dict:
+        avail = dict(node.resources)
+        for pg in self.placement_groups.values():
+            if pg.state not in ("CREATED",):
+                continue
+            for bundle, nid in zip(pg.bundles, pg.bundle_nodes):
+                if nid == node.node_id:
+                    for k, v in bundle.items():
+                        avail[k] = avail.get(k, 0) - v
+        return avail
+
+    def rpc_get_placement_group(self, conn, pg_id: bytes = None,
+                                name: str = None):
+        with self._lock:
+            if pg_id is None:
+                for pg in self.placement_groups.values():
+                    if pg.name == name and pg.state != "REMOVED":
+                        return pg.snapshot()
+                return None
+            pg = self.placement_groups.get(pg_id)
+            # Late scheduling: nodes may have joined since creation.
+            if pg is not None and pg.state in ("PENDING", "RESCHEDULING"):
+                self._try_schedule_pg(pg)
+            return pg.snapshot() if pg else None
+
+    def rpc_remove_placement_group(self, conn, pg_id: bytes):
+        with self._lock:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None:
+                return False
+            pg.state = "REMOVED"
+        self._publish("placement_groups", {"event": "removed",
+                                           "pg_id": pg_id})
+        return True
+
+    def rpc_list_placement_groups(self, conn):
+        with self._lock:
+            return [pg.snapshot() for pg in self.placement_groups.values()]
+
+    # ---- pubsub -------------------------------------------------------------
+
+    def rpc_subscribe(self, conn, channels: list[str]):
+        with self._lock:
+            for ch in channels:
+                subs = self._subscribers.setdefault(ch, [])
+                if conn not in subs:
+                    subs.append(conn)
+        return True
+
+    def _publish(self, channel: str, message: dict):
+        subs = list(self._subscribers.get(channel, ()))
+        for conn in subs:
+            if conn.alive:
+                conn.push("pubsub", channel=channel, message=message)
+            else:
+                with self._lock:
+                    try:
+                        self._subscribers[channel].remove(conn)
+                    except ValueError:
+                        pass
+
+    def rpc_publish(self, conn, channel: str, message: dict):
+        self._publish(channel, message)
+        return True
+
+    # ---- snapshot (GCS fault tolerance analog) ------------------------------
+
+    def rpc_save_snapshot(self, conn=None):
+        if not self._snapshot_path:
+            return False
+        with self._lock:
+            blob = pickle.dumps({
+                "kv": self.kv,
+                "named_actors": dict(self.named_actors),
+                "job_counter": self.job_counter,
+                "cluster_id": self.cluster_id,
+            })
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._snapshot_path)
+        return True
+
+    def _load_snapshot(self):
+        with open(self._snapshot_path, "rb") as f:
+            data = pickle.loads(f.read())
+        self.kv = data["kv"]
+        self.named_actors = data["named_actors"]
+        self.job_counter = data["job_counter"]
+        self.cluster_id = data["cluster_id"]
+
+    def rpc_debug_state(self, conn):
+        with self._lock:
+            return {
+                "nodes": len(self.nodes),
+                "alive_nodes": sum(n.alive for n in self.nodes.values()),
+                "actors": len(self.actors),
+                "alive_actors": sum(a.state == "ALIVE"
+                                    for a in self.actors.values()),
+                "objects_tracked": len(self.object_locations),
+                "placement_groups": len(self.placement_groups),
+            }
+
+
+def main():  # pragma: no cover - exercised as a subprocess
+    """Entry point: `python -m ray_tpu._private.gcs <port> [snapshot]`."""
+    import sys
+
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    snap = sys.argv[2] if len(sys.argv) > 2 else None
+    server = GcsServer(port=port, snapshot_path=snap).start()
+    # Report the bound port on stdout for the parent supervisor.
+    print(f"GCS_READY {server.addr[0]}:{server.addr[1]}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,123 @@
+"""ObjectRef — a future naming an immutable object in the cluster.
+
+Analog of the reference's ObjectRef (python/ray/includes/object_ref.pxi,
+ownership model in src/ray/core_worker/reference_count.h): every ref carries
+its id and the address of its *owner* (the worker that submitted the creating
+task or called put), which is the authority for its value/location.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_addr", "_worker", "__weakref__")
+
+    def __init__(self, object_id: bytes, owner_addr=None, worker=None):
+        assert isinstance(object_id, bytes) and len(object_id) == 16
+        self.id = object_id
+        self.owner_addr = tuple(owner_addr) if owner_addr else None
+        # The core worker that materialized this ref in this process; used
+        # for ref-counting on GC. Set by serialization on inbound refs.
+        self._worker = worker
+        if worker is not None:
+            worker.reference_counter.add_local_ref(self.id)
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id
+
+    @staticmethod
+    def nil() -> "ObjectRef":
+        return ObjectRef(b"\0" * 16)
+
+    @staticmethod
+    def from_random() -> "ObjectRef":
+        return ObjectRef(os.urandom(16))
+
+    def future(self):
+        """concurrent.futures-style future for await/as_completed interop."""
+        from ray_tpu._private import api
+
+        return api.get_runtime_context()._worker.as_future(self)
+
+    def __reduce__(self):
+        # Refs travel as (id, owner); the receiving process re-binds them to
+        # its own core worker via serialization context (never naive unpickle
+        # into a dead ref).
+        return (_rebuild_ref, (self.id, self.owner_addr))
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __del__(self):
+        worker = self._worker
+        if worker is not None:
+            try:
+                worker.reference_counter.remove_local_ref(self.id)
+            except Exception:
+                pass
+
+    # Explicitly not awaitable/iterable to fail fast on common misuse.
+    def __iter__(self):
+        raise TypeError(
+            "ObjectRef is not iterable; call ray_tpu.get(ref) first")
+
+
+def _rebuild_ref(object_id: bytes, owner_addr):
+    from ray_tpu._private.worker_runtime import current_worker
+
+    worker = current_worker()
+    return ObjectRef(object_id, owner_addr, worker)
+
+
+class ReferenceCounter:
+    """Process-local ref counting feeding the distributed release protocol.
+
+    Simplified from the reference's owner/borrower protocol
+    (src/ray/core_worker/reference_count.h): each process counts its local
+    Python refs per object id; when an id's count drops to zero the worker
+    notifies the owner, which frees the primary copy once all holders have
+    released. Lineage pinning is not implemented (objects are not
+    reconstructable in v1 — fetch failures raise ObjectLostError).
+    """
+
+    def __init__(self, on_zero=None):
+        self._counts: dict[bytes, int] = {}
+        self._lock = threading.Lock()
+        self._on_zero = on_zero
+
+    def add_local_ref(self, object_id: bytes):
+        with self._lock:
+            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+
+    def remove_local_ref(self, object_id: bytes):
+        notify = False
+        with self._lock:
+            n = self._counts.get(object_id)
+            if n is None:
+                return
+            if n <= 1:
+                del self._counts[object_id]
+                notify = True
+            else:
+                self._counts[object_id] = n - 1
+        if notify and self._on_zero is not None:
+            self._on_zero(object_id)
+
+    def count(self, object_id: bytes) -> int:
+        with self._lock:
+            return self._counts.get(object_id, 0)
+
+    def held_ids(self):
+        with self._lock:
+            return list(self._counts)

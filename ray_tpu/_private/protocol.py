@@ -1,0 +1,314 @@
+"""Control-plane RPC: length-prefixed pickle frames over TCP.
+
+The reference's control plane is gRPC (/root/reference/src/ray/rpc/ —
+GrpcServer, ClientCall); ours is a minimal threaded socket RPC with the same
+shape: persistent bidirectional connections, request/reply correlation ids,
+and one-way pushes. Pickle is safe here because every endpoint belongs to the
+same trust domain (one cluster, one user), exactly like the reference's
+cloudpickled task specs.
+
+Wire format: 8-byte big-endian length, then a pickled (kind, seq, payload)
+tuple. kind is REQUEST/REPLY/PUSH.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+import threading
+import time
+import uuid
+
+REQUEST, REPLY, PUSH = 0, 1, 2
+
+_HDR = struct.Struct(">Q")
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _send_frame(sock: socket.socket, kind: int, seq: int, payload,
+                lock: threading.Lock):
+    buf = io.BytesIO()
+    buf.write(b"\0" * 8)
+    pickle.dump((kind, seq, payload), buf, protocol=pickle.HIGHEST_PROTOCOL)
+    data = buf.getbuffer()
+    _HDR.pack_into(data, 0, len(data) - 8)
+    with lock:
+        sock.sendall(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionLost("peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket):
+    (length,) = _HDR.unpack(_recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, length))
+
+
+class _RemoteError:
+    """Marker wrapper: the handler raised; re-raise at the caller."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class RpcClient:
+    """A persistent connection to one RpcServer. Thread-safe; many in-flight
+    calls multiplex on the connection (like the reference's ClientCallManager,
+    rpc/client_call.h)."""
+
+    def __init__(self, addr: tuple[str, int], timeout: float = 30.0,
+                 on_push=None, retry: int = 3):
+        self.addr = tuple(addr)
+        self._timeout = timeout
+        self._on_push = on_push
+        last = None
+        for attempt in range(retry):
+            try:
+                self._sock = socket.create_connection(self.addr, timeout=timeout)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.05 * (2 ** attempt))
+        else:
+            raise ConnectionLost(f"cannot connect to {self.addr}: {last}")
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._pending: dict[int, _Future] = {}
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"rpc-client-{self.addr}")
+        self._reader.start()
+
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def _read_loop(self):
+        try:
+            while True:
+                kind, seq, payload = _recv_frame(self._sock)
+                if kind == REPLY:
+                    fut = self._pending.pop(seq, None)
+                    if fut is not None:
+                        fut.set(payload)
+                elif kind == PUSH and self._on_push is not None:
+                    try:
+                        self._on_push(payload)
+                    except Exception:
+                        pass
+        except (ConnectionLost, OSError, EOFError, pickle.UnpicklingError):
+            pass
+        finally:
+            self._closed = True
+            err = _RemoteError(ConnectionLost(f"connection to {self.addr} lost"))
+            for fut in list(self._pending.values()):
+                fut.set(err)
+            self._pending.clear()
+
+    def call(self, method: str, timeout: float | None = None, **kwargs):
+        """Synchronous request/reply."""
+        return self.call_async(method, **kwargs).result(
+            timeout if timeout is not None else self._timeout)
+
+    def call_async(self, method: str, **kwargs) -> "_Future":
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.addr} closed")
+        seq = self._next_seq()
+        fut = _Future()
+        self._pending[seq] = fut
+        # Re-check after registering: the reader may have drained _pending on
+        # connection loss between the check above and the insert, which would
+        # leave this future unresolvable.
+        if self._closed:
+            self._pending.pop(seq, None)
+            raise ConnectionLost(f"connection to {self.addr} closed")
+        try:
+            _send_frame(self._sock, REQUEST, seq, (method, kwargs), self._wlock)
+        except OSError as e:
+            self._pending.pop(seq, None)
+            self._closed = True
+            raise ConnectionLost(str(e)) from e
+        return fut
+
+    def push(self, method: str, **kwargs):
+        """One-way message; no reply expected."""
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.addr} closed")
+        try:
+            _send_frame(self._sock, PUSH, 0, (method, kwargs), self._wlock)
+        except OSError as e:
+            self._closed = True
+            raise ConnectionLost(str(e)) from e
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _Future:
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+
+    def set(self, value):
+        self._value = value
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("rpc call timed out")
+        if isinstance(self._value, _RemoteError):
+            raise self._value.exc
+        return self._value
+
+
+class Connection:
+    """Server-side view of one accepted client connection."""
+
+    def __init__(self, sock: socket.socket, addr):
+        self.sock = sock
+        self.peer = addr
+        self.wlock = threading.Lock()
+        self.id = uuid.uuid4().hex
+        self.meta: dict = {}
+        self.alive = True
+
+    def push(self, method: str, **kwargs):
+        try:
+            _send_frame(self.sock, PUSH, 0, (method, kwargs), self.wlock)
+        except OSError:
+            self.alive = False
+
+
+class RpcServer:
+    """Threaded RPC server. A handler object exposes `rpc_<method>` callables;
+    each gets (conn, **kwargs). Raising inside a handler propagates the
+    exception to the caller. A handler may also expose `on_connect(conn)` /
+    `on_disconnect(conn)` for liveness tracking (the reference tracks client
+    death via socket EOF the same way, common/client_connection.h)."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(512)
+        self.addr = self._listener.getsockname()
+        self._stopped = False
+        self._conns: dict[str, Connection] = {}
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"rpc-accept-{self.addr[1]}")
+
+    def start(self):
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = Connection(sock, addr)
+            self._conns[conn.id] = conn
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True,
+                             name=f"rpc-conn-{addr}").start()
+
+    def _serve_conn(self, conn: Connection):
+        on_connect = getattr(self._handler, "on_connect", None)
+        if on_connect is not None:
+            on_connect(conn)
+        try:
+            while not self._stopped:
+                kind, seq, payload = _recv_frame(conn.sock)
+                method, kwargs = payload
+                if kind == REQUEST:
+                    threading.Thread(
+                        target=self._dispatch, args=(conn, seq, method, kwargs),
+                        daemon=True).start()
+                elif kind == PUSH:
+                    try:
+                        self._lookup(method)(conn, **kwargs)
+                    except Exception:
+                        pass
+        except (ConnectionLost, OSError, EOFError, pickle.UnpicklingError):
+            pass
+        finally:
+            conn.alive = False
+            self._conns.pop(conn.id, None)
+            on_disconnect = getattr(self._handler, "on_disconnect", None)
+            if on_disconnect is not None:
+                try:
+                    on_disconnect(conn)
+                except Exception:
+                    pass
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+    def _lookup(self, method: str):
+        fn = getattr(self._handler, f"rpc_{method}", None)
+        if fn is None:
+            raise RpcError(f"no such rpc method: {method}")
+        return fn
+
+    def _dispatch(self, conn: Connection, seq: int, method: str, kwargs):
+        try:
+            result = self._lookup(method)(conn, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — ship handler errors back
+            result = _RemoteError(e)
+        try:
+            _send_frame(conn.sock, REPLY, seq, result, conn.wlock)
+        except OSError:
+            conn.alive = False
+
+    def connections(self):
+        return list(self._conns.values())
+
+    def stop(self):
+        self._stopped = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in list(self._conns.values()):
+            try:
+                conn.sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,54 @@
+"""Worker process entry point — forked by the raylet's worker pool.
+
+Analog of the reference's default_worker.py
+(/root/reference/python/ray/_private/workers/default_worker.py): connect the
+core worker to this node's raylet/GCS/store, then serve the task execution
+loop until the raylet (or an actor kill) terminates us.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+
+def main():
+    gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].split(":")
+    raylet_host, raylet_port = os.environ["RAY_TPU_RAYLET_ADDR"].split(":")
+
+    from ray_tpu._private.protocol import ConnectionLost
+    from ray_tpu._private.worker_runtime import CoreWorker, set_current_worker
+
+    try:
+        worker = CoreWorker(
+            gcs_addr=(gcs_host, int(gcs_port)),
+            raylet_addr=(raylet_host, int(raylet_port)),
+            mode="worker",
+            store_name=os.environ.get("RAY_TPU_STORE_NAME"),
+            spill_dir=os.environ.get("RAY_TPU_SPILL_DIR"),
+            worker_id=os.environ.get("RAY_TPU_WORKER_ID"),
+            job_id=0,
+        )
+    except ConnectionLost:
+        # Cluster shut down while we were starting (e.g. a prestarted worker
+        # racing teardown) — exit quietly.
+        return 0
+    set_current_worker(worker)
+
+    def _term(signum, frame):
+        worker.stopped = True
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+
+    # The RPC server threads do the work; park the main thread. If the raylet
+    # connection drops the node is gone — exit.
+    while True:
+        time.sleep(0.5)
+        if worker.raylet.closed:
+            os._exit(1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,150 @@
+"""Lazy task/actor DAG authoring (reference: python/ray/dag/dag_node.py —
+DAGNode, FunctionNode, ClassNode, ClassMethodNode, InputNode).
+
+`fn.bind(...)` builds nodes without executing; `node.execute(*inputs)`
+materializes the graph into tasks/actor calls and returns ObjectRefs. This is
+the substrate for Serve deployment graphs and Workflow DAGs.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+class DAGNode:
+    """A node in a lazily-built computation graph."""
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- traversal ------------------------------------------------------------
+
+    def _map_children(self, fn):
+        args = tuple(fn(a) if isinstance(a, DAGNode) else a
+                     for a in self._bound_args)
+        kwargs = {k: fn(v) if isinstance(v, DAGNode) else v
+                  for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def children(self) -> list["DAGNode"]:
+        out = [a for a in self._bound_args if isinstance(a, DAGNode)]
+        out += [v for v in self._bound_kwargs.values()
+                if isinstance(v, DAGNode)]
+        return out
+
+    def execute(self, *input_values, _cache: dict | None = None):
+        """Materialize the DAG rooted here. Shared sub-nodes execute once."""
+        cache: dict[int, Any] = {} if _cache is None else _cache
+        return self._execute_impl(input_values, cache)
+
+    def _execute_impl(self, input_values, cache):
+        key = id(self)
+        if key in cache:
+            return cache[key]
+        args, kwargs = self._map_children(
+            lambda child: child._execute_impl(input_values, cache))
+        result = self._execute_self(args, kwargs, input_values)
+        cache[key] = result
+        return result
+
+    def _execute_self(self, args, kwargs, input_values):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value supplied at execute() time. Supports
+    `with InputNode() as x:` authoring like the reference."""
+
+    def __init__(self, index: int = 0):
+        super().__init__((), {})
+        self._index = index
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def _execute_self(self, args, kwargs, input_values):
+        if self._index >= len(input_values):
+            raise ValueError(
+                f"DAG executed with {len(input_values)} inputs but an "
+                f"InputNode expects index {self._index}")
+        return input_values[self._index]
+
+
+class FunctionNode(DAGNode):
+    """fn.bind(...) — a task invocation."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_self(self, args, kwargs, input_values):
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """ActorClass.bind(...) — an actor instantiation."""
+
+    def __init__(self, actor_cls, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+
+    def _execute_self(self, args, kwargs, input_values):
+        return self._actor_cls.remote(*args, **kwargs)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundMethodNode(self, name)
+
+
+class _UnboundMethodNode:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs):
+        return ClassMethodNode(
+            (self._class_node, self._method_name), args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    """actor_method.bind(...) or class_node.method.bind(...)."""
+
+    def __init__(self, target, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._target = target
+
+    def children(self):
+        out = super().children()
+        if isinstance(self._target, tuple):
+            out.append(self._target[0])
+        return out
+
+    def _execute_self(self, args, kwargs, input_values):
+        if isinstance(self._target, tuple):   # (ClassNode, method_name)
+            class_node, method_name = self._target
+            handle = class_node._execute_impl(input_values, {})
+            return getattr(handle, method_name).remote(*args, **kwargs)
+        return self._target.remote(*args, **kwargs)
+
+    def _execute_impl(self, input_values, cache):
+        key = id(self)
+        if key in cache:
+            return cache[key]
+        args, kwargs = self._map_children(
+            lambda child: child._execute_impl(input_values, cache))
+        if isinstance(self._target, tuple):
+            class_node, method_name = self._target
+            handle = class_node._execute_impl(input_values, cache)
+            result = getattr(handle, method_name).remote(*args, **kwargs)
+        else:
+            result = self._target.remote(*args, **kwargs)
+        cache[key] = result
+        return result
+
+
+__all__ = ["DAGNode", "InputNode", "FunctionNode", "ClassNode",
+           "ClassMethodNode"]

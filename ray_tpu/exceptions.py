@@ -13,12 +13,31 @@ class RayTpuError(Exception):
 
 class TaskError(RayTpuError):
     """A task raised an exception; re-raised at `get()` with the remote
-    traceback attached."""
+    traceback attached. If the original exception pickled cleanly it is
+    available as `.cause` (and raised `from` it)."""
 
-    def __init__(self, cause_cls_name: str, traceback_str: str):
+    def __init__(self, cause_cls_name: str, traceback_str: str,
+                 cause: BaseException | None = None, task_desc: str = ""):
         self.cause_cls_name = cause_cls_name
         self.traceback_str = traceback_str
-        super().__init__(f"{cause_cls_name} raised in remote task:\n{traceback_str}")
+        self.cause = cause
+        self.task_desc = task_desc
+        where = f" in {task_desc}" if task_desc else ""
+        super().__init__(
+            f"{cause_cls_name} raised{where}:\n{traceback_str}")
+        if cause is not None:
+            self.__cause__ = cause
+
+    def __reduce__(self):
+        try:
+            import pickle
+
+            pickle.dumps(self.cause)
+            cause = self.cause
+        except Exception:
+            cause = None
+        return (type(self), (self.cause_cls_name, self.traceback_str,
+                             cause, self.task_desc))
 
 
 class WorkerCrashedError(RayTpuError):
@@ -73,3 +92,15 @@ class PlacementGroupUnschedulableError(RayTpuError):
 
 class CrossLanguageError(RayTpuError):
     pass
+
+
+class RaySystemError(RayTpuError):
+    """An internal framework component failed (narrow subclass — catching it
+    must NOT swallow user-code TaskErrors, matching reference semantics)."""
+
+
+# Reference-API-compatible aliases (python/ray/exceptions.py names) so users
+# migrating from the reference find the names they expect.
+RayError = RayTpuError
+RayTaskError = TaskError
+RayActorError = ActorDiedError

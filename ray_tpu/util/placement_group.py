@@ -1,0 +1,108 @@
+"""Placement groups — gang reservation of resource bundles across nodes.
+
+Reference: python/ray/util/placement_group.py (placement_group() at :128,
+PlacementGroup.ready/wait at :33, remove at :233) and the GCS-side 2-phase
+scheduler (src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h). The
+TPU-relevant extension is that bundles carrying a "TPU" resource are packed
+onto nodes within one ICI domain when possible (v1: node-level packing; slice
+topology awareness lands with the multi-host scheduler).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from ray_tpu._private import api
+from ray_tpu.exceptions import PlacementGroupUnschedulableError
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: bytes):
+        self.id = pg_id
+
+    def ready(self):
+        """ObjectRef resolving when the PG is created (reference returns a
+        ref from an internal task; we do the same with a waiter task)."""
+        pg_id = self.id
+
+        @api.remote
+        def _pg_ready_waiter():
+            # runs on any worker; PG readiness is a GCS question
+            from ray_tpu._private.worker_runtime import current_worker
+
+            worker = current_worker()
+            deadline = time.time() + 300.0
+            while time.time() < deadline:
+                snap = worker.gcs.call("get_placement_group", pg_id=pg_id)
+                if snap and snap["State"] == "CREATED":
+                    return True
+                time.sleep(0.05)
+            raise PlacementGroupUnschedulableError(
+                f"placement group {pg_id.hex()} not schedulable")
+
+        return _pg_ready_waiter.options(num_cpus=0.0).remote()
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        worker = api._require_worker()
+        deadline = time.time() + timeout_seconds
+        while time.time() < deadline:
+            snap = worker.gcs.call("get_placement_group", pg_id=self.id)
+            if snap and snap["State"] == "CREATED":
+                return True
+            time.sleep(0.05)
+        return False
+
+    @property
+    def bundle_specs(self):
+        worker = api._require_worker()
+        snap = worker.gcs.call("get_placement_group", pg_id=self.id)
+        return snap["Bundles"] if snap else []
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id,))
+
+
+def placement_group(bundles: list[dict], strategy: str = "PACK",
+                    name: str = "", lifetime=None) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(
+            f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty "
+                         "resource dicts")
+    worker = api._require_worker()
+    pg_id = os.urandom(16)
+    worker.gcs.call("create_placement_group", pg_id=pg_id,
+                    bundles=[{k: float(v) for k, v in b.items()}
+                             for b in bundles],
+                    strategy=strategy, name=name)
+    return PlacementGroup(pg_id)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    worker = api._require_worker()
+    worker.gcs.call("remove_placement_group", pg_id=pg.id)
+
+
+def get_placement_group(name: str) -> PlacementGroup:
+    worker = api._require_worker()
+    snap = worker.gcs.call("get_placement_group", name=name)
+    if snap is None:
+        raise ValueError(f"placement group {name!r} not found")
+    return PlacementGroup(bytes.fromhex(snap["PlacementGroupID"]))
+
+
+def placement_group_table():
+    worker = api._require_worker()
+    return {s["PlacementGroupID"]: s
+            for s in worker.gcs.call("list_placement_groups")}
+
+
+def get_current_placement_group():
+    return None   # capture of child tasks into the caller's PG: not yet

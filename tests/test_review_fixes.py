@@ -1,0 +1,158 @@
+"""Regression tests for review findings: cancel, zero-cpu tasks, option
+immutability, re-init function registration, kill-then-call, DAG binding."""
+import time
+
+import pytest
+
+
+def test_cancel_running_task(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def hang():
+        time.sleep(60)
+        return "finished"
+
+    ref = hang.remote()
+    time.sleep(1.0)   # let it start
+    ray.cancel(ref, force=True)
+    with pytest.raises((ray.exceptions.TaskCancelledError,
+                        ray.exceptions.TaskError)):
+        ray.get(ref, timeout=20)
+
+
+def test_cancel_queued_task(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(num_cpus=4)
+    def block():
+        time.sleep(5)
+
+    @ray.remote(num_cpus=4)
+    def queued():
+        return "ran"
+
+    b = block.remote()
+    time.sleep(0.5)
+    q = queued.remote()   # can't start while block holds all CPUs
+    ray.cancel(q)
+    with pytest.raises(ray.exceptions.TaskCancelledError):
+        ray.get(q, timeout=30)
+    ray.get(b)  # drain
+
+
+def test_zero_cpu_task(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(num_cpus=4)
+    def hog():
+        time.sleep(1.5)
+        return "hog"
+
+    @ray.remote(num_cpus=0)
+    def featherweight():
+        return "light"
+
+    h = hog.remote()
+    time.sleep(0.3)
+    # zero-cpu task must run even with all CPUs held
+    t0 = time.time()
+    assert ray.get(featherweight.remote(), timeout=10) == "light"
+    assert time.time() - t0 < 1.0, "zero-cpu task waited for CPU resources"
+    ray.get(h)
+
+
+def test_num_gpus_alias_stable_across_calls(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu._private.api import _build_resources
+
+    opts = {"num_cpus": 1, "num_gpus": 2}
+    first = _build_resources(opts)
+    second = _build_resources(opts)
+    assert first == second == {"CPU": 1.0, "TPU": 2.0}
+    assert opts.get("num_gpus") == 2   # not mutated
+
+
+def test_function_reregistered_after_reinit():
+    import ray_tpu as ray
+
+    @ray.remote
+    def f():
+        return 42
+
+    ray.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        assert ray.get(f.remote(), timeout=30) == 42
+    finally:
+        ray.shutdown()
+    ray.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        # second runtime has a fresh GCS function table
+        assert ray.get(f.remote(), timeout=30) == 42
+    finally:
+        ray.shutdown()
+
+
+def test_call_after_kill_fails_fast(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray.get(v.ping.remote()) == "pong"
+    ray.kill(v)
+    time.sleep(1.0)
+    # must resolve to an error, not hang forever
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(v.ping.remote(), timeout=30)
+
+
+def test_system_error_is_narrow():
+    from ray_tpu import exceptions as exc
+
+    assert not issubclass(exc.TaskError, exc.RaySystemError)
+    assert issubclass(exc.RaySystemError, exc.RayTpuError)
+    err = exc.TaskError("ValueError", "tb", cause=ValueError("x"))
+    assert err.__cause__ is err.cause
+
+
+def test_dag_bind_execute(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.dag import InputNode
+
+    @ray.remote
+    def double(x):
+        return 2 * x
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        d = double.bind(inp)
+        out = add.bind(d, d)   # shared sub-node executes once
+
+    ref = out.execute(5)
+    assert ray.get(ref, timeout=30) == 20
+
+
+def test_dag_actor_bind(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.dag import InputNode
+
+    @ray.remote
+    class Acc:
+        def __init__(self, base):
+            self.base = base
+
+        def add(self, x):
+            return self.base + x
+
+    with InputNode() as inp:
+        node = Acc.bind(100)
+        out = node.add.bind(inp)
+
+    assert ray.get(out.execute(5), timeout=30) == 105
