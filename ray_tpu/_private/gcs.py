@@ -477,9 +477,12 @@ class GcsServer:
         if all(a is not None for a in assignment):
             pg.bundle_nodes = assignment
             pg.state = "CREATED"
+            # bundles ride along so raylets can reserve without calling back
+            # into GCS (the push handler runs on their RPC reader thread)
             self._publish("placement_groups",
                           {"event": "created", "pg_id": pg.pg_id,
-                           "bundle_nodes": assignment})
+                           "bundle_nodes": assignment,
+                           "bundles": [dict(b) for b in pg.bundles]})
 
     def _node_available_for_pg(self, node: NodeInfo) -> dict:
         avail = dict(node.resources)

@@ -137,6 +137,9 @@ class Raylet:
     # ---- GCS pushes ---------------------------------------------------------
 
     def _on_gcs_push(self, payload):
+        """Runs on the GCS RpcClient's reader thread — must NEVER issue a
+        synchronous call back over the same connection (the reply could not
+        be read). Handlers are either local-only or spawn a thread."""
         method, kwargs = payload
         if method == "free_objects":
             for oid in kwargs["object_ids"]:
@@ -150,17 +153,15 @@ class Raylet:
         elif method == "pubsub" and kwargs.get("channel") == "placement_groups":
             msg = kwargs["message"]
             if msg["event"] == "created":
-                self._reserve_pg_bundles(msg["pg_id"], msg["bundle_nodes"])
+                self._reserve_pg_bundles(msg["pg_id"], msg["bundle_nodes"],
+                                         msg["bundles"])
             elif msg["event"] == "removed":
                 self._release_pg_bundles(msg["pg_id"])
 
-    def _reserve_pg_bundles(self, pg_id: bytes, bundle_nodes: list[str]):
-        pg = self._gcs.call("get_placement_group", pg_id=pg_id)
-        if not pg:
-            return
+    def _reserve_pg_bundles(self, pg_id: bytes, bundle_nodes: list[str],
+                            bundles: list[dict]):
         with self._lock:
-            for i, (bundle, nid) in enumerate(
-                    zip(pg["Bundles"], bundle_nodes)):
+            for i, (bundle, nid) in enumerate(zip(bundles, bundle_nodes)):
                 key = (pg_id, i)
                 if nid == self.node_id and key not in self._pg_reserved:
                     for k, v in bundle.items():
@@ -533,21 +534,40 @@ class Raylet:
                 time.sleep(_LEASE_QUEUE_POLL)
             reserved = resources
         resources = reserved
-        worker = self._pop_worker()
-        worker.is_actor = True
-        worker.actor_id = actor_id
-        lease_id = uuid.uuid4().hex
-        lease = Lease(lease_id, resources, worker)
-        worker.assigned_lease = lease_id
-        with self._lock:
-            self._leases[lease_id] = lease
-        # Tell the worker to become this actor.
-        client = RpcClient(worker.addr, timeout=60.0)
+        worker = None
         try:
-            client.call("become_actor", actor_id=actor_id, spec=spec,
-                        timeout=spec.get("creation_timeout", 60.0))
-        finally:
-            client.close()
+            worker = self._pop_worker()
+            worker.is_actor = True
+            worker.actor_id = actor_id
+            lease_id = uuid.uuid4().hex
+            lease = Lease(lease_id, resources, worker)
+            worker.assigned_lease = lease_id
+            with self._lock:
+                self._leases[lease_id] = lease
+            # Tell the worker to become this actor.
+            client = RpcClient(worker.addr, timeout=60.0)
+            try:
+                client.call("become_actor", actor_id=actor_id, spec=spec,
+                            timeout=spec.get("creation_timeout", 60.0))
+            finally:
+                client.close()
+        except BaseException:
+            # Failed creation must not leak the reservation (or the worker —
+            # a half-initialized actor process is not reusable). If the
+            # worker died mid-creation, _on_worker_exit may have already
+            # popped the lease and returned the resources — only give back
+            # when we pop the lease ourselves (or never registered one).
+            with self._lock:
+                if worker is None or worker.assigned_lease is None:
+                    self._give_back(resources)
+                elif self._leases.pop(worker.assigned_lease,
+                                      None) is not None:
+                    self._give_back(resources)
+            if worker is not None:
+                worker.is_actor = False
+                with self._lock:
+                    self._kill_worker(worker)
+            raise
         return {"granted": {"worker_id": worker.worker_id,
                             "worker_addr": worker.addr,
                             "node_id": self.node_id,

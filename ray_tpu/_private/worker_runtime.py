@@ -391,7 +391,12 @@ class CoreWorker:
         self.worker_id = worker_id or uuid.uuid4().hex[:16]
         self.stopped = False
         self.memory_store = MemoryStore()
-        self.reference_counter = ReferenceCounter()
+        self.reference_counter = ReferenceCounter(
+            on_zero=self._on_local_refs_zero)
+        self._owned: set[bytes] = set()      # ids this process owns
+        self._arg_pins: dict[bytes, int] = {}  # in-flight task-arg pins
+        self._deferred_free: set[bytes] = set()
+        self._actor_concurrency = threading.Semaphore(1)
         self._func_cache: dict[bytes, object] = {}
         self._sched_queues: dict[tuple, _SchedulingKeyQueue] = {}
         self._actor_queues: dict[bytes, _ActorQueue] = {}
@@ -449,8 +454,62 @@ class CoreWorker:
         self.store.put(object_id, data)
         self.gcs.push("add_object_location", object_id=object_id,
                       node_id=self.node_id, size=len(data))
+        self._owned.add(object_id)
         ref = ObjectRef(object_id, self.addr, self)
         return ref
+
+    # ---- distributed release (simplified owner-based protocol; reference:
+    # src/ray/core_worker/reference_count.h). The owner frees an object when
+    # its own local Python refs hit zero and no in-flight task of this
+    # process uses it as an argument. v1 limitation vs the reference's full
+    # borrower protocol: a remote process that stashes a deserialized ref
+    # beyond its task's lifetime does not extend the object's life.
+
+    def _on_local_refs_zero(self, object_id: bytes):
+        if self.stopped:
+            return
+        with self._lock:
+            if self._arg_pins.get(object_id):
+                self._deferred_free.add(object_id)
+                return
+        self._free_object(object_id)
+
+    def _free_object(self, object_id: bytes):
+        self.memory_store.free(object_id)
+        with self._lock:
+            self._ref_to_task.pop(object_id, None)
+            owned = object_id in self._owned
+            self._owned.discard(object_id)
+        if owned:
+            try:
+                self.gcs.push("free_objects", object_ids=[object_id])
+            except Exception:
+                pass
+
+    def _pin_args(self, spec: dict, args, kwargs):
+        ids = [r.id for r in ser.contained_refs((args, kwargs))]
+        if not ids:
+            return
+        spec["_arg_ids"] = ids   # stripped before the wire (leading _)
+        with self._lock:
+            for oid in ids:
+                self._arg_pins[oid] = self._arg_pins.get(oid, 0) + 1
+
+    def _unpin_args(self, spec: dict):
+        to_free = []
+        with self._lock:
+            for oid in spec.get("_arg_ids", ()):
+                n = self._arg_pins.get(oid, 0) - 1
+                if n <= 0:
+                    self._arg_pins.pop(oid, None)
+                    if oid in self._deferred_free and \
+                            self.reference_counter.count(oid) == 0:
+                        self._deferred_free.discard(oid)
+                        to_free.append(oid)
+                else:
+                    self._arg_pins[oid] = n
+        for oid in to_free:
+            self._free_object(oid)
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -659,6 +718,8 @@ class CoreWorker:
             "task_desc": task_desc,
             "job_id": self.job_id,
         }
+        self._pin_args(spec, args, kwargs)
+        self._owned.update(return_ids)
         refs = [ObjectRef(rid, self.addr, self) for rid in return_ids]
         for rid in return_ids:
             self.memory_store.entry(rid)  # pre-create pending futures
@@ -711,7 +772,9 @@ class CoreWorker:
                 target = opened
             raise RuntimeError("lease spillback loop exceeded")
         finally:
-            if opened is not None and opened is not target:
+            # the grant reply carries everything we need (worker addr,
+            # node id); the raylet connection is not kept
+            if opened is not None:
                 opened.close()
 
     def return_lease(self, lw: _LeasedWorker):
@@ -743,31 +806,22 @@ class CoreWorker:
             self.memory_store.put(rid, data)
             with self._lock:
                 self._ref_to_task.pop(rid, None)
+        self._unpin_args(spec)
 
     def _handle_task_reply(self, spec: dict, reply: dict, node_id):
         with self._lock:
             for rid in spec["return_ids"]:
                 self._ref_to_task.pop(rid, None)
+        self._unpin_args(spec)
         if reply.get("cancelled"):
             self._fail_task(spec, exc.TaskCancelledError(
                 spec.get("task_desc", "task")))
             return
         results = reply.get("results", {})
-        for rid in spec["return_ids"]:
-            if rid in results:
-                self.memory_store.put(rid, results[rid])
-            else:
-                # stored in shm on the executing node; owner records a
-                # memory-store marker? No: leave resolution to the store /
-                # directory. Mark the pending entry resolved lazily on get.
-                pass
-        if reply.get("stored"):
-            # Wake any local waiters: the object is now fetchable.
-            for rid in reply["stored"]:
-                entry = self.memory_store.entry(rid)
-                if not entry.event.is_set():
-                    # don't set data (it's in shm); but release get() spinners
-                    pass
+        for rid, data in results.items():
+            self.memory_store.put(rid, data)
+        # returns listed in reply["stored"] live in a shm store and resolve
+        # through the object directory in _fetch_bytes
 
     # --------------------------------------------------------------- actors
 
@@ -841,6 +895,8 @@ class CoreWorker:
             "task_desc": task_desc or f"actor method {method_name}",
             "job_id": self.job_id,
         }
+        self._pin_args(spec, args, kwargs)
+        self._owned.update(return_ids)
         refs = [ObjectRef(rid, self.addr, self) for rid in return_ids]
         for rid in return_ids:
             self.memory_store.entry(rid)
@@ -938,12 +994,17 @@ class CoreWorker:
                 return self._package_results(spec, None)
             method = getattr(self._actor_instance, method_name)
             args, kwargs = self._resolve_args(spec)
-            if inspect.iscoroutinefunction(method):
-                fut = asyncio.run_coroutine_threadsafe(
-                    method(*args, **kwargs), self._ensure_async_loop())
-                result = fut.result()
-            else:
-                result = method(*args, **kwargs)
+            # max_concurrency gate: callers from different processes each
+            # arrive on their own handler thread; the semaphore (default 1)
+            # restores the serial-execution guarantee across ALL callers
+            # (reference: concurrency_group_manager.h / max_concurrency).
+            with self._actor_concurrency:
+                if inspect.iscoroutinefunction(method):
+                    fut = asyncio.run_coroutine_threadsafe(
+                        method(*args, **kwargs), self._ensure_async_loop())
+                    result = fut.result()
+                else:
+                    result = method(*args, **kwargs)
             return self._package_results(spec, result)
         except BaseException as e:  # noqa: BLE001
             return self._package_error(spec, e)
@@ -1001,6 +1062,8 @@ class CoreWorker:
         self._ready.wait(30.0)
         self.actor_id = actor_id
         self._actor_spec = spec
+        self._actor_concurrency = threading.Semaphore(
+            max(1, int(spec.get("max_concurrency", 1) or 1)))
         cls = self._load_function(spec["class_hash"])
         args, kwargs = ser.deserialize(spec["args"], self)
         args = [self.get(a) if isinstance(a, ObjectRef) else a for a in args]

@@ -1,0 +1,9 @@
+from ray_tpu.air import session  # noqa: F401
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result  # noqa: F401

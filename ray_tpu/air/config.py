@@ -1,0 +1,62 @@
+"""AIR-style structured configs (reference: python/ray/air/config.py —
+ScalingConfig, RunConfig, FailureConfig, CheckpointConfig dataclasses)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers and what each needs.
+
+    TPU-native: `use_tpu` + `chips_per_worker` replace the reference's
+    use_gpu/num_gpus (one worker per TPU host, holding all its chips, is the
+    canonical multi-controller JAX layout)."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int | None = None
+    resources_per_worker: dict | None = None
+    placement_strategy: str = "PACK"
+    trainer_resources: dict | None = None
+
+    @property
+    def num_chips(self) -> int:
+        return (self.chips_per_worker or (1 if self.use_tpu else 0)) \
+            * self.num_workers
+
+    def worker_resources(self) -> dict:
+        res = dict(self.resources_per_worker or {"CPU": 1})
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = self.chips_per_worker or 1
+        return res
+
+    def as_placement_group_bundles(self) -> list[dict]:
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    """(reference: air/config.py FailureConfig) max_failures=-1 → unlimited
+    retries of the whole training run (gang restart, not per-worker)."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+    stop: dict | None = None
+    verbose: int = 1

@@ -1,0 +1,17 @@
+"""Training outcome (reference: python/ray/air/result.py)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Result:
+    metrics: dict = field(default_factory=dict)
+    checkpoint: object = None
+    error: BaseException | None = None
+    metrics_history: list = field(default_factory=list)
+    path: str | None = None
+
+    @property
+    def best_checkpoints(self):
+        return [(self.checkpoint, self.metrics)] if self.checkpoint else []

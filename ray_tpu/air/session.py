@@ -1,0 +1,76 @@
+"""Worker-facing training session API (reference: python/ray/air/session.py —
+report :41, get_world_rank :220, get_dataset_shard :345).
+
+Inside a training worker, `session.report(metrics, checkpoint=...)` streams
+an intermediate result back to the trainer; rank/size accessors describe the
+worker's place in the gang. The active session is process-global state set
+by the train worker actor before the user function runs.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class _Session:
+    def __init__(self, world_rank: int, world_size: int, local_rank: int = 0,
+                 dataset_shards: dict | None = None, trial_info=None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.dataset_shards = dataset_shards or {}
+        self.trial_info = trial_info
+        self.results: queue.Queue = queue.Queue()
+        self.finished = threading.Event()
+        self.error: BaseException | None = None
+        self.iteration = 0
+
+    def report(self, metrics: dict, checkpoint=None):
+        self.iteration += 1
+        self.results.put({"metrics": dict(metrics),
+                          "checkpoint": checkpoint,
+                          "iteration": self.iteration,
+                          "world_rank": self.world_rank})
+
+
+_active: _Session | None = None
+_lock = threading.Lock()
+
+
+def _set_session(sess: _Session | None):
+    global _active
+    with _lock:
+        _active = sess
+
+
+def _get_session() -> _Session:
+    if _active is None:
+        raise RuntimeError(
+            "session API used outside a training worker — these functions "
+            "only work inside a train_loop_per_worker")
+    return _active
+
+
+def report(metrics: dict, *, checkpoint=None):
+    _get_session().report(metrics, checkpoint)
+
+
+def get_world_rank() -> int:
+    return _get_session().world_rank
+
+
+def get_world_size() -> int:
+    return _get_session().world_size
+
+
+def get_local_rank() -> int:
+    return _get_session().local_rank
+
+
+def get_dataset_shard(dataset_name: str = "train"):
+    return _get_session().dataset_shards.get(dataset_name)
+
+
+def get_trial_name() -> str:
+    info = _get_session().trial_info
+    return info.get("name", "") if info else ""

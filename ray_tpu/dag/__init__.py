@@ -123,13 +123,6 @@ class ClassMethodNode(DAGNode):
             out.append(self._target[0])
         return out
 
-    def _execute_self(self, args, kwargs, input_values):
-        if isinstance(self._target, tuple):   # (ClassNode, method_name)
-            class_node, method_name = self._target
-            handle = class_node._execute_impl(input_values, {})
-            return getattr(handle, method_name).remote(*args, **kwargs)
-        return self._target.remote(*args, **kwargs)
-
     def _execute_impl(self, input_values, cache):
         key = id(self)
         if key in cache:

@@ -1,0 +1,12 @@
+from ray_tpu.train.backend_executor import (  # noqa: F401
+    Backend,
+    BackendExecutor,
+    JaxBackend,
+    JaxConfig,
+)
+from ray_tpu.train.trainer import (  # noqa: F401
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+)
+from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401

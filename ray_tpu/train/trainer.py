@@ -1,0 +1,164 @@
+"""Trainers (reference: python/ray/train/base_trainer.py:38 BaseTrainer.fit
+:339; data_parallel_trainer.py:55 DataParallelTrainer).
+
+JaxTrainer is the flagship: gang-schedules a worker per TPU host, wires the
+data-parallel backend, streams results/checkpoints, returns a Result. The
+reference wraps trainers in Tune trainables; here fit() drives the
+BackendExecutor directly, and the Tune layer wraps Trainer the same way when
+sweeping.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend_executor import BackendExecutor, JaxConfig
+
+
+class BaseTrainer:
+    def __init__(self, *, scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 datasets: dict | None = None,
+                 resume_from_checkpoint: Checkpoint | None = None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Adapter for the Tune layer: a function trainable running one
+        fit() per trial config (reference: base_trainer.py:369)."""
+        trainer = self
+
+        def _trainable(config):
+            from ray_tpu.air import session
+
+            t = trainer.with_updated_config(config)
+            result = t.fit()
+            if result.error is not None:
+                raise result.error
+            session.report(result.metrics, checkpoint=result.checkpoint)
+
+        return _trainable
+
+    def with_updated_config(self, config: dict) -> "BaseTrainer":
+        return self
+
+
+class DataParallelTrainer(BaseTrainer):
+    """(reference: data_parallel_trainer.py:55) Runs `train_loop_per_worker`
+    on every worker of the gang; workers cooperate via the collective group
+    (host backend) or a shared jax mesh (distributed mode)."""
+
+    def __init__(self, train_loop_per_worker, *,
+                 train_loop_config: dict | None = None,
+                 backend_config: JaxConfig | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 datasets: dict | None = None,
+                 resume_from_checkpoint: Checkpoint | None = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config, datasets=datasets,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = dict(train_loop_config or {})
+        self.backend_config = backend_config or JaxConfig()
+
+    def with_updated_config(self, config: dict) -> "DataParallelTrainer":
+        merged = {**self.train_loop_config, **config}
+        return type(self)(
+            self.train_loop_per_worker, train_loop_config=merged,
+            backend_config=self.backend_config,
+            scaling_config=self.scaling_config, run_config=self.run_config,
+            datasets=self.datasets,
+            resume_from_checkpoint=self.resume_from_checkpoint)
+
+    def fit(self) -> Result:
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        while True:
+            try:
+                return self._fit_once()
+            except Exception:
+                attempt += 1
+                if max_failures != -1 and attempt > max_failures:
+                    raise
+                time.sleep(min(2.0 * attempt, 10.0))
+
+    def _fit_once(self) -> Result:
+        executor = BackendExecutor(self.backend_config,
+                                   self.scaling_config).start()
+        try:
+            self._setup_datasets(executor)
+            config = dict(self.train_loop_config)
+            if self.resume_from_checkpoint is not None:
+                config["_resume_checkpoint"] = self.resume_from_checkpoint
+            executor.start_training(self.train_loop_per_worker, config)
+            return self._drive(executor)
+        finally:
+            executor.shutdown()
+
+    def _setup_datasets(self, executor):
+        for name, ds in self.datasets.items():
+            shards = self._shard_dataset(ds, self.scaling_config.num_workers)
+            executor.set_dataset_shards(name, shards)
+
+    @staticmethod
+    def _shard_dataset(ds, n: int):
+        # ray_tpu.data Dataset → split; plain lists/arrays → even chunks
+        if hasattr(ds, "split"):
+            return ds.split(n)
+        size = len(ds)
+        chunk = (size + n - 1) // n
+        return [ds[i * chunk:(i + 1) * chunk] for i in range(n)]
+
+    def _drive(self, executor) -> Result:
+        history: list[dict] = []
+        final_checkpoint = None
+        storage = self.run_config.storage_path
+        ckpt_dir = None
+        if storage:
+            ckpt_dir = os.path.join(
+                storage, self.run_config.name or "train_run")
+            os.makedirs(ckpt_dir, exist_ok=True)
+        kept: list[str] = []
+        num_keep = self.run_config.checkpoint_config.num_to_keep
+        while True:
+            rows = executor.next_results()
+            done = [r for r in rows if r.get("done")]
+            if done:
+                errors = [r["error"] for r in done if r.get("error")]
+                if errors:
+                    return Result(
+                        metrics=history[-1] if history else {},
+                        checkpoint=final_checkpoint,
+                        error=errors[0], metrics_history=history,
+                        path=ckpt_dir)
+                break
+            rank0 = next(r for r in rows if r["world_rank"] == 0)
+            history.append(rank0["metrics"])
+            if rank0.get("checkpoint") is not None:
+                final_checkpoint = rank0["checkpoint"]
+                if ckpt_dir:
+                    path = os.path.join(
+                        ckpt_dir, f"checkpoint_{rank0['iteration']:06d}")
+                    final_checkpoint.to_directory(path)
+                    kept.append(path)
+                    if num_keep and len(kept) > num_keep:
+                        import shutil
+
+                        shutil.rmtree(kept.pop(0), ignore_errors=True)
+        return Result(metrics=history[-1] if history else {},
+                      checkpoint=final_checkpoint,
+                      metrics_history=history, path=ckpt_dir)
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The canonical TPU trainer (the reference's TorchTrainer analog,
+    train/torch/torch_trainer.py). Alias with jax-specific defaults."""

@@ -1,0 +1,141 @@
+"""Gang of training worker actors (reference:
+python/ray/train/_internal/worker_group.py:92).
+
+Each worker hosts the user's train function in a thread and streams
+session.report results back through `next_result` calls. Workers are
+plain actors; gang placement comes from the BackendExecutor's placement
+group.
+"""
+from __future__ import annotations
+
+import threading
+
+import ray_tpu
+from ray_tpu._private import api as _api
+
+
+class TrainWorker:
+    """Actor body for one training worker."""
+
+    def __init__(self, world_rank: int, world_size: int):
+        from ray_tpu.air import session as _session
+
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.session = _session._Session(world_rank, world_size)
+        self._thread = None
+
+    def setup_collective_group(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend, group_name)
+        return rank
+
+    def run_setup(self, setup_fn_and_args):
+        """Backend hook (e.g. jax.distributed.initialize)."""
+        fn, args, kwargs = setup_fn_and_args
+        return fn(self.world_rank, self.world_size, *args, **kwargs)
+
+    def set_dataset_shard(self, name, shard):
+        self.session.dataset_shards[name] = shard
+
+    def start_training(self, train_fn, config):
+        from ray_tpu.air import session as _session
+
+        _session._set_session(self.session)
+
+        def _run():
+            try:
+                train_fn(config) if config is not None else train_fn()
+            except BaseException as e:  # noqa: BLE001
+                self.session.error = e
+            finally:
+                self.session.finished.set()
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="train-fn")
+        self._thread.start()
+        return True
+
+    def next_result(self, timeout: float = 300.0):
+        """Blocks for the next session.report() payload; returns
+        {"done": True, "error": ...} when the function finishes."""
+        import queue as _q
+
+        deadline_step = 0.1
+        waited = 0.0
+        while waited < timeout:
+            try:
+                return self.session.results.get(timeout=deadline_step)
+            except _q.Empty:
+                waited += deadline_step
+                if self.session.finished.is_set() and \
+                        self.session.results.empty():
+                    err = self.session.error
+                    return {"done": True,
+                            "error": err if err is None else
+                            _stringify_error(err)}
+        raise TimeoutError("no result from train function")
+
+    def shutdown(self):
+        return True
+
+
+def _stringify_error(err: BaseException):
+    # ship original if picklable, else a summary
+    import pickle
+
+    try:
+        pickle.dumps(err)
+        return err
+    except Exception:
+        return RuntimeError(f"{type(err).__name__}: {err}")
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: dict,
+                 placement_group=None):
+        remote_cls = ray_tpu.remote(TrainWorker)
+        self.workers = []
+        for rank in range(num_workers):
+            opts = dict(resources_per_worker)
+            kwargs = {
+                "num_cpus": opts.pop("CPU", 1),
+                "resources": opts or None,
+            }
+            if "TPU" in (resources_per_worker or {}):
+                kwargs["num_tpus"] = resources_per_worker["TPU"]
+                kwargs["resources"] = {
+                    k: v for k, v in (kwargs["resources"] or {}).items()
+                    if k != "TPU"} or None
+            if placement_group is not None:
+                from ray_tpu.util.scheduling_strategies import (
+                    PlacementGroupSchedulingStrategy,
+                )
+
+                kwargs["scheduling_strategy"] = \
+                    PlacementGroupSchedulingStrategy(
+                        placement_group=placement_group,
+                        placement_group_bundle_index=rank)
+            self.workers.append(
+                remote_cls.options(**kwargs).remote(rank, num_workers))
+
+    def __len__(self):
+        return len(self.workers)
+
+    def execute(self, method_name: str, *args, timeout=None, **kwargs):
+        refs = [getattr(w, method_name).remote(*args, **kwargs)
+                for w in self.workers]
+        return ray_tpu.get(refs, timeout=timeout)
+
+    def execute_single(self, rank: int, method_name: str, *args, **kwargs):
+        return ray_tpu.get(
+            getattr(self.workers[rank], method_name).remote(*args, **kwargs))
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

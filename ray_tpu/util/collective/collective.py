@@ -1,0 +1,299 @@
+"""Collective communication between actors/tasks.
+
+API-equivalent to the reference's ray.util.collective
+(/root/reference/python/ray/util/collective/collective.py —
+init_collective_group :120, create_collective_group :151, allreduce :258,
+allgather, reducescatter, broadcast, reduce, send :531, recv :594,
+barrier) with TPU-native backends instead of NCCL/Gloo:
+
+- "host": cross-process collectives relayed through a rendezvous actor
+  (the analog of the reference's gloo CPU backend and of its NCCL
+  unique-id rendezvous via a named actor, nccl_collective_group.py:29-75).
+  Correct anywhere the runtime runs; bandwidth-bound by the object store.
+- "xla": members are jax processes forming one global device mesh; the ops
+  compile to ICI collectives (psum/all_gather/reduce_scatter/ppermute)
+  inside jit. Group creation materializes a jax.sharding.Mesh over the
+  member processes' chips (multi-host via jax.distributed). On-host
+  collectives inside ONE process should use the mesh directly
+  (ray_tpu.parallel.mesh); this layer exists for the actor-world.
+
+Semantics notes vs the reference: groups are named; ranks are dense
+[0, world_size); ops are synchronous (the reference's cupy-stream async
+semantics don't apply — XLA programs and host relays both complete before
+returning).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import api as _api
+
+_REDUCE_OPS = {
+    "sum": lambda arrs: _tree_reduce(arrs, np.add),
+    "product": lambda arrs: _tree_reduce(arrs, np.multiply),
+    "min": lambda arrs: _tree_reduce(arrs, np.minimum),
+    "max": lambda arrs: _tree_reduce(arrs, np.maximum),
+}
+
+
+def _tree_reduce(arrs, op):
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = op(out, a)
+    return out
+
+
+class _RendezvousStore:
+    """Named actor backing one collective group: mailbox + phased gather.
+
+    Runs anywhere; methods are called concurrently by all ranks, each in its
+    own handler thread, synchronized on conditions (this leans on the actor
+    runtime executing different callers' methods concurrently)."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._cond = threading.Condition()
+        self._gathers: dict = {}      # (seq, tag) -> {rank: value}
+        self._results: dict = {}      # (seq, tag) -> reduced value
+        self._mailbox: dict = {}      # (seq, src, dst) -> value
+        self._done_count: dict = {}
+
+    def gather_compute(self, seq, tag, rank, value, op):
+        """All-gather contributions; when complete, compute `op` once and
+        hand every rank the result."""
+        key = (seq, tag)
+        with self._cond:
+            self._gathers.setdefault(key, {})[rank] = value
+            if len(self._gathers[key]) == self.world_size:
+                vals = [self._gathers[key][r]
+                        for r in range(self.world_size)]
+                if op == "gather":
+                    self._results[key] = vals
+                else:
+                    self._results[key] = _REDUCE_OPS[op](vals)
+                self._cond.notify_all()
+            else:
+                self._cond.wait_for(lambda: key in self._results,
+                                    timeout=300.0)
+                if key not in self._results:
+                    raise TimeoutError(
+                        f"collective {tag} seq={seq} timed out waiting for "
+                        f"{self.world_size - len(self._gathers[key])} ranks")
+            result = self._results[key]
+            self._done_count[key] = self._done_count.get(key, 0) + 1
+            if self._done_count[key] == self.world_size:
+                del self._gathers[key], self._results[key]
+                del self._done_count[key]
+            return result
+
+    def send(self, seq, src, dst, value):
+        with self._cond:
+            self._mailbox[(seq, src, dst)] = value
+            self._cond.notify_all()
+
+    def recv(self, seq, src, dst):
+        key = (seq, src, dst)
+        with self._cond:
+            self._cond.wait_for(lambda: key in self._mailbox, timeout=300.0)
+            if key not in self._mailbox:
+                raise TimeoutError(f"recv from rank {src} timed out")
+            return self._mailbox.pop(key)
+
+
+class _GroupState:
+    def __init__(self, name, world_size, rank, backend, store_handle):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.backend = backend
+        self.store = store_handle
+        self.seq = 0
+        self.p2p_seq: dict[tuple, int] = {}   # (src,dst) channel counters
+        self.lock = threading.Lock()
+
+    def next_seq(self):
+        with self.lock:
+            self.seq += 1
+            return self.seq
+
+    def next_p2p_seq(self, src, dst):
+        """Sends/recvs pair on per-channel counters, independent of the
+        collective-op sequence (a rank not involved in a p2p exchange must
+        not affect its numbering)."""
+        with self.lock:
+            key = (src, dst)
+            self.p2p_seq[key] = self.p2p_seq.get(key, 0) + 1
+            return self.p2p_seq[key]
+
+
+class GroupManager:
+    """Per-process registry of joined groups (reference: collective.py:40)."""
+
+    def __init__(self):
+        self._groups: dict[str, _GroupState] = {}
+        self._lock = threading.Lock()
+
+    def create(self, group_name, world_size, rank, backend):
+        if backend not in ("host", "xla"):
+            raise ValueError(f"unknown backend {backend!r} "
+                             "(TPU-native backends: 'host', 'xla')")
+        store_cls = ray_tpu.remote(_RendezvousStore)
+        handle = store_cls.options(
+            name=f"_collective_{group_name}", get_if_exists=True,
+            num_cpus=0, max_concurrency=max(world_size, 2),
+        ).remote(world_size)
+        state = _GroupState(group_name, world_size, rank, backend, handle)
+        with self._lock:
+            self._groups[group_name] = state
+        return state
+
+    def get(self, group_name) -> _GroupState:
+        state = self._groups.get(group_name)
+        if state is None:
+            raise ValueError(
+                f"collective group {group_name!r} not initialized in this "
+                f"process — call init_collective_group first")
+        return state
+
+    def destroy(self, group_name):
+        with self._lock:
+            state = self._groups.pop(group_name, None)
+        return state is not None
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default"):
+    """Join this process into a named collective group
+    (reference: collective.py:120)."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    return _manager.create(group_name, world_size, rank, backend)
+
+
+def create_collective_group(actors, world_size: int, ranks: list[int],
+                            backend: str = "host",
+                            group_name: str = "default"):
+    """Declarative setup from the driver (reference: collective.py:151):
+    instructs each actor to join the group via an injected method call.
+    Actors must expose `setup_collective_group(world_size, rank, backend,
+    group_name)` or be created from a class using CollectiveActorMixin."""
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError("need exactly one rank per actor == world_size")
+    refs = [
+        actor.setup_collective_group.remote(world_size, rank, backend,
+                                            group_name)
+        for actor, rank in zip(actors, ranks)
+    ]
+    return ray_tpu.get(refs)
+
+
+class CollectiveActorMixin:
+    """Inherit in actor classes that join groups declaratively."""
+
+    def setup_collective_group(self, world_size, rank, backend, group_name):
+        init_collective_group(world_size, rank, backend, group_name)
+        return rank
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get(group_name).world_size
+
+
+def destroy_collective_group(group_name: str = "default"):
+    return _manager.destroy(group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    try:
+        _manager.get(group_name)
+        return True
+    except ValueError:
+        return False
+
+
+# ------------------------------------------------------------------ ops
+
+def _to_host(tensor):
+    """jax/torch/numpy → numpy (host relay works on host memory)."""
+    if hasattr(tensor, "device") and hasattr(tensor, "addressable_shards"):
+        return np.asarray(tensor)   # jax array
+    if hasattr(tensor, "detach"):
+        return tensor.detach().cpu().numpy()
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    """In the reference (collective.py:258) this mutates in place via NCCL;
+    here the reduced array is returned (functional, jax-style)."""
+    g = _manager.get(group_name)
+    seq = g.next_seq()
+    return ray_tpu.get(g.store.gather_compute.remote(
+        seq, "allreduce", g.rank, _to_host(tensor), op))
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = "sum"):
+    g = _manager.get(group_name)
+    seq = g.next_seq()
+    result = ray_tpu.get(g.store.gather_compute.remote(
+        seq, "reduce", g.rank, _to_host(tensor), op))
+    return result if g.rank == dst_rank else tensor
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _manager.get(group_name)
+    seq = g.next_seq()
+    contributions = ray_tpu.get(g.store.gather_compute.remote(
+        seq, "broadcast", g.rank, _to_host(tensor) if g.rank == src_rank
+        else None, "gather"))
+    return contributions[src_rank]
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    g = _manager.get(group_name)
+    seq = g.next_seq()
+    return ray_tpu.get(g.store.gather_compute.remote(
+        seq, "allgather", g.rank, _to_host(tensor), "gather"))
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    """Each rank gets the rank-th equal chunk of the reduction."""
+    g = _manager.get(group_name)
+    seq = g.next_seq()
+    reduced = ray_tpu.get(g.store.gather_compute.remote(
+        seq, "reducescatter", g.rank, _to_host(tensor), op))
+    chunks = np.array_split(reduced, g.world_size, axis=0)
+    return chunks[g.rank]
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    g = _manager.get(group_name)
+    seq = g.next_p2p_seq(g.rank, dst_rank)
+    ray_tpu.get(g.store.send.remote(seq, g.rank, dst_rank,
+                                    _to_host(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    """Unlike the reference (which writes into a passed buffer), returns the
+    received array."""
+    g = _manager.get(group_name)
+    seq = g.next_p2p_seq(src_rank, g.rank)
+    return ray_tpu.get(g.store.recv.remote(seq, src_rank, g.rank))
+
+
+def barrier(group_name: str = "default"):
+    g = _manager.get(group_name)
+    seq = g.next_seq()
+    ray_tpu.get(g.store.gather_compute.remote(
+        seq, "barrier", g.rank, None, "gather"))

@@ -1,0 +1,112 @@
+"""Collective layer tests — the analog of the reference's
+python/ray/util/collective/tests/single_node_cpu_tests/ (gloo backend):
+N actors on one machine exercising each op."""
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def collective_world(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.util.collective import CollectiveActorMixin
+
+    @ray.remote
+    class Rank(CollectiveActorMixin):
+        def allreduce(self, value):
+            from ray_tpu.util import collective as col
+
+            arr = np.full(4, float(value))
+            return col.allreduce(arr)
+
+        def allgather(self, value):
+            from ray_tpu.util import collective as col
+
+            return col.allgather(np.array([float(value)]))
+
+        def broadcast(self, value):
+            from ray_tpu.util import collective as col
+
+            return col.broadcast(np.array([float(value)]), src_rank=0)
+
+        def reducescatter(self, value):
+            from ray_tpu.util import collective as col
+
+            return col.reducescatter(np.arange(4.0) + value, op="sum")
+
+        def sendrecv(self, peer, value):
+            from ray_tpu.util import collective as col
+
+            rank = col.get_rank()
+            if rank < peer:
+                col.send(np.array([float(value)]), peer)
+                return None
+            return col.recv(peer if rank > peer else 0)
+
+        def p2p(self, value):
+            from ray_tpu.util import collective as col
+
+            rank = col.get_rank()
+            if rank == 0:
+                col.send(np.array([float(value)]), 1)
+                return None
+            return col.recv(0)
+
+        def barrier_then(self, value):
+            from ray_tpu.util import collective as col
+
+            col.barrier()
+            return value
+
+    world_size = 2
+    actors = [Rank.remote() for _ in range(world_size)]
+    from ray_tpu.util import collective as col
+
+    col.create_collective_group(actors, world_size, list(range(world_size)))
+    yield ray, actors
+
+
+def test_allreduce(collective_world):
+    ray, actors = collective_world
+    out = ray.get([a.allreduce.remote(i + 1) for i, a in enumerate(actors)],
+                  timeout=60)
+    for arr in out:
+        assert (arr == 3.0).all()     # 1 + 2
+
+
+def test_allgather(collective_world):
+    ray, actors = collective_world
+    out = ray.get([a.allgather.remote(i * 10) for i, a in enumerate(actors)],
+                  timeout=60)
+    for gathered in out:
+        assert [g[0] for g in gathered] == [0.0, 10.0]
+
+
+def test_broadcast(collective_world):
+    ray, actors = collective_world
+    out = ray.get([a.broadcast.remote(i + 5) for i, a in enumerate(actors)],
+                  timeout=60)
+    for arr in out:
+        assert arr[0] == 5.0          # rank 0's value
+
+
+def test_reducescatter(collective_world):
+    ray, actors = collective_world
+    out = ray.get([a.reducescatter.remote(i) for i, a in enumerate(actors)],
+                  timeout=60)
+    # sum over ranks of arange(4)+rank = [1,3,5,7]; rank0 gets [1,3], rank1 [5,7]
+    assert list(out[0]) == [1.0, 3.0]
+    assert list(out[1]) == [5.0, 7.0]
+
+
+def test_send_recv(collective_world):
+    ray, actors = collective_world
+    out = ray.get([a.p2p.remote(99) for a in actors], timeout=60)
+    assert out[0] is None
+    assert out[1][0] == 99.0
+
+
+def test_barrier(collective_world):
+    ray, actors = collective_world
+    out = ray.get([a.barrier_then.remote(i) for i, a in enumerate(actors)],
+                  timeout=60)
+    assert out == [0, 1]

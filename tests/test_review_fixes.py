@@ -46,7 +46,7 @@ def test_zero_cpu_task(ray_start_regular):
 
     @ray.remote(num_cpus=4)
     def hog():
-        time.sleep(1.5)
+        time.sleep(6)
         return "hog"
 
     @ray.remote(num_cpus=0)
@@ -55,11 +55,12 @@ def test_zero_cpu_task(ray_start_regular):
 
     h = hog.remote()
     time.sleep(0.3)
-    # zero-cpu task must run even with all CPUs held
+    # zero-cpu task must run even with all CPUs held (finishing while the
+    # hog still sleeps proves it didn't wait for CPU resources)
     t0 = time.time()
     assert ray.get(featherweight.remote(), timeout=10) == "light"
-    assert time.time() - t0 < 1.0, "zero-cpu task waited for CPU resources"
-    ray.get(h)
+    assert time.time() - t0 < 4.0, "zero-cpu task waited for CPU resources"
+    ray.get(h, timeout=30)
 
 
 def test_num_gpus_alias_stable_across_calls(ray_start_regular):

@@ -1,0 +1,134 @@
+"""Train stack tests: gang scheduling, data-parallel training with gradient
+allreduce over the collective layer, checkpoint round trips (the reference's
+train/tests tier with DummyTrainer-style configs)."""
+import numpy as np
+import pytest
+
+
+def test_worker_group_basics(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.train import WorkerGroup
+
+    wg = WorkerGroup(2, {"CPU": 1})
+    try:
+        # no train fn started; workers respond to shutdown-style calls
+        assert len(wg) == 2
+    finally:
+        wg.shutdown()
+
+
+def test_data_parallel_training_allreduce(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train import JaxTrainer
+
+    def train_loop(config):
+        from ray_tpu.air import session
+        from ray_tpu.util import collective as col
+
+        rank = session.get_world_rank()
+        world = session.get_world_size()
+        assert world == 2
+        # "gradient": rank-dependent; allreduce averages across the gang
+        for step in range(3):
+            grad = np.full(4, float(rank + 1 + step))
+            summed = col.allreduce(grad, group_name="train_dp")
+            session.report({"step": step,
+                            "grad_mean": float(summed.mean()) / world})
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert len(result.metrics_history) == 3
+    # step s: ranks contribute s+1 and s+2 → mean (2s+3)/2
+    assert result.metrics_history[0]["grad_mean"] == pytest.approx(1.5)
+    assert result.metrics["grad_mean"] == pytest.approx(3.5)
+
+
+def test_training_with_checkpoint(ray_start_regular, tmp_path):
+    ray = ray_start_regular
+    from ray_tpu.air import Checkpoint
+    from ray_tpu.air.config import RunConfig, ScalingConfig
+    from ray_tpu.train import JaxTrainer
+
+    def train_loop(config):
+        from ray_tpu.air import Checkpoint, session
+
+        for step in range(2):
+            ckpt = Checkpoint.from_dict({"params": np.ones(3) * step,
+                                         "step": step})
+            session.report({"loss": 1.0 / (step + 1)}, checkpoint=ckpt)
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="ckpt_run", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    state = result.checkpoint.to_dict()
+    assert state["step"] == 1
+    # persisted to storage_path
+    import os
+
+    runs = os.listdir(tmp_path / "ckpt_run")
+    assert any(r.startswith("checkpoint_") for r in runs)
+
+
+def test_train_failure_surfaces(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train import JaxTrainer
+
+    def bad_loop(config):
+        raise RuntimeError("train exploded")
+
+    trainer = JaxTrainer(bad_loop,
+                         scaling_config=ScalingConfig(num_workers=1))
+    result = trainer.fit()
+    assert result.error is not None
+    assert "train exploded" in str(result.error)
+
+
+def test_dataset_sharding(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train import JaxTrainer
+
+    def train_loop(config):
+        from ray_tpu.air import session
+
+        shard = session.get_dataset_shard("train")
+        session.report({"shard_len": len(shard),
+                        "first": shard[0]})
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": list(range(10))},
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["shard_len"] == 5
+
+
+def test_checkpoint_conversions(tmp_path):
+    from ray_tpu.air import Checkpoint
+
+    data = {"w": np.arange(5), "meta": {"lr": 0.1}}
+    ckpt = Checkpoint.from_dict(data)
+    # dict -> bytes -> checkpoint -> dict
+    ckpt2 = Checkpoint.from_bytes(ckpt.to_bytes())
+    assert (ckpt2.to_dict()["w"] == data["w"]).all()
+    # dict -> dir -> checkpoint -> dict
+    d = ckpt.to_directory(str(tmp_path / "c1"))
+    ckpt3 = Checkpoint.from_directory(d)
+    assert ckpt3.to_dict()["meta"]["lr"] == 0.1
+    # uri round trip
+    uri = ckpt.to_uri(f"file://{tmp_path}/c2")
+    ckpt4 = Checkpoint.from_uri(uri)
+    assert (ckpt4.to_dict()["w"] == data["w"]).all()
