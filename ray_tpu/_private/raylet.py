@@ -71,10 +71,16 @@ class WorkerHandle:
 
 
 class Lease:
-    def __init__(self, lease_id: str, resources: dict, worker: WorkerHandle):
+    def __init__(self, lease_id: str, resources: dict, worker: WorkerHandle,
+                 lessee: tuple | None = None):
         self.lease_id = lease_id
         self.resources = resources
         self.worker = worker
+        # (worker_id, addr) of the requesting core worker: leases die with
+        # their lessee (reference: leases are tied to the lease client's
+        # connection; a dead lessee's resources must be reclaimed)
+        self.lessee_id = lessee[0] if lessee else None
+        self.lessee_addr = tuple(lessee[1]) if lessee else None
 
 
 class Raylet:
@@ -242,8 +248,10 @@ class Raylet:
             self._on_worker_exit(worker_id)
 
     def _reap_loop(self):
+        ticks = 0
         while not self._stopped:
             time.sleep(0.2)
+            ticks += 1
             dead = []
             with self._lock:
                 for wid, h in self._workers.items():
@@ -251,6 +259,44 @@ class Raylet:
                         dead.append(wid)
             for wid in dead:
                 self._on_worker_exit(wid)
+            if ticks % 25 == 0:   # every ~5s: GC leases of remote lessees
+                self._gc_remote_lessee_leases()
+
+    def _release_leases_of_lessee(self, lessee_id: str):
+        with self._lock:
+            doomed = [lease for lease in self._leases.values()
+                      if lease.lessee_id == lessee_id]
+            for lease in doomed:
+                self._leases.pop(lease.lease_id, None)
+                self._give_back(lease.resources)
+                worker = lease.worker
+                worker.assigned_lease = None
+                # The dead lessee may have left a task mid-execution on this
+                # worker; it is not safely reusable — kill it (reference
+                # kills leased workers when the lease client disconnects).
+                self._kill_worker(worker)
+
+    def _gc_remote_lessee_leases(self):
+        """Leases whose lessee lives on another node (spillback grants) are
+        not covered by local worker reaping — ping the lessee and reclaim on
+        failure."""
+        with self._lock:
+            remote = [(lease.lessee_id, lease.lessee_addr)
+                      for lease in self._leases.values()
+                      if lease.lessee_addr is not None
+                      and lease.lessee_id not in self._workers]
+        for lessee_id, addr in {(i, a) for i, a in remote}:
+            alive = True
+            try:
+                client = RpcClient(addr, timeout=2.0, retry=1)
+                try:
+                    client.call("ping", timeout=2.0)
+                finally:
+                    client.close()
+            except Exception:
+                alive = False
+            if not alive:
+                self._release_leases_of_lessee(lessee_id)
 
     def _on_worker_exit(self, worker_id: str):
         with self._lock:
@@ -264,6 +310,9 @@ class Raylet:
                 lease = self._leases.pop(handle.assigned_lease, None)
             if lease:
                 self._give_back(lease.resources)
+        # Leases this worker REQUESTED (as lessee) die with it: its
+        # submission queues can never return them.
+        self._release_leases_of_lessee(worker_id)
         if handle.is_actor and handle.actor_id is not None:
             self._handle_actor_death(handle)
         self._pump_pending()
@@ -338,7 +387,8 @@ class Raylet:
 
     def rpc_request_worker_lease(self, conn, resources: dict,
                                  strategy: dict | None = None,
-                                 grant_or_reject: bool = False):
+                                 grant_or_reject: bool = False,
+                                 lessee: tuple | None = None):
         """Returns {"granted": {...}} | {"spillback": addr} | queues until
         resources free (long-poll: the reply is sent when granted)."""
         strategy = strategy or {}
@@ -346,7 +396,7 @@ class Raylet:
         pg_id = strategy.get("placement_group_id")
         if pg_id is not None:
             return self._pg_lease(pg_id, strategy.get("bundle_index", -1),
-                                  resources)
+                                  resources, lessee)
         node_hint = strategy.get("node_id")
         if node_hint and node_hint != self.node_id:
             target = self._node_addr(node_hint)
@@ -363,7 +413,7 @@ class Raylet:
             if target is not None and os.urandom(1)[0] < 128:
                 return {"spillback": target}
         if self._try_reserve(resources):
-            return self._grant(resources)
+            return self._grant(resources, lessee)
         target = self._pick_spillback(resources)
         if target is not None:
             return {"spillback": target}
@@ -372,7 +422,7 @@ class Raylet:
         deadline = time.time() + 300.0
         while time.time() < deadline:
             if self._try_reserve(resources):
-                return self._grant(resources)
+                return self._grant(resources, lessee)
             if not self._feasible(resources):
                 raise ValueError(
                     f"infeasible resource request {resources}: cluster "
@@ -400,7 +450,7 @@ class Raylet:
                                for k, v in resources.items())
             for n in nodes)
 
-    def _grant(self, resources: dict) -> dict:
+    def _grant(self, resources: dict, lessee: tuple | None = None) -> dict:
         """Resources must already be reserved via _try_reserve. Runs outside
         _lock because _pop_worker may block on worker registration."""
         try:
@@ -410,7 +460,7 @@ class Raylet:
                 self._give_back(resources)
             raise
         lease_id = uuid.uuid4().hex
-        lease = Lease(lease_id, resources, worker)
+        lease = Lease(lease_id, resources, worker, lessee)
         worker.assigned_lease = lease_id
         with self._lock:
             self._leases[lease_id] = lease
@@ -419,7 +469,8 @@ class Raylet:
                             "worker_addr": worker.addr,
                             "node_id": self.node_id}}
 
-    def _pg_lease(self, pg_id: bytes, bundle_index: int, resources: dict):
+    def _pg_lease(self, pg_id: bytes, bundle_index: int, resources: dict,
+                  lessee: tuple | None = None):
         pg = self._gcs.call("get_placement_group", pg_id=pg_id)
         if pg is None or pg["State"] != "CREATED":
             raise ValueError(f"placement group {pg_id.hex()} not ready")
@@ -434,7 +485,7 @@ class Raylet:
             if addr is None:
                 raise ValueError("placement group node died")
             return {"spillback": addr}
-        return self._grant({})  # bundle resources were pre-reserved
+        return self._grant({}, lessee)  # bundle resources were pre-reserved
 
     def _node_addr(self, node_id: str):
         try:

@@ -14,6 +14,9 @@ import time
 
 
 def main():
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)  # `ray stack`
     gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].split(":")
     raylet_host, raylet_port = os.environ["RAY_TPU_RAYLET_ADDR"].split(":")
 

@@ -762,6 +762,7 @@ class CoreWorker:
             for _ in range(max_spillbacks):
                 reply = target.call("request_worker_lease",
                                     resources=resources, strategy=strategy,
+                                    lessee=(self.worker_id, self.addr),
                                     timeout=330.0)
                 if "granted" in reply:
                     return reply["granted"]
@@ -819,7 +820,10 @@ class CoreWorker:
             return
         results = reply.get("results", {})
         for rid, data in results.items():
-            self.memory_store.put(rid, data)
+            # fire-and-forget: if every ref was dropped while the task was in
+            # flight, storing the result would resurrect an unfreeable object
+            if self.reference_counter.count(rid) > 0 or rid in self._owned:
+                self.memory_store.put(rid, data)
         # returns listed in reply["stored"] live in a shm store and resolve
         # through the object directory in _fetch_bytes
 

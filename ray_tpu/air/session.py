@@ -71,6 +71,11 @@ def get_dataset_shard(dataset_name: str = "train"):
     return _get_session().dataset_shards.get(dataset_name)
 
 
+def get_checkpoint():
+    """Starting checkpoint when resuming (Tune restore / PBT exploit)."""
+    return getattr(_get_session(), "resume_checkpoint", None)
+
+
 def get_trial_name() -> str:
     info = _get_session().trial_info
     return info.get("name", "") if info else ""

@@ -1,0 +1,13 @@
+from ray_tpu.data.dataset import (  # noqa: F401
+    ActorPoolStrategy,
+    Dataset,
+    GroupedDataset,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)

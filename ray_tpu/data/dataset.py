@@ -1,0 +1,432 @@
+"""Distributed Dataset — blocks as object-store refs, lazy stage plan.
+
+Reference: python/ray/data/dataset.py:138 (Dataset), _internal/plan.py:46
+(ExecutionPlan + Stage), _internal/compute.py:58,173 (TaskPoolStrategy /
+ActorPoolStrategy), _internal/push_based_shuffle.py, _internal/sort.py.
+
+Design: a Dataset is a list of block refs plus a chain of not-yet-executed
+stages. Each block is a plain list of rows (dicts/values) or a numpy array;
+map-like stages fuse and execute one task per block. TPU-native additions:
+`iter_batches(..., device_put=True)` prefetches the next batch to the chip
+while the current one is consumed — the host→HBM feed pipeline that replaces
+the reference's `to_torch` pin-memory path.
+"""
+from __future__ import annotations
+
+import builtins
+import random as _random
+
+import numpy as np
+
+import ray_tpu
+
+
+def _exec_chain(stages, block):
+    for fn in stages:
+        block = fn(block)
+    return block
+
+
+_chain_task = None
+
+
+def _get_chain_task():
+    global _chain_task
+    if _chain_task is None:
+        _chain_task = ray_tpu.remote(_exec_chain)
+    return _chain_task
+
+
+class _ActorPoolStrategy:
+    """(reference: compute.py:173 ActorPoolStrategy) map stages run on a
+    pool of long-lived actors — amortizes heavyweight per-process state
+    (e.g. a compiled jax program or loaded model) across blocks."""
+
+    def __init__(self, size: int = 2):
+        self.size = size
+
+
+def ActorPoolStrategy(size: int = 2):
+    return _ActorPoolStrategy(size)
+
+
+class _BlockWorker:
+    """Actor body for ActorPoolStrategy."""
+
+    def apply(self, stages, block):
+        return _exec_chain(stages, block)
+
+
+class Dataset:
+    def __init__(self, block_refs: list, stages: list | None = None):
+        self._block_refs = list(block_refs)
+        self._stages = list(stages or [])
+
+    # ------------------------------------------------------------ plan
+
+    def _with_stage(self, fn) -> "Dataset":
+        return Dataset(self._block_refs, self._stages + [fn])
+
+    def materialize(self, compute=None) -> "Dataset":
+        """Execute pending stages: one task per block (TaskPoolStrategy) or
+        a round-robin actor pool (ActorPoolStrategy)."""
+        if not self._stages:
+            return self
+        stages = self._stages
+        if isinstance(compute, _ActorPoolStrategy):
+            worker_cls = ray_tpu.remote(_BlockWorker)
+            pool = [worker_cls.remote() for _ in builtins.range(compute.size)]
+            refs = [
+                pool[i % len(pool)].apply.remote(stages, ref)
+                for i, ref in enumerate(self._block_refs)
+            ]
+        else:
+            task = _get_chain_task()
+            refs = [task.remote(stages, ref) for ref in self._block_refs]
+        return Dataset(refs)
+
+    def _materialized_refs(self, compute=None):
+        return self.materialize(compute)._block_refs
+
+    def blocks(self) -> list:
+        return [ray_tpu.get(r) for r in self._materialized_refs()]
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    # ------------------------------------------------------- transforms
+
+    def map(self, fn) -> "Dataset":
+        return self._with_stage(
+            lambda block: [fn(row) for row in _rows(block)])
+
+    def flat_map(self, fn) -> "Dataset":
+        return self._with_stage(
+            lambda block: [out for row in _rows(block) for out in fn(row)])
+
+    def filter(self, fn) -> "Dataset":
+        return self._with_stage(
+            lambda block: [row for row in _rows(block) if fn(row)])
+
+    def map_batches(self, fn, *, batch_format: str = "auto") -> "Dataset":
+        """fn: block -> block (numpy array in → numpy array out when the
+        block is an array; list otherwise)."""
+        return self._with_stage(fn)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        rows = self.take_all()
+        return from_items(rows, parallelism=num_blocks)
+
+    def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
+        """Push-based two-stage shuffle (reference:
+        _internal/push_based_shuffle.py): map tasks split each block into
+        N random partitions; reduce tasks concatenate partition i of every
+        block. All intermediate partitions live in the object store."""
+        n = max(1, self.num_blocks)
+        seed_base = seed if seed is not None else _random.randrange(2**31)
+
+        @ray_tpu.remote(num_returns=n)
+        def shuffle_map(stages, block, block_idx):
+            block = _exec_chain(stages, block)
+            rows = _rows(block)
+            rng = _random.Random(seed_base + block_idx)
+            parts = [[] for _ in builtins.range(n)]
+            for row in rows:
+                parts[rng.randrange(n)].append(row)
+            return tuple(parts) if n > 1 else parts[0]
+
+        @ray_tpu.remote
+        def shuffle_reduce(*parts):
+            rows = [row for part in parts for row in part]
+            rng = _random.Random(seed_base ^ 0x5EED)
+            rng.shuffle(rows)
+            return rows
+
+        stages = self._stages
+        part_refs = [shuffle_map.remote(stages, ref, i)
+                     for i, ref in enumerate(self._block_refs)]
+        if n == 1:
+            part_refs = [[r] for r in part_refs]
+        reduced = [
+            shuffle_reduce.remote(*[part_refs[b][i] for b in builtins.range(n)])
+            for i in builtins.range(n)
+        ]
+        return Dataset(reduced)
+
+    def sort(self, key=None, descending: bool = False) -> "Dataset":
+        """Sample-partition-sort (reference: _internal/sort.py): sample
+        boundaries, range-partition blocks, sort each range."""
+        keyfn = key if callable(key) else (
+            (lambda row: row[key]) if key is not None else (lambda row: row))
+        n = max(1, self.num_blocks)
+        refs = self._materialized_refs()
+        if n == 1:
+            block = ray_tpu.get(refs[0])
+            rows = sorted(_rows(block), key=keyfn, reverse=descending)
+            return from_items(rows, parallelism=1)
+        # boundary sampling on the driver (small sample per block)
+        samples = []
+        for ref in refs:
+            rows = _rows(ray_tpu.get(ref))
+            step = max(1, len(rows) // 8)
+            samples.extend(keyfn(r) for r in rows[::step])
+        samples.sort()
+        bounds = [samples[int(len(samples) * (i + 1) / n)]
+                  for i in builtins.range(n - 1)] if samples else []
+
+        @ray_tpu.remote(num_returns=n)
+        def range_partition(block):
+            import bisect
+
+            parts = [[] for _ in builtins.range(n)]
+            for row in _rows(block):
+                parts[bisect.bisect_left(bounds, keyfn(row))].append(row)
+            return tuple(parts)
+
+        @ray_tpu.remote
+        def sort_merge(*parts):
+            rows = [row for part in parts for row in part]
+            return sorted(rows, key=keyfn, reverse=descending)
+
+        part_refs = [range_partition.remote(ref) for ref in refs]
+        ordered = [
+            sort_merge.remote(*[part_refs[b][i] for b in builtins.range(n)])
+            for i in builtins.range(n)
+        ]
+        if descending:
+            ordered = ordered[::-1]
+        return Dataset(ordered)
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return Dataset(self._materialized_refs()
+                       + other._materialized_refs())
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        mine, theirs = self.take_all(), other.take_all()
+        return from_items(list(zip(mine, theirs)),
+                          parallelism=self.num_blocks)
+
+    def split(self, n: int, *, equal: bool = True) -> list["Dataset"]:
+        """Shard for per-worker consumption (reference: dataset.py split;
+        used by Train's dataset_spec)."""
+        refs = self._materialized_refs()
+        if len(refs) >= n and len(refs) % n == 0:
+            per = len(refs) // n
+            return [Dataset(refs[i * per:(i + 1) * per]) for i in builtins.range(n)]
+        rows = self.take_all()
+        chunk = (len(rows) + n - 1) // n
+        return [from_items(rows[i * chunk:(i + 1) * chunk] or [],
+                           parallelism=1) for i in builtins.range(n)]
+
+    def groupby(self, key) -> "GroupedDataset":
+        return GroupedDataset(self, key)
+
+    # ------------------------------------------------------ consumption
+
+    def take(self, limit: int = 20) -> list:
+        out = []
+        for ref in self._materialized_refs():
+            out.extend(_rows(ray_tpu.get(ref)))
+            if len(out) >= limit:
+                return out[:limit]
+        return out
+
+    def take_all(self) -> list:
+        out = []
+        for block in self.blocks():
+            out.extend(_rows(block))
+        return out
+
+    def count(self) -> int:
+        counter = ray_tpu.remote(lambda stages, b: len(_rows(
+            _exec_chain(stages, b))))
+        return sum(ray_tpu.get([counter.remote(self._stages, r)
+                                for r in self._block_refs]))
+
+    def show(self, limit: int = 20):
+        for row in self.take(limit):
+            print(row)
+
+    def schema(self):
+        first = self.take(1)
+        if not first:
+            return None
+        row = first[0]
+        if isinstance(row, dict):
+            return {k: type(v).__name__ for k, v in row.items()}
+        return type(row).__name__
+
+    def iter_rows(self):
+        for ref in self._materialized_refs():
+            yield from _rows(ray_tpu.get(ref))
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     device_put: bool = False, drop_last: bool = False):
+        """Batched iteration with one-batch lookahead; with device_put the
+        next batch is already on its way to the device while the caller
+        consumes the current one (the TPU host→HBM feed pipeline)."""
+        def to_batch(rows):
+            if batch_format == "numpy":
+                batch = _rows_to_numpy(rows)
+            else:
+                batch = rows
+            if device_put:
+                import jax
+
+                batch = jax.device_put(batch)
+            return batch
+
+        pending_rows: list = []
+        prev = None
+        for ref in self._materialized_refs():
+            pending_rows.extend(_rows(ray_tpu.get(ref)))
+            while len(pending_rows) >= batch_size:
+                batch = to_batch(pending_rows[:batch_size])
+                pending_rows = pending_rows[batch_size:]
+                if prev is not None:
+                    yield prev
+                prev = batch    # lookahead: device transfer overlaps consume
+        if prev is not None:
+            yield prev
+        if pending_rows and not drop_last:
+            yield to_batch(pending_rows)
+
+    def to_numpy(self) -> np.ndarray:
+        return _rows_to_numpy(self.take_all())
+
+    def to_pandas(self):
+        import pandas as pd
+
+        rows = self.take_all()
+        if rows and isinstance(rows[0], dict):
+            return pd.DataFrame(rows)
+        return pd.DataFrame({"value": rows})
+
+    def stats(self) -> dict:
+        sizes = ray_tpu.get([
+            _get_chain_task().remote(
+                self._stages + [lambda b: len(_rows(b))], r)
+            for r in self._block_refs])
+        return {"num_blocks": len(sizes), "block_sizes": sizes,
+                "num_rows": sum(sizes)}
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={self.num_blocks}, "
+                f"pending_stages={len(self._stages)})")
+
+
+class GroupedDataset:
+    """(reference: data/grouped_dataset.py) hash-partition by key, then
+    per-group aggregation."""
+
+    def __init__(self, ds: Dataset, key):
+        self.ds = ds
+        self.keyfn = key if callable(key) else (lambda row: row[key])
+
+    def _groups(self) -> dict:
+        groups: dict = {}
+        for row in self.ds.take_all():
+            groups.setdefault(self.keyfn(row), []).append(row)
+        return groups
+
+    def count(self) -> Dataset:
+        return from_items([
+            {"key": k, "count": len(v)} for k, v in self._groups().items()])
+
+    def aggregate(self, agg_fn) -> Dataset:
+        return from_items([
+            {"key": k, "value": agg_fn(v)}
+            for k, v in self._groups().items()])
+
+    def map_groups(self, fn) -> Dataset:
+        return from_items([out for k, v in self._groups().items()
+                           for out in fn(v)])
+
+
+# -------------------------------------------------------------- block utils
+
+def _rows(block) -> list:
+    if isinstance(block, np.ndarray):
+        return list(block)
+    if hasattr(block, "to_dict") and hasattr(block, "columns"):  # DataFrame
+        return block.to_dict("records")
+    return list(block)
+
+
+def _rows_to_numpy(rows):
+    if rows and isinstance(rows[0], dict):
+        return {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+    return np.asarray(rows)
+
+
+# -------------------------------------------------------------- constructors
+
+def from_items(items: list, *, parallelism: int = 8) -> Dataset:
+    items = list(items)
+    n = max(1, min(parallelism, len(items) or 1))
+    chunk = (len(items) + n - 1) // n
+    refs = [ray_tpu.put(items[i * chunk:(i + 1) * chunk])
+            for i in builtins.range(n)]
+    return Dataset(refs)
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return from_items(list(builtins.range(n)), parallelism=parallelism)
+
+
+def from_numpy(arr: np.ndarray, *, parallelism: int = 8) -> Dataset:
+    chunks = np.array_split(arr, max(1, parallelism))
+    return Dataset([ray_tpu.put(c) for c in chunks if len(c)])
+
+
+def from_pandas(df, *, parallelism: int = 4) -> Dataset:
+    n = max(1, parallelism)
+    size = (len(df) + n - 1) // n
+    refs = [ray_tpu.put(df.iloc[i * size:(i + 1) * size])
+            for i in builtins.range(n) if i * size < len(df)]
+    return Dataset(refs)
+
+
+def read_csv(paths, *, parallelism: int = 4) -> Dataset:
+    import pandas as pd
+
+    if isinstance(paths, str):
+        paths = [paths]
+    refs = [ray_tpu.put(pd.read_csv(p)) for p in paths]
+    return Dataset(refs)
+
+
+def read_json(paths) -> Dataset:
+    import json
+
+    if isinstance(paths, str):
+        paths = [paths]
+    rows = []
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    return from_items(rows)
+
+
+def read_parquet(paths, *, parallelism: int = 4) -> Dataset:
+    import pandas as pd
+
+    if isinstance(paths, str):
+        paths = [paths]
+    refs = [ray_tpu.put(pd.read_parquet(p)) for p in paths]
+    return Dataset(refs)
+
+
+def read_text(paths) -> Dataset:
+    if isinstance(paths, str):
+        paths = [paths]
+    rows = []
+    for p in paths:
+        with open(p) as f:
+            rows.extend(line.rstrip("\n") for line in f)
+    return from_items(rows)

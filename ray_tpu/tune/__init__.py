@@ -1,0 +1,21 @@
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import (  # noqa: F401
+    ResultGrid,
+    Trial,
+    TrialRunner,
+    TuneConfig,
+    Tuner,
+    run,
+)

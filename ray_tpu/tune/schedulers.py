@@ -1,0 +1,164 @@
+"""Trial schedulers (reference: python/ray/tune/schedulers/ —
+async_hyperband.py ASHA, median_stopping_rule.py, pbt.py).
+
+Contract: `on_result(trial, result, runner) -> decision`, where decision is
+CONTINUE or STOP; PBT may additionally mutate other trials through the
+runner (exploit/explore).
+"""
+from __future__ import annotations
+
+import random
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial, result, runner):
+        return CONTINUE
+
+
+class AsyncHyperBandScheduler:
+    """ASHA: successive-halving rungs; a trial only continues past a rung if
+    it is in the top 1/reduction_factor of completed results at that rung
+    (reference: schedulers/async_hyperband.py)."""
+
+    def __init__(self, metric: str, mode: str = "max", grace_period: int = 1,
+                 max_t: int = 100, reduction_factor: int = 3):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # rung milestones: grace, grace*rf, grace*rf^2 ... < max_t
+        self.rungs: list[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.rung_values: dict[int, dict[str, float]] = \
+            {r: {} for r in self.rungs}
+
+    def on_result(self, trial, result, runner):
+        t = result.get("training_iteration", 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        # record at the rung the trial just reached
+        for rung in self.rungs:
+            if t == rung:
+                self.rung_values[rung][trial.trial_id] = float(value)
+        # (re-)evaluate against the latest rung at or below t on EVERY
+        # result: a trial that passed a rung while it had few peers is
+        # re-judged as peers arrive (async halving without first-arrival
+        # survivor bias).
+        latest = None
+        for rung in self.rungs:
+            if rung <= t and trial.trial_id in self.rung_values[rung]:
+                latest = rung
+        if latest is None:
+            return CONTINUE
+        records = self.rung_values[latest]
+        if len(records) < 2:
+            return CONTINUE
+        ordered = sorted(records.values(), reverse=(self.mode == "max"))
+        keep = max(1, len(ordered) // self.rf)
+        cutoff = ordered[keep - 1]
+        mine = records[trial.trial_id]
+        good = mine >= cutoff if self.mode == "max" else mine <= cutoff
+        return CONTINUE if good else STOP
+
+
+class MedianStoppingRule:
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running averages at the same step (reference:
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self.history: dict[str, list[float]] = {}
+
+    def on_result(self, trial, result, runner):
+        t = result.get("training_iteration", 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        self.history.setdefault(trial.trial_id, []).append(float(value))
+        if t < self.grace or len(self.history) < self.min_samples:
+            return CONTINUE
+        import statistics
+
+        averages = [statistics.fmean(v) for k, v in self.history.items()
+                    if k != trial.trial_id and v]
+        if len(averages) < self.min_samples - 1:
+            return CONTINUE
+        median = statistics.median(averages)
+        mine = (max if self.mode == "max" else min)(
+            self.history[trial.trial_id])
+        bad = mine < median if self.mode == "max" else mine > median
+        return STOP if bad else CONTINUE
+
+
+class PopulationBasedTraining:
+    """PBT (reference: schedulers/pbt.py): at each perturbation interval,
+    bottom-quantile trials clone the checkpoint + config of a top-quantile
+    trial, with hyperparameters perturbed (explore)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 perturbation_interval: int = 2,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 perturbation_factors=(0.8, 1.2), seed: int | None = None):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.factors = perturbation_factors
+        self.rng = random.Random(seed)
+        self.latest: dict[str, float] = {}
+        self.last_perturb: dict[str, int] = {}
+
+    def on_result(self, trial, result, runner):
+        value = result.get(self.metric)
+        t = result.get("training_iteration", 0)
+        if value is None:
+            return CONTINUE
+        self.latest[trial.trial_id] = float(value)
+        if t - self.last_perturb.get(trial.trial_id, 0) < self.interval:
+            return CONTINUE
+        self.last_perturb[trial.trial_id] = t
+        ranked = sorted(self.latest.items(), key=lambda kv: kv[1],
+                        reverse=(self.mode == "max"))
+        n = len(ranked)
+        if n < 2:
+            return CONTINUE
+        k = max(1, int(n * self.quantile))
+        top = [tid for tid, _ in ranked[:k]]
+        bottom = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id in bottom and trial.trial_id not in top:
+            source_id = self.rng.choice(top)
+            source = runner.get_trial(source_id)
+            if source is not None and source.latest_checkpoint is not None:
+                new_config = self._explore(dict(source.config))
+                runner.exploit(trial, source, new_config)
+        return CONTINUE
+
+    def _explore(self, config: dict) -> dict:
+        for key, mutation in self.mutations.items():
+            if key not in config:
+                continue
+            if callable(mutation):
+                config[key] = mutation()
+            elif isinstance(mutation, list):
+                config[key] = self.rng.choice(mutation)
+            else:   # numeric perturbation of the current value
+                config[key] = config[key] * self.rng.choice(self.factors)
+        return config

@@ -1,0 +1,120 @@
+"""Search spaces and the basic variant generator (reference:
+python/ray/tune/search/ — sample.py domains, basic_variant.py grid/random
+expansion).
+"""
+from __future__ import annotations
+
+import random
+
+
+class Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high):
+        import math
+
+        self.log_low, self.log_high = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.log_low, self.log_high))
+
+
+class Randint(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> Randint:
+    return Randint(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+class BasicVariantGenerator:
+    """Expand grid axes (cartesian product) × num_samples random draws
+    (reference: tune/search/basic_variant.py)."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1,
+                 seed: int | None = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+
+    def generate(self) -> list[dict]:
+        grids = self._grid_axes(self.param_space)
+        combos = [{}]
+        for path, values in grids:
+            combos = [dict(c, **{path: v}) for c in combos for v in values]
+        configs = []
+        for _ in range(self.num_samples):
+            for combo in combos:
+                configs.append(self._materialize(self.param_space, combo))
+        return configs
+
+    def _grid_axes(self, space, prefix=""):
+        axes = []
+        for key, value in space.items():
+            path = f"{prefix}{key}"
+            if isinstance(value, GridSearch):
+                axes.append((path, value.values))
+            elif isinstance(value, dict):
+                axes.extend(self._grid_axes(value, prefix=f"{path}."))
+        return axes
+
+    def _materialize(self, space, grid_values, prefix=""):
+        out = {}
+        for key, value in space.items():
+            path = f"{prefix}{key}"
+            if isinstance(value, GridSearch):
+                out[key] = grid_values[path]
+            elif isinstance(value, Domain):
+                out[key] = value.sample(self.rng)
+            elif isinstance(value, dict):
+                out[key] = self._materialize(value, grid_values,
+                                             prefix=f"{path}.")
+            else:
+                out[key] = value
+        return out

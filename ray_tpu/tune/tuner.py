@@ -1,0 +1,275 @@
+"""Tuner / TrialRunner — the experiment driver (reference:
+python/ray/tune/tune.py:130 tune.run, tuner.py:220 Tuner.fit,
+execution/trial_runner.py:236 TrialRunner.step,
+execution/ray_trial_executor.py:205 — each Trial is an actor).
+
+Each trial runs its function trainable inside a `_TrialActor`; the runner
+polls results, feeds the scheduler, and applies decisions (stop / PBT
+exploit). Trials needing gang resources use their own placement groups via
+the trainable (e.g. a Trainer.as_trainable()).
+"""
+from __future__ import annotations
+
+import time
+import uuid
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.result import Result
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+class TuneConfig:
+    def __init__(self, num_samples: int = 1, max_concurrent_trials: int = 0,
+                 metric: str | None = None, mode: str = "max",
+                 scheduler=None, seed: int | None = None):
+        self.num_samples = num_samples
+        self.max_concurrent_trials = max_concurrent_trials
+        self.metric = metric
+        self.mode = mode
+        self.scheduler = scheduler
+        self.seed = seed
+
+
+class Trial:
+    def __init__(self, config: dict, trial_id: str | None = None):
+        self.trial_id = trial_id or uuid.uuid4().hex[:8]
+        self.config = config
+        self.status = "PENDING"    # RUNNING/TERMINATED/ERROR/STOPPED
+        self.results: list[dict] = []
+        self.latest_checkpoint: Checkpoint | None = None
+        self.error: BaseException | None = None
+        self.actor = None
+        self.iteration = 0
+
+    @property
+    def last_result(self) -> dict:
+        return self.results[-1] if self.results else {}
+
+
+class _TrialActor:
+    """Actor body hosting one trial's function trainable."""
+
+    def __init__(self):
+        self.session = None
+
+    def run(self, fn, config, resume_checkpoint):
+        import threading
+
+        from ray_tpu.air import session as _session
+
+        self.session = _session._Session(0, 1)
+        self.session.resume_checkpoint = resume_checkpoint
+        _session._set_session(self.session)
+
+        def _target():
+            try:
+                fn(config)
+            except BaseException as e:  # noqa: BLE001
+                self.session.error = e
+            finally:
+                self.session.finished.set()
+
+        threading.Thread(target=_target, daemon=True,
+                         name="trial-fn").start()
+        return True
+
+    def next_result(self, timeout: float = 300.0):
+        import queue as _q
+
+        waited = 0.0
+        while waited < timeout:
+            try:
+                return self.session.results.get(timeout=0.1)
+            except _q.Empty:
+                waited += 0.1
+                if self.session.finished.is_set() and \
+                        self.session.results.empty():
+                    err = self.session.error
+                    if err is not None:
+                        import pickle
+
+                        try:
+                            pickle.dumps(err)
+                        except Exception:
+                            err = RuntimeError(
+                                f"{type(err).__name__}: {err}")
+                    return {"done": True, "error": err}
+        raise TimeoutError("trial produced no result")
+
+
+class TrialRunner:
+    def __init__(self, trainable, trials: list[Trial], tune_config: TuneConfig,
+                 run_config: RunConfig, resources_per_trial: dict | None):
+        self.trainable = trainable
+        self.trials = trials
+        self.tune_config = tune_config
+        self.run_config = run_config
+        self.resources = resources_per_trial or {"CPU": 1}
+        self.scheduler = tune_config.scheduler or sched_mod.FIFOScheduler()
+        self._pending_exploits: list[tuple] = []
+
+    def get_trial(self, trial_id: str) -> Trial | None:
+        for t in self.trials:
+            if t.trial_id == trial_id:
+                return t
+        return None
+
+    def exploit(self, trial: Trial, source: Trial, new_config: dict):
+        """PBT exploit: restart `trial` from `source`'s checkpoint with the
+        explored config (reference: pbt.py _exploit)."""
+        self._pending_exploits.append((trial, source, new_config))
+
+    def run(self) -> list[Trial]:
+        limit = self.tune_config.max_concurrent_trials or len(self.trials)
+        active: list[Trial] = []
+        queue = list(self.trials)
+        while queue or active:
+            while queue and len(active) < limit:
+                trial = queue.pop(0)
+                self._start_trial(trial)
+                active.append(trial)
+            progressed = False
+            for trial in list(active):
+                row = self._poll(trial)
+                if row is None:
+                    continue
+                progressed = True
+                if row.get("done"):
+                    trial.status = ("ERROR" if row.get("error")
+                                    else "TERMINATED")
+                    trial.error = row.get("error")
+                    self._stop_actor(trial)
+                    active.remove(trial)
+                    continue
+                trial.iteration = row.get("iteration", trial.iteration + 1)
+                metrics = dict(row["metrics"])
+                metrics.setdefault("training_iteration", trial.iteration)
+                trial.results.append(metrics)
+                if row.get("checkpoint") is not None:
+                    trial.latest_checkpoint = row["checkpoint"]
+                decision = self.scheduler.on_result(trial, metrics, self)
+                if decision == sched_mod.STOP:
+                    trial.status = "STOPPED"
+                    self._stop_actor(trial)
+                    active.remove(trial)
+            for trial, source, new_config in self._pending_exploits:
+                if trial in active:
+                    self._stop_actor(trial)
+                    trial.config = new_config
+                    trial.latest_checkpoint = source.latest_checkpoint
+                    self._start_trial(
+                        trial, resume=source.latest_checkpoint)
+            self._pending_exploits.clear()
+            if not progressed:
+                time.sleep(0.05)
+        return self.trials
+
+    def _start_trial(self, trial: Trial, resume=None):
+        actor_cls = ray_tpu.remote(_TrialActor)
+        opts = dict(self.resources)
+        trial.actor = actor_cls.options(
+            num_cpus=opts.pop("CPU", 1), resources=opts or None).remote()
+        # Fully async: actor creation may queue behind running trials for
+        # resources — blocking here would starve the poll loop that frees
+        # them. run() and the first next_result() chain in submission order.
+        trial.actor.run.remote(
+            self.trainable, trial.config,
+            resume if resume is not None else trial.latest_checkpoint)
+        trial.status = "RUNNING"
+        trial._pending = trial.actor.next_result.remote()
+
+    def _poll(self, trial: Trial):
+        ready, _ = ray_tpu.wait([trial._pending], num_returns=1, timeout=0.01)
+        if not ready:
+            return None
+        try:
+            row = ray_tpu.get(ready[0])
+        except Exception as e:  # actor died etc.
+            return {"done": True, "error": e}
+        if not row.get("done"):
+            trial._pending = trial.actor.next_result.remote()
+        return row
+
+    def _stop_actor(self, trial: Trial):
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+
+class ResultGrid:
+    def __init__(self, trials: list[Trial], metric: str | None,
+                 mode: str = "max"):
+        self.trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self.trials)
+
+    def __getitem__(self, i) -> Result:
+        t = self.trials[i]
+        return Result(metrics=t.last_result, checkpoint=t.latest_checkpoint,
+                      error=t.error, metrics_history=t.results)
+
+    def get_best_result(self, metric: str | None = None,
+                        mode: str | None = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [t for t in self.trials
+                  if t.results and metric in t.last_result]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        best = (max if mode == "max" else min)(
+            scored, key=lambda t: t.last_result[metric])
+        return Result(metrics=best.last_result,
+                      checkpoint=best.latest_checkpoint,
+                      error=best.error, metrics_history=best.results)
+
+    @property
+    def errors(self):
+        return [t.error for t in self.trials if t.error is not None]
+
+
+class Tuner:
+    """(reference: tune/tuner.py:220)"""
+
+    def __init__(self, trainable, *, param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 resources_per_trial: dict | None = None):
+        if hasattr(trainable, "as_trainable"):   # a Trainer
+            trainable = trainable.as_trainable()
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+
+    def fit(self) -> ResultGrid:
+        configs = BasicVariantGenerator(
+            self.param_space, self.tune_config.num_samples,
+            seed=self.tune_config.seed).generate()
+        trials = [Trial(c) for c in configs]
+        runner = TrialRunner(self.trainable, trials, self.tune_config,
+                             self.run_config, self.resources_per_trial)
+        runner.run()
+        return ResultGrid(trials, self.tune_config.metric,
+                          self.tune_config.mode)
+
+
+def run(trainable, *, config: dict | None = None, num_samples: int = 1,
+        metric: str | None = None, mode: str = "max", scheduler=None,
+        resources_per_trial: dict | None = None, **_ignored) -> ResultGrid:
+    """Functional entry point (reference: tune/tune.py:130)."""
+    tuner = Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(num_samples=num_samples, metric=metric,
+                               mode=mode, scheduler=scheduler),
+        resources_per_trial=resources_per_trial)
+    return tuner.fit()

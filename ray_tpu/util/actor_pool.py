@@ -1,0 +1,83 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py) — work distribution
+over a fixed set of actors with streaming results."""
+from __future__ import annotations
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: list):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._pending: list = []          # (fn, value) waiting for an actor
+        self._results_order: list = []    # refs in submit order
+        self._next_return = 0
+
+    def submit(self, fn, value):
+        """fn: (actor, value) -> ObjectRef"""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = actor
+            self._results_order.append(ref)
+        else:
+            self._pending.append((fn, value))
+
+    def _reclaim(self, ref):
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            if self._pending:
+                fn, value = self._pending.pop(0)
+                new_ref = fn(actor, value)
+                self._future_to_actor[new_ref] = actor
+                self._results_order.append(new_ref)
+            else:
+                self._idle.append(actor)
+
+    def get_next(self, timeout: float | None = None):
+        """Next result in submission order."""
+        if self._next_return >= len(self._results_order):
+            # invariant: each consumed ref reclaims its actor and drains one
+            # pending item into _results_order, so an index beyond the list
+            # means nothing was submitted
+            raise StopIteration("no pending results")
+        ref = self._results_order[self._next_return]
+        value = ray_tpu.get(ref, timeout=timeout)   # may raise: cursor stays
+        self._next_return += 1
+        self._reclaim(ref)
+        return value
+
+    def get_next_unordered(self, timeout: float | None = None):
+        outstanding = [r for r in self._results_order[self._next_return:]
+                       if r in self._future_to_actor]
+        if not outstanding:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(outstanding, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        self._results_order.remove(ref)
+        self._results_order.insert(self._next_return, ref)
+        self._next_return += 1
+        value = ray_tpu.get(ref)
+        self._reclaim(ref)
+        return value
+
+    def map(self, fn, values: list):
+        for v in values:
+            self.submit(fn, v)
+        for _ in values:
+            yield self.get_next()
+
+    def map_unordered(self, fn, values: list):
+        for v in values:
+            self.submit(fn, v)
+        for _ in values:
+            yield self.get_next_unordered()
+
+    def has_next(self) -> bool:
+        return self._next_return < len(self._results_order) \
+            or bool(self._pending)
+
+    def has_free(self) -> bool:
+        return bool(self._idle)

@@ -1,0 +1,83 @@
+"""Distributed FIFO queue backed by an actor
+(reference: python/ray/util/queue.py)."""
+from __future__ import annotations
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import queue as _q
+
+        self.q = _q.Queue(maxsize=maxsize)
+
+    def put(self, item, block=True, timeout=None):
+        import queue as _q
+
+        try:
+            self.q.put(item, block=block, timeout=timeout)
+            return True
+        except _q.Full:
+            return False
+
+    def get(self, block=True, timeout=None):
+        import queue as _q
+
+        try:
+            return (True, self.q.get(block=block, timeout=timeout))
+        except _q.Empty:
+            return (False, None)
+
+    def qsize(self):
+        return self.q.qsize()
+
+    def empty(self):
+        return self.q.empty()
+
+    def full(self):
+        return self.q.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: dict | None = None):
+        opts = {"num_cpus": 0, "max_concurrency": 8,
+                **(actor_options or {})}
+        self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
+            maxsize)
+
+    def put(self, item, block: bool = True, timeout: float | None = None):
+        ok = ray_tpu.get(self.actor.put.remote(item, block, timeout))
+        if not ok:
+            raise Full("queue full")
+
+    def get(self, block: bool = True, timeout: float | None = None):
+        ok, item = ray_tpu.get(self.actor.get.remote(block, timeout))
+        if not ok:
+            raise Empty("queue empty")
+        return item
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    def shutdown(self):
+        ray_tpu.kill(self.actor)

@@ -1,0 +1,181 @@
+"""Dataset tests (the reference's python/ray/data/tests tier): transforms,
+shuffle, sort, split, batching, groupby, IO."""
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def ds_env(ray_start_regular):
+    yield ray_start_regular
+
+
+def test_range_map_filter(ds_env):
+    from ray_tpu import data
+
+    ds = data.range(100, parallelism=4)
+    out = ds.map(lambda x: x * 2).filter(lambda x: x % 10 == 0).take_all()
+    assert out == [x * 2 for x in range(100) if (x * 2) % 10 == 0]
+
+
+def test_flat_map_and_count(ds_env):
+    from ray_tpu import data
+
+    ds = data.from_items([1, 2, 3], parallelism=2)
+    out = ds.flat_map(lambda x: [x] * x)
+    assert out.count() == 6
+    assert sorted(out.take_all()) == [1, 2, 2, 3, 3, 3]
+
+
+def test_map_batches_numpy(ds_env):
+    from ray_tpu import data
+
+    ds = data.from_numpy(np.arange(32.0), parallelism=4)
+    out = ds.map_batches(lambda arr: arr * 10).to_numpy()
+    assert (np.sort(out) == np.arange(32.0) * 10).all()
+
+
+def test_map_batches_actor_pool(ds_env):
+    from ray_tpu import data
+    from ray_tpu.data import ActorPoolStrategy
+
+    ds = data.range(16, parallelism=4).map(lambda x: x + 1)
+    mat = ds.materialize(compute=ActorPoolStrategy(size=2))
+    assert sorted(mat.take_all()) == list(range(1, 17))
+
+
+def test_random_shuffle(ds_env):
+    from ray_tpu import data
+
+    ds = data.range(64, parallelism=4)
+    shuffled = ds.random_shuffle(seed=7).take_all()
+    assert sorted(shuffled) == list(range(64))
+    assert shuffled != list(range(64)), "shuffle left data ordered"
+
+
+def test_sort(ds_env):
+    from ray_tpu import data
+
+    rng = np.random.default_rng(0)
+    values = [int(v) for v in rng.integers(0, 1000, size=80)]
+    ds = data.from_items(values, parallelism=4)
+    out = ds.sort()
+    assert out.take_all() == sorted(values)
+    out_desc = data.from_items(values, parallelism=4).sort(descending=True)
+    assert out_desc.take_all() == sorted(values, reverse=True)
+
+
+def test_sort_by_key(ds_env):
+    from ray_tpu import data
+
+    rows = [{"k": i % 5, "v": i} for i in range(20)]
+    out = data.from_items(rows, parallelism=3).sort(key="k").take_all()
+    assert [r["k"] for r in out] == sorted(r["k"] for r in rows)
+
+
+def test_split_for_workers(ds_env):
+    from ray_tpu import data
+
+    ds = data.range(40, parallelism=4)
+    shards = ds.split(2)
+    assert len(shards) == 2
+    all_rows = sorted(shards[0].take_all() + shards[1].take_all())
+    assert all_rows == list(range(40))
+
+
+def test_iter_batches(ds_env):
+    from ray_tpu import data
+
+    ds = data.from_numpy(np.arange(100.0), parallelism=4)
+    batches = list(ds.iter_batches(batch_size=32))
+    sizes = [len(b) for b in batches]
+    assert sum(sizes) == 100
+    assert sizes[:-1] == [32, 32, 32]
+
+
+def test_iter_batches_device_put(ds_env):
+    import jax
+
+    from ray_tpu import data
+
+    ds = data.range(32, parallelism=2)
+    batches = list(ds.iter_batches(batch_size=16, device_put=True))
+    assert all(isinstance(b, jax.Array) for b in batches)
+    total = sum(float(b.sum()) for b in batches)
+    assert total == sum(range(32))
+
+
+def test_groupby(ds_env):
+    from ray_tpu import data
+
+    rows = [{"team": t, "score": s}
+            for t, s in [("a", 1), ("b", 2), ("a", 3), ("b", 4), ("a", 5)]]
+    counts = {r["key"]: r["count"]
+              for r in data.from_items(rows).groupby("team").count()
+              .take_all()}
+    assert counts == {"a": 3, "b": 2}
+    sums = {r["key"]: r["value"]
+            for r in data.from_items(rows).groupby("team")
+            .aggregate(lambda g: sum(r["score"] for r in g)).take_all()}
+    assert sums == {"a": 9, "b": 6}
+
+
+def test_union_zip_repartition(ds_env):
+    from ray_tpu import data
+
+    a = data.range(5, parallelism=2)
+    b = data.from_items([10, 11], parallelism=1)
+    assert sorted(a.union(b).take_all()) == [0, 1, 2, 3, 4, 10, 11]
+    zipped = data.from_items([1, 2]).zip(data.from_items(["x", "y"]))
+    assert zipped.take_all() == [(1, "x"), (2, "y")]
+    rp = data.range(10, parallelism=5).repartition(2)
+    assert rp.num_blocks == 2
+    assert sorted(rp.take_all()) == list(range(10))
+
+
+def test_pandas_io_roundtrip(ds_env, tmp_path):
+    import pandas as pd
+
+    from ray_tpu import data
+
+    df = pd.DataFrame({"x": range(10), "y": [f"s{i}" for i in range(10)]})
+    csv = tmp_path / "t.csv"
+    df.to_csv(csv, index=False)
+    ds = data.read_csv(str(csv))
+    assert ds.count() == 10
+    back = ds.to_pandas()
+    assert list(back["x"]) == list(range(10))
+
+    pq = tmp_path / "t.parquet"
+    df.to_parquet(pq)
+    assert data.read_parquet(str(pq)).count() == 10
+
+
+def test_json_text_io(ds_env, tmp_path):
+    from ray_tpu import data
+
+    j = tmp_path / "t.jsonl"
+    j.write_text('{"a": 1}\n{"a": 2}\n')
+    assert [r["a"] for r in data.read_json(str(j)).take_all()] == [1, 2]
+    t = tmp_path / "t.txt"
+    t.write_text("hello\nworld\n")
+    assert data.read_text(str(t)).take_all() == ["hello", "world"]
+
+
+def test_dataset_in_trainer(ds_env):
+    ray = ds_env
+    from ray_tpu import data
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train import JaxTrainer
+
+    def loop(config):
+        from ray_tpu.air import session
+
+        shard = session.get_dataset_shard("train")
+        total = sum(shard.take_all())
+        session.report({"total": total})
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": data.range(20, parallelism=4)})
+    result = trainer.fit()
+    assert result.error is None

@@ -140,3 +140,35 @@ def test_object_not_freed_while_task_uses_it(ray_start_regular):
 
     gc.collect()
     assert ray.get(out, timeout=60) == 200_000.0
+
+
+def test_leases_reclaimed_when_lessee_dies(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Leaker:
+        def spawn_and_die(self):
+            import os
+
+            import ray_tpu
+
+            @ray_tpu.remote
+            def child():
+                time.sleep(60)
+
+            child.remote()      # acquires a lease from the raylet
+            time.sleep(1.0)     # let the lease be granted
+            os._exit(1)         # die without returning it
+
+    a = Leaker.remote()
+    try:
+        ray.get(a.spawn_and_die.remote(), timeout=30)
+    except Exception:
+        pass
+    raylet = ray._private.api._global_node.raylet
+    deadline = time.time() + 20
+    while time.time() < deadline and \
+            raylet.resources_avail.get("CPU", 0) < 4.0:
+        time.sleep(0.3)
+    assert raylet.resources_avail["CPU"] == pytest.approx(4.0), \
+        "leases of a dead lessee must be reclaimed"

@@ -1,0 +1,74 @@
+"""ActorPool and distributed Queue tests (reference:
+python/ray/tests/test_actor_pool.py, test_queue.py)."""
+import pytest
+
+
+def test_actor_pool_map(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray.remote
+    class Doubler:
+        def double(self, x):
+            return 2 * x
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), list(range(8))))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_actor_pool_unordered(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray.remote
+    class Sleeper:
+        def work(self, t):
+            import time
+
+            time.sleep(t)
+            return t
+
+    pool = ActorPool([Sleeper.remote() for _ in range(2)])
+    out = list(pool.map_unordered(lambda a, v: a.work.remote(v),
+                                  [0.4, 0.05]))
+    assert sorted(out) == [0.05, 0.4]
+    assert out[0] == 0.05, "unordered map must yield fastest first"
+
+
+def test_queue_basic(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.util.queue import Empty, Queue
+
+    q = Queue(maxsize=4)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+    assert q.get() == "b"
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_across_tasks(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.util.queue import Queue
+
+    q = Queue()
+
+    @ray.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    @ray.remote
+    def consumer(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    p = producer.remote(q, 5)
+    c = consumer.remote(q, 5)
+    assert ray.get(p, timeout=60)
+    assert sorted(ray.get(c, timeout=60)) == list(range(5))
+    q.shutdown()
