@@ -113,6 +113,7 @@ class GcsServer:
         self.kv: dict[str, dict[bytes, bytes]] = {}
         self.object_locations: dict[bytes, set[str]] = {}
         self.object_sizes: dict[bytes, int] = {}
+        self.lost_objects: set[bytes] = set()  # created, then all copies died
         self.placement_groups: dict[bytes, PlacementGroupInfo] = {}
         self.job_counter = 0
         self.cluster_id = uuid.uuid4().hex
@@ -151,9 +152,14 @@ class GcsServer:
             if node is None or not node.alive:
                 return
             node.alive = False
-            # Objects whose only copies were there are gone.
+            # Objects whose only copies were there are gone — record them as
+            # lost so owners raise ObjectLostError instead of polling forever
+            # (reference: reconstruction kicks in here, object_recovery_manager.h;
+            # our lineage re-execution consumes the same signal).
             for oid, locs in list(self.object_locations.items()):
                 locs.discard(node_id)
+                if not locs and oid in self.object_sizes:
+                    self.lost_objects.add(oid)
             for actor in self.actors.values():
                 if actor.node_id != node_id:
                     continue
@@ -253,6 +259,7 @@ class GcsServer:
                                 size: int = 0):
         with self._lock:
             self.object_locations.setdefault(object_id, set()).add(node_id)
+            self.lost_objects.discard(object_id)  # recreated copies revive it
             if size:
                 self.object_sizes[object_id] = size
         return True
@@ -271,6 +278,7 @@ class GcsServer:
             return {
                 "nodes": [self.nodes[n].snapshot() for n in node_ids],
                 "size": self.object_sizes.get(object_id, 0),
+                "lost": object_id in self.lost_objects,
             }
 
     def rpc_free_objects(self, conn, object_ids: list[bytes]):

@@ -13,6 +13,7 @@ tuple. kind is REQUEST/REPLY/PUSH.
 from __future__ import annotations
 
 import io
+import os
 import pickle
 import socket
 import struct
@@ -117,7 +118,11 @@ class RpcClient:
                     except Exception:
                         pass
         except (ConnectionLost, OSError, EOFError, pickle.UnpicklingError):
-            pass
+            if os.environ.get("RAY_TPU_RPC_DEBUG"):
+                import traceback
+                print(f"[rpc-debug pid={os.getpid()}] client read_loop to "
+                      f"{self.addr} died:", flush=True)
+                traceback.print_exc()
         finally:
             self._closed = True
             err = _RemoteError(ConnectionLost(f"connection to {self.addr} lost"))
@@ -243,9 +248,22 @@ class RpcServer:
                 sock, addr = self._listener.accept()
             except OSError:
                 return
+            if self._stopped:
+                # stop() raced the accept (stop() joins us before releasing
+                # the listener fd, so this conn is genuinely ours): the
+                # server is going down — close instead of serving.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn = Connection(sock, addr)
             self._conns[conn.id] = conn
+            if os.environ.get("RAY_TPU_RPC_DEBUG"):
+                print(f"[rpc-debug pid={os.getpid()}] "
+                      f"{type(self._handler).__name__}@{self.addr} accepted "
+                      f"conn from {addr}", flush=True)
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True,
                              name=f"rpc-conn-{addr}").start()
 
@@ -266,8 +284,12 @@ class RpcServer:
                         self._lookup(method)(conn, **kwargs)
                     except Exception:
                         pass
-        except (ConnectionLost, OSError, EOFError, pickle.UnpicklingError):
-            pass
+        except (ConnectionLost, OSError, EOFError, pickle.UnpicklingError) as e:
+            if os.environ.get("RAY_TPU_RPC_DEBUG"):
+                print(f"[rpc-debug pid={os.getpid()}] "
+                      f"{type(self._handler).__name__}@{self.addr} conn from "
+                      f"{conn.peer} died: {type(e).__name__}: {e} "
+                      f"(stopped={self._stopped})", flush=True)
         finally:
             conn.alive = False
             self._conns.pop(conn.id, None)
@@ -303,6 +325,23 @@ class RpcServer:
 
     def stop(self):
         self._stopped = True
+        if os.environ.get("RAY_TPU_RPC_DEBUG"):
+            print(f"[rpc-debug pid={os.getpid()}] "
+                  f"{type(self._handler).__name__}@{self.addr} stop(): closing "
+                  f"{len(self._conns)} conns", flush=True)
+        # Wake the accept thread BEFORE releasing the listener fd: a thread
+        # blocked in accept() does not notice close(), and once the fd number
+        # is reused by a new listener in this process the stale thread would
+        # steal (and instantly close) the new server's connections. shutdown()
+        # interrupts the blocked accept; join guarantees the thread is gone
+        # before close() frees the fd for reuse.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        if self._accept_thread.is_alive() and \
+                threading.current_thread() is not self._accept_thread:
+            self._accept_thread.join(timeout=5.0)
         try:
             self._listener.close()
         except OSError:

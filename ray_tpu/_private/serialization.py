@@ -9,7 +9,11 @@ the runtime can track borrows and resolve nested refs).
 Wire layout of a serialized value:
     [u32 meta_len][meta pickle][buffer 0][buffer 1]...
 meta = {"payload": <pickled-with-oob-markers>, "buffer_sizes": [...],
-        "refs": [(id, owner_addr), ...], "error": bool}
+        "refs": [(id, owner_addr), ...], "raised": bool}
+"raised" is True only for payloads produced by serialize_error (the task
+RAISED); a task that merely *returns* an exception object has raised=False
+and ray_tpu.get() returns it instead of raising (reference parity: only
+RayTaskError wrappers re-raise, worker.py get path).
 """
 from __future__ import annotations
 
@@ -42,8 +46,9 @@ class _RefPlaceholder:
         self.index = index
 
 
-def serialize(value) -> bytes:
-    """Serialize a Python value; returns the framed bytes."""
+def serialize(value, raised: bool = False) -> bytes:
+    """Serialize a Python value; returns the framed bytes. raised=True marks
+    the payload as a shipped task failure (set by serialize_error only)."""
     buffers: list = []
     refs: list = []
     ref_index: dict[bytes, int] = {}
@@ -83,7 +88,7 @@ def serialize(value) -> bytes:
             "payload": payload,
             "buffer_sizes": [b.nbytes for b in buffers],
             "refs": refs,
-            "error": isinstance(value, BaseException),
+            "raised": raised,
         },
         protocol=pickle.HIGHEST_PROTOCOL,
     )
@@ -134,10 +139,11 @@ def _map_matching(value, kind, fn, depth=0):
     return value
 
 
-def deserialize(data, worker=None):
+def deserialize(data, worker=None, with_meta: bool = False):
     """Inverse of serialize. `data` may be bytes or memoryview (zero-copy from
     the shm store). If the value is a shipped exception it is returned (not
-    raised) — callers decide."""
+    raised) — callers decide via meta["raised"] (with_meta=True returns
+    (value, meta))."""
     view = memoryview(data)
     (meta_len,) = _U32.unpack(view[:4])
     meta = pickle.loads(view[4:4 + meta_len])
@@ -153,7 +159,10 @@ def deserialize(data, worker=None):
     ]
 
     value = pickle.loads(meta["payload"], buffers=buffers)
-    return _map_matching(value, _RefPlaceholder, lambda ph: refs[ph.index])
+    value = _map_matching(value, _RefPlaceholder, lambda ph: refs[ph.index])
+    if with_meta:
+        return value, meta
+    return value
 
 
 def serialize_error(exc: BaseException, task_desc: str = "") -> bytes:
@@ -161,11 +170,12 @@ def serialize_error(exc: BaseException, task_desc: str = "") -> bytes:
     wrapped = exc if isinstance(exc, RayError) else RayTaskError(
         type(exc).__name__, _format_tb(exc), cause=exc, task_desc=task_desc)
     try:
-        return serialize(wrapped)
+        return serialize(wrapped, raised=True)
     except Exception:
         return serialize(
             RayTaskError(type(exc).__name__, _format_tb(exc),
-                         cause=None, task_desc=task_desc))
+                         cause=None, task_desc=task_desc),
+            raised=True)
 
 
 def _format_tb(exc: BaseException) -> str:

@@ -12,6 +12,7 @@ pushed tasks).
 """
 from __future__ import annotations
 
+import collections
 import hashlib
 import os
 import queue
@@ -43,6 +44,59 @@ class _PendingValue:
     def __init__(self):
         self.event = threading.Event()
         self.data = None
+
+
+class FifoSemaphore:
+    """Counting semaphore granting slots in enqueue order.
+
+    threading.Semaphore wakes waiters in unspecified order, which would let
+    actor call m3 run before m2 even at max_concurrency=1; grant order here
+    follows enqueue order, which the per-caller seq gate makes equal to
+    submission order (reference: actor_scheduling_queue.h runs client-side
+    sequence numbers in order; concurrency groups bound parallelism)."""
+
+    def __init__(self, n: int):
+        self._n = max(1, n)
+        self._lock = threading.Lock()
+        self._active = 0
+        self._waiters: "collections.deque[threading.Event]" = \
+            collections.deque()
+
+    def enqueue(self):
+        """Reserve a place in line without blocking. Returns a ticket to pass
+        to wait(); None means the slot was granted immediately."""
+        with self._lock:
+            if self._active < self._n and not self._waiters:
+                self._active += 1
+                return None
+            ev = threading.Event()
+            self._waiters.append(ev)
+            return ev
+
+    def wait(self, ticket):
+        if ticket is not None:
+            ticket.wait()
+
+    def release(self):
+        with self._lock:
+            if self._waiters:
+                # hand the slot to the next in line (active count unchanged)
+                self._waiters.popleft().set()
+            else:
+                self._active -= 1
+
+    def cancel(self, ticket):
+        """Back out of the line (task aborted before running)."""
+        if ticket is None:
+            self.release()
+            return
+        with self._lock:
+            try:
+                self._waiters.remove(ticket)
+                return
+            except ValueError:
+                pass  # already granted by a release() — give the slot back
+        self.release()
 
 
 class MemoryStore:
@@ -283,7 +337,20 @@ class _ActorQueue:
 
     def _connect(self, timeout: float = 60.0):
         """Resolve the actor address (waiting through RESTARTING) and open a
-        connection."""
+        connection.
+
+        MUST NOT hold self._lock while polling: assign_seq() runs on the
+        caller's thread for every handle.method.remote(), and a submit
+        thread camped on the lock here (up to 60s while the actor is
+        pending) would block the caller — in Tune this deadlocked the
+        driver's poll loop against a queued trial actor whose resources
+        only free when the poll loop runs. The lock guards only the client
+        field handoff.
+
+        A PENDING_CREATION actor does not count against the timeout: like
+        the reference (tasks buffer until the actor schedules,
+        direct_actor_task_submitter.h), creation may legitimately wait
+        behind resource availability for arbitrarily long."""
         with self._lock:
             if self.client is not None:
                 if not self.client.closed:
@@ -291,27 +358,36 @@ class _ActorQueue:
                 # stale connection: new epoch so the replacement actor's
                 # receiver doesn't wait for seqs lost with the old process
                 self._on_connection_lost()
-            deadline = time.time() + timeout
-            while time.time() < deadline:
-                info = self.worker.gcs.call("get_actor",
-                                            actor_id=self.actor_id)
-                if info is None:
-                    raise exc.ActorDiedError(self.actor_id.hex(),
-                                             "actor not found")
-                if info["state"] == "DEAD":
-                    raise exc.ActorDiedError(self.actor_id.hex(),
-                                             info.get("death_cause") or "dead")
-                if info["state"] == "ALIVE" and info["addr"]:
-                    try:
-                        self.client = RpcClient(tuple(info["addr"]),
-                                                timeout=None)
+        deadline = time.time() + timeout
+        while True:
+            info = self.worker.gcs.call("get_actor",
+                                        actor_id=self.actor_id)
+            if info is None:
+                raise exc.ActorDiedError(self.actor_id.hex(),
+                                         "actor not found")
+            if info["state"] == "DEAD":
+                raise exc.ActorDiedError(self.actor_id.hex(),
+                                         info.get("death_cause") or "dead")
+            if info["state"] == "ALIVE" and info["addr"]:
+                try:
+                    c = RpcClient(tuple(info["addr"]), timeout=None)
+                except ConnectionLost:
+                    c = None  # raced a death; loop
+                if c is not None:
+                    with self._lock:
+                        if self.client is not None and \
+                                not self.client.closed:
+                            c.close()  # another submit thread won the race
+                            return self.client
+                        self.client = c
                         self.addr = tuple(info["addr"])
-                        return self.client
-                    except ConnectionLost:
-                        pass  # raced a death; loop
-                time.sleep(0.05)
-            raise exc.GetTimeoutError(
-                f"actor {self.actor_id.hex()} not ready in {timeout}s")
+                        return c
+            if info["state"] == "PENDING_CREATION":
+                deadline = time.time() + timeout   # not a failure: queued
+            elif time.time() > deadline:
+                raise exc.GetTimeoutError(
+                    f"actor {self.actor_id.hex()} not ready in {timeout}s")
+            time.sleep(0.05)
 
     def assign_seq(self, spec: dict):
         """Must be called in program submission order (caller thread)."""
@@ -396,7 +472,7 @@ class CoreWorker:
         self._owned: set[bytes] = set()      # ids this process owns
         self._arg_pins: dict[bytes, int] = {}  # in-flight task-arg pins
         self._deferred_free: set[bytes] = set()
-        self._actor_concurrency = threading.Semaphore(1)
+        self._actor_concurrency = FifoSemaphore(1)
         self._func_cache: dict[bytes, object] = {}
         self._sched_queues: dict[tuple, _SchedulingKeyQueue] = {}
         self._actor_queues: dict[bytes, _ActorQueue] = {}
@@ -416,6 +492,8 @@ class CoreWorker:
         self._current_task_thread = None
         self._next_seq_to_run: dict[str, int] = {}
         self._seq_cond = threading.Condition()
+        self._col_mailbox: dict[tuple, object] = {}
+        self._col_cond = threading.Condition()
         self._ready = threading.Event()
         # Normal tasks execute serially: the lease under which tasks are
         # pushed accounts for exactly one task's resources at a time
@@ -520,16 +598,19 @@ class CoreWorker:
         for ref in refs:
             remaining = None if deadline is None else max(
                 0.0, deadline - time.time())
-            value = self._get_one(ref, remaining)
-            if isinstance(value, BaseException):
+            value, raised = self._get_one(ref, remaining)
+            if raised and isinstance(value, BaseException):
                 raise value
             out.append(value)
         return out[0] if single else out
 
     def _get_one(self, ref: ObjectRef, timeout: float | None):
+        # Only payloads shipped by serialize_error (the task raised) re-raise
+        # at get(); a task returning an exception object is a normal value
+        # (reference parity: only RayTaskError wrappers re-raise).
         data = self._fetch_bytes(ref, timeout)
-        value = ser.deserialize(data, self)
-        return value
+        value, meta = ser.deserialize(data, self, with_meta=True)
+        return value, meta.get("raised", False)
 
     def _fetch_bytes(self, ref: ObjectRef, timeout: float | None):
         deadline = None if timeout is None else time.time() + timeout
@@ -563,6 +644,11 @@ class CoreWorker:
                 data = self._ask_owner(ref, deadline)
                 if data is not None:
                     return data
+            # The GCS knows it was created and that every copy died with its
+            # node: fail fast unless the producing task is still in flight
+            # locally (a retry will republish a location).
+            if locs.get("lost") and ref.id not in self._ref_to_task:
+                raise exc.ObjectLostError(ref.hex())
             if deadline is not None and time.time() > deadline:
                 raise exc.GetTimeoutError(
                     f"get() timed out waiting for {ref.hex()}")
@@ -929,7 +1015,7 @@ class CoreWorker:
         RPCs, so blocking here is fine and gives natural backpressure)."""
         self._ready.wait(30.0)
         if spec.get("actor_id") is not None and self.actor_id is not None:
-            return self._execute_actor_task(spec)
+            return self._execute_actor_task(spec, conn)
         return self._execute_normal_task(spec)
 
     def _resolve_args(self, spec):
@@ -961,36 +1047,38 @@ class CoreWorker:
                 self._current_task_id = None
                 self._current_task_thread = None
 
-    def _execute_actor_task(self, spec: dict) -> dict:
-        # Per-caller ordering: run tasks in seq order for each caller
+    def _execute_actor_task(self, spec: dict, conn=None) -> dict:
+        # Per-caller ordering: DISPATCH tasks in seq order for each caller
         # (reference: actor_scheduling_queue.h client-side sequence numbers).
-        # The epoch scopes seqs to one client connection; bounded wait keeps
-        # liveness if a predecessor was lost to a dead connection.
+        # The gate orders entry into the FIFO concurrency semaphore, so
+        # max_concurrency=1 executes strictly in submission order while
+        # max_concurrency>1 pipelines without reordering starts. There is no
+        # wall-clock skip-ahead: a successor waits however long its
+        # predecessor runs; it only skips when the caller's connection is
+        # dead (the predecessor can no longer arrive, and replies would go
+        # nowhere anyway — advisor finding on the old 60s deadline).
         caller = f"{spec.get('caller_id', '')}:{spec.get('caller_epoch', 0)}"
         seq = spec.get("seq", 0)
-        deadline = time.time() + 60.0
         with self._seq_cond:
-            expected = self._next_seq_to_run.get(caller, 0)
-            while seq > expected and time.time() < deadline:
-                self._seq_cond.wait(timeout=1.0)
-                expected = self._next_seq_to_run.get(caller, 0)
-                if seq < expected:
+            while seq > self._next_seq_to_run.get(caller, 0):
+                if conn is not None and not conn.alive:
                     break
-        try:
-            result_packet = self._run_actor_method(spec)
-        finally:
-            with self._seq_cond:
-                cur = self._next_seq_to_run.get(caller, 0)
-                if seq >= cur:
-                    self._next_seq_to_run[caller] = seq + 1
-                self._seq_cond.notify_all()
-        return result_packet
+                self._seq_cond.wait(timeout=0.5)
+            # our turn (or dead caller): let the next seq through as soon as
+            # we are in line for a concurrency slot
+            ticket = self._actor_concurrency.enqueue()
+            cur = self._next_seq_to_run.get(caller, 0)
+            if seq >= cur:
+                self._next_seq_to_run[caller] = seq + 1
+            self._seq_cond.notify_all()
+        return self._run_actor_method(spec, ticket)
 
-    def _run_actor_method(self, spec: dict) -> dict:
+    def _run_actor_method(self, spec: dict, ticket=None) -> dict:
         import asyncio
         import inspect
 
         method_name = spec["method_name"]
+        acquired = False
         try:
             if method_name == "__ray_terminate__":
                 threading.Thread(target=self._graceful_exit,
@@ -998,20 +1086,26 @@ class CoreWorker:
                 return self._package_results(spec, None)
             method = getattr(self._actor_instance, method_name)
             args, kwargs = self._resolve_args(spec)
-            # max_concurrency gate: callers from different processes each
-            # arrive on their own handler thread; the semaphore (default 1)
-            # restores the serial-execution guarantee across ALL callers
-            # (reference: concurrency_group_manager.h / max_concurrency).
-            with self._actor_concurrency:
+            # max_concurrency gate: the FIFO semaphore (default 1 slot)
+            # restores the serial-execution guarantee across ALL callers in
+            # dispatch order (reference: concurrency_group_manager.h).
+            self._actor_concurrency.wait(ticket)
+            acquired = True
+            try:
                 if inspect.iscoroutinefunction(method):
                     fut = asyncio.run_coroutine_threadsafe(
                         method(*args, **kwargs), self._ensure_async_loop())
                     result = fut.result()
                 else:
                     result = method(*args, **kwargs)
+            finally:
+                self._actor_concurrency.release()
             return self._package_results(spec, result)
         except BaseException as e:  # noqa: BLE001
             return self._package_error(spec, e)
+        finally:
+            if not acquired:
+                self._actor_concurrency.cancel(ticket)
 
     def _ensure_async_loop(self):
         import asyncio
@@ -1066,7 +1160,7 @@ class CoreWorker:
         self._ready.wait(30.0)
         self.actor_id = actor_id
         self._actor_spec = spec
-        self._actor_concurrency = threading.Semaphore(
+        self._actor_concurrency = FifoSemaphore(
             max(1, int(spec.get("max_concurrency", 1) or 1)))
         cls = self._load_function(spec["class_hash"])
         args, kwargs = ser.deserialize(spec["args"], self)
@@ -1110,6 +1204,31 @@ class CoreWorker:
                 ctypes.pythonapi.PyThreadState_SetAsyncExc(
                     ctypes.c_long(ident), ctypes.py_object(KeyboardInterrupt))
         return True
+
+    # ---------------------------------------------- collective p2p mailbox
+    # Direct worker-to-worker data plane for ray_tpu.util.collective's host
+    # backend: ring/tree collectives push chunks straight between member
+    # processes instead of funnelling every tensor through one rendezvous
+    # actor (the reference's gloo backend is likewise peer-to-peer,
+    # gloo_collective_group.py; the named actor only rendezvouses metadata).
+
+    def col_push_local(self, key: tuple, data):
+        with self._col_cond:
+            self._col_mailbox[key] = data
+            self._col_cond.notify_all()
+
+    def rpc_col_push(self, conn, key: tuple, data):
+        self.col_push_local(tuple(key), data)
+        return True
+
+    def col_take(self, key: tuple, timeout: float = 300.0):
+        key = tuple(key)
+        with self._col_cond:
+            ok = self._col_cond.wait_for(lambda: key in self._col_mailbox,
+                                         timeout=timeout)
+            if not ok:
+                raise TimeoutError(f"collective recv timed out on {key}")
+            return self._col_mailbox.pop(key)
 
     def rpc_ping(self, conn):
         return "pong"

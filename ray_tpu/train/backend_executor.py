@@ -52,21 +52,39 @@ class JaxBackend(Backend):
         group_name = self.config.group_name
         col.create_collective_group(
             [w for w in worker_group.workers], world, list(range(world)),
-            backend="host", group_name=group_name)
+            backend=self.config.collective_backend, group_name=group_name)
         if self.config.distributed:
-            # rank 0's host becomes the jax.distributed coordinator
+            # rank 0's host becomes the jax.distributed coordinator; the
+            # port is negotiated on that host (a fixed default like
+            # 127.0.0.1:9876 collides on real pods — advisor finding)
+            coordinator = self.config.coordinator_address
+            if coordinator is None:
+                coordinator = worker_group.execute_single(
+                    0, "free_coordinator_address")
+
             def _init_jax_distributed(rank, world_size, coordinator):
                 import jax
 
-                jax.distributed.initialize(
-                    coordinator_address=coordinator,
-                    num_processes=world_size, process_id=rank)
+                if not (hasattr(jax.distributed, "is_initialized") and
+                        jax.distributed.is_initialized()):
+                    jax.distributed.initialize(
+                        coordinator_address=coordinator,
+                        num_processes=world_size, process_id=rank)
                 return True
 
-            coordinator = self.config.coordinator_address or "127.0.0.1:9876"
             worker_group.execute(
                 "run_setup",
                 (_init_jax_distributed, (coordinator,), {}))
+
+    def on_shutdown(self, worker_group):
+        # Tear the group down on every member: drops the per-process state
+        # and kills the rendezvous actor so the next run under this group
+        # name starts clean (advisor finding: the actor used to leak).
+        try:
+            worker_group.execute("destroy_collective",
+                                 self.config.group_name)
+        except Exception:
+            pass
 
 
 class JaxConfig:
@@ -74,10 +92,12 @@ class JaxConfig:
 
     def __init__(self, distributed: bool = False,
                  coordinator_address: str | None = None,
-                 group_name: str = "train_dp"):
+                 group_name: str = "train_dp",
+                 collective_backend: str = "host"):
         self.distributed = distributed
         self.coordinator_address = coordinator_address
         self.group_name = group_name
+        self.collective_backend = collective_backend
 
     def backend_cls(self):
         return JaxBackend(self)
@@ -104,8 +124,8 @@ class BackendExecutor:
         self.worker_group = WorkerGroup(
             self.scaling.num_workers, self.scaling.worker_resources(),
             placement_group=self.pg)
-        backend = self.backend_config.backend_cls()
-        backend.on_start(self.worker_group, self.scaling)
+        self.backend = self.backend_config.backend_cls()
+        self.backend.on_start(self.worker_group, self.scaling)
         return self
 
     def set_dataset_shards(self, name: str, shards: list):
@@ -115,12 +135,20 @@ class BackendExecutor:
     def start_training(self, train_fn, config):
         self.worker_group.execute("start_training", train_fn, config)
 
-    def next_results(self, timeout: float = 600.0):
-        """One row of results across the gang (or done/error markers)."""
+    def next_results(self, timeout: float | None = None):
+        """One row of results across the gang (or done/error markers).
+
+        Blocks as long as the train functions run: the per-worker
+        next_result only returns when a report arrives or the function
+        ends, so a driver-side deadline would spuriously kill long steps
+        (first-step XLA compile, big evals). Pass a timeout only to bound
+        a run you are willing to abandon."""
         return self.worker_group.execute("next_result", timeout=timeout)
 
     def shutdown(self):
         if self.worker_group is not None:
+            if getattr(self, "backend", None) is not None:
+                self.backend.on_shutdown(self.worker_group)
             self.worker_group.shutdown()
             self.worker_group = None
         if self.pg is not None:

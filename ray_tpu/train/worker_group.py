@@ -36,6 +36,23 @@ class TrainWorker:
         fn, args, kwargs = setup_fn_and_args
         return fn(self.world_rank, self.world_size, *args, **kwargs)
 
+    def free_coordinator_address(self):
+        """A jax.distributed coordinator endpoint on THIS worker's host
+        (port negotiated here instead of a collision-prone fixed default)."""
+        import socket
+
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        host = socket.gethostbyname(socket.gethostname())
+        return f"{host}:{port}"
+
+    def destroy_collective(self, group_name: str):
+        from ray_tpu.util import collective as col
+
+        return col.destroy_collective_group(group_name)
+
     def set_dataset_shard(self, name, shard):
         self.session.dataset_shards[name] = shard
 
@@ -59,23 +76,31 @@ class TrainWorker:
 
     def next_result(self, timeout: float = 300.0):
         """Blocks for the next session.report() payload; returns
-        {"done": True, "error": ...} when the function finishes."""
+        {"done": True, "error": ...} when the function finishes.
+
+        `timeout` only bounds the wait once the train thread is no longer
+        alive: while the user function is still running it may legitimately
+        go far longer than any fixed budget between reports (first-step XLA
+        compiles, large eval passes), and killing the run for that would be
+        spurious (advisor finding on the old hard 300s deadline)."""
         import queue as _q
 
-        deadline_step = 0.1
-        waited = 0.0
-        while waited < timeout:
+        waited_dead = 0.0
+        while True:
             try:
-                return self.session.results.get(timeout=deadline_step)
+                return self.session.results.get(timeout=0.1)
             except _q.Empty:
-                waited += deadline_step
                 if self.session.finished.is_set() and \
                         self.session.results.empty():
                     err = self.session.error
                     return {"done": True,
                             "error": err if err is None else
                             _stringify_error(err)}
-        raise TimeoutError("no result from train function")
+                if self._thread is None or not self._thread.is_alive():
+                    waited_dead += 0.1
+                    if waited_dead >= timeout:
+                        raise TimeoutError(
+                            "train thread gone without reporting a result")
 
     def shutdown(self):
         return True

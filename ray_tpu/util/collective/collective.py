@@ -6,21 +6,25 @@ init_collective_group :120, create_collective_group :151, allreduce :258,
 allgather, reducescatter, broadcast, reduce, send :531, recv :594,
 barrier) with TPU-native backends instead of NCCL/Gloo:
 
-- "host": cross-process collectives relayed through a rendezvous actor
-  (the analog of the reference's gloo CPU backend and of its NCCL
-  unique-id rendezvous via a named actor, nccl_collective_group.py:29-75).
-  Correct anywhere the runtime runs; bandwidth-bound by the object store.
-- "xla": members are jax processes forming one global device mesh; the ops
-  compile to ICI collectives (psum/all_gather/reduce_scatter/ppermute)
-  inside jit. Group creation materializes a jax.sharding.Mesh over the
-  member processes' chips (multi-host via jax.distributed). On-host
-  collectives inside ONE process should use the mesh directly
-  (ray_tpu.parallel.mesh); this layer exists for the actor-world.
+- "host": peer-to-peer ring/tree collectives between the member worker
+  processes (host_backend.py). The named group actor rendezvouses
+  MEMBERSHIP ONLY (rank -> worker address); tensor data moves directly
+  between members' mailboxes — the same decentralised topology class as
+  the reference's gloo/NCCL rings, never through a relay.
+- "xla": the group becomes a jax.distributed process world and every op
+  compiles to the XLA collective (psum / all_gather / psum_scatter) via
+  shard_map over a Mesh spanning the group (xla_backend.py). On TPU these
+  ride ICI; this is the SURVEY §5 retargeting of NCCL communicators.
+
+Group lifecycle (advisor finding, round 1): the rendezvous actor is OWNED
+by the group — destroy_collective_group kills it, and each group's
+rendezvous state is namespaced by a per-creation nonce so two runs reusing
+a group name (e.g. back-to-back Tune trials) can never see each other's
+membership or in-flight state.
 
 Semantics notes vs the reference: groups are named; ranks are dense
-[0, world_size); ops are synchronous (the reference's cupy-stream async
-semantics don't apply — XLA programs and host relays both complete before
-returning).
+[0, world_size); ops are synchronous and return the result (functional,
+jax-style) instead of mutating buffers in place.
 """
 from __future__ import annotations
 
@@ -29,87 +33,71 @@ import threading
 import numpy as np
 
 import ray_tpu
-from ray_tpu._private import api as _api
-
-_REDUCE_OPS = {
-    "sum": lambda arrs: _tree_reduce(arrs, np.add),
-    "product": lambda arrs: _tree_reduce(arrs, np.multiply),
-    "min": lambda arrs: _tree_reduce(arrs, np.minimum),
-    "max": lambda arrs: _tree_reduce(arrs, np.maximum),
-}
 
 
-def _tree_reduce(arrs, op):
-    out = arrs[0]
-    for a in arrs[1:]:
-        out = op(out, a)
-    return out
+class _Rendezvous:
+    """Named actor backing one collective group: membership exchange only.
 
-
-class _RendezvousStore:
-    """Named actor backing one collective group: mailbox + phased gather.
-
-    Runs anywhere; methods are called concurrently by all ranks, each in its
-    own handler thread, synchronized on conditions (this leans on the actor
-    runtime executing different callers' methods concurrently)."""
+    Carries no tensor data (round 1's design funnelled all ranks' tensors
+    through this actor; see host_backend.py for why that was replaced)."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
         self._cond = threading.Condition()
-        self._gathers: dict = {}      # (seq, tag) -> {rank: value}
-        self._results: dict = {}      # (seq, tag) -> reduced value
-        self._mailbox: dict = {}      # (seq, src, dst) -> value
-        self._done_count: dict = {}
+        self._members: dict[int, tuple] = {}
+        self._epoch = 0
+        self._coordinator_port = None
 
-    def gather_compute(self, seq, tag, rank, value, op):
-        """All-gather contributions; when complete, compute `op` once and
-        hand every rank the result."""
-        key = (seq, tag)
+    def join(self, rank: int, addr, timeout: float = 300.0):
+        """Register and block until the full membership is present.
+        Returns (members, coordinator_addr)."""
+        import time as _time
+
+        deadline = _time.time() + timeout
         with self._cond:
-            self._gathers.setdefault(key, {})[rank] = value
-            if len(self._gathers[key]) == self.world_size:
-                vals = [self._gathers[key][r]
-                        for r in range(self.world_size)]
-                if op == "gather":
-                    self._results[key] = vals
-                else:
-                    self._results[key] = _REDUCE_OPS[op](vals)
-                self._cond.notify_all()
-            else:
-                self._cond.wait_for(lambda: key in self._results,
-                                    timeout=300.0)
-                if key not in self._results:
+            if rank in self._members and tuple(addr) != self._members[rank]:
+                # a new worker took this rank (restart): new membership epoch
+                self._epoch += 1
+                self._members = {}
+            if self._coordinator_port is None:
+                import socket
+
+                s = socket.socket()
+                s.bind(("127.0.0.1", 0))
+                self._coordinator_port = s.getsockname()[1]
+                s.close()
+            while True:
+                # (re-)register under the current epoch: an epoch reset by a
+                # restarting peer wipes the table, so waiters must re-add
+                # themselves before waiting again
+                self._members[rank] = tuple(addr)
+                if len(self._members) == self.world_size:
+                    self._cond.notify_all()
+                    break
+                epoch = self._epoch
+                ok = self._cond.wait_for(
+                    lambda: (len(self._members) == self.world_size or
+                             self._epoch != epoch),
+                    timeout=max(0.0, deadline - _time.time()))
+                if not ok:
                     raise TimeoutError(
-                        f"collective {tag} seq={seq} timed out waiting for "
-                        f"{self.world_size - len(self._gathers[key])} ranks")
-            result = self._results[key]
-            self._done_count[key] = self._done_count.get(key, 0) + 1
-            if self._done_count[key] == self.world_size:
-                del self._gathers[key], self._results[key]
-                del self._done_count[key]
-            return result
-
-    def send(self, seq, src, dst, value):
-        with self._cond:
-            self._mailbox[(seq, src, dst)] = value
-            self._cond.notify_all()
-
-    def recv(self, seq, src, dst):
-        key = (seq, src, dst)
-        with self._cond:
-            self._cond.wait_for(lambda: key in self._mailbox, timeout=300.0)
-            if key not in self._mailbox:
-                raise TimeoutError(f"recv from rank {src} timed out")
-            return self._mailbox.pop(key)
+                        f"collective group rendezvous timed out with "
+                        f"{len(self._members)}/{self.world_size} ranks")
+                if self._epoch == epoch and \
+                        len(self._members) == self.world_size:
+                    break
+            host = self._members[0][0]
+            return dict(self._members), f"{host}:{self._coordinator_port}"
 
 
 class _GroupState:
-    def __init__(self, name, world_size, rank, backend, store_handle):
+    def __init__(self, name, world_size, rank, backend, impl, store_handle):
         self.name = name
         self.world_size = world_size
         self.rank = rank
         self.backend = backend
-        self.store = store_handle
+        self.impl = impl              # HostGroup or XlaGroup
+        self.store = store_handle     # rendezvous actor handle
         self.seq = 0
         self.p2p_seq: dict[tuple, int] = {}   # (src,dst) channel counters
         self.lock = threading.Lock()
@@ -140,12 +128,30 @@ class GroupManager:
         if backend not in ("host", "xla"):
             raise ValueError(f"unknown backend {backend!r} "
                              "(TPU-native backends: 'host', 'xla')")
-        store_cls = ray_tpu.remote(_RendezvousStore)
+        from ray_tpu._private.worker_runtime import current_worker
+
+        worker = current_worker()
+        if worker is None:
+            raise RuntimeError("init_collective_group requires ray_tpu to "
+                               "be initialized in this process")
+        store_cls = ray_tpu.remote(_Rendezvous)
         handle = store_cls.options(
             name=f"_collective_{group_name}", get_if_exists=True,
             num_cpus=0, max_concurrency=max(world_size, 2),
         ).remote(world_size)
-        state = _GroupState(group_name, world_size, rank, backend, handle)
+        members, coordinator = ray_tpu.get(
+            handle.join.remote(rank, worker.addr), timeout=330.0)
+
+        if backend == "xla":
+            from ray_tpu.util.collective.xla_backend import XlaGroup
+
+            impl = XlaGroup(group_name, world_size, rank, coordinator)
+        else:
+            from ray_tpu.util.collective.host_backend import HostGroup
+
+            impl = HostGroup(group_name, world_size, rank, members)
+        state = _GroupState(group_name, world_size, rank, backend, impl,
+                            handle)
         with self._lock:
             self._groups[group_name] = state
         return state
@@ -161,7 +167,20 @@ class GroupManager:
     def destroy(self, group_name):
         with self._lock:
             state = self._groups.pop(group_name, None)
-        return state is not None
+        if state is None:
+            return False
+        try:
+            state.impl.close()
+        except Exception:
+            pass
+        # Kill the rendezvous actor so a future group under the same name
+        # starts from clean state (advisor finding: the actor used to leak
+        # and leak state across runs).
+        try:
+            ray_tpu.kill(state.store)
+        except Exception:
+            pass
+        return True
 
 
 _manager = GroupManager()
@@ -225,7 +244,8 @@ def is_group_initialized(group_name: str = "default") -> bool:
 # ------------------------------------------------------------------ ops
 
 def _to_host(tensor):
-    """jax/torch/numpy → numpy (host relay works on host memory)."""
+    """jax/torch/numpy → numpy (collectives operate on host memory; the
+    xla backend device_puts shards back itself)."""
     if hasattr(tensor, "device") and hasattr(tensor, "addressable_shards"):
         return np.asarray(tensor)   # jax array
     if hasattr(tensor, "detach"):
@@ -237,51 +257,35 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum"):
     """In the reference (collective.py:258) this mutates in place via NCCL;
     here the reduced array is returned (functional, jax-style)."""
     g = _manager.get(group_name)
-    seq = g.next_seq()
-    return ray_tpu.get(g.store.gather_compute.remote(
-        seq, "allreduce", g.rank, _to_host(tensor), op))
+    return g.impl.allreduce(_to_host(tensor), op, g.next_seq())
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: str = "sum"):
     g = _manager.get(group_name)
-    seq = g.next_seq()
-    result = ray_tpu.get(g.store.gather_compute.remote(
-        seq, "reduce", g.rank, _to_host(tensor), op))
-    return result if g.rank == dst_rank else tensor
+    return g.impl.reduce(_to_host(tensor), dst_rank, op, g.next_seq())
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _manager.get(group_name)
-    seq = g.next_seq()
-    contributions = ray_tpu.get(g.store.gather_compute.remote(
-        seq, "broadcast", g.rank, _to_host(tensor) if g.rank == src_rank
-        else None, "gather"))
-    return contributions[src_rank]
+    return g.impl.broadcast(_to_host(tensor), src_rank, g.next_seq())
 
 
 def allgather(tensor, group_name: str = "default") -> list:
     g = _manager.get(group_name)
-    seq = g.next_seq()
-    return ray_tpu.get(g.store.gather_compute.remote(
-        seq, "allgather", g.rank, _to_host(tensor), "gather"))
+    return g.impl.allgather(_to_host(tensor), g.next_seq())
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
     """Each rank gets the rank-th equal chunk of the reduction."""
     g = _manager.get(group_name)
-    seq = g.next_seq()
-    reduced = ray_tpu.get(g.store.gather_compute.remote(
-        seq, "reducescatter", g.rank, _to_host(tensor), op))
-    chunks = np.array_split(reduced, g.world_size, axis=0)
-    return chunks[g.rank]
+    return g.impl.reducescatter(_to_host(tensor), op, g.next_seq())
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
     g = _manager.get(group_name)
     seq = g.next_p2p_seq(g.rank, dst_rank)
-    ray_tpu.get(g.store.send.remote(seq, g.rank, dst_rank,
-                                    _to_host(tensor)))
+    _p2p(g).send(_to_host(tensor), dst_rank, seq)
 
 
 def recv(src_rank: int, group_name: str = "default"):
@@ -289,11 +293,32 @@ def recv(src_rank: int, group_name: str = "default"):
     received array."""
     g = _manager.get(group_name)
     seq = g.next_p2p_seq(src_rank, g.rank)
-    return ray_tpu.get(g.store.recv.remote(seq, src_rank, g.rank))
+    return _p2p(g).recv(src_rank, seq)
 
 
 def barrier(group_name: str = "default"):
     g = _manager.get(group_name)
-    seq = g.next_seq()
-    ray_tpu.get(g.store.gather_compute.remote(
-        seq, "barrier", g.rank, None, "gather"))
+    g.impl.barrier(g.next_seq())
+
+
+def _p2p(g: _GroupState):
+    """p2p plane: host mailboxes for both backends (an SPMD program cannot
+    express a two-party exchange; the reference's p2p likewise bypasses the
+    collective rings)."""
+    if g.backend == "host":
+        return g.impl
+    host = getattr(g, "_host_p2p", None)
+    if host is None:
+        from ray_tpu.util.collective.host_backend import HostGroup
+
+        members, _ = ray_tpu.get(g.store.join.remote(
+            g.rank, _current_addr()), timeout=330.0)
+        host = HostGroup(g.name, g.world_size, g.rank, members)
+        g._host_p2p = host
+    return host
+
+
+def _current_addr():
+    from ray_tpu._private.worker_runtime import current_worker
+
+    return current_worker().addr

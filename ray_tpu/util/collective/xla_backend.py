@@ -1,0 +1,177 @@
+"""XLA collective backend: a group IS a jax process world.
+
+Group creation runs jax.distributed.initialize over the member processes
+(coordinator = rank 0, address exchanged through the group's rendezvous
+actor), materializing one global device world; every op then compiles to
+the corresponding XLA collective (psum / all_gather / psum_scatter) via
+shard_map over a Mesh spanning the group — on TPU these lower to ICI
+collectives, on the CPU test world to the Gloo cross-process backend.
+
+This is the retargeting SURVEY.md §5 prescribes for the reference's
+NCCL/gloo groups (nccl_collective_group.py: communicator per group,
+rendezvous via named actor): the "communicator" is the compiled program's
+collective, the rendezvous carries only the coordinator address.
+
+p2p send/recv are not SPMD ops (only two ranks participate) and ride the
+host mailbox plane — same split as the reference, whose p2p also bypasses
+collective rings (collective.py:531 send / :594 recv are point-to-point).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_OP_TO_LAX = ("sum", "product", "min", "max")
+
+
+class XlaGroup:
+    """Membership of this process in a jax.distributed world."""
+
+    def __init__(self, name: str, world_size: int, rank: int,
+                 coordinator: str):
+        import jax
+
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        # One jax.distributed world per process (jax constraint); a second
+        # xla group in the same process reuses it and must have the same
+        # membership shape.
+        already = jax.distributed.is_initialized() \
+            if hasattr(jax.distributed, "is_initialized") else False
+        if world_size > 1 and not already:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world_size,
+                process_id=rank,
+            )
+        if jax.process_count() not in (1, world_size):
+            raise RuntimeError(
+                f"xla group {name!r}: process already in a "
+                f"{jax.process_count()}-process world, cannot host a "
+                f"{world_size}-rank group")
+        self._jax = jax
+        self._mesh = None
+        self._fns: dict = {}
+
+    # -- mesh / compiled-op cache ------------------------------------------
+
+    def _ensure_mesh(self):
+        if self._mesh is None:
+            jax = self._jax
+            # one device per rank keeps the group axis == process axis
+            devs = []
+            by_proc: dict[int, list] = {}
+            for d in jax.devices():
+                by_proc.setdefault(d.process_index, []).append(d)
+            for p in sorted(by_proc):
+                devs.append(sorted(by_proc[p], key=lambda d: d.id)[0])
+            self._mesh = jax.sharding.Mesh(np.array(devs), ("ranks",))
+        return self._mesh
+
+    def _global_array(self, arr: np.ndarray):
+        """Stack this rank's array as its shard of a leading `ranks` axis."""
+        jax = self._jax
+        mesh = self._ensure_mesh()
+        spec = jax.sharding.PartitionSpec("ranks", *([None] * arr.ndim))
+        sharding = jax.sharding.NamedSharding(mesh, spec)
+        local_dev = [d for d in mesh.devices.flat
+                     if d.process_index == jax.process_index()][0]
+        shard = jax.device_put(arr[None, ...], local_dev)
+        return jax.make_array_from_single_device_arrays(
+            (self.world_size,) + arr.shape, sharding, [shard]), sharding
+
+    def _compiled(self, kind: str, op: str, shape, dtype):
+        key = (kind, op, shape, dtype)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self._ensure_mesh()
+        ndim = len(shape)
+        in_spec = P("ranks", *([None] * ndim))
+
+        def reduce_term(x):
+            # x: (1, *shape) block on this rank
+            if op == "sum":
+                return lax.psum(x, "ranks")
+            if op == "max":
+                return lax.pmax(x, "ranks")
+            if op == "min":
+                return lax.pmin(x, "ranks")
+            # product via exp/log is lossy; use all_gather + prod
+            g = lax.all_gather(x[0], "ranks")        # (world, *shape)
+            return jax.numpy.prod(g, axis=0)[None]
+
+        if kind == "allreduce":
+            body = reduce_term
+            out_spec = in_spec
+        elif kind == "reducescatter":
+            def body(x):
+                r = reduce_term(x)[0]                # (*shape,)
+                return lax.dynamic_slice_in_dim(
+                    r, lax.axis_index("ranks") * (shape[0] //
+                                                  self.world_size),
+                    shape[0] // self.world_size, axis=0)[None]
+            out_spec = in_spec
+        elif kind == "allgather":
+            def body(x):
+                return lax.all_gather(x[0], "ranks")[None]
+            out_spec = in_spec
+        else:
+            raise ValueError(kind)
+
+        sm = jax.shard_map(body, mesh=mesh, in_specs=in_spec,
+                           out_specs=out_spec)
+        fn = jax.jit(sm)
+        self._fns[key] = fn
+        return fn
+
+    # -- ops ----------------------------------------------------------------
+
+    def _run(self, kind: str, arr: np.ndarray, op: str = "sum"):
+        arr = np.asarray(arr)
+        garr, _ = self._global_array(arr)
+        fn = self._compiled(kind, op, arr.shape, str(arr.dtype))
+        out = fn(garr)
+        return np.asarray(out.addressable_shards[0].data[0])
+
+    def allreduce(self, arr, op, seq):
+        if self.world_size == 1:
+            return np.asarray(arr)
+        return self._run("allreduce", arr, op)
+
+    def reduce(self, arr, dst, op, seq):
+        out = self.allreduce(arr, op, seq)
+        return out if self.rank == dst else arr
+
+    def broadcast(self, arr, src, seq):
+        if self.world_size == 1:
+            return np.asarray(arr)
+        base = np.asarray(arr)
+        contrib = base if self.rank == src else np.zeros_like(base)
+        return self._run("allreduce", contrib, "sum")
+
+    def allgather(self, arr, seq) -> list:
+        if self.world_size == 1:
+            return [np.asarray(arr)]
+        stacked = self._run("allgather", np.asarray(arr))
+        return [stacked[i] for i in range(self.world_size)]
+
+    def reducescatter(self, arr, op, seq):
+        arr = np.asarray(arr)
+        if self.world_size == 1:
+            return arr
+        if arr.shape[0] % self.world_size:
+            # uneven leading dim: fall back to allreduce + local slice
+            out = self._run("allreduce", arr, op)
+            return np.array_split(out, self.world_size, axis=0)[self.rank]
+        return self._run("reducescatter", arr, op)
+
+    def barrier(self, seq):
+        self.allreduce(np.zeros((1,), np.float32), "sum", seq)
+
+    def close(self):
+        pass  # the jax.distributed world outlives individual groups

@@ -57,7 +57,9 @@ def test_actor_on_specific_node(two_node_cluster):
     cluster, ray = two_node_cluster
     from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
 
-    target = sorted(self_id["NodeID"] for self_id in ray.nodes())[-1]
+    # ray.nodes() includes the dead original head node (reference parity:
+    # dead nodes are listed with Alive=False) — only target alive ones.
+    target = sorted(n["NodeID"] for n in ray.nodes() if n["Alive"])[-1]
 
     @ray.remote
     class Pin:
@@ -186,3 +188,29 @@ def test_node_death_actor_restarts_elsewhere(two_node_cluster):
             time.sleep(0.5)
     assert second == cluster.head_node.node_id, (
         f"actor should restart on surviving node, got {second}")
+
+
+def test_get_raises_object_lost_on_node_death(ray_start_cluster):
+    """When every copy of a created object dies with its node, get() raises
+    ObjectLostError instead of polling forever (reference raises the same
+    after reconstruction is exhausted; advisor finding on the hang)."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)          # head: driver-only
+    node2 = cluster.add_node(num_cpus=2)
+    cluster.connect()
+    import ray_tpu
+    from ray_tpu.exceptions import ObjectLostError
+
+    @ray_tpu.remote(num_cpus=2, max_retries=0)
+    def produce():
+        return np.full(300_000, 3.0)      # > inline limit -> node2's store
+
+    ref = produce.remote()
+    # wait for creation WITHOUT fetching (a get() would cache a copy on the
+    # driver's node and the object would rightly not be lost)
+    done, _ = ray_tpu.wait([ref], timeout=60, fetch_local=False)
+    assert done, "produce task did not finish"
+    cluster.remove_node(node2)
+
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(ref, timeout=20)

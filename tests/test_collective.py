@@ -5,9 +5,10 @@ import numpy as np
 import pytest
 
 
-@pytest.fixture
-def collective_world(ray_start_regular):
+@pytest.fixture(params=["host", "xla"])
+def collective_world(request, ray_start_regular):
     ray = ray_start_regular
+    backend = request.param
     from ray_tpu.util.collective import CollectiveActorMixin
 
     @ray.remote
@@ -57,12 +58,28 @@ def collective_world(ray_start_regular):
             col.barrier()
             return value
 
+        def reduce_to0(self, value):
+            from ray_tpu.util import collective as col
+
+            return col.reduce(np.full(3, float(value)), dst_rank=0)
+
+        def destroy(self):
+            from ray_tpu.util import collective as col
+
+            col.destroy_collective_group()
+
     world_size = 2
     actors = [Rank.remote() for _ in range(world_size)]
     from ray_tpu.util import collective as col
 
-    col.create_collective_group(actors, world_size, list(range(world_size)))
+    col.create_collective_group(actors, world_size, list(range(world_size)),
+                                backend=backend)
     yield ray, actors
+    for a in actors:
+        try:
+            a.destroy.remote()
+        except Exception:
+            pass
 
 
 def test_allreduce(collective_world):
@@ -110,3 +127,69 @@ def test_barrier(collective_world):
     out = ray.get([a.barrier_then.remote(i) for i, a in enumerate(actors)],
                   timeout=60)
     assert out == [0, 1]
+
+
+def test_reduce(collective_world):
+    ray, actors = collective_world
+    out = ray.get([a.reduce_to0.remote(i + 1) for i, a in enumerate(actors)],
+                  timeout=60)
+    assert (out[0] == 3.0).all()      # dst rank holds the sum
+
+
+def test_host_ring_four_ranks(ray_start_regular):
+    """4-rank ring with a larger tensor: data crosses every link of the
+    decentralized ring (nothing funnels through one process)."""
+    ray = ray_start_regular
+    from ray_tpu.util.collective import CollectiveActorMixin
+    from ray_tpu.util import collective as col
+
+    @ray.remote
+    class Rank(CollectiveActorMixin):
+        def go(self, value):
+            from ray_tpu.util import collective as c
+
+            arr = np.full(1000, float(value))
+            total = c.allreduce(arr, group_name="ring4")
+            gathered = c.allgather(np.array([float(value)]),
+                                   group_name="ring4")
+            chunk = c.reducescatter(np.arange(8.0), group_name="ring4")
+            return total[0], [g[0] for g in gathered], chunk
+
+    n = 4
+    actors = [Rank.options(num_cpus=1).remote() for _ in range(n)]
+    col.create_collective_group(actors, n, list(range(n)), backend="host",
+                                group_name="ring4")
+    out = ray.get([a.go.remote(i + 1) for i, a in enumerate(actors)],
+                  timeout=120)
+    for rank, (total, gathered, chunk) in enumerate(out):
+        assert total == 10.0                       # 1+2+3+4
+        assert gathered == [1.0, 2.0, 3.0, 4.0]
+        assert list(chunk) == [4 * v for v in
+                               np.arange(8.0)[2 * rank:2 * rank + 2]]
+
+
+def test_group_reuse_after_destroy(ray_start_regular):
+    """ADVICE regression: back-to-back groups under the SAME name (two Tune
+    trials both using 'train_dp') must not share rendezvous state."""
+    ray = ray_start_regular
+    from ray_tpu.util.collective import CollectiveActorMixin
+    from ray_tpu.util import collective as col
+
+    @ray.remote
+    class Rank(CollectiveActorMixin):
+        def go(self, value):
+            from ray_tpu.util import collective as c
+
+            out = c.allreduce(np.full(2, float(value)), group_name="reused")
+            c.destroy_collective_group("reused")
+            return out[0]
+
+    for round_no in range(2):
+        actors = [Rank.remote() for _ in range(2)]
+        col.create_collective_group(actors, 2, [0, 1], backend="host",
+                                    group_name="reused")
+        out = ray.get([a.go.remote(round_no + i) for i, a in
+                       enumerate(actors)], timeout=60)
+        assert out[0] == out[1] == 2 * round_no + 1
+        for a in actors:
+            ray.kill(a)

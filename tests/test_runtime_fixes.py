@@ -172,3 +172,114 @@ def test_leases_reclaimed_when_lessee_dies(ray_start_regular):
         time.sleep(0.3)
     assert raylet.resources_avail["CPU"] == pytest.approx(4.0), \
         "leases of a dead lessee must be reclaimed"
+
+
+def test_returned_exception_is_a_value(ray_start_regular):
+    """A task that RETURNS an exception object yields it from get();
+    only a task that RAISES re-raises (reference: only RayTaskError
+    wrappers re-raise on the get path, _private/worker.py)."""
+    ray = ray_start_regular
+
+    @ray.remote
+    def collect_err():
+        return ValueError("collected, not raised")
+
+    out = ray.get(collect_err.remote(), timeout=30)
+    assert isinstance(out, ValueError)
+    assert "collected" in str(out)
+
+    @ray.remote
+    def boom():
+        raise ValueError("raised for real")
+
+    with pytest.raises(Exception) as ei:
+        ray.get(boom.remote(), timeout=30)
+    assert "raised for real" in str(ei.value)
+
+
+def test_returned_exception_in_list(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def maybe_fail(i):
+        if i % 2:
+            return RuntimeError(f"bad {i}")
+        return i
+
+    out = ray.get([maybe_fail.remote(i) for i in range(4)], timeout=30)
+    assert out[0] == 0 and out[2] == 2
+    assert isinstance(out[1], RuntimeError)
+    assert isinstance(out[3], RuntimeError)
+
+
+def test_fifo_semaphore_grant_order():
+    """Slots are granted strictly in enqueue order."""
+    import threading
+
+    from ray_tpu._private.worker_runtime import FifoSemaphore
+
+    sem = FifoSemaphore(1)
+    order = []
+    first = sem.enqueue()
+    assert first is None  # immediate grant
+
+    tickets = [sem.enqueue() for _ in range(3)]
+    done = []
+
+    def runner(idx, t):
+        sem.wait(t)
+        order.append(idx)
+        sem.release()
+        done.append(idx)
+
+    threads = [threading.Thread(target=runner, args=(i, t))
+               for i, t in enumerate(tickets)]
+    # start in reverse to prove wakeup follows enqueue order, not start order
+    for t in reversed(threads):
+        t.start()
+    time.sleep(0.2)
+    sem.release()  # release the initial slot -> cascade
+    for t in threads:
+        t.join(timeout=10)
+    assert order == [0, 1, 2]
+
+
+def test_fifo_semaphore_cancel():
+    from ray_tpu._private.worker_runtime import FifoSemaphore
+
+    sem = FifoSemaphore(1)
+    assert sem.enqueue() is None
+    t1 = sem.enqueue()
+    sem.cancel(t1)          # back out of the queue
+    sem.release()           # slot free again
+    assert sem.enqueue() is None  # would block if t1 leaked the slot
+    sem.release()
+
+
+def test_actor_ordering_survives_long_method(ray_start_regular):
+    """A successor call never barges past a long-running predecessor
+    (the old 60s wall-clock skip-ahead is gone; scaled-down probe)."""
+    ray = ray_start_regular
+
+    @ray.remote
+    class Log:
+        def __init__(self):
+            self.calls = []
+
+        def slow(self):
+            time.sleep(3.0)
+            self.calls.append("slow")
+            return "slow"
+
+        def fast(self):
+            self.calls.append("fast")
+            return "fast"
+
+        def log(self):
+            return self.calls
+
+    a = Log.remote()
+    r1 = a.slow.remote()
+    r2 = a.fast.remote()
+    assert ray.get([r1, r2], timeout=60) == ["slow", "fast"]
+    assert ray.get(a.log.remote(), timeout=30) == ["slow", "fast"]
