@@ -29,6 +29,9 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "max_io_workers": 2,
     "inline_object_max_size_bytes": 100 * 1024,  # small results ride the RPC reply
     "object_transfer_chunk_bytes": 4 * 1024 * 1024,
+    "pull_max_inflight_bytes": 256 * 1024 * 1024,  # pull admission control
+    # --- lineage / reconstruction ---
+    "max_lineage_bytes": 64 * 1024 * 1024,  # retained task specs for rebuild
     # --- fault tolerance ---
     "task_max_retries_default": 3,
     "actor_max_restarts_default": 0,
@@ -54,6 +57,7 @@ _CONFIG_DEFS: Dict[str, Any] = {
 class _Config:
     def __init__(self):
         self._values = dict(_CONFIG_DEFS)
+        self._system_overrides: set = set()
         for name, default in _CONFIG_DEFS.items():
             env = os.environ.get("RAY_TPU_" + name.upper())
             if env is not None:
@@ -72,6 +76,7 @@ class _Config:
             if k not in self._values:
                 raise ValueError(f"Unknown system config key: {k}")
             self._values[k] = v
+            self._system_overrides.add(k)
 
     def snapshot(self) -> Dict[str, Any]:
         return dict(self._values)
@@ -90,3 +95,14 @@ def _parse(env: str, default: Any):
 
 
 GlobalConfig = _Config()
+
+
+def get_config(name: str):
+    """Read one config value. Precedence (matching the module contract and
+    the reference's RayConfig): init(system_config=...) > `RAY_TPU_<NAME>`
+    env (read live, so tests/operators can set it after import) > default."""
+    if name not in GlobalConfig._system_overrides:
+        env = os.environ.get("RAY_TPU_" + name.upper())
+        if env is not None:
+            return _parse(env, _CONFIG_DEFS[name])
+    return getattr(GlobalConfig, name)

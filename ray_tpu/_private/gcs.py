@@ -153,9 +153,10 @@ class GcsServer:
                 return
             node.alive = False
             # Objects whose only copies were there are gone — record them as
-            # lost so owners raise ObjectLostError instead of polling forever
-            # (reference: reconstruction kicks in here, object_recovery_manager.h;
-            # our lineage re-execution consumes the same signal).
+            # lost. Owners consume this signal in CoreWorker._fetch_bytes /
+            # rpc_get_owned_value: if they hold lineage for the object they
+            # re-execute the creating task (worker_runtime._maybe_reconstruct,
+            # reference object_recovery_manager.h:30), else ObjectLostError.
             for oid, locs in list(self.object_locations.items()):
                 locs.discard(node_id)
                 if not locs and oid in self.object_sizes:

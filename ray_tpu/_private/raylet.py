@@ -654,14 +654,26 @@ class Raylet:
     # ---- object plane -------------------------------------------------------
 
     def rpc_fetch_object(self, conn, object_id: bytes):
-        """Remote pull: return the object's raw bytes (reference:
-        ObjectManager push/pull, object_manager.h; single-frame transfer —
-        chunking is an optimization left to the C++ data plane)."""
+        """Whole-object pull (kept for small objects / compatibility)."""
         buf = self.store.get(object_id)
         if buf is None:
             return None
         try:
             return buf.to_bytes()
+        finally:
+            buf.release()
+
+    def rpc_fetch_object_chunk(self, conn, object_id: bytes, offset: int,
+                               length: int):
+        """Chunked pull (reference: ObjectManager chunked gRPC transfer,
+        object_manager.h + push_manager.h:29). Returns {"size", "data"} or
+        None if the object isn't here (pullers retry elsewhere)."""
+        buf = self.store.get(object_id)
+        if buf is None:
+            return None
+        try:
+            mv = buf.memoryview()
+            return {"size": len(mv), "data": bytes(mv[offset:offset + length])}
         finally:
             buf.release()
 

@@ -478,6 +478,21 @@ class CoreWorker:
         self._actor_queues: dict[bytes, _ActorQueue] = {}
         self._task_futures: dict[bytes, PyFuture] = {}
         self._ref_to_task: dict[bytes, tuple] = {}  # rid -> (spec, queue)
+        # Lineage for object reconstruction (reference:
+        # core_worker/object_recovery_manager.h:30 + task_manager.h:93-110
+        # lineage pinning): completed normal-task specs are retained, keyed
+        # by task_id, while any of their return objects is still referenced,
+        # so a sealed-then-lost object can be recomputed by re-executing its
+        # creating task. Arg pins are held for the lineage's lifetime.
+        self._lineage_specs: dict[bytes, tuple] = {}   # task_id -> (spec, q)
+        self._lineage_index: dict[bytes, bytes] = {}   # rid -> task_id
+        self._lineage_live: dict[bytes, int] = {}      # task_id -> live rids
+        self._lineage_bytes = 0
+        self._lineage_order: collections.deque = collections.deque()
+        # PullManager-lite admission control (reference: pull_manager.h:48):
+        # bounds the total bytes of concurrently in-flight remote pulls.
+        self._pull_lock = threading.Condition()
+        self._pull_inflight_bytes = 0
         self._lock = threading.RLock()
 
         # Actor-side state (populated by become_actor)
@@ -554,15 +569,98 @@ class CoreWorker:
 
     def _free_object(self, object_id: bytes):
         self.memory_store.free(object_id)
+        to_unpin = None
         with self._lock:
             self._ref_to_task.pop(object_id, None)
             owned = object_id in self._owned
             self._owned.discard(object_id)
+            tid = self._lineage_index.pop(object_id, None)
+            if tid is not None:
+                self._lineage_live[tid] -= 1
+                if self._lineage_live[tid] <= 0:
+                    to_unpin = self._drop_lineage_locked(tid)
+        if to_unpin is not None:
+            self._unpin_args(to_unpin)
         if owned:
             try:
                 self.gcs.push("free_objects", object_ids=[object_id])
             except Exception:
                 pass
+
+    # ------------------------------------------------ lineage reconstruction
+    # Reference: object_recovery_manager.h:30 (re-execute the creating task
+    # when all copies are lost) with task_manager.h-style lineage pinning.
+
+    def _retain_lineage(self, spec: dict):
+        from ray_tpu._private.config import get_config
+
+        cap = int(get_config("max_lineage_bytes"))
+        tid = spec["task_id"]
+        cost = len(spec.get("args", b"")) + 512
+        retained = False
+        evicted: list[dict] = []
+        with self._lock:
+            if tid in self._lineage_specs:     # reconstruction round-trip:
+                return                         # already retained, pins held
+            live = [r for r in spec["return_ids"] if r in self._owned]
+            if (live and spec.get("_queue") is not None and cost <= cap
+                    and spec.get("reconstructions_left", 0) > 0):
+                self._lineage_specs[tid] = (spec, spec["_queue"])
+                self._lineage_live[tid] = len(live)
+                for rid in live:
+                    self._lineage_index[rid] = tid
+                self._lineage_bytes += cost
+                self._lineage_order.append(tid)
+                retained = True
+                while (self._lineage_bytes > cap
+                        and len(self._lineage_order) > 1):
+                    old_tid = self._lineage_order.popleft()
+                    dropped = self._drop_lineage_locked(old_tid)
+                    if dropped is not None:
+                        evicted.append(dropped)
+                # Compact stale tids (dropped via _free_object) so the
+                # deque stays O(live lineage), not O(tasks ever submitted).
+                if len(self._lineage_order) > 2 * len(self._lineage_specs) + 64:
+                    self._lineage_order = collections.deque(
+                        t for t in self._lineage_order
+                        if t in self._lineage_specs)
+        if not retained:
+            self._unpin_args(spec)
+        for old in evicted:
+            self._unpin_args(old)
+
+    def _drop_lineage_locked(self, tid: bytes):
+        """Remove a lineage spec (caller holds self._lock). Returns the spec
+        whose arg pins should be released, or None."""
+        entry = self._lineage_specs.pop(tid, None)
+        self._lineage_live.pop(tid, None)
+        if entry is None:
+            return None
+        spec, _q = entry
+        for rid in spec["return_ids"]:
+            if self._lineage_index.get(rid) == tid:
+                del self._lineage_index[rid]
+        self._lineage_bytes -= len(spec.get("args", b"")) + 512
+        return spec
+
+    def _maybe_reconstruct(self, object_id: bytes) -> bool:
+        """If we own lineage for a lost object, re-submit its creating task.
+        Returns True when a reconstruction is in flight (caller should keep
+        polling), False when the loss is unrecoverable."""
+        with self._lock:
+            tid = self._lineage_index.get(object_id)
+            if tid is None:
+                return False
+            spec, q = self._lineage_specs[tid]
+            if any(rid in self._ref_to_task for rid in spec["return_ids"]):
+                return True    # a reconstruction is already in flight
+            if spec.get("reconstructions_left", 0) <= 0:
+                return False
+            spec["reconstructions_left"] -= 1
+            for rid in spec["return_ids"]:
+                self._ref_to_task[rid] = (spec, q)
+        q.submit(spec)
+        return True
 
     def _pin_args(self, spec: dict, args, kwargs):
         ids = [r.id for r in ser.contained_refs((args, kwargs))]
@@ -645,10 +743,16 @@ class CoreWorker:
                 if data is not None:
                     return data
             # The GCS knows it was created and that every copy died with its
-            # node: fail fast unless the producing task is still in flight
-            # locally (a retry will republish a location).
-            if locs.get("lost") and ref.id not in self._ref_to_task:
-                raise exc.ObjectLostError(ref.hex())
+            # node. Recovery is the OWNER's job (reference:
+            # ObjectRecoveryManager runs in the owner's core worker): the
+            # owner re-executes the creating task if it holds lineage, else
+            # fails fast. Borrowers keep polling — the owner's verdict
+            # reaches them through _ask_owner ("lost" reply) instead.
+            we_own = not ref.owner_addr or tuple(ref.owner_addr) == self.addr
+            if locs.get("lost") and ref.id not in self._ref_to_task \
+                    and we_own:
+                if not self._maybe_reconstruct(ref.id):
+                    raise exc.ObjectLostError(ref.hex())
             if deadline is not None and time.time() > deadline:
                 raise exc.GetTimeoutError(
                     f"get() timed out waiting for {ref.hex()}")
@@ -661,17 +765,45 @@ class CoreWorker:
             poll = min(poll * 2, 0.1)
 
     def _pull_remote(self, object_id: bytes, node_snapshot: dict):
+        """Chunked node-to-node pull with admission control.
+
+        Reference: PullManager (pull_manager.h:48) bounds in-flight pull
+        bytes; PushManager (push_manager.h:29) moves objects as chunks. A
+        large object crosses the network in `object_transfer_chunk_bytes`
+        frames instead of one pickle frame, and the total bytes being
+        pulled concurrently by this worker is capped."""
+        from ray_tpu._private.config import get_config
+
         addr = (node_snapshot["NodeManagerAddress"],
                 node_snapshot["NodeManagerPort"])
+        chunk = int(get_config("object_transfer_chunk_bytes"))
         try:
             client = RpcClient(addr, timeout=120.0)
         except ConnectionLost:
             return None
+        admitted = 0
         try:
-            data = client.call("fetch_object", object_id=object_id)
+            first = client.call("fetch_object_chunk", object_id=object_id,
+                                offset=0, length=chunk)
+            if first is None:
+                return None
+            size = first["size"]
+            admitted = size
+            self._admit_pull(size)
+            data = bytearray(first["data"])
+            while len(data) < size:
+                part = client.call("fetch_object_chunk",
+                                   object_id=object_id,
+                                   offset=len(data), length=chunk)
+                if part is None:   # evicted mid-pull
+                    return None
+                data += part["data"]
+            data = bytes(data)
         except (ConnectionLost, Exception):  # noqa: BLE001
             return None
         finally:
+            if admitted:
+                self._release_pull(admitted)
             client.close()
         if data is None:
             return None
@@ -685,15 +817,38 @@ class CoreWorker:
             pass
         return data
 
+    def _admit_pull(self, nbytes: int):
+        """Block until the pull fits the in-flight budget (always admit when
+        nothing else is in flight, so an object larger than the budget can
+        still be fetched — same escape hatch as the reference's PullManager)."""
+        from ray_tpu._private.config import get_config
+
+        cap = int(get_config("pull_max_inflight_bytes"))
+        with self._pull_lock:
+            while (self._pull_inflight_bytes > 0
+                    and self._pull_inflight_bytes + nbytes > cap):
+                self._pull_lock.wait(0.5)
+            self._pull_inflight_bytes += nbytes
+
+    def _release_pull(self, nbytes: int):
+        with self._pull_lock:
+            self._pull_inflight_bytes = max(
+                0, self._pull_inflight_bytes - nbytes)
+            self._pull_lock.notify_all()
+
     def _ask_owner(self, ref: ObjectRef, deadline):
         try:
             client = RpcClient(tuple(ref.owner_addr), timeout=30.0)
         except ConnectionLost:
             raise exc.ObjectLostError(ref.hex()) from None
         try:
-            data = client.call("get_owned_value", object_id=ref.id,
-                               timeout=5.0)
-            return data
+            reply = client.call("get_owned_value", object_id=ref.id,
+                                timeout=6.0)
+            if isinstance(reply, dict) and "status" in reply:
+                if reply["status"] == "lost":
+                    raise exc.ObjectLostError(ref.hex())
+                return reply.get("data")
+            return reply
         except TimeoutError:
             return None
         except ConnectionLost:
@@ -703,18 +858,31 @@ class CoreWorker:
 
     def rpc_get_owned_value(self, conn, object_id: bytes):
         """Serve a value we own to a borrower. Blocks briefly if the task
-        producing it hasn't finished."""
+        producing it hasn't finished. If every copy of a sealed value died,
+        the owner is the one holding lineage — kick reconstruction here so
+        borrowers recover too (reference: recovery runs in the owner's core
+        worker, object_recovery_manager.h)."""
         entry = self.memory_store.entry(object_id)
-        if entry.event.wait(4.0):
-            return entry.data
-        # maybe it's in our shm store (large result)
+        if entry.event.wait(0.5):
+            return {"status": "ok", "data": entry.data}
         buf = self.store.get(object_id)
         if buf is not None:
             try:
-                return buf.to_bytes()
+                return {"status": "ok", "data": buf.to_bytes()}
             finally:
                 buf.release()
-        return None
+        try:
+            locs = self.gcs.call("get_object_locations", object_id=object_id)
+        except ConnectionLost:
+            locs = {}
+        if locs.get("lost") and object_id not in self._ref_to_task:
+            if not self._maybe_reconstruct(object_id):
+                return {"status": "lost"}
+        if entry.event.wait(3.0):
+            return {"status": "ok", "data": entry.data}
+        # pending: task still running / reconstruction in flight / result
+        # lives in some shm store (borrower finds it via the directory)
+        return {"status": "pending"}
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         if num_returns > len(refs):
@@ -801,6 +969,11 @@ class CoreWorker:
             "return_ids": return_ids,
             "owner_addr": self.addr,
             "retries_left": max_retries,
+            # budget for re-executing this task after its sealed result is
+            # lost (node death). Reference semantics: reconstruction rides
+            # the retry budget — max_retries=0 tasks are never re-executed
+            # (their loss raises ObjectLostError, see _fetch_bytes).
+            "reconstructions_left": max_retries,
             "task_desc": task_desc,
             "job_id": self.job_id,
         }
@@ -893,17 +1066,29 @@ class CoreWorker:
             self.memory_store.put(rid, data)
             with self._lock:
                 self._ref_to_task.pop(rid, None)
+        # A failed reconstruction arrives here with the spec still retained
+        # as lineage. Pins were taken once at submit and are NOT released at
+        # retain time, so: drop the lineage bookkeeping (no unpin of its
+        # own), then unpin exactly once.
+        with self._lock:
+            self._drop_lineage_locked(spec["task_id"])
         self._unpin_args(spec)
 
     def _handle_task_reply(self, spec: dict, reply: dict, node_id):
+        q = None
         with self._lock:
             for rid in spec["return_ids"]:
-                self._ref_to_task.pop(rid, None)
-        self._unpin_args(spec)
+                entry = self._ref_to_task.pop(rid, None)
+                if entry is not None:
+                    q = entry[1]
+        spec["_queue"] = q   # stripped before the wire (leading _)
         if reply.get("cancelled"):
             self._fail_task(spec, exc.TaskCancelledError(
-                spec.get("task_desc", "task")))
+                spec.get("task_desc", "task")))   # _fail_task unpins args
             return
+        # Successful completion: keep the spec as lineage (arg pins held)
+        # so a lost result can be recomputed; unpin happens at lineage drop.
+        self._retain_lineage(spec)
         results = reply.get("results", {})
         for rid, data in results.items():
             # fire-and-forget: if every ref was dropped while the task was in

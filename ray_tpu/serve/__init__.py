@@ -1,0 +1,47 @@
+"""ray_tpu.serve — online model serving on the TPU-native runtime.
+
+Capability parity with the reference's Serve library
+(python/ray/serve/, ~32.7k LoC; see SURVEY.md §2.3): a detached controller
+actor reconciling a DeploymentState FSM, named replica actors holding the
+user callable (for TPU: a jitted jax program with device-resident weights),
+in-flight-capped routing with power-of-two-choices, per-node HTTP proxies,
+long-poll config push, replica autoscaling, graceful drain, and
+model-composition deployment graphs via ``.bind()`` + handle passing.
+"""
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    http_port,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve._private.proxy import Request, Response
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "HTTPOptions",
+    "Request",
+    "Response",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "http_port",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

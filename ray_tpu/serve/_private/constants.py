@@ -1,0 +1,17 @@
+"""Serve-internal constants (reference: serve/_private/constants.py)."""
+
+SERVE_NAMESPACE = "serve"
+CONTROLLER_NAME = "SERVE_CONTROLLER_ACTOR"
+PROXY_NAME_PREFIX = "SERVE_PROXY_ACTOR"
+DEFAULT_APP_NAME = "default"
+
+# Long-poll keys
+ROUTE_TABLE_KEY = "route_table"
+
+
+def replicas_key(deployment_id: str) -> str:
+    return f"replicas::{deployment_id}"
+
+
+def deployment_id(app_name: str, deployment_name: str) -> str:
+    return f"{app_name}#{deployment_name}"

@@ -1,0 +1,475 @@
+"""ServeController actor: owns all deployment state and reconciles it.
+
+Reference: python/ray/serve/controller.py:61 (ServeController),
+serve/_private/deployment_state.py:958 (DeploymentState FSM; scale loop at
+:1281,1623; ActorReplicaWrapper at :168) and
+serve/_private/autoscaling_policy.py. One detached named actor; a
+background thread runs the reconcile loop:
+
+    target state (app specs) ──reconcile──▶ replica actors
+                                     │
+                 long-poll push ◀────┘  (routers/proxies learn replica sets)
+
+Replica FSM: STARTING ─ready──▶ RUNNING ─drain──▶ STOPPING ─▶ gone; a
+failed health check or dead actor re-enters through STARTING via a fresh
+replica (replicas are cattle — same as the reference).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+from ray_tpu.serve._private.constants import (
+    ROUTE_TABLE_KEY,
+    deployment_id as make_dep_id,
+    replicas_key,
+)
+from ray_tpu.serve._private.long_poll import LongPollHost
+from ray_tpu.serve.config import DeploymentConfig
+
+STARTING, RUNNING, STOPPING = "STARTING", "RUNNING", "STOPPING"
+RECONCILE_PERIOD_S = 0.1
+
+
+class _Replica:
+    def __init__(self, replica_id, actor_name, handle, ready_ref):
+        self.replica_id = replica_id
+        self.actor_name = actor_name
+        self.handle = handle
+        self.state = STARTING
+        self.ready_ref = ready_ref
+        self.drain_ref = None
+        self.drain_deadline = None
+        self.health_ref = None
+        self.health_deadline = None
+        self.last_health_check = time.monotonic()
+        self.metrics_ref = None
+        self.num_ongoing = 0.0
+
+
+class _DeploymentState:
+    """Target + actual state for one deployment."""
+
+    def __init__(self, dep_id: str, spec: dict, host: LongPollHost):
+        self.dep_id = dep_id
+        self.spec = spec                       # user_callable/init args/...
+        self.config = DeploymentConfig.from_dict(spec["config"])
+        self.host = host
+        self.replicas: list[_Replica] = []
+        self.deleting = False
+        self.version = spec.get("version") or "1"
+        # autoscaling bookkeeping
+        ac = self.config.autoscaling_config
+        self.target_num = (ac.min_replicas if ac
+                           else self.config.num_replicas)
+        self._scale_proposal_since: tuple[int, float] | None = None
+        self._last_metrics_poll = 0.0
+        # handle-side demand: {router_id: (queued+in_flight, monotonic ts)}
+        self.handle_metrics: dict[int, tuple[float, float]] = {}
+
+    # ---------------------------------------------------------- target edit
+    def update_spec(self, spec: dict):
+        old_config = self.config
+        self.spec = spec
+        self.config = DeploymentConfig.from_dict(spec["config"])
+        new_version = spec.get("version") or "1"
+        code_changed = new_version != self.version
+        self.version = new_version
+        ac = self.config.autoscaling_config
+        if ac:
+            self.target_num = max(ac.min_replicas,
+                                  min(ac.max_replicas, self.target_num))
+        else:
+            self.target_num = self.config.num_replicas
+        if code_changed:
+            # roll every replica (simple stop-all; the reference does a
+            # gradual rolling update — acceptable simplification, the FSM
+            # recreates capacity on the next ticks)
+            for r in self.replicas:
+                if r.state != STOPPING:
+                    self._begin_stop(r)
+        elif old_config.user_config != self.config.user_config:
+            for r in self.replicas:
+                if r.state == RUNNING:
+                    try:
+                        r.handle.reconfigure.remote(self.config.user_config)
+                    except Exception:
+                        pass
+
+    def mark_deleting(self):
+        self.deleting = True
+        for r in self.replicas:
+            if r.state != STOPPING:
+                self._begin_stop(r)
+
+    # ------------------------------------------------------------ reconcile
+    def reconcile(self) -> bool:
+        """One tick. Returns True when (deleting and fully stopped)."""
+        import ray_tpu
+
+        changed = False
+        # 1. STARTING → RUNNING when ready_ref resolves
+        for r in self.replicas:
+            if r.state == STARTING:
+                try:
+                    done, _ = ray_tpu.wait([r.ready_ref], timeout=0)
+                except Exception:
+                    done = []
+                if done:
+                    try:
+                        ray_tpu.get(r.ready_ref)   # surface init errors
+                        r.state = RUNNING
+                        changed = True
+                    except Exception:
+                        self._drop(r)
+                        changed = True
+        # 2. reap STOPPING
+        for r in list(self.replicas):
+            if r.state == STOPPING:
+                drained = False
+                if r.drain_ref is not None:
+                    try:
+                        done, _ = ray_tpu.wait([r.drain_ref], timeout=0)
+                        drained = bool(done)
+                    except Exception:
+                        drained = True
+                if drained or time.monotonic() > r.drain_deadline:
+                    self._kill(r)
+                    changed = True
+        if self.deleting:
+            return not self.replicas
+        # 3. health checks on RUNNING
+        changed |= self._health_checks()
+        # 4. autoscaling metrics + decision
+        self._autoscale()
+        # 5. scale toward target
+        live = [r for r in self.replicas if r.state in (STARTING, RUNNING)]
+        if len(live) < self.target_num:
+            for _ in range(self.target_num - len(live)):
+                self._start_replica()
+            changed = True
+        elif len(live) > self.target_num:
+            # stop youngest first (prefer keeping warmed replicas)
+            extra = len(live) - self.target_num
+            for r in reversed(live):
+                if extra == 0:
+                    break
+                if r.state == STARTING or r.state == RUNNING:
+                    self._begin_stop(r)
+                    extra -= 1
+            changed = True
+        if changed:
+            self.broadcast()
+        return False
+
+    def _health_checks(self) -> bool:
+        import ray_tpu
+
+        changed = False
+        now = time.monotonic()
+        for r in list(self.replicas):
+            if r.state != RUNNING:
+                continue
+            if r.health_ref is not None:
+                try:
+                    done, _ = ray_tpu.wait([r.health_ref], timeout=0)
+                except Exception:
+                    done = [r.health_ref]
+                if done:
+                    try:
+                        ray_tpu.get(r.health_ref)
+                        r.health_ref = None
+                        r.last_health_check = now
+                    except Exception:
+                        # failed health check → replace
+                        self._drop(r)
+                        changed = True
+                elif now > r.health_deadline:
+                    self._drop(r)
+                    changed = True
+            elif (now - r.last_health_check
+                    >= self.config.health_check_period_s):
+                try:
+                    r.health_ref = r.handle.check_health.remote()
+                    r.health_deadline = (
+                        now + self.config.health_check_timeout_s)
+                except Exception:
+                    self._drop(r)
+                    changed = True
+        return changed
+
+    def _autoscale(self):
+        import ray_tpu
+
+        ac = self.config.autoscaling_config
+        if ac is None:
+            return
+        now = time.monotonic()
+        if now - self._last_metrics_poll >= ac.metrics_interval_s:
+            self._last_metrics_poll = now
+            for r in self.replicas:
+                if r.state != RUNNING:
+                    continue
+                if r.metrics_ref is not None:
+                    try:
+                        done, _ = ray_tpu.wait([r.metrics_ref], timeout=0)
+                        if done:
+                            m = ray_tpu.get(r.metrics_ref)
+                            r.num_ongoing = m["num_ongoing_requests"]
+                            r.metrics_ref = None
+                    except Exception:
+                        r.metrics_ref = None
+                if r.metrics_ref is None:
+                    try:
+                        r.metrics_ref = r.handle.get_metrics.remote()
+                    except Exception:
+                        pass
+        running = [r for r in self.replicas if r.state == RUNNING]
+        if not running:
+            return
+        # Handle-side metrics (queued + in-flight at routers) capture demand
+        # the replicas never see when the router caps in-flight; fall back
+        # to replica-side ongoing when no router has reported recently.
+        fresh_cutoff = now - 2.0
+        handle_total = sum(v for v, ts in self.handle_metrics.values()
+                           if ts >= fresh_cutoff)
+        has_fresh = any(ts >= fresh_cutoff
+                        for _, ts in self.handle_metrics.values())
+        total_ongoing = (handle_total if has_fresh
+                         else sum(r.num_ongoing for r in running))
+        desired = ac.desired_replicas(len(running), total_ongoing)
+        if desired == self.target_num:
+            self._scale_proposal_since = None
+            return
+        delay = (ac.upscale_delay_s if desired > self.target_num
+                 else ac.downscale_delay_s)
+        prop = self._scale_proposal_since
+        if prop is None or prop[0] != desired:
+            self._scale_proposal_since = (desired, now)
+            return
+        if now - prop[1] >= delay:
+            self.target_num = desired
+            self._scale_proposal_since = None
+
+    # ------------------------------------------------------------- actions
+    def _start_replica(self):
+        import ray_tpu
+        from ray_tpu.serve._private.replica import ReplicaActor
+
+        rid = f"{self.dep_id}#{uuid.uuid4().hex[:6]}"
+        actor_name = f"SERVE_REPLICA::{rid}"
+        opts = dict(self.spec["config"].get("ray_actor_options") or {})
+        opts.setdefault("num_cpus", 0)
+        cap = int(self.config.max_ongoing_requests)
+        handle = ray_tpu.remote(ReplicaActor).options(
+            name=actor_name, namespace="serve",
+            max_concurrency=cap + 8,    # headroom for health/metrics calls
+            max_restarts=0,             # controller replaces, not restarts
+            **opts,
+        ).remote(self.dep_id, rid, self.spec["user_callable"],
+                 self.spec.get("init_args") or (),
+                 self.spec.get("init_kwargs") or {},
+                 self.config.user_config)
+        ready_ref = handle.ready.remote()
+        self.replicas.append(_Replica(rid, actor_name, handle, ready_ref))
+
+    def _begin_stop(self, r: _Replica):
+        r.state = STOPPING
+        try:
+            r.drain_ref = r.handle.prepare_for_shutdown.remote(
+                self.config.graceful_shutdown_timeout_s)
+        except Exception:
+            r.drain_ref = None
+        r.drain_deadline = (time.monotonic()
+                            + self.config.graceful_shutdown_timeout_s + 1.0)
+
+    def _drop(self, r: _Replica):
+        """Immediate removal (failed init / failed health check)."""
+        self._kill(r)
+
+    def _kill(self, r: _Replica):
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(r.handle)
+        except Exception:
+            pass
+        if r in self.replicas:
+            self.replicas.remove(r)
+
+    # ------------------------------------------------------------ broadcast
+    def broadcast(self):
+        entries = [{"replica_id": r.replica_id, "actor_name": r.actor_name}
+                   for r in self.replicas if r.state == RUNNING]
+        self.host.notify_changed(
+            replicas_key(self.dep_id),
+            {"replicas": entries,
+             "max_ongoing_requests": self.config.max_ongoing_requests})
+
+    def status(self) -> dict:
+        return {
+            "name": self.spec["name"],
+            "status": ("DELETING" if self.deleting else
+                       "HEALTHY" if self._num_running() >= self.target_num
+                       else "UPDATING"),
+            "target_num_replicas": self.target_num,
+            "replica_states": {
+                s: sum(1 for r in self.replicas if r.state == s)
+                for s in (STARTING, RUNNING, STOPPING)},
+        }
+
+    def _num_running(self):
+        return sum(1 for r in self.replicas if r.state == RUNNING)
+
+
+class ServeController:
+    """The detached controller actor (reference: controller.py:61)."""
+
+    def __init__(self, http_options: dict | None = None):
+        self._host = LongPollHost()
+        self._lock = threading.RLock()
+        self._deployments: dict[str, _DeploymentState] = {}
+        self._apps: dict[str, dict] = {}      # name → {route_prefix, ingress}
+        self._http_options = http_options or {}
+        self._shutdown = threading.Event()
+        self._loop = threading.Thread(target=self._run_control_loop,
+                                      daemon=True, name="serve-controller")
+        self._loop.start()
+
+    # ------------------------------------------------------------- RPC API
+    def listen_for_change(self, snapshot_ids: dict):
+        return self._host.listen_for_change(snapshot_ids)
+
+    def get_http_options(self) -> dict:
+        return self._http_options
+
+    def deploy_application(self, app_spec: dict):
+        """app_spec: {name, route_prefix, ingress, deployments: [dep specs]}
+        Each dep spec: {name, user_callable, init_args, init_kwargs, config,
+        version}."""
+        with self._lock:
+            name = app_spec["name"]
+            new_deps = {}
+            for dep in app_spec["deployments"]:
+                dep_id = make_dep_id(name, dep["name"])
+                new_deps[dep_id] = dep
+            # remove deployments dropped from the app
+            old = self._apps.get(name)
+            if old:
+                for dep_id in old["deployment_ids"]:
+                    if dep_id not in new_deps:
+                        ds = self._deployments.get(dep_id)
+                        if ds:
+                            ds.mark_deleting()
+            for dep_id, dep in new_deps.items():
+                if dep_id in self._deployments and \
+                        not self._deployments[dep_id].deleting:
+                    self._deployments[dep_id].update_spec(dep)
+                else:
+                    self._deployments[dep_id] = _DeploymentState(
+                        dep_id, dep, self._host)
+                self._deployments[dep_id].broadcast()
+            self._apps[name] = {
+                "route_prefix": app_spec.get("route_prefix"),
+                "ingress": make_dep_id(name, app_spec["ingress"]),
+                "deployment_ids": list(new_deps),
+            }
+            self._broadcast_routes()
+        return True
+
+    def delete_application(self, name: str):
+        with self._lock:
+            app = self._apps.pop(name, None)
+            if not app:
+                return False
+            for dep_id in app["deployment_ids"]:
+                ds = self._deployments.get(dep_id)
+                if ds:
+                    ds.mark_deleting()
+            self._broadcast_routes()
+        return True
+
+    def get_app_status(self, name: str | None = None) -> dict:
+        with self._lock:
+            out = {}
+            for app_name, app in self._apps.items():
+                if name is not None and app_name != name:
+                    continue
+                deps = {}
+                for dep_id in app["deployment_ids"]:
+                    ds = self._deployments.get(dep_id)
+                    if ds:
+                        deps[ds.spec["name"]] = ds.status()
+                states = [d["status"] for d in deps.values()]
+                out[app_name] = {
+                    "route_prefix": app["route_prefix"],
+                    "ingress": app["ingress"],
+                    "status": ("RUNNING" if states and
+                               all(s == "HEALTHY" for s in states)
+                               else "DEPLOYING"),
+                    "deployments": deps,
+                }
+            return out
+
+    def record_handle_metrics(self, dep_id: str, router_id: int,
+                              num_requests: float):
+        """Routers push (queued + in-flight) demand for autoscaling."""
+        with self._lock:
+            ds = self._deployments.get(dep_id)
+            if ds is not None:
+                ds.handle_metrics[router_id] = (num_requests,
+                                                time.monotonic())
+        return True
+
+    def get_deployment_info(self, dep_id: str) -> dict | None:
+        with self._lock:
+            ds = self._deployments.get(dep_id)
+            if ds is None:
+                return None
+            return {"max_ongoing_requests":
+                        ds.config.max_ongoing_requests,
+                    "status": ds.status()}
+
+    def graceful_shutdown(self):
+        with self._lock:
+            for name in list(self._apps):
+                self.delete_application(name)
+        # wait for replicas to drain out via the control loop
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._deployments:
+                    break
+            time.sleep(0.05)
+        self._shutdown.set()
+        return True
+
+    # ------------------------------------------------------------ internals
+    def _broadcast_routes(self):
+        routes = {}
+        for app_name, app in self._apps.items():
+            if app.get("route_prefix"):
+                routes[app["route_prefix"]] = {
+                    "app_name": app_name,
+                    "ingress_deployment": app["ingress"],
+                }
+        self._host.notify_changed(ROUTE_TABLE_KEY, routes)
+
+    def _run_control_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                with self._lock:
+                    for dep_id, ds in list(self._deployments.items()):
+                        finished = ds.reconcile()
+                        if finished:
+                            del self._deployments[dep_id]
+                            self._host.drop_key(replicas_key(dep_id))
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            self._shutdown.wait(RECONCILE_PERIOD_S)
+
+    def ready(self):
+        return True

@@ -1,0 +1,105 @@
+"""Long-poll config push: controller → routers/proxies.
+
+Reference: python/ray/serve/_private/long_poll.py (LongPollHost,
+LongPollClient at :67). The host side lives inside the controller actor;
+clients issue a blocking ``listen_for_change`` actor call carrying the
+versions they have seen, and the call returns only when some key advances
+(or a timeout passes, so clients can detect a dead controller). This is the
+same push-on-change design as the reference, carried over our actor RPC
+instead of gRPC.
+"""
+from __future__ import annotations
+
+import threading
+
+
+LISTEN_TIMEOUT_S = 10.0
+
+
+class LongPollHost:
+    """State holder + condition variable. Embedded in ServeController."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._values: dict[str, object] = {}
+        self._versions: dict[str, int] = {}
+
+    def notify_changed(self, key: str, value) -> None:
+        with self._lock:
+            self._values[key] = value
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._lock.notify_all()
+
+    def drop_key(self, key: str) -> None:
+        with self._lock:
+            self._values.pop(key, None)
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._lock.notify_all()
+
+    def listen_for_change(self, snapshot_ids: dict[str, int],
+                          timeout_s: float = LISTEN_TIMEOUT_S) -> dict:
+        """Block until any key in snapshot_ids has a newer version than the
+        caller has seen (version -1 = "send me whatever exists"). Returns
+        {key: (version, value)} for changed keys; {} on timeout."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                updates = {}
+                for key, seen in snapshot_ids.items():
+                    cur = self._versions.get(key, 0)
+                    if cur > seen and key in self._values:
+                        updates[key] = (cur, self._values[key])
+                    elif cur > seen and key not in self._values:
+                        # key dropped — tell the client so it stops caching
+                        updates[key] = (cur, None)
+                if updates:
+                    return updates
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                self._lock.wait(remaining)
+
+
+class LongPollClient:
+    """Background thread repeatedly long-polling the controller.
+
+    callbacks: {key: fn(value)} invoked (on the poll thread) each time the
+    key's value changes.
+    """
+
+    def __init__(self, controller_handle, callbacks: dict):
+        self._controller = controller_handle
+        self._callbacks = dict(callbacks)
+        self._snapshot_ids = {key: -1 for key in self._callbacks}
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serve-long-poll")
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _loop(self):
+        import ray_tpu
+
+        while not self._stopped.is_set():
+            try:
+                ref = self._controller.listen_for_change.remote(
+                    self._snapshot_ids)
+                updates = ray_tpu.get(ref, timeout=LISTEN_TIMEOUT_S + 5.0)
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                # controller restarting / transient RPC failure — back off
+                self._stopped.wait(0.5)
+                continue
+            for key, (version, value) in (updates or {}).items():
+                self._snapshot_ids[key] = version
+                cb = self._callbacks.get(key)
+                if cb is not None:
+                    try:
+                        cb(value)
+                    except Exception:
+                        pass

@@ -1,0 +1,191 @@
+"""HTTP proxy actor: HTTP front door → router → replica.
+
+Reference: python/ray/serve/_private/http_proxy.py:189 (HTTPProxy, one
+actor per node, uvicorn/starlette) and http_state.py. Ours serves with the
+stdlib ThreadingHTTPServer — thread-per-request maps onto the runtime's
+thread-based actors, keeps zero extra dependencies, and the proxy is not on
+the TPU hot path (model compute happens in the replica's jax program).
+
+Request → longest-prefix route match → per-deployment Router (long-poll
+updated) → replica ``handle_request``. The user callable receives a
+``serve.Request``; returns str/bytes/dict (dict ⇒ JSON), or a
+``serve.Response`` for full control.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+from ray_tpu.serve._private.constants import ROUTE_TABLE_KEY
+from ray_tpu.serve._private.long_poll import LongPollClient
+
+
+class Request:
+    """What an HTTP-ingress user callable receives (starlette.Request
+    analog, minimal)."""
+
+    def __init__(self, method: str, path: str, query_params: dict,
+                 headers: dict, body: bytes):
+        self.method = method
+        self.path = path
+        self.query_params = query_params
+        self.headers = headers
+        self.body = body
+
+    def json(self):
+        return json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode()
+
+    def __reduce__(self):
+        return (Request, (self.method, self.path, self.query_params,
+                          self.headers, self.body))
+
+
+class Response:
+    def __init__(self, body, status_code: int = 200,
+                 content_type: str | None = None, headers: dict | None = None):
+        self.body = body
+        self.status_code = status_code
+        self.content_type = content_type
+        self.headers = headers or {}
+
+    def __reduce__(self):
+        return (Response, (self.body, self.status_code, self.content_type,
+                           self.headers))
+
+
+def _encode_response(result) -> tuple[int, bytes, str, dict]:
+    if isinstance(result, Response):
+        body = result.body
+        if isinstance(body, (dict, list)):
+            raw = json.dumps(body).encode()
+            ctype = result.content_type or "application/json"
+        elif isinstance(body, bytes):
+            raw, ctype = body, result.content_type or "application/octet-stream"
+        else:
+            raw = str(body).encode()
+            ctype = result.content_type or "text/plain"
+        return result.status_code, raw, ctype, result.headers
+    if isinstance(result, (dict, list)):
+        return 200, json.dumps(result).encode(), "application/json", {}
+    if isinstance(result, bytes):
+        return 200, result, "application/octet-stream", {}
+    return 200, str(result).encode(), "text/plain", {}
+
+
+class HTTPProxyActor:
+    """The actor body. Holds the HTTP server + routing state."""
+
+    def __init__(self, host: str, port: int, controller_name: str,
+                 controller_namespace: str = "serve"):
+        import ray_tpu
+
+        self._controller = ray_tpu.get_actor(
+            controller_name, namespace=controller_namespace)
+        self._routes: dict[str, dict] = {}
+        self._routes_lock = threading.Lock()
+        self._long_poll = LongPollClient(
+            self._controller, {ROUTE_TABLE_KEY: self._update_routes})
+
+        proxy = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):      # silence per-request stderr spam
+                pass
+
+            def _do(self):
+                proxy._handle_http(self)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _do
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+
+    # ------------------------------------------------------------ callbacks
+    def _update_routes(self, routes):
+        with self._routes_lock:
+            self._routes = dict(routes or {})
+
+    # -------------------------------------------------------------- serving
+    def _match_route(self, path: str):
+        with self._routes_lock:
+            best = None
+            for prefix, info in self._routes.items():
+                norm = prefix.rstrip("/") or "/"
+                if path == norm or path.startswith(
+                        norm if norm == "/" else norm + "/"):
+                    if best is None or len(norm) > len(best[0]):
+                        best = (norm, info)
+            return best
+
+    def _handle_http(self, h: BaseHTTPRequestHandler):
+        try:
+            parsed = urlparse(h.path)
+            path = parsed.path
+            if path == "/-/healthz":
+                self._send(h, 200, b"success", "text/plain", {})
+                return
+            if path == "/-/routes":
+                with self._routes_lock:
+                    body = json.dumps({p: i["app_name"]
+                                       for p, i in self._routes.items()})
+                self._send(h, 200, body.encode(), "application/json", {})
+                return
+            match = self._match_route(path)
+            if match is None:
+                self._send(h, 404, b'{"error": "no route"}',
+                           "application/json", {})
+                return
+            _prefix, info = match
+            length = int(h.headers.get("Content-Length") or 0)
+            body = h.rfile.read(length) if length else b""
+            request = Request(
+                h.command, path, dict(parse_qsl(parsed.query)),
+                {k.lower(): v for k, v in h.headers.items()}, body)
+            from ray_tpu.serve.handle import DeploymentResponse, _get_router
+
+            router = _get_router(info["ingress_deployment"])
+            response = DeploymentResponse(router, "__call__", (request,), {})
+            result = response.result(timeout_s=60.0)
+            status, raw, ctype, headers = _encode_response(result)
+            self._send(h, status, raw, ctype, headers)
+        except Exception as e:
+            tb = traceback.format_exc()
+            try:
+                self._send(h, 500,
+                           json.dumps({"error": str(e),
+                                       "traceback": tb}).encode(),
+                           "application/json", {})
+            except Exception:
+                pass
+
+    @staticmethod
+    def _send(h, status, raw: bytes, ctype: str, headers: dict):
+        h.send_response(status)
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(raw)))
+        for k, v in headers.items():
+            h.send_header(k, v)
+        h.end_headers()
+        h.wfile.write(raw)
+
+    # ----------------------------------------------------------------- RPC
+    def ready(self) -> int:
+        """Returns the bound port (0-port binds resolve here)."""
+        return self._port
+
+    def shutdown(self):
+        self._long_poll.stop()
+        self._server.shutdown()
+        return True

@@ -1,0 +1,124 @@
+"""Replica actor: wraps the user's deployment callable.
+
+Reference: python/ray/serve/_private/replica.py (RayServeReplica). The
+controller creates one named actor per replica from this class. For TPU
+serving the typical user class holds a jitted jax program built in
+``__init__`` (weights resident on device); ``handle_request`` then runs the
+compiled program — the replica actor pins the model to one device/process
+exactly like the reference's GPU replicas.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+
+
+class ReplicaActor:
+    """The body of every Serve replica actor.
+
+    Instantiated via ActorClass options by the controller; the user class is
+    shipped pickled (cloudpickle via the runtime's function table).
+    """
+
+    def __init__(self, deployment_id: str, replica_id: str,
+                 user_callable, init_args, init_kwargs, user_config=None):
+        self._deployment_id = deployment_id
+        self._replica_id = replica_id
+        self._lock = threading.Lock()
+        self._num_ongoing = 0
+        self._num_total = 0
+        self._shutdown = False
+        if isinstance(user_callable, type):
+            self._user = user_callable(*init_args, **(init_kwargs or {}))
+        else:
+            # plain function deployment: calls go straight to it
+            self._user = user_callable
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # ------------------------------------------------------------- requests
+    def handle_request(self, method_name: str, args, kwargs):
+        """Execute one request against the user callable.
+
+        Composition: upstream DeploymentResponses arrive as ObjectRefs
+        nested inside `args`; the runtime only auto-resolves top-level actor
+        call args, so resolve them here."""
+        import ray_tpu
+        from ray_tpu import ObjectRef
+
+        args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
+                     for a in args)
+        kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef) else v
+                  for k, v in (kwargs or {}).items()}
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError(
+                    f"replica {self._replica_id} is shutting down")
+            self._num_ongoing += 1
+            self._num_total += 1
+        try:
+            target = self._resolve_method(method_name)
+            return target(*args, **(kwargs or {}))
+        finally:
+            with self._lock:
+                self._num_ongoing -= 1
+
+    def _resolve_method(self, method_name: str):
+        if method_name in (None, "", "__call__"):
+            if callable(self._user):
+                return self._user
+            raise AttributeError(
+                f"deployment {self._deployment_id} is not callable; "
+                f"specify a method name")
+        target = getattr(self._user, method_name, None)
+        if target is None or not callable(target):
+            raise AttributeError(
+                f"deployment {self._deployment_id} has no method "
+                f"{method_name!r}")
+        return target
+
+    # ------------------------------------------------------------ lifecycle
+    def reconfigure(self, user_config):
+        """Apply a new user_config without restarting (reference:
+        replica.py reconfigure → user class's `reconfigure`)."""
+        fn = getattr(self._user, "reconfigure", None)
+        if callable(fn):
+            fn(user_config)
+        return True
+
+    def check_health(self):
+        fn = getattr(self._user, "check_health", None)
+        if callable(fn):
+            fn()
+        return True
+
+    def get_metrics(self) -> dict:
+        with self._lock:
+            return {"replica_id": self._replica_id,
+                    "num_ongoing_requests": self._num_ongoing,
+                    "num_total_requests": self._num_total}
+
+    def prepare_for_shutdown(self, timeout_s: float = 5.0) -> bool:
+        """Drain: refuse new work, wait for in-flight requests to finish.
+        Returns True if fully drained."""
+        with self._lock:
+            self._shutdown = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._num_ongoing == 0:
+                    break
+            time.sleep(0.02)
+        fn = getattr(self._user, "__serve_shutdown__", None)
+        if callable(fn):
+            try:
+                fn()
+            except Exception:
+                traceback.print_exc()
+        with self._lock:
+            return self._num_ongoing == 0
+
+    def ready(self) -> bool:
+        """Liveness probe used by the controller while STARTING."""
+        return True

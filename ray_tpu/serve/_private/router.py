@@ -1,0 +1,186 @@
+"""Router: picks a replica for each request, capping in-flight per replica.
+
+Reference: python/ray/serve/_private/router.py — Router at :261,
+ReplicaSet._try_assign_replica (in-flight-capped selection) at :134. Ours
+uses power-of-two-choices over the in-flight counts (the reference's newer
+replica scheduler does the same); when every replica is at its cap the
+request queues on a condition variable until a slot frees.
+
+Completion tracking: one monitor thread per Router waits on outstanding
+ObjectRefs (batched ``wait``) and releases slots as tasks finish — the
+equivalent of the reference's asyncio done-callbacks.
+"""
+from __future__ import annotations
+
+import random
+import threading
+
+from ray_tpu.serve._private.constants import replicas_key
+from ray_tpu.serve._private.long_poll import LongPollClient
+
+
+class _ReplicaSlot:
+    __slots__ = ("replica_id", "handle", "in_flight")
+
+    def __init__(self, replica_id, handle):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.in_flight = 0
+
+
+class Router:
+    def __init__(self, controller_handle, deployment_id: str,
+                 max_ongoing_requests: int = 8):
+        self._controller = controller_handle
+        self._deployment_id = deployment_id
+        self._max_ongoing = max_ongoing_requests
+        self._lock = threading.Condition()
+        self._replicas: dict[str, _ReplicaSlot] = {}
+        self._outstanding: dict = {}   # ObjectRef -> replica_id
+        self._num_queued = 0           # callers blocked waiting for a slot
+        self._last_metrics_push = 0.0
+        self._stopped = threading.Event()
+        self._long_poll = LongPollClient(
+            controller_handle,
+            {replicas_key(deployment_id): self._update_replicas})
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name=f"serve-router-{deployment_id}")
+        self._monitor.start()
+
+    # ------------------------------------------------------------ callbacks
+    def _update_replicas(self, info):
+        """Long-poll callback: (replica list, max_ongoing) snapshot."""
+        import ray_tpu
+
+        if info is None:
+            entries, cap = [], self._max_ongoing
+        else:
+            entries, cap = info["replicas"], info["max_ongoing_requests"]
+        with self._lock:
+            self._max_ongoing = cap
+            seen = set()
+            for entry in entries:
+                rid, name = entry["replica_id"], entry["actor_name"]
+                seen.add(rid)
+                if rid not in self._replicas:
+                    try:
+                        handle = ray_tpu.get_actor(
+                            name, namespace="serve")
+                    except ValueError:
+                        continue   # died between snapshot and now
+                    self._replicas[rid] = _ReplicaSlot(rid, handle)
+            for rid in list(self._replicas):
+                if rid not in seen:
+                    del self._replicas[rid]
+            self._lock.notify_all()
+
+    # ------------------------------------------------------------- requests
+    def assign_request(self, method_name: str, args, kwargs,
+                       timeout_s: float = 30.0):
+        """Pick a replica (p2c by in-flight, capped) and submit. Returns
+        (ObjectRef, replica_id) of the replica call."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            self._num_queued += 1
+            try:
+                while True:
+                    slot = self._pick_slot()
+                    if slot is not None:
+                        slot.in_flight += 1
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"no replica of {self._deployment_id} available "
+                            f"within {timeout_s}s "
+                            f"({len(self._replicas)} replicas, all at "
+                            f"max_ongoing_requests={self._max_ongoing})")
+                    self._lock.wait(min(remaining, 0.5))
+            finally:
+                self._num_queued -= 1
+        try:
+            ref = slot.handle.handle_request.remote(
+                method_name, args, kwargs)
+        except Exception:
+            with self._lock:
+                slot.in_flight -= 1
+                self._lock.notify_all()
+            raise
+        with self._lock:
+            self._outstanding[ref] = slot.replica_id
+            self._lock.notify_all()   # wake monitor
+        return ref, slot.replica_id
+
+    def mark_replica_dead(self, replica_id: str):
+        """Drop a replica observed dead by a caller (ActorDiedError on its
+        result). The long-poll will also remove it once the controller
+        notices — this is the fast path so retries don't re-pick it."""
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+            for ref, rid in list(self._outstanding.items()):
+                if rid == replica_id:
+                    del self._outstanding[ref]
+            self._lock.notify_all()
+
+    def _pick_slot(self):
+        live = [s for s in self._replicas.values()
+                if s.in_flight < self._max_ongoing]
+        if not live:
+            return None
+        if len(live) == 1:
+            return live[0]
+        a, b = random.sample(live, 2)
+        return a if a.in_flight <= b.in_flight else b
+
+    # -------------------------------------------------------------- monitor
+    def _monitor_loop(self):
+        import time
+
+        import ray_tpu
+
+        while not self._stopped.is_set():
+            # push handle-side metrics (queued + in-flight) so the
+            # controller's autoscaler sees demand the replicas can't
+            # (reference: handle-side autoscaling metrics push)
+            now = time.monotonic()
+            if now - self._last_metrics_push >= 0.2:
+                self._last_metrics_push = now
+                with self._lock:
+                    queued = self._num_queued
+                    in_flight = sum(s.in_flight
+                                    for s in self._replicas.values())
+                try:
+                    self._controller.record_handle_metrics.remote(
+                        self._deployment_id, id(self), queued + in_flight)
+                except Exception:
+                    pass
+            with self._lock:
+                refs = list(self._outstanding)
+            if not refs:
+                with self._lock:
+                    self._lock.wait(0.2)
+                continue
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5,
+                                       fetch_local=False)
+            except Exception:
+                done = []
+            if done:
+                with self._lock:
+                    for ref in done:
+                        rid = self._outstanding.pop(ref, None)
+                        slot = self._replicas.get(rid)
+                        if slot is not None:
+                            slot.in_flight = max(0, slot.in_flight - 1)
+                    self._lock.notify_all()
+
+    def num_replicas(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def stop(self):
+        self._stopped.set()
+        self._long_poll.stop()

@@ -1,0 +1,299 @@
+"""Serve public API: @serve.deployment, serve.run, serve.start, ...
+
+Reference: python/ray/serve/api.py (@serve.deployment at :251, serve.run at
+:455, serve.start at :56) and serve/_private/deployment_graph_build.py
+(bind-tree → deployment list). The controller is a detached named actor in
+the "serve" namespace; ``serve.run`` is idempotent per app name (in-place
+upgrade of a running app).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ray_tpu.serve._private.constants import (
+    CONTROLLER_NAME,
+    DEFAULT_APP_NAME,
+    PROXY_NAME_PREFIX,
+    SERVE_NAMESPACE,
+    deployment_id as make_dep_id,
+)
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,  # noqa: F401  (re-export)
+    _get_controller,
+    _shutdown_routers,
+)
+
+_lock = threading.RLock()
+_proxy_handle = None
+_proxy_port = None
+
+
+class Application:
+    """A bound deployment node (the result of ``.bind()``); reference:
+    serve's Application / DAGNode for deployment graphs."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self._deployment = deployment
+        self._args = args
+        self._kwargs = kwargs
+
+
+class Deployment:
+    """An undeployed deployment definition (reference: serve/deployment.py
+    Deployment). Immutable; ``.options()`` copies."""
+
+    def __init__(self, func_or_class, name: str, config: DeploymentConfig,
+                 version: str | None = None):
+        self._func_or_class = func_or_class
+        self.name = name
+        self.config = config
+        self.version = version
+
+    def options(self, *, name=None, num_replicas=None, user_config=None,
+                max_ongoing_requests=None, autoscaling_config=None,
+                ray_actor_options=None, health_check_period_s=None,
+                health_check_timeout_s=None, graceful_shutdown_timeout_s=None,
+                version=None):
+        cfg = DeploymentConfig.from_dict(self.config.to_dict())
+        if num_replicas is not None:
+            if num_replicas == "auto":
+                cfg.autoscaling_config = (cfg.autoscaling_config
+                                          or AutoscalingConfig())
+            else:
+                cfg.num_replicas = int(num_replicas)
+        if user_config is not None:
+            cfg.user_config = user_config
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = int(max_ongoing_requests)
+        if autoscaling_config is not None:
+            if isinstance(autoscaling_config, dict):
+                autoscaling_config = AutoscalingConfig(**autoscaling_config)
+            cfg.autoscaling_config = autoscaling_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        if health_check_timeout_s is not None:
+            cfg.health_check_timeout_s = health_check_timeout_s
+        if graceful_shutdown_timeout_s is not None:
+            cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        return Deployment(self._func_or_class, name or self.name, cfg,
+                          version or self.version)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+
+def deployment(func_or_class=None, *, name=None, num_replicas=None,
+               user_config=None, max_ongoing_requests=None,
+               autoscaling_config=None, ray_actor_options=None,
+               health_check_period_s=None, health_check_timeout_s=None,
+               graceful_shutdown_timeout_s=None, version=None):
+    """@serve.deployment decorator (reference: serve/api.py:251)."""
+
+    def build(target):
+        dep = Deployment(target, name or target.__name__,
+                         DeploymentConfig(), version)
+        return dep.options(
+            num_replicas=num_replicas, user_config=user_config,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=ray_actor_options,
+            health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s)
+
+    if func_or_class is not None:
+        return build(func_or_class)
+    return build
+
+
+# ------------------------------------------------------------------ runtime
+
+def start(http_options: HTTPOptions | dict | None = None, **kwargs):
+    """Ensure the Serve instance (controller + HTTP proxy) is running.
+    Reference: serve/api.py:56."""
+    global _proxy_handle, _proxy_port
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    if http_options is not None and kwargs:
+        raise TypeError("pass either http_options or keyword options, "
+                        "not both")
+    if isinstance(http_options, dict):
+        http_options = HTTPOptions(**http_options)
+    elif http_options is None:
+        http_options = HTTPOptions(**kwargs)
+    with _lock:
+        from ray_tpu.serve._private.controller import ServeController
+
+        controller = ray_tpu.remote(ServeController).options(
+            name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
+            lifetime="detached", max_concurrency=64, num_cpus=0,
+            get_if_exists=True,
+        ).remote({"host": http_options.host, "port": http_options.port})
+        ray_tpu.get(controller.ready.remote())
+        if _proxy_handle is None:
+            from ray_tpu.serve._private.proxy import HTTPProxyActor
+
+            opts = ray_tpu.get(controller.get_http_options.remote())
+            host = opts.get("host", http_options.host)
+            port = opts.get("port", http_options.port)
+            # One proxy per node, fixed name: a second driver on the same
+            # cluster reuses the detached proxy (and its bound port)
+            # instead of colliding on EADDRINUSE (reference: per-node
+            # HTTPProxy actors keyed by node, http_state.py).
+            node_id = ray_tpu.get_runtime_context().get_node_id()
+            _proxy_handle = ray_tpu.remote(HTTPProxyActor).options(
+                name=f"{PROXY_NAME_PREFIX}:{node_id}",
+                namespace=SERVE_NAMESPACE, lifetime="detached",
+                max_concurrency=64, num_cpus=0, get_if_exists=True,
+            ).remote(host, port, CONTROLLER_NAME, SERVE_NAMESPACE)
+            _proxy_port = ray_tpu.get(_proxy_handle.ready.remote())
+        return controller
+
+
+def _build_app_spec(target: Application, name: str, route_prefix: str | None):
+    """Flatten the bind tree into deployment specs; nested Application args
+    become DeploymentHandles (reference: deployment_graph_build.py)."""
+    deployments: dict[str, dict] = {}
+
+    def visit(app: Application) -> DeploymentHandle:
+        dep = app._deployment
+        if dep.name in deployments:
+            # same node object may be bound in several places — reuse
+            return DeploymentHandle(dep.name, name)
+
+        def convert(v):
+            if isinstance(v, Application):
+                return visit(v)
+            return v
+
+        # reserve the slot first so diamond graphs don't recurse forever
+        deployments[dep.name] = None
+        init_args = tuple(convert(a) for a in app._args)
+        init_kwargs = {k: convert(v) for k, v in app._kwargs.items()}
+        deployments[dep.name] = {
+            "name": dep.name,
+            "user_callable": dep._func_or_class,
+            "init_args": init_args,
+            "init_kwargs": init_kwargs,
+            "config": dep.config.to_dict(),
+            "version": dep.version or "1",
+        }
+        return DeploymentHandle(dep.name, name)
+
+    visit(target)
+    ingress = target._deployment.name
+    return {
+        "name": name,
+        "route_prefix": route_prefix,
+        "ingress": ingress,
+        "deployments": [d for d in deployments.values() if d],
+    }
+
+
+def run(target: Application, *, name: str = DEFAULT_APP_NAME,
+        route_prefix: str | None = "/", blocking: bool = False,
+        _timeout_s: float = 60.0) -> DeploymentHandle:
+    """Deploy an application and wait until healthy (reference:
+    serve/api.py:455)."""
+    import ray_tpu
+
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(f"serve.run expects an Application (from .bind()), "
+                        f"got {type(target)}")
+    controller = start()
+    spec = _build_app_spec(target, name, route_prefix)
+    ray_tpu.get(controller.deploy_application.remote(spec))
+    # wait for the app to report RUNNING
+    deadline = time.monotonic() + _timeout_s
+    while time.monotonic() < deadline:
+        status = ray_tpu.get(controller.get_app_status.remote(name))
+        app = status.get(name)
+        if app and app["status"] == "RUNNING":
+            break
+        time.sleep(0.05)
+    else:
+        raise TimeoutError(
+            f"app {name!r} did not become RUNNING within {_timeout_s}s: "
+            f"{ray_tpu.get(controller.get_app_status.remote(name))}")
+    handle = DeploymentHandle(spec["ingress"], name)
+    if blocking:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def status() -> dict:
+    import ray_tpu
+
+    controller = _get_controller()
+    return ray_tpu.get(controller.get_app_status.remote())
+
+
+def delete(name: str):
+    import ray_tpu
+
+    controller = _get_controller()
+    ray_tpu.get(controller.delete_application.remote(name))
+
+
+def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:
+    import ray_tpu
+
+    controller = _get_controller()
+    apps = ray_tpu.get(controller.get_app_status.remote(name))
+    if name not in apps:
+        raise ValueError(f"no Serve app named {name!r}")
+    ingress = apps[name]["ingress"]
+    return DeploymentHandle(ingress.split("#", 1)[1], name)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = DEFAULT_APP_NAME
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def http_port() -> int | None:
+    """The port the local HTTP proxy bound (useful with port=0 in tests)."""
+    return _proxy_port
+
+
+def shutdown():
+    """Tear down the Serve instance (reference: serve/api.py serve.shutdown)."""
+    global _proxy_handle, _proxy_port
+    import ray_tpu
+
+    with _lock:
+        _shutdown_routers()
+        if _proxy_handle is not None:
+            try:
+                ray_tpu.get(_proxy_handle.shutdown.remote(), timeout=5.0)
+                ray_tpu.kill(_proxy_handle)
+            except Exception:
+                pass
+            _proxy_handle = None
+            _proxy_port = None
+        try:
+            controller = _get_controller()
+        except ValueError:
+            return
+        try:
+            ray_tpu.get(controller.graceful_shutdown.remote(), timeout=15.0)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(controller)
+        except Exception:
+            pass

@@ -1,0 +1,84 @@
+"""Serve configuration dataclasses.
+
+Reference parity: python/ray/serve/config.py (DeploymentConfig,
+AutoscalingConfig, HTTPOptions). Plain dataclasses here — the reference uses
+pydantic for REST-facing validation; our REST surface is the JSON status
+endpoint only, so stdlib dataclasses keep the dependency surface zero.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AutoscalingConfig:
+    """Replica autoscaling policy inputs.
+
+    Reference: python/ray/serve/config.py AutoscalingConfig and
+    serve/_private/autoscaling_policy.py. The controller scales the number
+    of replicas so that (total ongoing requests / replicas) tracks
+    ``target_ongoing_requests``, with hysteresis via the up/downscale delays.
+    """
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+    metrics_interval_s: float = 0.2
+    # Fraction of the gap between current and desired replicas applied per
+    # decision (1.0 = jump straight to desired).
+    smoothing_factor: float = 1.0
+
+    def desired_replicas(self, current: int, total_ongoing: float) -> int:
+        if current == 0:
+            return self.min_replicas
+        error_ratio = (total_ongoing / current) / self.target_ongoing_requests
+        desired = current * error_ratio
+        if self.smoothing_factor != 1.0:
+            desired = current + (desired - current) * self.smoothing_factor
+        import math
+
+        desired = math.ceil(desired - 1e-9)
+        return max(self.min_replicas, min(self.max_replicas, desired))
+
+
+@dataclass
+class DeploymentConfig:
+    """Per-deployment behavior knobs.
+
+    Reference: serve/config.py DeploymentConfig (num_replicas,
+    max_ongoing_requests nee max_concurrent_queries, user_config,
+    graceful_shutdown, health checks).
+    """
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    user_config: object = None
+    graceful_shutdown_timeout_s: float = 5.0
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 5.0
+    autoscaling_config: AutoscalingConfig | None = None
+    ray_actor_options: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+
+        d = asdict(self)
+        if self.autoscaling_config is not None:
+            d["autoscaling_config"] = asdict(self.autoscaling_config)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeploymentConfig":
+        d = dict(d)
+        ac = d.get("autoscaling_config")
+        if isinstance(ac, dict):
+            d["autoscaling_config"] = AutoscalingConfig(**ac)
+        return cls(**d)
+
+
+@dataclass
+class HTTPOptions:
+    """Reference: serve/config.py HTTPOptions (host/port/root_path)."""
+    host: str = "127.0.0.1"
+    port: int = 8000
+    root_path: str = ""

@@ -1,0 +1,137 @@
+"""DeploymentHandle — Python-native calls into a deployment.
+
+Reference: python/ray/serve/handle.py (DeploymentHandle / ServeHandle →
+Router → ReplicaSet). A handle owns (a process-wide cached) Router for its
+deployment; ``.remote()`` returns a DeploymentResponse whose ``.result()``
+blocks on the replica call. Responses can be passed as arguments to other
+handle calls (model composition) — they are converted to the underlying
+ObjectRef, which the runtime resolves at execution time.
+"""
+from __future__ import annotations
+
+import threading
+
+from ray_tpu.serve._private.constants import (
+    CONTROLLER_NAME,
+    SERVE_NAMESPACE,
+)
+
+_routers_lock = threading.Lock()
+_routers: dict[str, object] = {}
+
+
+def _get_controller():
+    import ray_tpu
+
+    return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+
+
+def _get_router(deployment_id: str):
+    from ray_tpu.serve._private.router import Router
+
+    with _routers_lock:
+        router = _routers.get(deployment_id)
+        if router is None:
+            import ray_tpu
+
+            controller = _get_controller()
+            info = ray_tpu.get(
+                controller.get_deployment_info.remote(deployment_id))
+            cap = (info or {}).get("max_ongoing_requests", 8)
+            router = Router(controller, deployment_id,
+                            max_ongoing_requests=cap)
+            _routers[deployment_id] = router
+        return router
+
+
+def _shutdown_routers():
+    with _routers_lock:
+        for r in _routers.values():
+            r.stop()
+        _routers.clear()
+
+
+class DeploymentResponse:
+    """Future-like result of a handle call (reference: handle.py
+    DeploymentResponse). Submits eagerly; ``result()`` transparently
+    retries on another replica if the chosen one died (the reference's
+    replica scheduler does the same for actor-died failures)."""
+
+    MAX_REPLICA_RETRIES = 3
+
+    def __init__(self, router, method_name, args, kwargs):
+        self._router = router
+        self._method_name = method_name
+        self._args = args
+        self._kwargs = kwargs
+        self._ref, self._replica_id = router.assign_request(
+            method_name, args, kwargs)
+
+    def result(self, timeout_s: float | None = None):
+        import time
+
+        import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError
+
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+
+        def remaining():
+            return (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+
+        for attempt in range(self.MAX_REPLICA_RETRIES + 1):
+            try:
+                return ray_tpu.get(self._ref, timeout=remaining())
+            except ActorDiedError:
+                self._router.mark_replica_dead(self._replica_id)
+                if attempt == self.MAX_REPLICA_RETRIES:
+                    raise
+                left = remaining()   # re-read: the failed get consumed time
+                self._ref, self._replica_id = self._router.assign_request(
+                    self._method_name, self._args, self._kwargs,
+                    timeout_s=left if left is not None else 30.0)
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str,
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+
+    @property
+    def _deployment_id(self):
+        from ray_tpu.serve._private.constants import deployment_id
+
+        return deployment_id(self.app_name, self.deployment_name)
+
+    def options(self, *, method_name: str | None = None):
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                method_name or self._method_name)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, self.app_name, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        args = tuple(a._to_object_ref()
+                     if isinstance(a, DeploymentResponse) else a
+                     for a in args)
+        kwargs = {k: (v._to_object_ref()
+                      if isinstance(v, DeploymentResponse) else v)
+                  for k, v in kwargs.items()}
+        router = _get_router(self._deployment_id)
+        return DeploymentResponse(router, self._method_name, args, kwargs)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._method_name))
+
+    def __repr__(self):
+        return (f"DeploymentHandle({self.app_name}#{self.deployment_name}"
+                f".{self._method_name})")

@@ -1,0 +1,108 @@
+"""Custom-VJP correctness: the memory-lean layer_norm / MLP backward rules
+against plain autodiff of naive reference implementations.
+
+These rules exist for HBM reasons (see models/layers.py docstrings); these
+tests pin their math so perf work can't silently corrupt gradients.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import layers as L
+
+
+def _naive_ln(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.var(x32, -1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _naive_mlp(params, x):
+    h = jnp.einsum("...d,df->...f", x, params["w1"]) + params["b1"]
+    h = jax.nn.gelu(h)
+    return jnp.einsum("...f,fd->...d", h, params["w2"]) + params["b2"]
+
+
+def test_layer_norm_vjp_matches_autodiff():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (4, 16, 32), jnp.float32)
+    scale = jax.random.normal(jax.random.fold_in(key, 1), (32,)) * 0.1 + 1.0
+    bias = jax.random.normal(jax.random.fold_in(key, 2), (32,)) * 0.1
+    ct = jax.random.normal(jax.random.fold_in(key, 3), (4, 16, 32))
+
+    def loss(fn, x, s, b):
+        return jnp.sum(fn(x, s, b) * ct)
+
+    g1 = jax.grad(lambda *a: loss(L.layer_norm, *a), argnums=(0, 1, 2))(
+        x, scale, bias)
+    g2 = jax.grad(lambda *a: loss(_naive_ln, *a), argnums=(0, 1, 2))(
+        x, scale, bias)
+    for a, b, name in zip(g1, g2, ["dx", "dscale", "dbias"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4, err_msg=name)
+
+
+def test_layer_norm_bf16_input():
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32)).astype(
+        jnp.bfloat16)
+    scale = jnp.ones((32,))
+    bias = jnp.zeros((32,))
+    y = L.layer_norm(x, scale, bias)
+    assert y.dtype == jnp.bfloat16
+    g = jax.grad(lambda x: jnp.sum(
+        L.layer_norm(x, scale, bias).astype(jnp.float32)))(x)
+    assert g.dtype == jnp.bfloat16
+
+
+def test_mlp_vjp_matches_autodiff():
+    key = jax.random.PRNGKey(42)
+    D, F = 32, 64
+    params = L.init_mlp(key, D, F)
+    x = jax.random.normal(jax.random.fold_in(key, 9), (2, 8, D), jnp.float32)
+    ct = jax.random.normal(jax.random.fold_in(key, 10), (2, 8, D))
+
+    def loss_lean(params, x):
+        return jnp.sum(
+            L.apply_mlp(params, x, compute_dtype=jnp.float32) * ct)
+
+    def loss_naive(params, x):
+        return jnp.sum(_naive_mlp(params, x) * ct)
+
+    g1 = jax.grad(loss_lean, argnums=(0, 1))(params, x)
+    g2 = jax.grad(loss_naive, argnums=(0, 1))(params, x)
+    flat1 = jax.tree_util.tree_leaves(g1)
+    flat2 = jax.tree_util.tree_leaves(g2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-3)
+
+
+def test_mlp_vjp_under_scan_and_vmap():
+    """The lean VJP must hold up inside the model's scan-over-layers."""
+    key = jax.random.PRNGKey(7)
+    D, F, N = 16, 32, 3
+    stacked = jax.vmap(lambda k: L.init_mlp(k, D, F))(jax.random.split(key, N))
+    x = jax.random.normal(jax.random.fold_in(key, 1), (2, 4, D))
+
+    def run(stacked, x):
+        def body(x, p):
+            return L.apply_mlp(p, x, compute_dtype=jnp.float32), None
+
+        y, _ = jax.lax.scan(body, x, stacked)
+        return jnp.sum(y ** 2)
+
+    def run_naive(stacked, x):
+        def body(x, p):
+            return _naive_mlp(p, x), None
+
+        y, _ = jax.lax.scan(body, x, stacked)
+        return jnp.sum(y ** 2)
+
+    g1 = jax.grad(run)(stacked, x)
+    g2 = jax.grad(run_naive)(stacked, x)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-3)

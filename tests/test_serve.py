@@ -1,0 +1,265 @@
+"""Serve tests — deploy/route/scale/heal.
+
+Models the reference's serve test surface (python/ray/serve/tests/):
+handle calls, HTTP ingress, composition graphs, reconfigure, replica
+failure recovery, autoscaling.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture
+def serve_instance():
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, object_store_memory=64 * 1024 * 1024)
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    yield serve
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _http_get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read()
+
+
+def _http_post(port, path, data: bytes):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, r.read()
+
+
+def test_function_deployment_handle(serve_instance):
+    serve = serve_instance
+
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind(), name="fn_app", route_prefix=None)
+    assert handle.remote(21).result() == 42
+
+
+def test_class_deployment_http(serve_instance):
+    serve = serve_instance
+
+    @serve.deployment(num_replicas=2)
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+
+        def __call__(self, request):
+            name = request.query_params.get("name", "world")
+            return {"greeting": f"{self.greeting}, {name}!"}
+
+    serve.run(Greeter.bind("hello"), name="greet", route_prefix="/greet")
+    port = serve.http_port()
+    status, body = _http_get(port, "/greet?name=tpu")
+    assert status == 200
+    assert json.loads(body) == {"greeting": "hello, tpu!"}
+    # routes endpoint lists the app
+    status, body = _http_get(port, "/-/routes")
+    assert json.loads(body) == {"/greet": "greet"}
+    # unknown path 404s
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _http_get(port, "/nope")
+    assert err.value.code == 404
+
+
+def test_http_post_json_and_error(serve_instance):
+    serve = serve_instance
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            data = request.json()
+            if data.get("boom"):
+                raise ValueError("boom requested")
+            return {"echo": data}
+
+    serve.run(Echo.bind(), name="echo", route_prefix="/echo")
+    port = serve.http_port()
+    status, body = _http_post(port, "/echo", json.dumps({"a": 1}).encode())
+    assert json.loads(body) == {"echo": {"a": 1}}
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _http_post(port, "/echo", json.dumps({"boom": True}).encode())
+    assert err.value.code == 500
+    assert "boom requested" in err.value.read().decode()
+
+
+def test_composition_graph(serve_instance):
+    serve = serve_instance
+
+    @serve.deployment
+    class Adder:
+        def __init__(self, increment):
+            self.increment = increment
+
+        def add(self, x):
+            return x + self.increment
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, adder):
+            self.adder = adder   # DeploymentHandle injected by the graph
+
+        def __call__(self, x):
+            resp = self.adder.add.remote(x)
+            return resp.result() * 10
+
+    handle = serve.run(Ingress.bind(Adder.bind(5)), name="graph",
+                       route_prefix=None)
+    assert handle.remote(1).result() == 60
+
+
+def test_reconfigure_user_config(serve_instance):
+    serve = serve_instance
+
+    @serve.deployment(user_config={"threshold": 1})
+    class Thresholder:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, x):
+            return x > self.threshold
+
+    dep = Thresholder.bind()
+    handle = serve.run(dep, name="cfg", route_prefix=None)
+    assert handle.remote(2).result() is True
+    # redeploy with a new user_config — replicas reconfigure in place
+    serve.run(Thresholder.options(user_config={"threshold": 10}).bind(),
+              name="cfg", route_prefix=None)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if handle.remote(2).result() is False:
+            break
+        time.sleep(0.1)
+    assert handle.remote(2).result() is False
+    assert handle.remote(11).result() is True
+
+
+def test_replica_death_recovery(serve_instance):
+    serve = serve_instance
+    import ray_tpu
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.2)
+    class Worker:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Worker.bind(), name="heal", route_prefix=None)
+    pids = {handle.pid.remote().result() for _ in range(10)}
+    assert len(pids) >= 1
+    # kill one replica actor out from under the controller
+    status = serve.status()
+    assert status["heal"]["status"] == "RUNNING"
+    victims = [a for a in ray_tpu.nodes()]  # noqa: F841 (cluster sanity)
+    # find a replica actor by name through the controller's routing table
+    from ray_tpu.serve.handle import _get_router
+
+    router = _get_router("heal#Worker")
+    deadline = time.monotonic() + 10
+    while router.num_replicas() < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert router.num_replicas() == 2
+    with router._lock:
+        victim = next(iter(router._replicas.values())).handle
+    ray_tpu.kill(victim)
+    # requests keep succeeding throughout recovery
+    for _ in range(20):
+        assert isinstance(handle.pid.remote().result(timeout_s=15), int)
+        time.sleep(0.05)
+    # controller replaces the dead replica
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        st = serve.status()["heal"]
+        if st["deployments"]["Worker"]["replica_states"]["RUNNING"] == 2:
+            break
+        time.sleep(0.1)
+    assert serve.status()["heal"]["deployments"]["Worker"][
+        "replica_states"]["RUNNING"] == 2
+
+
+def test_autoscaling_up_and_down(serve_instance):
+    serve = serve_instance
+
+    @serve.deployment(
+        max_ongoing_requests=1,
+        autoscaling_config=dict(min_replicas=1, max_replicas=3,
+                                target_ongoing_requests=1.0,
+                                upscale_delay_s=0.2, downscale_delay_s=0.5,
+                                metrics_interval_s=0.1),
+        graceful_shutdown_timeout_s=1.0,
+    )
+    class Slow:
+        def __call__(self, t):
+            time.sleep(t)
+            return True
+
+    handle = serve.run(Slow.bind(), name="auto", route_prefix=None)
+
+    def peak_replicas():
+        return serve.status()["auto"]["deployments"]["Slow"][
+            "replica_states"]["RUNNING"]
+
+    assert peak_replicas() == 1
+    # sustained concurrent load → scale up
+    results = []
+
+    def fire():
+        results.append(handle.remote(0.3).result(timeout_s=60))
+
+    threads = [threading.Thread(target=fire) for _ in range(12)]
+    for t in threads:
+        t.start()
+    peak = 1
+    deadline = time.monotonic() + 20
+    while any(t.is_alive() for t in threads) and time.monotonic() < deadline:
+        peak = max(peak, peak_replicas())
+        time.sleep(0.05)
+    for t in threads:
+        t.join(timeout=30)
+    assert all(results) and len(results) == 12
+    assert peak >= 2, f"expected scale-up beyond 1 replica, peak={peak}"
+    # idle → back down to min_replicas
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if peak_replicas() == 1:
+            break
+        time.sleep(0.1)
+    assert peak_replicas() == 1
+
+
+def test_redeploy_scales_and_deletes(serve_instance):
+    serve = serve_instance
+
+    @serve.deployment(num_replicas=1)
+    class S:
+        def __call__(self, _=None):
+            return "ok"
+
+    serve.run(S.bind(), name="scale", route_prefix="/scale")
+    serve.run(S.options(num_replicas=3).bind(), name="scale",
+              route_prefix="/scale")
+    st = serve.status()["scale"]["deployments"]["S"]
+    assert st["target_num_replicas"] == 3
+    serve.delete("scale")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if "scale" not in serve.status():
+            break
+        time.sleep(0.1)
+    assert "scale" not in serve.status()
