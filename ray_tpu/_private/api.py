@@ -250,7 +250,33 @@ def get_gpu_ids():
 
 
 def timeline(filename=None):
-    return []   # profiling timeline lands with the tracing subsystem
+    """Cluster-wide task/actor execution spans in chrome://tracing format
+    (reference: `ray timeline`, scripts.py:1757 over core-worker profiling
+    events). Open the written file at chrome://tracing or Perfetto."""
+    from ray_tpu._private import profiling
+    from ray_tpu._private.protocol import RpcClient
+
+    worker = _require_worker()
+    events = profiling.snapshot()             # this process (driver)
+    for n in worker.gcs.call("get_nodes"):
+        if not n["Alive"]:
+            continue
+        try:
+            c = RpcClient((n["NodeManagerAddress"], n["NodeManagerPort"]),
+                          timeout=5.0)
+            try:
+                events.extend(c.call("profile_events"))
+            finally:
+                c.close()
+        except Exception:
+            continue
+    trace = profiling.to_chrome_trace(events)
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
 
 
 class RayContext:

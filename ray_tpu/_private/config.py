@@ -44,6 +44,7 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "memory_usage_threshold": 0.95,
     # --- collective / mesh ---
     "collective_default_backend": "xla",
+    "collective_op_timeout_s": 300.0,  # dead-member failure detector
     "mesh_ici_axis_order": "dp,pp,ep,sp,tp",  # slowest→fastest varying axes
     # --- misc ---
     "rpc_max_message_bytes": 512 * 1024 * 1024,

@@ -42,6 +42,11 @@ class NodeInfo:
         self.meta = dict(meta)            # store name, spill dir, hostname...
         self.alive = True
         self.start_time = time.time()
+        # live availability gossiped by the raylet (~600ms cadence); the PG
+        # scheduler packs against this so bundles don't land on top of
+        # non-PG load (reference: RaySyncer resource view)
+        self.resources_reported: dict | None = None
+        self.reported_at: float = 0.0
 
     def snapshot(self) -> dict:
         return {
@@ -89,6 +94,8 @@ class PlacementGroupInfo:
         self.name = name
         self.state = "PENDING"            # CREATED / REMOVED / RESCHEDULING
         self.bundle_nodes: list[str | None] = [None] * len(bundles)
+        self.commit_ts = 0.0              # when it became CREATED
+        self.last_sched_attempt = 0.0     # rate-limits PENDING rescans
 
     def snapshot(self) -> dict:
         return {
@@ -203,6 +210,19 @@ class GcsServer:
         self._publish("nodes", {"event": "alive", "node_id": node_id,
                                 "snapshot": self.nodes[node_id].snapshot()})
         return {"cluster_id": self.cluster_id}
+
+    def rpc_report_resources(self, conn, node_id: str, available: dict):
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is not None:
+                node.resources_reported = dict(available)
+                node.reported_at = time.time()
+            # fresh capacity may unblock pending placement groups
+            pending = [pg for pg in self.placement_groups.values()
+                       if pg.state in ("PENDING", "RESCHEDULING")]
+            for pg in pending:
+                self._try_schedule_pg(pg)
+        return True
 
     def rpc_drain_node(self, conn, node_id: str):
         self._mark_node_dead(node_id, "drained")
@@ -446,14 +466,30 @@ class GcsServer:
 
         assignment: list[str | None] = [None] * len(pg.bundles)
         order = sorted(avail, key=lambda n: -sum(avail[n].values()))
+        # ICI-topology-aware gang packing (the TPU-native extension of
+        # gcs_placement_group_scheduler.h, SURVEY §2.4/§7 phase 3): TPU
+        # bundles under PACK/STRICT_PACK land on a contiguous block of
+        # hosts inside ONE slice, so the gang's collectives ride ICI
+        # instead of DCN. Falls through to the generic policy when no
+        # slice can host the gang.
         if pg.strategy in ("PACK", "STRICT_PACK"):
-            for i, bundle in enumerate(pg.bundles):
-                for node_id in order:
-                    if fits(node_id, bundle):
-                        assignment[i] = node_id
-                        take(node_id, bundle)
-                        break
-            if pg.strategy == "STRICT_PACK" and len(
+            ici_placed = False
+            if all(b.get("TPU", 0) > 0 for b in pg.bundles):
+                ici = self._place_on_contiguous_slice(pg, avail, take)
+                if ici is not None:
+                    assignment = ici
+                    ici_placed = True
+            if any(a is None for a in assignment):
+                for i, bundle in enumerate(pg.bundles):
+                    for node_id in order:
+                        if fits(node_id, bundle):
+                            assignment[i] = node_id
+                            take(node_id, bundle)
+                            break
+            # For TPU gangs STRICT_PACK means "one contiguous ICI domain"
+            # (a multi-host slice block), not one host — don't collapse an
+            # ICI placement onto a single node.
+            if pg.strategy == "STRICT_PACK" and not ici_placed and len(
                     {a for a in assignment if a}) > 1:
                 assignment = [None] * len(pg.bundles)
                 # retry all on one node
@@ -486,6 +522,7 @@ class GcsServer:
         if all(a is not None for a in assignment):
             pg.bundle_nodes = assignment
             pg.state = "CREATED"
+            pg.commit_ts = time.time()
             # bundles ride along so raylets can reserve without calling back
             # into GCS (the push handler runs on their RPC reader thread)
             self._publish("placement_groups",
@@ -493,11 +530,85 @@ class GcsServer:
                            "bundle_nodes": assignment,
                            "bundles": [dict(b) for b in pg.bundles]})
 
+    def _place_on_contiguous_slice(self, pg, avail, take):
+        """Try to place every bundle on a contiguous run of hosts (by TPU
+        worker index) within a single slice. Returns the assignment list or
+        None. Contiguous worker indices share ICI neighbours on TPU pods,
+        so the gang's mesh axes map onto torus links instead of DCN."""
+        slices: dict[str, list] = {}
+        for node_id in avail:
+            node = self.nodes.get(node_id)
+            tpu = (node.meta or {}).get("tpu") if node else None
+            if not tpu:
+                continue
+            slices.setdefault(str(tpu.get("slice_id", "slice-0")), []).append(
+                (int(tpu.get("worker_id", 0)), node_id))
+        best = None
+        for slice_id, hosts in sorted(slices.items()):
+            hosts.sort()
+            worker_ids = [w for w, _ in hosts]
+            # hosts must themselves be consecutive worker indices to form a
+            # window; scan all windows of every length ≥ 1
+            n = len(hosts)
+            for width in range(1, n + 1):
+                for start in range(0, n - width + 1):
+                    window = hosts[start:start + width]
+                    if window[-1][0] - window[0][0] != width - 1:
+                        continue   # gap (a dead host) breaks contiguity
+                    trial_avail = {nid: dict(avail[nid])
+                                   for _, nid in window}
+
+                    def t_fits(nid, b):
+                        a = trial_avail[nid]
+                        return all(a.get(k, 0) >= v for k, v in b.items())
+
+                    assignment = [None] * len(pg.bundles)
+                    ok = True
+                    for i, bundle in enumerate(pg.bundles):
+                        for _, nid in window:
+                            if t_fits(nid, bundle):
+                                assignment[i] = nid
+                                for k, v in bundle.items():
+                                    trial_avail[nid][k] = \
+                                        trial_avail[nid].get(k, 0) - v
+                                break
+                        else:
+                            ok = False
+                            break
+                    if ok:
+                        best = assignment
+                        break
+                if best:
+                    break
+            if best:
+                break
+        if best is None:
+            return None
+        for i, bundle in enumerate(pg.bundles):
+            take(best[i], bundle)
+        return best
+
     def _node_available_for_pg(self, node: NodeInfo) -> dict:
-        avail = dict(node.resources)
+        """Capacity the PG scheduler may hand out on this node. Prefer the
+        raylet's gossiped live availability (which already excludes both
+        non-PG load and bundles it has reserved); bundles committed AFTER
+        the last report aren't reflected there yet, so subtract those. Fall
+        back to totals-minus-all-bundles when no report arrived (fresh
+        node) — that path is blind to non-PG load, which is why raylets
+        gossip in the first place."""
+        fresh = (node.resources_reported is not None
+                 and time.time() - node.reported_at < 5.0)
+        if fresh:
+            avail = dict(node.resources_reported)
+            cutoff = node.reported_at
+        else:
+            avail = dict(node.resources)
+            cutoff = 0.0
         for pg in self.placement_groups.values():
             if pg.state not in ("CREATED",):
                 continue
+            if pg.commit_ts <= cutoff:
+                continue    # already reflected in the raylet's report
             for bundle, nid in zip(pg.bundles, pg.bundle_nodes):
                 if nid == node.node_id:
                     for k, v in bundle.items():
@@ -513,9 +624,14 @@ class GcsServer:
                         return pg.snapshot()
                 return None
             pg = self.placement_groups.get(pg_id)
-            # Late scheduling: nodes may have joined since creation.
+            # Late scheduling: nodes may have joined since creation. Rate-
+            # limited — dozens of queued actor creations poll this RPC at
+            # 50/s each and the window scan is O(hosts² · bundles).
             if pg is not None and pg.state in ("PENDING", "RESCHEDULING"):
-                self._try_schedule_pg(pg)
+                now = time.time()
+                if now - pg.last_sched_attempt > 0.25:
+                    pg.last_sched_attempt = now
+                    self._try_schedule_pg(pg)
             return pg.snapshot() if pg else None
 
     def rpc_remove_placement_group(self, conn, pg_id: bytes):
@@ -531,6 +647,17 @@ class GcsServer:
     def rpc_list_placement_groups(self, conn):
         with self._lock:
             return [pg.snapshot() for pg in self.placement_groups.values()]
+
+    def rpc_list_objects(self, conn):
+        """Object directory dump (state API `list objects` / `ray memory`
+        source; reference: memory_utils.py over raylet stats)."""
+        with self._lock:
+            return [{
+                "ObjectID": oid.hex(),
+                "Size": self.object_sizes.get(oid, 0),
+                "Locations": sorted(locs),
+                "Lost": oid in self.lost_objects,
+            } for oid, locs in self.object_locations.items()]
 
     # ---- pubsub -------------------------------------------------------------
 

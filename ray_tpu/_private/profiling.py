@@ -1,0 +1,54 @@
+"""Per-process profiling spans → chrome://tracing timeline.
+
+Reference: src/ray/core_worker/profiling.h (events pushed to GCS, dumped by
+`ray timeline`, scripts.py:1757). Here every worker/driver process keeps a
+bounded ring of completed spans; `ray_tpu.timeline()` fans out over
+raylets → workers, merges, and emits the chrome trace-event JSON format.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+
+_MAX_EVENTS = 10_000
+
+_lock = threading.Lock()
+_events: collections.deque = collections.deque(maxlen=_MAX_EVENTS)
+
+
+@contextlib.contextmanager
+def record_span(category: str, name: str, extra: dict | None = None):
+    start = time.time()
+    try:
+        yield
+    finally:
+        end = time.time()
+        with _lock:
+            _events.append({
+                "cat": category,
+                "name": name,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 2**31,
+                "ts": int(start * 1e6),     # microseconds, chrome format
+                "dur": int((end - start) * 1e6),
+                "ph": "X",
+                "args": extra or {},
+            })
+
+
+def snapshot() -> list[dict]:
+    with _lock:
+        return list(_events)
+
+
+def clear():
+    with _lock:
+        _events.clear()
+
+
+def to_chrome_trace(events: list[dict]) -> list[dict]:
+    """Already chrome-shaped; kept as a seam for format evolution."""
+    return sorted(events, key=lambda e: e["ts"])

@@ -1,0 +1,111 @@
+"""Core microbenchmark — the ray_perf.py port BASELINE.md names.
+
+Reference: python/ray/_private/ray_perf.py:93-200 (run by
+release/microbenchmark/run_microbenchmark.py). Same harness shape: each
+benchmark times a loop and reports ops/sec; numbers quantify the control
+plane (pure-Python runtime, pickle+TCP per hop), not TPU compute.
+
+Run: `python -m ray_tpu._private.ray_perf` or `ray-tpu microbenchmark`.
+Prints one human line per benchmark plus a final JSON summary.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def timeit(name, fn, multiplier=1, *, min_time=1.0, results=None):
+    # warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    print(f"{name} per second: {rate:.2f}")
+    if results is not None:
+        results[name] = round(rate, 2)
+    return rate
+
+
+def main(min_time: float = 1.0):
+    import ray_tpu
+
+    owns_runtime = not ray_tpu.is_initialized()
+    if owns_runtime:
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    results: dict = {}
+
+    @ray_tpu.remote(num_cpus=0, max_retries=0)
+    def noop():
+        return None
+
+    @ray_tpu.remote(num_cpus=0, max_retries=0)
+    def noop_arg(x):
+        return None
+
+    @ray_tpu.remote(num_cpus=0)
+    class Sink:
+        def ping(self):
+            return None
+
+        def ping_arg(self, x):
+            return None
+
+    # --- object store -----------------------------------------------------
+    small = np.zeros(64, dtype=np.uint8)
+    timeit("single client get calls",
+           lambda: ray_tpu.get(ray_tpu.put(small)),
+           min_time=min_time, results=results)
+    timeit("single client put calls",
+           lambda: ray_tpu.put(small),
+           min_time=min_time, results=results)
+    big = np.zeros(1024 * 1024, dtype=np.uint8)   # 1 MiB
+    rate = timeit("single client put (MiB/s)",
+                  lambda: ray_tpu.put(big), multiplier=1,
+                  min_time=min_time, results=None)
+    results["single client put gigabytes per second"] = round(
+        rate * big.nbytes / 2**30, 3)
+    print(f"single client put gigabytes per second: "
+          f"{results['single client put gigabytes per second']}")
+
+    # --- tasks ------------------------------------------------------------
+    timeit("single client tasks sync",
+           lambda: ray_tpu.get(noop.remote()),
+           min_time=min_time, results=results)
+    timeit("single client tasks async",
+           lambda: ray_tpu.get([noop.remote() for _ in range(100)]),
+           multiplier=100, min_time=min_time, results=results)
+    obj = ray_tpu.put(small)
+    timeit("single client tasks with object ref arg",
+           lambda: ray_tpu.get([noop_arg.remote(obj) for _ in range(20)]),
+           multiplier=20, min_time=min_time, results=results)
+
+    # --- actors -----------------------------------------------------------
+    a = Sink.remote()
+    ray_tpu.get(a.ping.remote())
+    timeit("single client actor calls sync",
+           lambda: ray_tpu.get(a.ping.remote()),
+           min_time=min_time, results=results)
+    timeit("single client actor calls async",
+           lambda: ray_tpu.get([a.ping.remote() for _ in range(100)]),
+           multiplier=100, min_time=min_time, results=results)
+    pool = [Sink.remote() for _ in range(4)]
+    ray_tpu.get([b.ping.remote() for b in pool])
+    timeit("n:n actor calls async",
+           lambda: ray_tpu.get([b.ping.remote()
+                                for _ in range(25) for b in pool]),
+           multiplier=100, min_time=min_time, results=results)
+
+    print(json.dumps({"benchmark": "ray_perf", "results": results}))
+    if owns_runtime:
+        ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -33,13 +33,67 @@ _IDLE_WORKER_CAP = 8          # max idle workers kept warm per node
 _LEASE_QUEUE_POLL = 0.02
 
 
+def _chip_detection_enabled() -> bool:
+    # On by default on real deployments; off under tests (importing jax per
+    # in-process raylet is slow and every virtual node would claim the same
+    # tunneled chip).
+    default = "0" if os.environ.get("RAY_TPU_TESTING") == "1" else "1"
+    return os.environ.get("RAY_TPU_DETECT_CHIPS", default) == "1"
+
+
+def detect_tpu_topology() -> dict | None:
+    """Structured TPU topology for this host (the ICI-aware scheduler's
+    input; reference role: the flat `resources: {"TPU": n}` of
+    autoscaler/gcp/tpu.yaml:29, which loses slice/coord structure).
+
+    Sources: the TPU runtime env (TPU_ACCELERATOR_TYPE / TPU_TOPOLOGY /
+    TPU_WORKER_ID / TPU_NAME are set on GCE/GKE TPU VMs) plus jax device
+    coords when available. Returns None off-TPU.
+    """
+    env = os.environ
+    info: dict = {}
+    if env.get("TPU_ACCELERATOR_TYPE"):
+        info["accelerator_type"] = env["TPU_ACCELERATOR_TYPE"]
+    if env.get("TPU_TOPOLOGY"):
+        info["topology"] = env["TPU_TOPOLOGY"]
+    if env.get("TPU_WORKER_ID") is not None and env.get("TPU_WORKER_ID") != "":
+        try:
+            info["worker_id"] = int(env["TPU_WORKER_ID"])
+        except ValueError:
+            pass
+    slice_id = env.get("TPU_NAME") or env.get("TPU_SLICE_ID")
+    if slice_id:
+        info["slice_id"] = slice_id
+    if _chip_detection_enabled():
+        try:
+            import jax
+
+            chips = [d for d in jax.devices() if d.platform == "tpu"]
+            if chips:
+                info["chips"] = len(chips)
+                coords = [tuple(getattr(d, "coords", ()) or ())
+                          for d in chips]
+                if any(coords):
+                    info["coords"] = coords
+                si = getattr(chips[0], "slice_index", None)
+                if si is not None and "slice_id" not in info:
+                    info["slice_id"] = f"slice-{si}"
+        except Exception:
+            pass
+    if not info:
+        return None
+    info.setdefault("slice_id", "slice-0")
+    info.setdefault("worker_id", 0)
+    return info
+
+
 def detect_resources(num_cpus=None, num_tpus=None, memory=None,
                      resources=None) -> dict:
     out = dict(resources or {})
     out["CPU"] = float(num_cpus if num_cpus is not None else os.cpu_count() or 1)
     if num_tpus is None:
         num_tpus = 0
-        if os.environ.get("RAY_TPU_DETECT_CHIPS", "0") == "1":
+        if _chip_detection_enabled():
             try:
                 import jax
 
@@ -88,10 +142,15 @@ class Raylet:
                  host: str = "127.0.0.1", port: int = 0,
                  resources: dict | None = None,
                  store_size: int = 256 * 1024 * 1024,
-                 session_dir: str | None = None):
+                 session_dir: str | None = None,
+                 tpu_topology: dict | None = None):
         self.node_id = node_id or uuid.uuid4().hex[:16]
         self.gcs_addr = tuple(gcs_addr)
         self.resources_total = dict(resources or detect_resources())
+        # structured TPU info for the ICI-aware PG scheduler; tests inject
+        # fake slices, real deployments auto-detect
+        self.tpu_topology = (tpu_topology if tpu_topology is not None
+                             else detect_tpu_topology())
         self.resources_avail = dict(self.resources_total)
         self.session_dir = session_dir or os.path.join(
             "/tmp/ray_tpu", f"session_{os.getpid()}")
@@ -118,7 +177,8 @@ class Raylet:
                              "spill_dir": self.spill_dir,
                              "session_dir": self.session_dir,
                              "hostname": os.uname().nodename,
-                             "pid": os.getpid()})
+                             "pid": os.getpid(),
+                             "tpu": self.tpu_topology})
         self._gcs.call("subscribe", channels=["placement_groups"])
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
                                         name=f"raylet-reap-{self.node_id[:6]}")
@@ -261,6 +321,16 @@ class Raylet:
                 self._on_worker_exit(wid)
             if ticks % 25 == 0:   # every ~5s: GC leases of remote lessees
                 self._gc_remote_lessee_leases()
+            if ticks % 3 == 0:    # ~600ms: resource view → GCS (the
+                # RaySyncer-gossip analog; the PG scheduler packs against
+                # this instead of node totals)
+                try:
+                    with self._lock:
+                        avail = dict(self.resources_avail)
+                    self._gcs.push("report_resources",
+                                   node_id=self.node_id, available=avail)
+                except Exception:
+                    pass
 
     def _release_leases_of_lessee(self, lessee_id: str):
         with self._lock:
@@ -406,7 +476,7 @@ class Raylet:
             else:
                 return {"spillback": target}
         spread = strategy.get("spread", False)
-        if spread:
+        if spread and not strategy.get("no_spill"):
             # SPREAD policy: coin-flip toward a remote capable node first
             # (reference: scheduling/policy/spread_scheduling_policy).
             target = self._pick_spillback(resources)
@@ -414,9 +484,13 @@ class Raylet:
                 return {"spillback": target}
         if self._try_reserve(resources):
             return self._grant(resources, lessee)
-        target = self._pick_spillback(resources)
-        if target is not None:
-            return {"spillback": target}
+        # no_spill: the caller exhausted its spillback hops on a saturated
+        # cluster — queue here instead of bouncing (the reference keeps the
+        # request in ClusterTaskManager's queue in this state).
+        if not strategy.get("no_spill"):
+            target = self._pick_spillback(resources)
+            if target is not None:
+                return {"spillback": target}
         # Queue until local resources free up (reference: lease request stays
         # in ClusterTaskManager queue). Block this handler thread.
         deadline = time.time() + 300.0
@@ -534,9 +608,23 @@ class Raylet:
         strategy = spec.get("strategy") or {}
         pg_id = strategy.get("placement_group_id")
         if pg_id is not None:
-            pg = self._gcs.call("get_placement_group", pg_id=pg_id)
-            if pg is None or pg["State"] != "CREATED":
-                raise ValueError("placement group not ready")
+            # A PENDING group just means its resources are currently held
+            # (e.g. by other gang-scheduled trials): queue until the GCS
+            # reserves the bundles, like the plain-resource path queues.
+            deadline = time.time() + 300.0
+            poll = _LEASE_QUEUE_POLL
+            while True:
+                pg = self._gcs.call("get_placement_group", pg_id=pg_id)
+                if pg is None or pg["State"] == "REMOVED":
+                    raise ValueError("placement group removed")
+                if pg["State"] == "CREATED":
+                    break
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        "placement group not ready within 300s")
+                time.sleep(poll)
+                poll = min(poll * 1.5, 0.5)   # back off: dozens of queued
+                # creations at 50 polls/s each would hammer the GCS
             idx = strategy.get("bundle_index", -1)
             target = (pg["BundleNodes"][idx] if idx >= 0
                       else next((n for n in pg["BundleNodes"]
@@ -690,6 +778,56 @@ class Raylet:
                 "num_idle": len(self._idle),
                 "num_leases": len(self._leases),
             }
+
+    def rpc_list_leases(self, conn):
+        """Active leases = the raylet-level view of running work (state API
+        `list tasks` source; reference: NodeManagerService GetNodeStats)."""
+        with self._lock:
+            return [{
+                "lease_id": lease.lease_id,
+                "node_id": self.node_id,
+                "resources": dict(lease.resources),
+                "worker_id": lease.worker.worker_id,
+                "worker_pid": lease.worker.proc.pid,
+                "is_actor": lease.worker.is_actor,
+            } for lease in self._leases.values()]
+
+    def rpc_list_workers(self, conn):
+        with self._lock:
+            return [{
+                "worker_id": w.worker_id,
+                "node_id": self.node_id,
+                "pid": w.proc.pid,
+                "state": ("actor" if w.is_actor
+                          else "leased" if w.assigned_lease else "idle"),
+                "actor_id": w.actor_id.hex() if w.actor_id else None,
+            } for w in self._workers.values()]
+
+    def _fanout_workers(self, method: str) -> list:
+        """Collect per-worker state (profiling spans, metrics) from every
+        registered worker process on this node."""
+        from ray_tpu._private.protocol import RpcClient
+
+        with self._lock:
+            addrs = [w.addr for w in self._workers.values()
+                     if w.addr is not None]
+        out = []
+        for addr in addrs:
+            try:
+                c = RpcClient(tuple(addr), timeout=5.0)
+                try:
+                    out.extend(c.call(method))
+                finally:
+                    c.close()
+            except Exception:
+                continue
+        return out
+
+    def rpc_profile_events(self, conn):
+        return self._fanout_workers("profile_events")
+
+    def rpc_metrics_snapshot(self, conn):
+        return self._fanout_workers("metrics_snapshot")
 
     def rpc_ping(self, conn):
         return "pong"

@@ -856,6 +856,16 @@ class CoreWorker:
         finally:
             client.close()
 
+    def rpc_profile_events(self, conn):
+        from ray_tpu._private import profiling
+
+        return profiling.snapshot()
+
+    def rpc_metrics_snapshot(self, conn):
+        from ray_tpu.util import metrics
+
+        return metrics.registry_snapshot()
+
     def rpc_get_owned_value(self, conn, object_id: bytes):
         """Serve a value we own to a borrower. Blocks briefly if the task
         producing it hasn't finished. If every copy of a sealed value died,
@@ -1018,7 +1028,13 @@ class CoreWorker:
         target = self.raylet
         opened = None
         try:
-            for _ in range(max_spillbacks):
+            for hop in range(max_spillbacks + 1):
+                # Saturated cluster: every node keeps redirecting to some
+                # other busy node. After max_spillbacks hops, stop bouncing
+                # and queue on the current raylet until resources free.
+                if hop == max_spillbacks:
+                    strategy = dict(strategy or {})
+                    strategy["no_spill"] = True
                 reply = target.call("request_worker_lease",
                                     resources=resources, strategy=strategy,
                                     lessee=(self.worker_id, self.addr),
@@ -1030,7 +1046,8 @@ class CoreWorker:
                     opened.close()
                 opened = RpcClient(addr, timeout=None)
                 target = opened
-            raise RuntimeError("lease spillback loop exceeded")
+            raise RuntimeError(
+                "lease not granted after queueing on a saturated cluster")
         finally:
             # the grant reply carries everything we need (worker addr,
             # node id); the raylet connection is not kept
@@ -1221,10 +1238,14 @@ class CoreWorker:
                 return {"cancelled": True}
             self._current_task_id = task_id
             self._current_task_thread = threading.get_ident()
+            from ray_tpu._private.profiling import record_span
+
             try:
-                fn = self._load_function(spec["func_hash"])
-                args, kwargs = self._resolve_args(spec)
-                result = fn(*args, **kwargs)
+                with record_span("task", spec.get("task_desc", "task"),
+                                 {"task_id": task_id.hex()}):
+                    fn = self._load_function(spec["func_hash"])
+                    args, kwargs = self._resolve_args(spec)
+                    result = fn(*args, **kwargs)
                 return self._package_results(spec, result)
             except BaseException as e:  # noqa: BLE001
                 return self._package_error(spec, e)
@@ -1276,13 +1297,21 @@ class CoreWorker:
             # dispatch order (reference: concurrency_group_manager.h).
             self._actor_concurrency.wait(ticket)
             acquired = True
+            from ray_tpu._private.profiling import record_span
+
             try:
-                if inspect.iscoroutinefunction(method):
-                    fut = asyncio.run_coroutine_threadsafe(
-                        method(*args, **kwargs), self._ensure_async_loop())
-                    result = fut.result()
-                else:
-                    result = method(*args, **kwargs)
+                with record_span(
+                        "actor_task",
+                        spec.get("task_desc", f"actor.{method_name}"),
+                        {"actor_id": (self.actor_id.hex()
+                                      if self.actor_id else "")}):
+                    if inspect.iscoroutinefunction(method):
+                        fut = asyncio.run_coroutine_threadsafe(
+                            method(*args, **kwargs),
+                            self._ensure_async_loop())
+                        result = fut.result()
+                    else:
+                        result = method(*args, **kwargs)
             finally:
                 self._actor_concurrency.release()
             return self._package_results(spec, result)

@@ -34,12 +34,16 @@ class Cluster:
     def add_node(self, num_cpus: int = 1, num_tpus: int = 0,
                  resources: dict | None = None,
                  object_store_memory: int = 64 * 1024 * 1024,
+                 tpu_topology: dict | None = None,
                  **_ignored) -> Raylet:
+        """tpu_topology: inject a fake slice/worker layout for topology
+        tests, e.g. {"slice_id": "s0", "worker_id": 2, "chips": 4}."""
         raylet = Raylet(
             self.gcs.addr,
             resources=detect_resources(num_cpus, num_tpus,
                                        resources=resources),
             store_size=object_store_memory,
+            tpu_topology=tpu_topology,
         )
         self._raylets[raylet.node_id] = raylet
         return raylet

@@ -1,3 +1,4 @@
+from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
 from ray_tpu.data.dataset import (  # noqa: F401
     ActorPoolStrategy,
     Dataset,

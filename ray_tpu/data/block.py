@@ -1,0 +1,123 @@
+"""Block representations + vectorized block ops.
+
+Reference: python/ray/data/block.py (Block = Arrow table / pandas / list).
+TPU-native choice: the columnar format is dict[str, np.ndarray] (or a bare
+np.ndarray for untyped datasets) — numpy is what feeds jax.device_put with
+zero conversion, so batches slice out of blocks without touching Python
+rows. List-of-rows remains the fallback for ragged/object data.
+
+Block kinds:
+    np.ndarray                 — columnless typed data
+    dict[str, np.ndarray]      — columnar ("table") data
+    list                       — rows (dicts or scalars), the slow path
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def is_columnar(block) -> bool:
+    return isinstance(block, np.ndarray) or (
+        isinstance(block, dict)
+        and all(isinstance(v, np.ndarray) for v in block.values()))
+
+
+def columnarize(rows: list):
+    """Rows → columnar block when the rows are uniform; otherwise return
+    the row list unchanged."""
+    if not rows:
+        return rows
+    first = rows[0]
+    try:
+        if isinstance(first, dict):
+            keys = list(first)
+            if all(isinstance(r, dict) and list(r) == keys for r in rows):
+                cols = {k: np.asarray([r[k] for r in rows]) for k in keys}
+                if all(v.dtype != object for v in cols.values()):
+                    return cols
+            return rows
+        # Only scalar-like rows become an array: tuples/lists must survive
+        # round trips as tuples/lists (np.asarray would turn ("x", 1) rows
+        # into a 2-D unicode array).
+        if not isinstance(first, (int, float, complex, str, bytes,
+                                  np.generic, np.ndarray)):
+            return rows
+        arr = np.asarray(rows)
+        if arr.dtype == object:
+            return rows
+        return arr
+    except Exception:
+        return rows
+
+
+def num_rows(block) -> int:
+    if isinstance(block, np.ndarray):
+        return len(block)
+    if isinstance(block, dict):
+        return len(next(iter(block.values()))) if block else 0
+    if hasattr(block, "to_dict") and hasattr(block, "columns"):
+        return len(block)
+    return len(block)
+
+
+def slice_block(block, start: int, stop: int):
+    if isinstance(block, np.ndarray):
+        return block[start:stop]
+    if isinstance(block, dict):
+        return {k: v[start:stop] for k, v in block.items()}
+    if hasattr(block, "iloc"):
+        return block.iloc[start:stop]
+    return block[start:stop]
+
+
+def concat_blocks(blocks: list):
+    blocks = [b for b in blocks if num_rows(b) > 0]
+    if not blocks:
+        return []
+    first = blocks[0]
+    if all(isinstance(b, np.ndarray) for b in blocks):
+        return np.concatenate(blocks)
+    if all(isinstance(b, dict) and is_columnar(b) for b in blocks):
+        keys = list(first)
+        if all(list(b) == keys for b in blocks):
+            return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    # fallback: rows
+    out = []
+    for b in blocks:
+        out.extend(to_rows(b))
+    return out
+
+
+def to_rows(block) -> list:
+    if isinstance(block, np.ndarray):
+        return list(block)
+    if isinstance(block, dict) and is_columnar(block):
+        keys = list(block)
+        n = num_rows(block)
+        return [{k: block[k][i] for k in keys} for i in range(n)]
+    if hasattr(block, "to_dict") and hasattr(block, "columns"):
+        return block.to_dict("records")
+    return list(block)
+
+
+def to_numpy_batch(block):
+    """Columnar/array block → the numpy batch handed to jax.device_put.
+    No per-row Python for columnar blocks."""
+    if isinstance(block, np.ndarray):
+        return block
+    if isinstance(block, dict) and is_columnar(block):
+        return block
+    rows = to_rows(block)
+    if rows and isinstance(rows[0], dict):
+        return {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+    return np.asarray(rows)
+
+
+def take_indices(block, idx: np.ndarray):
+    """Vectorized row selection (shuffle/partition fast path)."""
+    if isinstance(block, np.ndarray):
+        return block[idx]
+    if isinstance(block, dict) and is_columnar(block):
+        return {k: v[idx] for k, v in block.items()}
+    rows = to_rows(block)
+    return [rows[i] for i in idx]

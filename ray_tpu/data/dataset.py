@@ -1,15 +1,20 @@
-"""Distributed Dataset — blocks as object-store refs, lazy stage plan.
+"""Distributed Dataset — columnar blocks as object-store refs, lazy plan.
 
-Reference: python/ray/data/dataset.py:138 (Dataset), _internal/plan.py:46
-(ExecutionPlan + Stage), _internal/compute.py:58,173 (TaskPoolStrategy /
-ActorPoolStrategy), _internal/push_based_shuffle.py, _internal/sort.py.
+Reference: python/ray/data/dataset.py:138 (Dataset), data/block.py (Block),
+_internal/plan.py:46 (ExecutionPlan + Stage), _internal/compute.py:58,173
+(TaskPoolStrategy / ActorPoolStrategy), _internal/push_based_shuffle.py,
+_internal/sort.py.
 
 Design: a Dataset is a list of block refs plus a chain of not-yet-executed
-stages. Each block is a plain list of rows (dicts/values) or a numpy array;
-map-like stages fuse and execute one task per block. TPU-native additions:
-`iter_batches(..., device_put=True)` prefetches the next batch to the chip
-while the current one is consumed — the host→HBM feed pipeline that replaces
-the reference's `to_torch` pin-memory path.
+stages. Blocks are columnar (np.ndarray or dict[str, np.ndarray] — see
+data/block.py) with list-of-rows as the ragged-data fallback; map-like
+stages fuse and execute one task per block, shuffle partitions blocks with
+vectorized numpy index math (no per-row Python on the hot path). TPU-native
+additions: `iter_batches(..., device_put=True)` slices batches straight out
+of columnar blocks and prefetches the next batch to the chip while the
+current one is consumed — the host→HBM feed pipeline that replaces the
+reference's `to_torch` pin-memory path. `window()` gives the pipelined
+execution of the reference's DatasetPipeline (data/dataset_pipeline.py).
 """
 from __future__ import annotations
 
@@ -19,6 +24,7 @@ import random as _random
 import numpy as np
 
 import ray_tpu
+from ray_tpu.data import block as B
 
 
 def _exec_chain(stages, block):
@@ -99,15 +105,17 @@ class Dataset:
 
     def map(self, fn) -> "Dataset":
         return self._with_stage(
-            lambda block: [fn(row) for row in _rows(block)])
+            lambda block: B.columnarize([fn(row) for row in _rows(block)]))
 
     def flat_map(self, fn) -> "Dataset":
         return self._with_stage(
-            lambda block: [out for row in _rows(block) for out in fn(row)])
+            lambda block: B.columnarize(
+                [out for row in _rows(block) for out in fn(row)]))
 
     def filter(self, fn) -> "Dataset":
         return self._with_stage(
-            lambda block: [row for row in _rows(block) if fn(row)])
+            lambda block: B.columnarize(
+                [row for row in _rows(block) if fn(row)]))
 
     def map_batches(self, fn, *, batch_format: str = "auto") -> "Dataset":
         """fn: block -> block (numpy array in → numpy array out when the
@@ -122,26 +130,27 @@ class Dataset:
         """Push-based two-stage shuffle (reference:
         _internal/push_based_shuffle.py): map tasks split each block into
         N random partitions; reduce tasks concatenate partition i of every
-        block. All intermediate partitions live in the object store."""
+        block. All intermediate partitions live in the object store.
+        Columnar blocks partition with one numpy permutation + array
+        indexing per block — no per-row Python."""
         n = max(1, self.num_blocks)
         seed_base = seed if seed is not None else _random.randrange(2**31)
 
         @ray_tpu.remote(num_returns=n)
         def shuffle_map(stages, block, block_idx):
             block = _exec_chain(stages, block)
-            rows = _rows(block)
-            rng = _random.Random(seed_base + block_idx)
-            parts = [[] for _ in builtins.range(n)]
-            for row in rows:
-                parts[rng.randrange(n)].append(row)
+            rows_n = B.num_rows(block)
+            rng = np.random.default_rng(seed_base + block_idx)
+            perm = rng.permutation(rows_n)
+            parts = [B.take_indices(block, idx)
+                     for idx in np.array_split(perm, n)]
             return tuple(parts) if n > 1 else parts[0]
 
         @ray_tpu.remote
-        def shuffle_reduce(*parts):
-            rows = [row for part in parts for row in part]
-            rng = _random.Random(seed_base ^ 0x5EED)
-            rng.shuffle(rows)
-            return rows
+        def shuffle_reduce(reduce_idx, *parts):
+            merged = B.concat_blocks(list(parts))
+            rng = np.random.default_rng((seed_base ^ 0x5EED) + reduce_idx)
+            return B.take_indices(merged, rng.permutation(B.num_rows(merged)))
 
         stages = self._stages
         part_refs = [shuffle_map.remote(stages, ref, i)
@@ -149,7 +158,8 @@ class Dataset:
         if n == 1:
             part_refs = [[r] for r in part_refs]
         reduced = [
-            shuffle_reduce.remote(*[part_refs[b][i] for b in builtins.range(n)])
+            shuffle_reduce.remote(
+                i, *[part_refs[b][i] for b in builtins.range(n)])
             for i in builtins.range(n)
         ]
         return Dataset(reduced)
@@ -222,6 +232,27 @@ class Dataset:
     def groupby(self, key) -> "GroupedDataset":
         return GroupedDataset(self, key)
 
+    def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
+        """Windowed pipelined execution (reference:
+        data/dataset_pipeline.py): stages of window i+1 execute while
+        window i is consumed."""
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        windows = []
+        refs = self._block_refs
+        for i in builtins.range(0, len(refs), blocks_per_window):
+            windows.append(Dataset(refs[i:i + blocks_per_window],
+                                   self._stages))
+        return DatasetPipeline(windows)
+
+    def repeat(self, times: int | None = None) -> "DatasetPipeline":
+        """Epoch loop as a pipeline (reference: dataset.py repeat)."""
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        if times is None:
+            return DatasetPipeline([self], loop=True)
+        return DatasetPipeline([self] * times, loop=False)
+
     # ------------------------------------------------------ consumption
 
     def take(self, limit: int = 20) -> list:
@@ -267,31 +298,50 @@ class Dataset:
         """Batched iteration with one-batch lookahead; with device_put the
         next batch is already on its way to the device while the caller
         consumes the current one (the TPU host→HBM feed pipeline)."""
-        def to_batch(rows):
+        def to_batch(blk):
             if batch_format == "numpy":
-                batch = _rows_to_numpy(rows)
+                batch = B.to_numpy_batch(blk)
             else:
-                batch = rows
+                batch = B.to_rows(blk)
             if device_put:
                 import jax
 
                 batch = jax.device_put(batch)
             return batch
 
-        pending_rows: list = []
+        # Batches slice straight out of blocks (columnar: numpy views +
+        # one concat per batch — zero per-row Python).
+        pending: list = []       # partial blocks carried across block refs
+        pending_n = 0
         prev = None
         for ref in self._materialized_refs():
-            pending_rows.extend(_rows(ray_tpu.get(ref)))
-            while len(pending_rows) >= batch_size:
-                batch = to_batch(pending_rows[:batch_size])
-                pending_rows = pending_rows[batch_size:]
+            blk = ray_tpu.get(ref)
+            pending.append(blk)
+            pending_n += B.num_rows(blk)
+            while pending_n >= batch_size:
+                take, taken = [], 0
+                while taken < batch_size:
+                    head = pending[0]
+                    hn = B.num_rows(head)
+                    need = batch_size - taken
+                    if hn <= need:
+                        take.append(head)
+                        taken += hn
+                        pending.pop(0)
+                    else:
+                        take.append(B.slice_block(head, 0, need))
+                        pending[0] = B.slice_block(head, need, hn)
+                        taken += need
+                pending_n -= batch_size
+                batch = to_batch(B.concat_blocks(take)
+                                 if len(take) > 1 else take[0])
                 if prev is not None:
                     yield prev
                 prev = batch    # lookahead: device transfer overlaps consume
         if prev is not None:
             yield prev
-        if pending_rows and not drop_last:
-            yield to_batch(pending_rows)
+        if pending_n and not drop_last:
+            yield to_batch(B.concat_blocks(pending))
 
     def to_numpy(self) -> np.ndarray:
         return _rows_to_numpy(self.take_all())
@@ -318,41 +368,71 @@ class Dataset:
 
 
 class GroupedDataset:
-    """(reference: data/grouped_dataset.py) hash-partition by key, then
-    per-group aggregation."""
+    """(reference: data/grouped_dataset.py) distributed hash-partition by
+    key, then per-group aggregation inside reduce tasks — group data never
+    lands on the driver."""
 
     def __init__(self, ds: Dataset, key):
         self.ds = ds
         self.keyfn = key if callable(key) else (lambda row: row[key])
 
-    def _groups(self) -> dict:
-        groups: dict = {}
-        for row in self.ds.take_all():
-            groups.setdefault(self.keyfn(row), []).append(row)
-        return groups
+    def _reduce(self, per_groups_fn) -> Dataset:
+        """Two-stage: map tasks hash-partition each block's rows; reduce
+        task i groups partition i of every block and applies
+        per_groups_fn(groups_dict) -> rows."""
+        ds = self.ds
+        keyfn = self.keyfn
+        n = max(1, ds.num_blocks)
+
+        @ray_tpu.remote(num_returns=n)
+        def part_map(stages, blk):
+            import zlib
+
+            rows = _rows(_exec_chain(stages, blk))
+            parts = [[] for _ in builtins.range(n)]
+            for row in rows:
+                # stable hash: builtin hash() is salted per process, and the
+                # map tasks run in different workers
+                h = zlib.crc32(str(keyfn(row)).encode())
+                parts[h % n].append(row)
+            return tuple(parts) if n > 1 else parts[0]
+
+        @ray_tpu.remote
+        def part_reduce(*parts):
+            groups: dict = {}
+            for part in parts:
+                for row in part:
+                    groups.setdefault(keyfn(row), []).append(row)
+            return B.columnarize(per_groups_fn(groups))
+
+        part_refs = [part_map.remote(ds._stages, ref)
+                     for ref in ds._block_refs]
+        if n == 1:
+            part_refs = [[r] for r in part_refs]
+        reduced = [
+            part_reduce.remote(*[part_refs[b][i]
+                                 for b in builtins.range(n)])
+            for i in builtins.range(n)
+        ]
+        return Dataset(reduced)
 
     def count(self) -> Dataset:
-        return from_items([
-            {"key": k, "count": len(v)} for k, v in self._groups().items()])
+        return self._reduce(lambda groups: [
+            {"key": k, "count": len(v)} for k, v in groups.items()])
 
     def aggregate(self, agg_fn) -> Dataset:
-        return from_items([
-            {"key": k, "value": agg_fn(v)}
-            for k, v in self._groups().items()])
+        return self._reduce(lambda groups: [
+            {"key": k, "value": agg_fn(v)} for k, v in groups.items()])
 
     def map_groups(self, fn) -> Dataset:
-        return from_items([out for k, v in self._groups().items()
-                           for out in fn(v)])
+        return self._reduce(lambda groups: [
+            out for _, v in groups.items() for out in fn(v)])
 
 
 # -------------------------------------------------------------- block utils
 
 def _rows(block) -> list:
-    if isinstance(block, np.ndarray):
-        return list(block)
-    if hasattr(block, "to_dict") and hasattr(block, "columns"):  # DataFrame
-        return block.to_dict("records")
-    return list(block)
+    return B.to_rows(block)
 
 
 def _rows_to_numpy(rows):
@@ -367,7 +447,7 @@ def from_items(items: list, *, parallelism: int = 8) -> Dataset:
     items = list(items)
     n = max(1, min(parallelism, len(items) or 1))
     chunk = (len(items) + n - 1) // n
-    refs = [ray_tpu.put(items[i * chunk:(i + 1) * chunk])
+    refs = [ray_tpu.put(B.columnarize(items[i * chunk:(i + 1) * chunk]))
             for i in builtins.range(n)]
     return Dataset(refs)
 

@@ -1,0 +1,179 @@
+"""State API — programmatic cluster observability.
+
+Reference: python/ray/experimental/state/api.py (list_actors/list_tasks/
+list_objects/list_nodes/..., StateApiClient) with the aggregation the
+reference does in dashboard/state_aggregator.py done client-side here: the
+GCS serves cluster tables, raylets serve per-node lease/worker state.
+
+Works connected (inside a driver: uses the current worker's GCS) or
+standalone (address="host:port", e.g. from the CLI).
+"""
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _gcs(address: str | None):
+    """Yield a call(method, **kw) callable for the GCS."""
+    if address is None:
+        from ray_tpu._private.worker_runtime import current_worker
+
+        w = current_worker()
+        if w is not None:
+            yield w.gcs.call
+            return
+        from ray_tpu.scripts.node import CLUSTER_FILE
+        import json
+        import os
+
+        if not os.path.exists(CLUSTER_FILE):
+            raise RuntimeError("not connected and no local cluster file; "
+                               "pass address='host:port'")
+        with open(CLUSTER_FILE) as f:
+            address = json.load(f)["gcs_address"]
+    from ray_tpu._private.protocol import RpcClient
+
+    host, port = address.rsplit(":", 1)
+    client = RpcClient((host, int(port)), timeout=10.0)
+    try:
+        yield client.call
+    finally:
+        client.close()
+
+
+def _each_raylet(call, method: str) -> list:
+    from ray_tpu._private.protocol import RpcClient
+
+    out = []
+    for n in call("get_nodes"):
+        if not n["Alive"]:
+            continue
+        try:
+            c = RpcClient((n["NodeManagerAddress"], n["NodeManagerPort"]),
+                          timeout=5.0)
+            try:
+                out.extend(c.call(method))
+            finally:
+                c.close()
+        except Exception:
+            continue
+    return out
+
+
+def list_nodes(*, address: str | None = None) -> list[dict]:
+    with _gcs(address) as call:
+        return call("get_nodes")
+
+
+def list_actors(*, address: str | None = None) -> list[dict]:
+    with _gcs(address) as call:
+        return call("list_actors")
+
+
+def list_placement_groups(*, address: str | None = None) -> list[dict]:
+    with _gcs(address) as call:
+        return call("list_placement_groups")
+
+
+def list_objects(*, address: str | None = None) -> list[dict]:
+    with _gcs(address) as call:
+        return call("list_objects")
+
+
+def list_tasks(*, address: str | None = None) -> list[dict]:
+    """Raylet-level view: one row per active lease (running task slot).
+    The reference's task events flow through its dashboard agent; here the
+    lease table is the source of truth for what is running where."""
+    with _gcs(address) as call:
+        return _each_raylet(call, "list_leases")
+
+
+def list_workers(*, address: str | None = None) -> list[dict]:
+    with _gcs(address) as call:
+        return _each_raylet(call, "list_workers")
+
+
+def cluster_status(*, address: str | None = None) -> str:
+    """`ray status` analog (reference: scripts.py:1872): node table +
+    resource usage summary."""
+    from ray_tpu._private.protocol import RpcClient
+
+    with _gcs(address) as call:
+        nodes = call("get_nodes")
+        lines = ["======== Cluster status ========"]
+        alive = [n for n in nodes if n["Alive"]]
+        dead = [n for n in nodes if not n["Alive"]]
+        lines.append(f"Nodes: {len(alive)} alive, {len(dead)} dead")
+        total: dict = {}
+        avail: dict = {}
+        for n in alive:
+            for k, v in n["Resources"].items():
+                total[k] = total.get(k, 0) + v
+            try:
+                c = RpcClient((n["NodeManagerAddress"],
+                               n["NodeManagerPort"]), timeout=5.0)
+                try:
+                    info = c.call("node_info")
+                finally:
+                    c.close()
+                for k, v in info["resources_available"].items():
+                    avail[k] = avail.get(k, 0) + v
+            except Exception:
+                continue
+        lines.append("Resources (used/total):")
+        for k in sorted(total):
+            used = total[k] - avail.get(k, total[k])
+            if k == "memory":
+                lines.append(f"  {used / 2**30:.1f}/"
+                             f"{total[k] / 2**30:.1f} GiB memory")
+            else:
+                lines.append(f"  {used:g}/{total[k]:g} {k}")
+        for n in alive:
+            tpu = n.get("tpu")
+            suffix = (f" slice={tpu['slice_id']} worker={tpu['worker_id']}"
+                      if tpu else "")
+            lines.append(f"  node {n['NodeID'][:12]} "
+                         f"{n['NodeManagerAddress']}:{n['NodeManagerPort']}"
+                         f"{suffix}")
+        return "\n".join(lines)
+
+
+def memory_summary(*, address: str | None = None) -> str:
+    """`ray memory` analog (reference: scripts.py:1822)."""
+    objs = list_objects(address=address)
+    lines = ["======== Object store ========",
+             f"Objects tracked: {len(objs)}"]
+    total = sum(o["Size"] for o in objs)
+    lost = [o for o in objs if o["Lost"]]
+    lines.append(f"Total bytes: {total}")
+    if lost:
+        lines.append(f"Lost objects: {len(lost)}")
+    for o in sorted(objs, key=lambda o: -o["Size"])[:20]:
+        lines.append(f"  {o['ObjectID'][:16]}  {o['Size']:>12}  "
+                     f"on {len(o['Locations'])} node(s)")
+    return "\n".join(lines)
+
+
+def metrics_summary(*, address: str | None = None,
+                    prometheus: bool = False):
+    """Aggregate user metrics (ray_tpu.util.metrics Counter/Gauge/
+    Histogram) across every worker process. prometheus=True renders the
+    text exposition format (reference: the dashboard agent's Prometheus
+    endpoint, reporter_agent.py:296)."""
+    from ray_tpu.util.metrics import prometheus_text, registry_snapshot
+
+    with _gcs(address) as call:
+        snaps = registry_snapshot()           # this process too
+        snaps.extend(_each_raylet(call, "metrics_snapshot"))
+    if prometheus:
+        return prometheus_text(snaps)
+    return snaps
+
+
+def summarize_tasks(*, address: str | None = None) -> dict:
+    rows = list_tasks(address=address)
+    return {"total_running": len(rows),
+            "by_node": {r["node_id"]: sum(1 for x in rows
+                                          if x["node_id"] == r["node_id"])
+                        for r in rows}}

@@ -241,7 +241,20 @@ def forward_pipelined(
             "pipelined forward does not yet propagate the MoE aux loss; "
             "use pp=1 with MoE or a dense (non-MoE) config with pp>1"
         )
-    impl = "flash" if jax.default_backend() == "tpu" else "reference"
+    n_sp = dict(mesh.shape).get("sp", 1)
+    # pp×sp composition: ONE flat manual region over {pp, sp} with the
+    # per-shard ring attention inside stages (a nested sp-shard_map in the
+    # pp scan does not differentiate — DuplicateSpecError in transpose).
+    if n_sp > 1:
+        impl = "ring_local"
+        manual_axes = ("sp",)
+        from jax.sharding import PartitionSpec as _P
+
+        mb_spec = _P(None, None, "sp", None)   # [M, B_mb, S, D]
+    else:
+        impl = "flash" if jax.default_backend() == "tpu" else "reference"
+        manual_axes = ()
+        mb_spec = None
     per_stage = cfg.n_layer // n_pp
     staged = jax.tree_util.tree_map(
         lambda leaf: leaf.reshape((n_pp, per_stage) + leaf.shape[1:]),
@@ -261,7 +274,8 @@ def forward_pipelined(
     x = embed(params, tokens, cfg)
     x = sh.constrain(x, mesh, "batch", "seq", "embed")
     mb = microbatch(x, n_microbatches)
-    y = gpipe(stage_fn, staged, mb, mesh)
+    y = gpipe(stage_fn, staged, mb, mesh, manual_axes=manual_axes,
+              mb_spec=mb_spec)
     x = unmicrobatch(y)
     logits = unembed(params, x, cfg)
     return sh.constrain(logits, mesh, "batch", "seq", "vocab"), jnp.float32(0)

@@ -10,14 +10,13 @@ neighbor transfers). See PAPERS.md "Scaling Deep Learning Training with
 MPMD Pipeline Parallelism" for the design space; this is the simpler SPMD
 point in it.
 
-Composability: the shard_map here is manual ONLY over `pp` — inside a
-stage, arrays keep their global dp/sp/tp shardings and GSPMD still inserts
-tensor-parallel collectives; ring attention (manual over `sp`) nests in the
-FORWARD pass. Known limitation (jax 0.9): differentiating a nested
-sp-shard_map inside the pp scan trips a DuplicateSpecError in transpose, so
-training steps combine pp with flash/dense attention (sp=1) or ring
-attention without pp; pp+sp joint training is tracked for a manual-SPMD
-block implementation.
+Composability: the shard_map is manual over `pp` plus any `manual_axes`
+the caller adds — inside a stage, arrays keep their global dp/tp shardings
+and GSPMD still inserts tensor-parallel collectives. For pp×sp joint
+training, pass manual_axes=("sp",) and use the PER-SHARD ring attention
+(ring_attention_local / impl="ring_local") inside the stage: one flat
+manual region differentiates cleanly, where a nested sp-shard_map inside
+the pp scan used to trip DuplicateSpecError in transpose (jax 0.9).
 
 Schedule: GPipe with M microbatches over P stages — T = M + P - 1 ticks;
 stage s works on microbatch t - s at tick t. Bubble fraction (P-1)/T.
@@ -110,23 +109,38 @@ def gpipe(
     mesh: Optional[Mesh] = None,
     *,
     axis_name: str = "pp",
+    manual_axes: tuple = (),
+    mb_spec: Optional[P] = None,
 ) -> jnp.ndarray:
     """Global entry: params have a leading [n_stages] dim (sharded over
-    `axis_name`), microbatches [M, B, ...] (any dp/sp sharding — preserved).
+    `axis_name`), microbatches [M, B, ...] (any dp/tp sharding — preserved).
     Returns [M, B, ...] outputs of the final stage.
+
+    manual_axes/mb_spec: extra mesh axes to manualize alongside pp (e.g.
+    ("sp",) with mb_spec=P(None, None, "sp") for sequence-parallel stages
+    whose stage_fn uses per-shard collectives like ring_attention_local).
     """
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    io_spec = mb_spec if mb_spec is not None else P()
 
     def body(params, mb):
         params = jax.tree_util.tree_map(lambda p: p[0], params)  # drop stage dim
+        if manual_axes:
+            # Params are replicated over the extra manual axes, but their
+            # cotangents are axis-varying partial sums — mark the primals
+            # varying too so the backward scan carry has consistent VMA
+            # (the psum of the partials happens at shard_map transpose).
+            params = jax.tree_util.tree_map(
+                lambda p: jax.lax.pcast(p, tuple(manual_axes),
+                                        to="varying"), params)
         return gpipe_local(stage_fn, params, mb, axis_name=axis_name)
 
     mapped = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
-        axis_names={axis_name},
+        in_specs=(param_specs, io_spec),
+        out_specs=io_spec,
+        axis_names={axis_name, *manual_axes},
     )
     return mapped(stacked_params, microbatches)
 

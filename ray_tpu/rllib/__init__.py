@@ -1,0 +1,18 @@
+"""ray_tpu.rllib — reinforcement learning on the runtime's actors.
+
+Thin capability-parity core of the reference's RLlib (rllib/, 156k LoC;
+SURVEY.md §2.3): AlgorithmConfig builder → Algorithm owning a WorkerSet of
+RolloutWorker actors (sampling + GAE on CPU hosts) and a jitted jax
+learner (PPO's clipped surrogate). Sample batches flow through the object
+store — the async sample/learn split of
+rllib/execution/multi_gpu_learner_thread.py:20 with the object store as
+the ring buffer and the compiled jax update as the device step.
+"""
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, PPO
+from ray_tpu.rllib.env import CartPole, make_env
+from ray_tpu.rllib.models import init_policy, policy_apply
+from ray_tpu.rllib.rollout_worker import RolloutWorker, concat_batches
+
+__all__ = ["Algorithm", "AlgorithmConfig", "CartPole", "PPO",
+           "RolloutWorker", "concat_batches", "init_policy", "make_env",
+           "policy_apply"]

@@ -1,0 +1,185 @@
+"""Algorithm base + PPO.
+
+Reference: rllib/algorithms/algorithm.py:148 (Algorithm(Trainable),
+train/step), ppo/ppo.py:307, and the learner pattern of
+execution/multi_gpu_learner_thread.py:20 — sampling actors feed batches
+through the object store, the driver-side jax learner runs jitted
+minibatch updates (on TPU the update is the compiled program; the host
+ring buffer is the object store itself).
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.models import init_policy, policy_apply
+from ray_tpu.rllib.rollout_worker import RolloutWorker, concat_batches
+
+
+class AlgorithmConfig:
+    """Builder-style config (reference: algorithm_config.py)."""
+
+    def __init__(self, algo_class=None):
+        self.algo_class = algo_class
+        self.env_spec = "CartPole-v1"
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 2
+        self.rollout_fragment_length = 128
+        self.gamma = 0.99
+        self.gae_lambda = 0.95
+        self.lr = 3e-4
+        self.train_batch_epochs = 4
+        self.minibatch_size = 128
+        self.clip_param = 0.2
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.seed = 0
+
+    def environment(self, env):
+        self.env_spec = env
+        return self
+
+    def rollouts(self, *, num_rollout_workers=None, num_envs_per_worker=None,
+                 rollout_fragment_length=None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr=None, gamma=None, clip_param=None,
+                 entropy_coeff=None, minibatch_size=None,
+                 train_batch_epochs=None):
+        for name, v in [("lr", lr), ("gamma", gamma),
+                        ("clip_param", clip_param),
+                        ("entropy_coeff", entropy_coeff),
+                        ("minibatch_size", minibatch_size),
+                        ("train_batch_epochs", train_batch_epochs)]:
+            if v is not None:
+                setattr(self, name, v)
+        return self
+
+    def build(self):
+        return (self.algo_class or PPO)(self)
+
+
+class Algorithm:
+    """Own a WorkerSet of rollout actors + a jax learner state."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        worker_cls = ray_tpu.remote(RolloutWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=0).remote(
+                config.env_spec, num_envs=config.num_envs_per_worker,
+                seed=config.seed + i, gamma=config.gamma,
+                gae_lambda=config.gae_lambda)
+            for i in range(config.num_rollout_workers)
+        ]
+        obs_size, num_actions = ray_tpu.get(self.workers[0].spaces.remote())
+        self.params = init_policy(
+            jax.random.PRNGKey(config.seed), obs_size, num_actions)
+        self.iteration = 0
+        self._recent_returns: list = []
+
+    def train(self) -> dict:
+        t0 = time.time()
+        self.iteration += 1
+        batch_refs = [w.sample.remote(self.params,
+                                      self.config.rollout_fragment_length)
+                      for w in self.workers]
+        batches = ray_tpu.get(batch_refs, timeout=300)
+        batch = concat_batches(batches)
+        returns = batch.pop("episode_returns")
+        self._recent_returns.extend(returns.tolist())
+        self._recent_returns = self._recent_returns[-100:]
+        metrics = self.training_step(batch)
+        metrics.update({
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else 0.0),
+            "episodes_this_iter": len(returns),
+            "num_env_steps_sampled": len(batch["obs"]),
+            "time_this_iter_s": time.time() - t0,
+        })
+        return metrics
+
+    def training_step(self, batch) -> dict:
+        raise NotImplementedError
+
+    def save(self) -> dict:
+        return {"params": self.params, "iteration": self.iteration}
+
+    def restore(self, state: dict):
+        self.params = state["params"]
+        self.iteration = state["iteration"]
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+
+class PPO(Algorithm):
+    """Clipped-surrogate PPO (reference: rllib/algorithms/ppo/ppo.py:307)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        cfg = config
+
+        def loss_fn(params, mb):
+            logits, values = policy_apply(params, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb["actions"][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            ratio = jnp.exp(logp - mb["logp"])
+            adv = mb["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv)
+            pi_loss = -surr.mean()
+            vf_loss = jnp.mean((values - mb["value_targets"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
+            total = (pi_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, mb):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def training_step(self, batch) -> dict:
+        n = len(batch["obs"])
+        mbs = max(1, self.config.minibatch_size)
+        rng = np.random.default_rng(self.config.seed + self.iteration)
+        aux = {}
+        for _ in range(self.config.train_batch_epochs):
+            perm = rng.permutation(n)
+            for start in range(0, n - mbs + 1, mbs):
+                idx = perm[start:start + mbs]
+                mb = {k: v[idx] for k, v in batch.items()}
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.opt_state, mb)
+        return {k: float(v) for k, v in aux.items()}

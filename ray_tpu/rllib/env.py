@@ -1,0 +1,80 @@
+"""Environment API + built-in CartPole.
+
+Reference: rllib/env/ (gym-style envs, vectorized wrappers). The API is
+gymnasium's reset/step; `make_env` accepts a spec string ("CartPole-v1"
+uses the built-in numpy implementation so tests are hermetic; any other
+string is resolved through gymnasium when installed) or a callable.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class CartPole:
+    """Classic cart-pole balancing, pure numpy (dynamics follow the classic
+    control formulation; public-domain physics)."""
+
+    def __init__(self, seed: int | None = None, max_steps: int = 500):
+        self._rng = np.random.default_rng(seed)
+        self.max_steps = max_steps
+        self.observation_size = 4
+        self.num_actions = 2
+        self._state = None
+        self._t = 0
+
+    def reset(self, seed: int | None = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._t = 0
+        return self._state.astype(np.float32), {}
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self._state
+        force = 10.0 if action == 1 else -10.0
+        g, mc, mp, length = 9.8, 1.0, 0.1, 0.5
+        total_mass = mc + mp
+        polemass_length = mp * length
+        tau = 0.02
+
+        costh, sinth = np.cos(theta), np.sin(theta)
+        temp = (force + polemass_length * theta_dot**2 * sinth) / total_mass
+        theta_acc = (g * sinth - costh * temp) / (
+            length * (4.0 / 3.0 - mp * costh**2 / total_mass))
+        x_acc = temp - polemass_length * theta_acc * costh / total_mass
+
+        x += tau * x_dot
+        x_dot += tau * x_acc
+        theta += tau * theta_dot
+        theta_dot += tau * theta_acc
+        self._state = np.array([x, x_dot, theta, theta_dot])
+        self._t += 1
+
+        terminated = bool(abs(x) > 2.4 or abs(theta) > 12 * np.pi / 180)
+        truncated = self._t >= self.max_steps
+        return (self._state.astype(np.float32), 1.0, terminated, truncated,
+                {})
+
+
+def make_env(env_spec, seed: int | None = None):
+    """env_spec: "CartPole-v1" (built-in), a gymnasium id, or a zero-arg
+    callable returning a reset/step env."""
+    if callable(env_spec):
+        return env_spec()
+    if env_spec in ("CartPole-v1", "CartPole-v0"):
+        return CartPole(seed=seed,
+                        max_steps=500 if env_spec.endswith("v1") else 200)
+    import gymnasium
+
+    env = gymnasium.make(env_spec)
+    if seed is not None:
+        env.reset(seed=seed)
+    return env
+
+
+def env_spaces(env) -> tuple[int, int]:
+    """(observation_size, num_actions) for a discrete-action env."""
+    if hasattr(env, "observation_size"):
+        return env.observation_size, env.num_actions
+    obs_size = int(np.prod(env.observation_space.shape))
+    return obs_size, int(env.action_space.n)

@@ -1,0 +1,45 @@
+"""Policy/value networks — small jax MLPs (reference: rllib/models/).
+
+One shared set of helpers: init_policy builds {pi, vf} MLP params;
+policy_apply returns (logits, value). jit-compiled by callers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _init_mlp(key, sizes):
+    params = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        scale = jnp.sqrt(2.0 / fan_in)
+        params.append({
+            "w": jax.random.normal(sub, (fan_in, fan_out)) * scale,
+            "b": jnp.zeros((fan_out,)),
+        })
+    return params
+
+
+def _apply_mlp(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def init_policy(key, obs_size: int, num_actions: int,
+                hidden: tuple = (64, 64)):
+    kp, kv = jax.random.split(key)
+    return {
+        "pi": _init_mlp(kp, (obs_size, *hidden, num_actions)),
+        "vf": _init_mlp(kv, (obs_size, *hidden, 1)),
+    }
+
+
+def policy_apply(params, obs):
+    """obs [B, obs_size] -> (logits [B, A], value [B])."""
+    logits = _apply_mlp(params["pi"], obs)
+    value = _apply_mlp(params["vf"], obs)[..., 0]
+    return logits, value

@@ -1,0 +1,106 @@
+"""RolloutWorker — the sampling actor.
+
+Reference: rllib/evaluation/rollout_worker.py:150 (sample at :849). Each
+worker owns env instances + a jitted policy forward; `sample(params, n)`
+steps the envs for n transitions per env, computes GAE advantages, and
+returns a SampleBatch (dict of numpy arrays) through the object store —
+the learner never touches an environment.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.env import env_spaces, make_env
+from ray_tpu.rllib.models import policy_apply
+
+
+class RolloutWorker:
+    def __init__(self, env_spec, *, num_envs: int = 2, seed: int = 0,
+                 gamma: float = 0.99, gae_lambda: float = 0.95):
+        self.envs = [make_env(env_spec, seed=seed * 1000 + i)
+                     for i in range(num_envs)]
+        self.obs_size, self.num_actions = env_spaces(self.envs[0])
+        self.gamma = gamma
+        self.gae_lambda = gae_lambda
+        self._rng = np.random.default_rng(seed)
+        self._obs = [env.reset(seed=seed * 1000 + i)[0]
+                     for i, env in enumerate(self.envs)]
+        self._episode_returns = [0.0] * num_envs
+        self._completed: list[float] = []
+        import jax
+
+        self._fwd = jax.jit(policy_apply)
+
+    def spaces(self):
+        return self.obs_size, self.num_actions
+
+    def sample(self, params, steps_per_env: int) -> dict:
+        """Collect steps_per_env transitions from every env; returns a
+        SampleBatch with GAE advantages and value targets."""
+        E = len(self.envs)
+        T = steps_per_env
+        obs = np.zeros((T, E, self.obs_size), np.float32)
+        actions = np.zeros((T, E), np.int32)
+        rewards = np.zeros((T, E), np.float32)
+        dones = np.zeros((T, E), np.float32)
+        logps = np.zeros((T, E), np.float32)
+        values = np.zeros((T, E), np.float32)
+
+        for t in range(T):
+            stacked = np.stack(self._obs)
+            logits, v = self._fwd(params, stacked)
+            logits = np.asarray(logits)
+            v = np.asarray(v)
+            # sample actions from the categorical policy
+            z = self._rng.gumbel(size=logits.shape)
+            act = np.argmax(logits + z, axis=-1)
+            logp_all = logits - _logsumexp(logits)
+            obs[t] = stacked
+            actions[t] = act
+            values[t] = v
+            logps[t] = logp_all[np.arange(E), act]
+            for e, env in enumerate(self.envs):
+                nobs, r, terminated, truncated, _ = env.step(int(act[e]))
+                rewards[t, e] = r
+                self._episode_returns[e] += r
+                if terminated or truncated:
+                    dones[t, e] = 1.0
+                    self._completed.append(self._episode_returns[e])
+                    self._episode_returns[e] = 0.0
+                    nobs = env.reset()[0]
+                self._obs[e] = nobs
+
+        # bootstrap value for the final observation
+        _, last_v = self._fwd(params, np.stack(self._obs))
+        last_v = np.asarray(last_v)
+        adv = np.zeros((T, E), np.float32)
+        last_gae = np.zeros(E, np.float32)
+        for t in reversed(range(T)):
+            next_v = last_v if t == T - 1 else values[t + 1]
+            nonterminal = 1.0 - dones[t]
+            delta = rewards[t] + self.gamma * next_v * nonterminal - values[t]
+            last_gae = delta + \
+                self.gamma * self.gae_lambda * nonterminal * last_gae
+            adv[t] = last_gae
+        targets = adv + values
+
+        flat = lambda a: a.reshape((T * E,) + a.shape[2:])
+        completed, self._completed = self._completed, []
+        return {
+            "obs": flat(obs),
+            "actions": flat(actions),
+            "logp": flat(logps),
+            "advantages": flat(adv),
+            "value_targets": flat(targets),
+            "episode_returns": np.asarray(completed, np.float32),
+        }
+
+
+def _logsumexp(x):
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))
+
+
+def concat_batches(batches: list[dict]) -> dict:
+    return {k: np.concatenate([b[k] for b in batches])
+            for k in batches[0]}

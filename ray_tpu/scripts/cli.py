@@ -1,0 +1,208 @@
+"""ray-tpu CLI: `python -m ray_tpu.scripts.cli <command>`.
+
+Reference: python/ray/scripts/scripts.py — start :532, stop :977,
+status :1872, memory :1822, `ray list ...` (state CLI), microbenchmark
+:1743. argparse instead of click (zero extra deps); each command talks to
+the cluster through the same GCS RPCs the runtime uses.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ray_tpu.scripts.node import CLUSTER_FILE, SESSION_ROOT
+
+PID_DIR = os.path.join(SESSION_ROOT, "node_pids")
+
+
+def _spawn_node(node_args: list[str]) -> dict:
+    os.makedirs(PID_DIR, exist_ok=True)
+    ready = os.path.join(
+        SESSION_ROOT, f"ready_{os.getpid()}_{int(time.time()*1000)}.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts.node",
+         "--ready-file", ready] + node_args,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.exists(ready):
+            with open(ready) as f:
+                info = json.load(f)
+            os.unlink(ready)
+            with open(os.path.join(PID_DIR, str(proc.pid)), "w") as f:
+                json.dump(info, f)
+            return info
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"node process exited rc={proc.returncode} during startup")
+        time.sleep(0.1)
+    proc.kill()
+    raise TimeoutError("node did not come up within 60s")
+
+
+def cmd_start(args):
+    node_args = []
+    if args.head:
+        node_args += ["--head", "--port", str(args.port)]
+    else:
+        addr = args.address or _current_cluster()["gcs_address"]
+        node_args += ["--address", addr]
+    if args.num_cpus is not None:
+        node_args += ["--num-cpus", str(args.num_cpus)]
+    if args.num_tpus is not None:
+        node_args += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        node_args += ["--resources", args.resources]
+    node_args += ["--object-store-memory", str(args.object_store_memory)]
+    info = _spawn_node(node_args)
+    print(f"started {'head' if args.head else 'worker'} node "
+          f"{info['node_id']} (pid {info['pid']})")
+    print(f"GCS address: {info['gcs_address']}")
+    if args.head:
+        print(f"connect with: ray_tpu.init(address={info['gcs_address']!r})")
+    return 0
+
+
+def cmd_stop(_args):
+    stopped = 0
+    if os.path.isdir(PID_DIR):
+        for name in os.listdir(PID_DIR):
+            path = os.path.join(PID_DIR, name)
+            try:
+                pid = int(name)
+                os.kill(pid, signal.SIGTERM)
+                stopped += 1
+            except (ValueError, ProcessLookupError):
+                pass
+            finally:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+    # give nodes a beat to drain, then force-kill stragglers
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        alive = [p for p in _known_pids() if _pid_alive(p)]
+        if not alive:
+            break
+        time.sleep(0.1)
+    print(f"stopped {stopped} node process(es)")
+    return 0
+
+
+def _known_pids():
+    if not os.path.isdir(PID_DIR):
+        return []
+    return [int(n) for n in os.listdir(PID_DIR) if n.isdigit()]
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def _current_cluster() -> dict:
+    if not os.path.exists(CLUSTER_FILE):
+        raise SystemExit("no running cluster (no head started on this host); "
+                         "pass --address or run `start --head` first")
+    with open(CLUSTER_FILE) as f:
+        return json.load(f)
+
+
+def _gcs_client(address: str | None):
+    from ray_tpu._private.protocol import RpcClient
+
+    addr = address or _current_cluster()["gcs_address"]
+    host, port = addr.rsplit(":", 1)
+    return RpcClient((host, int(port)), timeout=10.0)
+
+
+def cmd_status(args):
+    from ray_tpu.experimental.state.api import cluster_status
+
+    print(cluster_status(address=args.address))
+    return 0
+
+
+def cmd_list(args):
+    from ray_tpu.experimental.state import api as state
+
+    fn = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+        "tasks": state.list_tasks,
+        "workers": state.list_workers,
+    }[args.kind]
+    rows = fn(address=args.address)
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_memory(args):
+    from ray_tpu.experimental.state.api import memory_summary
+
+    print(memory_summary(address=args.address))
+    return 0
+
+
+def cmd_microbenchmark(_args):
+    from ray_tpu._private.ray_perf import main as perf_main
+
+    perf_main()
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node process")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--num-tpus", type=int, default=None)
+    sp.add_argument("--resources", default=None)
+    sp.add_argument("--object-store-memory", type=int,
+                    default=256 * 1024 * 1024)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop node processes on this host")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resource summary")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["nodes", "actors", "objects",
+                                     "placement-groups", "tasks", "workers"])
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("memory", help="object store summary")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("microbenchmark",
+                        help="core task/actor/object throughput numbers")
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

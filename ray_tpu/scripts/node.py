@@ -1,0 +1,102 @@
+"""Standalone node process: `python -m ray_tpu.scripts.node`.
+
+Reference role: the `raylet` / `gcs_server` binaries plus
+python/ray/_private/node.py:41 (Node process supervisor). The CLI spawns
+this detached; it hosts GCS + raylet (head) or raylet-only (worker),
+writes its address/PID bookkeeping under the session dir, and exits
+cleanly on SIGTERM (draining the node from GCS first).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+SESSION_ROOT = "/tmp/ray_tpu"
+CLUSTER_FILE = os.path.join(SESSION_ROOT, "ray_current_cluster")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu.scripts.node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None, help="existing GCS host:port")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0, help="GCS port (head)")
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--resources", default=None, help="JSON dict")
+    p.add_argument("--object-store-memory", type=int,
+                   default=256 * 1024 * 1024)
+    p.add_argument("--ready-file", default=None)
+    args = p.parse_args(argv)
+
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.raylet import Raylet, detect_resources
+
+    os.makedirs(SESSION_ROOT, exist_ok=True)
+    extra = json.loads(args.resources) if args.resources else None
+
+    gcs = None
+    if args.head:
+        gcs = GcsServer(host=args.host, port=args.port).start()
+        gcs_addr = gcs.addr
+    else:
+        if not args.address:
+            p.error("worker nodes need --address host:port")
+        host, port = args.address.rsplit(":", 1)
+        gcs_addr = (host, int(port))
+
+    raylet = Raylet(
+        gcs_addr,
+        resources=detect_resources(args.num_cpus, args.num_tpus,
+                                   resources=extra),
+        store_size=args.object_store_memory,
+    )
+
+    info = {
+        "gcs_address": f"{gcs_addr[0]}:{gcs_addr[1]}",
+        "node_id": raylet.node_id,
+        "pid": os.getpid(),
+        "head": bool(args.head),
+    }
+    if args.head:
+        with open(CLUSTER_FILE, "w") as f:
+            json.dump(info, f)
+    if args.ready_file:
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(info, f)
+        os.replace(tmp, args.ready_file)
+
+    stop = threading.Event()
+
+    def _term(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    while not stop.is_set():
+        time.sleep(0.2)
+    # graceful: drain this node, then tear down
+    try:
+        raylet.stop(kill_workers=True)
+    except Exception:
+        pass
+    if gcs is not None:
+        try:
+            gcs.stop()
+        except Exception:
+            pass
+        try:
+            os.unlink(CLUSTER_FILE)
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

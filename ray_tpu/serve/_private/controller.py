@@ -66,7 +66,7 @@ class _DeploymentState:
         self._scale_proposal_since: tuple[int, float] | None = None
         self._last_metrics_poll = 0.0
         # handle-side demand: {router_id: (queued+in_flight, monotonic ts)}
-        self.handle_metrics: dict[int, tuple[float, float]] = {}
+        self.handle_metrics: dict[str, tuple[float, float]] = {}
 
     # ---------------------------------------------------------- target edit
     def update_spec(self, spec: dict):
@@ -412,7 +412,7 @@ class ServeController:
                 }
             return out
 
-    def record_handle_metrics(self, dep_id: str, router_id: int,
+    def record_handle_metrics(self, dep_id: str, router_id: str,
                               num_requests: float):
         """Routers push (queued + in-flight) demand for autoscaling."""
         with self._lock:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import random
 import threading
+import uuid
 
 from ray_tpu.serve._private.constants import replicas_key
 from ray_tpu.serve._private.long_poll import LongPollClient
@@ -38,6 +39,9 @@ class Router:
         self._replicas: dict[str, _ReplicaSlot] = {}
         self._outstanding: dict = {}   # ObjectRef -> replica_id
         self._num_queued = 0           # callers blocked waiting for a slot
+        # stable identity for controller-side demand bookkeeping: id(self)
+        # collides across processes (proxy vs driver handles)
+        self._router_id = uuid.uuid4().hex
         self._last_metrics_push = 0.0
         self._stopped = threading.Event()
         self._long_poll = LongPollClient(
@@ -154,7 +158,8 @@ class Router:
                                     for s in self._replicas.values())
                 try:
                     self._controller.record_handle_metrics.remote(
-                        self._deployment_id, id(self), queued + in_flight)
+                        self._deployment_id, self._router_id,
+                        queued + in_flight)
                 except Exception:
                     pass
             with self._lock:

@@ -42,6 +42,7 @@ class Trial:
         self.latest_checkpoint: Checkpoint | None = None
         self.error: BaseException | None = None
         self.actor = None
+        self.pg = None             # the trial's placement group
         self.iteration = 0
 
     @property
@@ -157,7 +158,7 @@ class TrialRunner:
                     active.remove(trial)
             for trial, source, new_config in self._pending_exploits:
                 if trial in active:
-                    self._stop_actor(trial)
+                    self._stop_actor(trial, release_pg=False)
                     trial.config = new_config
                     trial.latest_checkpoint = source.latest_checkpoint
                     self._start_trial(
@@ -168,10 +169,29 @@ class TrialRunner:
         return self.trials
 
     def _start_trial(self, trial: Trial, resume=None):
+        from ray_tpu.util.placement_group import placement_group
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+        )
+
         actor_cls = ray_tpu.remote(_TrialActor)
         opts = dict(self.resources)
+        # Gang-schedule every trial in its own placement group (reference:
+        # tune/execution/placement_groups.py wraps each Trial in a PG).
+        # Atomic reservation means two concurrent multi-resource trials
+        # can't deadlock-interleave; TPU bundles additionally get the
+        # ICI-contiguous STRICT_PACK placement from the GCS scheduler.
+        bundles = opts.pop("bundles", None) or [dict(opts) or {"CPU": 1}]
+        if trial.pg is None:
+            trial.pg = placement_group(bundles, strategy="STRICT_PACK",
+                                       name=f"trial-{trial.trial_id}")
         trial.actor = actor_cls.options(
-            num_cpus=opts.pop("CPU", 1), resources=opts or None).remote()
+            num_cpus=bundles[0].get("CPU", 0),
+            resources={k: v for k, v in bundles[0].items() if k != "CPU"}
+                      or None,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                trial.pg, placement_group_bundle_index=0),
+        ).remote()
         # Fully async: actor creation may queue behind running trials for
         # resources — blocking here would starve the poll loop that frees
         # them. run() and the first next_result() chain in submission order.
@@ -193,13 +213,21 @@ class TrialRunner:
             trial._pending = trial.actor.next_result.remote()
         return row
 
-    def _stop_actor(self, trial: Trial):
+    def _stop_actor(self, trial: Trial, release_pg: bool = True):
         if trial.actor is not None:
             try:
                 ray_tpu.kill(trial.actor)
             except Exception:
                 pass
             trial.actor = None
+        if release_pg and trial.pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(trial.pg)
+            except Exception:
+                pass
+            trial.pg = None
 
 
 class ResultGrid:

@@ -243,11 +243,16 @@ def is_group_initialized(group_name: str = "default") -> bool:
 
 # ------------------------------------------------------------------ ops
 
-def _to_host(tensor):
-    """jax/torch/numpy → numpy (collectives operate on host memory; the
-    xla backend device_puts shards back itself)."""
-    if hasattr(tensor, "device") and hasattr(tensor, "addressable_shards"):
-        return np.asarray(tensor)   # jax array
+def _coerce(g, tensor):
+    """Per-backend input coercion: the host backend moves host memory, so
+    jax/torch arrays are fetched; the xla backend keeps jax arrays ON
+    DEVICE end-to-end (its result is a device array too) and only
+    converts foreign (torch/list) inputs."""
+    is_jax = hasattr(tensor, "addressable_shards")
+    if getattr(g, "backend", None) == "xla" and is_jax:
+        return tensor
+    if is_jax:
+        return np.asarray(tensor)
     if hasattr(tensor, "detach"):
         return tensor.detach().cpu().numpy()
     return np.asarray(tensor)
@@ -257,35 +262,36 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum"):
     """In the reference (collective.py:258) this mutates in place via NCCL;
     here the reduced array is returned (functional, jax-style)."""
     g = _manager.get(group_name)
-    return g.impl.allreduce(_to_host(tensor), op, g.next_seq())
+    return g.impl.allreduce(_coerce(g, tensor), op, g.next_seq())
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: str = "sum"):
     g = _manager.get(group_name)
-    return g.impl.reduce(_to_host(tensor), dst_rank, op, g.next_seq())
+    return g.impl.reduce(_coerce(g, tensor), dst_rank, op, g.next_seq())
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _manager.get(group_name)
-    return g.impl.broadcast(_to_host(tensor), src_rank, g.next_seq())
+    return g.impl.broadcast(_coerce(g, tensor), src_rank, g.next_seq())
 
 
 def allgather(tensor, group_name: str = "default") -> list:
     g = _manager.get(group_name)
-    return g.impl.allgather(_to_host(tensor), g.next_seq())
+    return g.impl.allgather(_coerce(g, tensor), g.next_seq())
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
     """Each rank gets the rank-th equal chunk of the reduction."""
     g = _manager.get(group_name)
-    return g.impl.reducescatter(_to_host(tensor), op, g.next_seq())
+    return g.impl.reducescatter(_coerce(g, tensor), op, g.next_seq())
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
     g = _manager.get(group_name)
     seq = g.next_p2p_seq(g.rank, dst_rank)
-    _p2p(g).send(_to_host(tensor), dst_rank, seq)
+    _p2p(g).send(_coerce(g, tensor) if getattr(g, "backend", None) != "xla"
+             else np.asarray(tensor), dst_rank, seq)
 
 
 def recv(src_rank: int, group_name: str = "default"):

@@ -44,10 +44,16 @@ class HostGroup:
 
     # -- plumbing -----------------------------------------------------------
 
+    @staticmethod
+    def _op_timeout() -> float:
+        from ray_tpu._private.config import get_config
+
+        return float(get_config("collective_op_timeout_s"))
+
     def _client(self, rank: int) -> RpcClient:
         c = self._clients.get(rank)
         if c is None or c.closed:
-            c = RpcClient(self.members[rank], timeout=300.0)
+            c = RpcClient(self.members[rank], timeout=self._op_timeout())
             self._clients[rank] = c
         return c
 
@@ -58,7 +64,11 @@ class HostGroup:
         else:
             self._client(dst).call("col_push", key=full_key, data=payload)
 
-    def _recv(self, src: int, key: tuple, timeout: float = 300.0):
+    def _recv(self, src: int, key: tuple, timeout: float | None = None):
+        # Timeout doubles as the failure detector (the NCCL-watchdog analog):
+        # a dead member makes the op raise instead of hanging forever.
+        if timeout is None:
+            timeout = self._op_timeout()
         return self._worker.col_take((self.name,) + key + (src,),
                                      timeout=timeout)
 

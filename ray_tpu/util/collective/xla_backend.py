@@ -129,18 +129,68 @@ class XlaGroup:
         self._fns[key] = fn
         return fn
 
-    # -- ops ----------------------------------------------------------------
+    def _compiled_broadcast(self, src: int, shape, dtype):
+        """Binomial-tree broadcast over ppermute: ⌈log2(N)⌉ steps, total
+        payload moved ≈ N-1 copies (a psum-of-zeros "broadcast" moves
+        2(N-1)/N of an allreduce — this is the real thing)."""
+        key = ("broadcast", src, shape, dtype)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
 
-    def _run(self, kind: str, arr: np.ndarray, op: str = "sum"):
-        arr = np.asarray(arr)
+        mesh = self._ensure_mesh()
+        N = self.world_size
+        in_spec = P("ranks", *([None] * len(shape)))
+
+        def body(x):
+            # x holds the payload only on src; zero elsewhere
+            idx = lax.axis_index("ranks")
+            x = jax.numpy.where(idx == src, x, jax.numpy.zeros_like(x))
+            have = 1            # effective ranks 0..have-1 hold the data
+            while have < N:
+                pairs = []
+                for e in range(have):
+                    te = e + have
+                    if te < N:
+                        pairs.append(((e + src) % N, (te + src) % N))
+                recv = lax.ppermute(x, "ranks", perm=pairs)
+                x = x + recv    # recv is zero except at the new holders
+                have *= 2
+            return x
+
+        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_spec,
+                                   out_specs=in_spec))
+        self._fns[key] = fn
+        return fn
+
+    # -- ops ----------------------------------------------------------------
+    # Device residency: jax-array inputs stay on device end-to-end — the
+    # result is returned as a jax array (no host round-trip); numpy inputs
+    # round-trip through the host as before. One device per process carries
+    # the group axis; an actor owning several chips spreads *data* over them
+    # through the Train stack's global mesh, not through this per-rank API.
+
+    def _is_device_array(self, arr) -> bool:
+        return isinstance(arr, self._jax.Array)
+
+    def _run(self, kind: str, arr, op: str = "sum"):
+        keep_on_device = self._is_device_array(arr)
+        if not keep_on_device:
+            arr = np.asarray(arr)
         garr, _ = self._global_array(arr)
-        fn = self._compiled(kind, op, arr.shape, str(arr.dtype))
+        fn = self._compiled(kind, op, tuple(arr.shape), str(arr.dtype))
         out = fn(garr)
-        return np.asarray(out.addressable_shards[0].data[0])
+        local = out.addressable_shards[0].data[0]
+        if keep_on_device:
+            return local
+        return np.asarray(local)
 
     def allreduce(self, arr, op, seq):
         if self.world_size == 1:
-            return np.asarray(arr)
+            return arr if self._is_device_array(arr) else np.asarray(arr)
         return self._run("allreduce", arr, op)
 
     def reduce(self, arr, dst, op, seq):
@@ -149,24 +199,37 @@ class XlaGroup:
 
     def broadcast(self, arr, src, seq):
         if self.world_size == 1:
-            return np.asarray(arr)
-        base = np.asarray(arr)
-        contrib = base if self.rank == src else np.zeros_like(base)
-        return self._run("allreduce", contrib, "sum")
+            return arr if self._is_device_array(arr) else np.asarray(arr)
+        keep = self._is_device_array(arr)
+        if not keep:
+            arr = np.asarray(arr)
+        garr, _ = self._global_array(arr)
+        fn = self._compiled_broadcast(src, tuple(arr.shape),
+                                      str(arr.dtype))
+        out = fn(garr)
+        local = out.addressable_shards[0].data[0]
+        return local if keep else np.asarray(local)
 
     def allgather(self, arr, seq) -> list:
         if self.world_size == 1:
-            return [np.asarray(arr)]
-        stacked = self._run("allgather", np.asarray(arr))
+            return [arr if self._is_device_array(arr) else np.asarray(arr)]
+        stacked = self._run("allgather", arr)
         return [stacked[i] for i in range(self.world_size)]
 
     def reducescatter(self, arr, op, seq):
-        arr = np.asarray(arr)
         if self.world_size == 1:
-            return arr
-        if arr.shape[0] % self.world_size:
+            return arr if self._is_device_array(arr) else np.asarray(arr)
+        dim0 = arr.shape[0]
+        if dim0 % self.world_size:
             # uneven leading dim: fall back to allreduce + local slice
             out = self._run("allreduce", arr, op)
+            if self._is_device_array(out):
+                splits = np.cumsum([len(s) for s in np.array_split(
+                    np.empty(dim0), self.world_size)])[:-1]
+                start = 0 if self.rank == 0 else int(splits[self.rank - 1])
+                stop = int(splits[self.rank]) if self.rank < len(splits) \
+                    else dim0
+                return out[start:stop]
             return np.array_split(out, self.world_size, axis=0)[self.rank]
         return self._run("reducescatter", arr, op)
 

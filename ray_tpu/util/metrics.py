@@ -1,0 +1,145 @@
+"""User-facing metrics: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py (backed by OpenCensus → dashboard
+agent → Prometheus, reporter_agent.py:296). Here each process keeps a
+registry; `ray_tpu.experimental.state.api.metrics_summary()` aggregates
+across live workers, and `prometheus_text()` renders the standard text
+exposition format for scraping.
+"""
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_registry: dict[str, "_Metric"] = {}
+
+
+class _Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: tuple = ()):
+        if not name or any(c in name for c in " \t\n"):
+            raise ValueError(f"bad metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict = {}
+        self._values: dict[tuple, float] = {}
+        with _lock:
+            existing = _registry.get(name)
+            if existing is not None and type(existing) is not type(self):
+                raise ValueError(
+                    f"metric {name!r} already registered with a different "
+                    f"type")
+            _registry[name] = self
+
+    def set_default_tags(self, tags: dict):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: dict | None) -> tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        return tuple(sorted(merged.items()))
+
+    def snapshot(self) -> dict:
+        with _lock:
+            return {
+                "name": self.name,
+                "type": type(self).__name__,
+                "description": self.description,
+                "values": [{"tags": dict(k), "value": v}
+                           for k, v in self._values.items()],
+            }
+
+
+class Counter(_Metric):
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = self._key(tags)
+        with _lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(_Metric):
+    def set(self, value: float, tags: dict | None = None):
+        with _lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(_Metric):
+    def __init__(self, name: str, description: str = "",
+                 boundaries: list | None = None, tag_keys: tuple = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or
+                                 [0.001, 0.01, 0.1, 1, 10, 100])
+        self._counts: dict[tuple, list] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def observe(self, value: float, tags: dict | None = None):
+        key = self._key(tags)
+        with _lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            import bisect
+
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._values[key] = self._sums[key]   # exported as _sum
+
+    def snapshot(self) -> dict:
+        base = super().snapshot()
+        with _lock:
+            base["boundaries"] = self.boundaries
+            base["counts"] = [{"tags": dict(k), "counts": v}
+                              for k, v in self._counts.items()]
+        return base
+
+
+def registry_snapshot() -> list[dict]:
+    with _lock:
+        metrics = list(_registry.values())
+    return [m.snapshot() for m in metrics]
+
+
+def _label(tags: dict, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in tags.items()]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text(snapshots: list[dict]) -> str:
+    """Standard Prometheus text exposition of aggregated snapshots.
+    Histograms emit the full family: cumulative _bucket{le=...}, _count,
+    and _sum series."""
+    lines = []
+    for snap in snapshots:
+        name = snap["name"]
+        kind = {"Counter": "counter", "Gauge": "gauge",
+                "Histogram": "histogram"}.get(snap["type"], "untyped")
+        if snap.get("description"):
+            lines.append(f"# HELP {name} {snap['description']}")
+        lines.append(f"# TYPE {name} {kind}")
+        if kind == "histogram":
+            bounds = snap.get("boundaries", [])
+            sums = {tuple(sorted(r["tags"].items())): r["value"]
+                    for r in snap["values"]}
+            for row in snap.get("counts", []):
+                tags = row["tags"]
+                counts = row["counts"]
+                cum = 0
+                for b, c in zip(bounds, counts):
+                    cum += c
+                    lines.append(
+                        f"{name}_bucket{_label(tags, f'le=\"{b}\"')} {cum}")
+                cum += counts[len(bounds)] if len(counts) > len(bounds) else 0
+                lines.append(
+                    f"{name}_bucket{_label(tags, 'le=\"+Inf\"')} {cum}")
+                lines.append(f"{name}_count{_label(tags)} {cum}")
+                key = tuple(sorted(tags.items()))
+                lines.append(f"{name}_sum{_label(tags)} "
+                             f"{sums.get(key, 0.0)}")
+        else:
+            for row in snap["values"]:
+                lines.append(f"{name}{_label(row['tags'])} {row['value']}")
+    return "\n".join(lines) + "\n"

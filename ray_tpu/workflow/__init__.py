@@ -1,0 +1,112 @@
+"""ray_tpu.workflow — durable DAG execution with exact resume.
+
+Capability parity with the reference's Workflow library
+(python/ray/workflow/, ~10.1k LoC; see SURVEY.md §2.3): a bound task DAG
+(`fn.bind(...)`, from ray_tpu.dag) is staged to durable storage step by
+step, executed as runtime tasks with every result persisted before the step
+counts as done, and can be resumed after a driver kill — completed steps
+replay from storage, pending ones re-execute, and the final answer is
+identical. Steps returning a new DAG expand as continuations
+(reference: workflow.continuation).
+"""
+from __future__ import annotations
+
+import uuid
+from typing import Any, Optional
+
+from ray_tpu.workflow.executor import WorkflowExecutor
+from ray_tpu.workflow.storage import WorkflowStorage
+
+_storage: Optional[WorkflowStorage] = None
+
+
+def init(storage_dir: str | None = None):
+    """Choose the durable storage root (reference: workflow.init)."""
+    global _storage
+    _storage = WorkflowStorage(storage_dir)
+    return _storage
+
+
+def _get_storage() -> WorkflowStorage:
+    global _storage
+    if _storage is None:
+        _storage = WorkflowStorage()
+    return _storage
+
+
+def run(dag, *, workflow_id: str | None = None,
+        storage_dir: str | None = None) -> Any:
+    """Stage + execute a DAG durably; returns the output value.
+    (reference: workflow/api.py run)"""
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        raise RuntimeError("call ray_tpu.init() before workflow.run()")
+    storage = WorkflowStorage(storage_dir) if storage_dir else _get_storage()
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    ex = WorkflowExecutor(workflow_id, storage)
+    ex.stage(dag)
+    return ex.run_until_complete()
+
+
+def resume(workflow_id: str, *, storage_dir: str | None = None) -> Any:
+    """Resume a killed/failed workflow from storage: completed steps load,
+    the rest re-execute (reference: workflow/api.py resume)."""
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        raise RuntimeError("call ray_tpu.init() before workflow.resume()")
+    storage = WorkflowStorage(storage_dir) if storage_dir else _get_storage()
+    if not storage.exists(workflow_id):
+        raise ValueError(f"no workflow {workflow_id!r} in storage")
+    ex = WorkflowExecutor(workflow_id, storage)
+    storage.set_status(workflow_id, "RUNNING")
+    return ex.run_until_complete()
+
+
+def resume_all(*, storage_dir: str | None = None) -> dict[str, Any]:
+    """Resume every workflow not in a terminal SUCCEEDED state."""
+    storage = WorkflowStorage(storage_dir) if storage_dir else _get_storage()
+    out = {}
+    for wid, status in storage.list_workflows():
+        if status != "SUCCEEDED":
+            out[wid] = resume(wid, storage_dir=storage_dir)
+    return out
+
+
+def get_status(workflow_id: str, *, storage_dir: str | None = None) -> str:
+    storage = WorkflowStorage(storage_dir) if storage_dir else _get_storage()
+    status = storage.get_status(workflow_id)
+    if status is None:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    return status
+
+
+def get_output(workflow_id: str, *, storage_dir: str | None = None) -> Any:
+    """Output of a SUCCEEDED workflow, loaded from storage (no re-run)."""
+    storage = WorkflowStorage(storage_dir) if storage_dir else _get_storage()
+    out_step = storage.get_output_step(workflow_id)
+    if out_step is None or not storage.has_step_result(workflow_id, out_step):
+        raise ValueError(f"workflow {workflow_id!r} has no stored output")
+    return storage.load_step_result(workflow_id, out_step)
+
+
+def list_all(*, storage_dir: str | None = None) -> list[tuple[str, str]]:
+    storage = WorkflowStorage(storage_dir) if storage_dir else _get_storage()
+    return storage.list_workflows()
+
+
+def delete(workflow_id: str, *, storage_dir: str | None = None):
+    storage = WorkflowStorage(storage_dir) if storage_dir else _get_storage()
+    storage.delete_workflow(workflow_id)
+
+
+def continuation(dag):
+    """Mark a DAG returned from a step as the step's continuation. Our
+    engine treats any returned DAGNode as a continuation, so this is the
+    explicit-intent spelling (reference: workflow.continuation)."""
+    return dag
+
+
+__all__ = ["continuation", "delete", "get_output", "get_status", "init",
+            "list_all", "resume", "resume_all", "run"]

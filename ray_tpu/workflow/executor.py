@@ -1,0 +1,204 @@
+"""Workflow executor — durable DAG execution with exact resume.
+
+Reference: python/ray/workflow/workflow_executor.py:32,72 (run_until_complete
+over a WorkflowState), workflow_state_from_dag.py (DAG → steps),
+workflow_state_from_storage.py (resume). The engine:
+
+1. flattens a ray_tpu.dag bind-tree into steps with DETERMINISTIC ids,
+   persisting each step's spec (cloudpickled fn + options + arg tree) before
+   anything executes — resume never needs the original driver code;
+2. runs ready steps as ray_tpu tasks with bounded parallelism, persisting
+   each result before the step is considered done;
+3. on resume, loads specs from storage, skips steps whose results exist,
+   and re-executes the rest — a kill at ANY point replays to the same
+   answer (steps must be deterministic/idempotent, as in the reference);
+4. supports continuations: a step returning a DAGNode expands into
+   sub-steps namespaced under the parent (reference: workflow.continuation).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.dag import ClassMethodNode, ClassNode, DAGNode, FunctionNode, InputNode
+from ray_tpu.workflow.storage import WorkflowStorage
+
+MAX_PARALLEL_STEPS = 16
+
+
+class _StepRef:
+    """Marker inside persisted arg trees: 'this argument is the output of
+    step X'."""
+
+    __slots__ = ("step_id",)
+
+    def __init__(self, step_id: str):
+        self.step_id = step_id
+
+    def __reduce__(self):
+        return (_StepRef, (self.step_id,))
+
+
+def _flatten_dag(node: DAGNode, prefix: str = "") -> tuple[str, dict]:
+    """DAG → {step_id: spec}. Deterministic ids: post-order index + fn name
+    so the same DAG built twice yields the same ids (resume correctness).
+    Returns (output_step_id, specs)."""
+    specs: dict[str, dict] = {}
+    seen: dict[int, str] = {}
+    counter = [0]
+
+    def visit(n: DAGNode) -> str:
+        if id(n) in seen:
+            return seen[id(n)]
+        if isinstance(n, (ClassNode, ClassMethodNode)):
+            raise ValueError(
+                "workflows execute task DAGs; actor nodes are not durable "
+                "(reference workflows have the same task-only core)")
+        if isinstance(n, InputNode):
+            raise ValueError(
+                "workflow DAGs must be fully bound (no InputNode); bind "
+                "concrete arguments instead")
+
+        def convert(v):
+            if isinstance(v, DAGNode):
+                return _StepRef(visit(v))
+            return v
+
+        args = tuple(convert(a) for a in n._bound_args)
+        kwargs = {k: convert(v) for k, v in n._bound_kwargs.items()}
+        fn = n._remote_fn
+        sid = f"{prefix}{counter[0]}_{fn._fn.__name__}"
+        counter[0] += 1
+        specs[sid] = {
+            "step_id": sid,
+            "fn": fn._fn,
+            "options": {k: v for k, v in fn._options.items()
+                        if k != "scheduling_strategy"},
+            "args": args,
+            "kwargs": kwargs,
+        }
+        seen[id(n)] = sid
+        return sid
+
+    if not isinstance(node, FunctionNode):
+        raise TypeError(f"workflow.run expects a bound task DAG "
+                        f"(fn.bind(...)), got {type(node)}")
+    out = visit(node)
+    return out, specs
+
+
+class WorkflowExecutor:
+    def __init__(self, workflow_id: str, storage: WorkflowStorage):
+        self.workflow_id = workflow_id
+        self.storage = storage
+
+    # ------------------------------------------------------------ authoring
+    def stage(self, dag: DAGNode):
+        """Persist the full step graph before executing anything."""
+        output_step, specs = _flatten_dag(dag)
+        for sid, spec in specs.items():
+            self.storage.save_step_spec(self.workflow_id, sid, spec)
+        self.storage.set_output_step(self.workflow_id, output_step)
+        self.storage.set_status(self.workflow_id, "RUNNING")
+
+    # ------------------------------------------------------------ execution
+    def run_until_complete(self) -> Any:
+        wid = self.workflow_id
+        try:
+            result = self._drive()
+            self.storage.set_status(wid, "SUCCEEDED")
+            return result
+        except BaseException:
+            self.storage.set_status(wid, "FAILED")
+            raise
+
+    def _drive(self) -> Any:
+        import ray_tpu
+
+        wid = self.workflow_id
+        specs = self.storage.load_step_specs(wid)
+        output_step = self.storage.get_output_step(wid)
+        if output_step is None:
+            raise ValueError(f"workflow {wid!r} has no staged steps")
+
+        done: dict[str, Any] = {}
+        for sid in list(specs):
+            if self.storage.has_step_result(wid, sid):
+                done[sid] = self.storage.load_step_result(wid, sid)
+
+        in_flight: dict = {}          # ObjectRef -> step_id
+        while True:
+            # continuations may have rewritten the output pointer
+            output_step = self.storage.get_output_step(wid)
+            if output_step in done:
+                return done[output_step]
+            # launch every ready step (deps done, not running, not done)
+            running = set(in_flight.values())
+            for sid, spec in sorted(specs.items()):
+                if sid in done or sid in running:
+                    continue
+                if len(in_flight) >= MAX_PARALLEL_STEPS:
+                    break
+                deps = self._dep_ids(spec)
+                if all(d in done for d in deps):
+                    ref = self._submit(spec, done)
+                    in_flight[ref] = sid
+            if not in_flight:
+                raise RuntimeError(
+                    f"workflow {wid!r} stalled: no runnable steps "
+                    f"({len(done)}/{len(specs)} done) — dependency cycle "
+                    f"or missing spec")
+            ready, _ = ray_tpu.wait(list(in_flight), num_returns=1,
+                                    timeout=1.0)
+            for ref in ready:
+                sid = in_flight.pop(ref)
+                value = ray_tpu.get(ref)   # raises → workflow FAILED
+                if isinstance(value, DAGNode):
+                    # continuation: expand into namespaced sub-steps; the
+                    # parent's "result" becomes the sub-DAG's output
+                    sub_out, sub_specs = _flatten_dag(
+                        value, prefix=f"{sid}/")
+                    for ssid, sspec in sub_specs.items():
+                        self.storage.save_step_spec(wid, ssid, sspec)
+                        specs[ssid] = sspec
+                    # alias: parent step forwards the sub-output
+                    alias = {
+                        "step_id": sid,
+                        "fn": _identity,
+                        "options": {"num_cpus": 0, "max_retries": 0},
+                        "args": (_StepRef(sub_out),),
+                        "kwargs": {},
+                    }
+                    self.storage.save_step_spec(wid, sid, alias)
+                    specs[sid] = alias
+                    continue
+                self.storage.save_step_result(wid, sid, value)
+                done[sid] = value
+
+    @staticmethod
+    def _dep_ids(spec: dict) -> list[str]:
+        deps = [a.step_id for a in spec["args"]
+                if isinstance(a, _StepRef)]
+        deps += [v.step_id for v in spec["kwargs"].values()
+                 if isinstance(v, _StepRef)]
+        return deps
+
+    @staticmethod
+    def _submit(spec: dict, done: dict):
+        import ray_tpu
+
+        def resolve(v):
+            if isinstance(v, _StepRef):
+                return done[v.step_id]
+            return v
+
+        args = tuple(resolve(a) for a in spec["args"])
+        kwargs = {k: resolve(v) for k, v in spec["kwargs"].items()}
+        opts = dict(spec.get("options") or {})
+        remote_fn = ray_tpu.remote(spec["fn"])
+        if opts:
+            remote_fn = remote_fn.options(**opts)
+        return remote_fn.remote(*args, **kwargs)
+
+
+def _identity(x):
+    return x

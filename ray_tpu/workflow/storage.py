@@ -1,0 +1,125 @@
+"""Durable workflow storage — filesystem-backed, atomic per-step records.
+
+Reference: python/ray/workflow/workflow_storage.py + storage/ (pluggable
+filesystem/S3 backends). One directory per workflow:
+
+    <root>/<workflow_id>/
+        status                  RUNNING | SUCCEEDED | FAILED | CANCELED
+        steps/<sid>.spec.pkl    cloudpickled step spec (fn, options, arg tree)
+        steps/<sid>.result.pkl  pickled result (present ⇔ step completed)
+        output                  step id whose result is the workflow output
+
+Every write is tmp+rename so a crash never leaves a half-written record —
+that is what makes kill-and-resume exact."""
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+
+import cloudpickle
+
+
+def _atomic_write(path: str, data: bytes):
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class WorkflowStorage:
+    def __init__(self, root: str | None = None):
+        self.root = root or os.environ.get(
+            "RAY_TPU_WORKFLOW_STORAGE",
+            os.path.expanduser("~/.ray_tpu/workflows"))
+        os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------ workflows
+    def _wf_dir(self, workflow_id: str) -> str:
+        if "/" in workflow_id or workflow_id.startswith("."):
+            raise ValueError(f"bad workflow id {workflow_id!r}")
+        return os.path.join(self.root, workflow_id)
+
+    def list_workflows(self) -> list[tuple[str, str]]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            status_file = os.path.join(self.root, name, "status")
+            if os.path.exists(status_file):
+                with open(status_file) as f:
+                    out.append((name, f.read().strip()))
+        return out
+
+    def exists(self, workflow_id: str) -> bool:
+        return os.path.exists(os.path.join(self._wf_dir(workflow_id),
+                                           "status"))
+
+    def delete_workflow(self, workflow_id: str):
+        import shutil
+
+        shutil.rmtree(self._wf_dir(workflow_id), ignore_errors=True)
+
+    def set_status(self, workflow_id: str, status: str):
+        _atomic_write(os.path.join(self._wf_dir(workflow_id), "status"),
+                      status.encode())
+
+    def get_status(self, workflow_id: str) -> str | None:
+        try:
+            with open(os.path.join(self._wf_dir(workflow_id), "status")) as f:
+                return f.read().strip()
+        except FileNotFoundError:
+            return None
+
+    def set_output_step(self, workflow_id: str, step_id: str):
+        _atomic_write(os.path.join(self._wf_dir(workflow_id), "output"),
+                      step_id.encode())
+
+    def get_output_step(self, workflow_id: str) -> str | None:
+        try:
+            with open(os.path.join(self._wf_dir(workflow_id), "output")) as f:
+                return f.read().strip()
+        except FileNotFoundError:
+            return None
+
+    # ----------------------------------------------------------------- steps
+    def _step_path(self, workflow_id: str, step_id: str, kind: str) -> str:
+        safe = step_id.replace("/", "__")
+        return os.path.join(self._wf_dir(workflow_id), "steps",
+                            f"{safe}.{kind}.pkl")
+
+    def save_step_spec(self, workflow_id: str, step_id: str, spec: dict):
+        _atomic_write(self._step_path(workflow_id, step_id, "spec"),
+                      cloudpickle.dumps(spec))
+
+    def load_step_specs(self, workflow_id: str) -> dict[str, dict]:
+        steps_dir = os.path.join(self._wf_dir(workflow_id), "steps")
+        specs = {}
+        if not os.path.isdir(steps_dir):
+            return specs
+        for name in os.listdir(steps_dir):
+            if name.endswith(".spec.pkl") and not name.startswith(".tmp"):
+                with open(os.path.join(steps_dir, name), "rb") as f:
+                    spec = pickle.load(f)
+                specs[spec["step_id"]] = spec
+        return specs
+
+    def save_step_result(self, workflow_id: str, step_id: str, value):
+        _atomic_write(self._step_path(workflow_id, step_id, "result"),
+                      cloudpickle.dumps(value))
+
+    def has_step_result(self, workflow_id: str, step_id: str) -> bool:
+        return os.path.exists(self._step_path(workflow_id, step_id,
+                                              "result"))
+
+    def load_step_result(self, workflow_id: str, step_id: str):
+        with open(self._step_path(workflow_id, step_id, "result"),
+                  "rb") as f:
+            return pickle.load(f)

@@ -1,0 +1,120 @@
+"""CLI + standalone node processes + state API.
+
+Reference tier: `ray start/stop/status` smoke tests. The done-criterion
+from the round brief: a two-process cluster stood up from the shell, tasks
+run against it, state inspected, clean stop.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _cli(*args, timeout=90):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+        capture_output=True, text=True, timeout=timeout,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+@pytest.fixture
+def shell_cluster():
+    out = _cli("start", "--head", "--num-cpus", "2",
+               "--object-store-memory", str(64 * 1024 * 1024))
+    assert out.returncode == 0, out.stderr
+    address = [line for line in out.stdout.splitlines()
+               if line.startswith("GCS address:")][0].split(": ")[1]
+    out2 = _cli("start", "--address", address, "--num-cpus", "2",
+                "--resources", json.dumps({"side": 1}),
+                "--object-store-memory", str(64 * 1024 * 1024))
+    assert out2.returncode == 0, out2.stderr
+    yield address
+    _cli("stop")
+
+
+def test_shell_cluster_end_to_end(shell_cluster):
+    address = shell_cluster
+    # status sees both nodes
+    out = _cli("status", "--address", address)
+    assert out.returncode == 0, out.stderr
+    assert "Nodes: 2 alive" in out.stdout
+    # run real tasks against the shell-started cluster from a driver
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    try:
+        @ray_tpu.remote(num_cpus=0, resources={"side": 0.5})
+        def on_worker_node():
+            return "remote-ok"
+
+        @ray_tpu.remote
+        def anywhere(x):
+            return x * 2
+
+        assert ray_tpu.get(on_worker_node.remote(), timeout=60) == "remote-ok"
+        assert ray_tpu.get(anywhere.remote(21), timeout=60) == 42
+        # state API over the live cluster
+        from ray_tpu.experimental.state import api as state
+
+        nodes = state.list_nodes()
+        assert sum(1 for n in nodes if n["Alive"]) == 2
+        workers = state.list_workers()
+        assert len(workers) >= 1
+    finally:
+        ray_tpu.shutdown()
+    # CLI list commands (standalone, via address)
+    out = _cli("list", "nodes", "--address", address)
+    assert out.returncode == 0 and json.loads(out.stdout)
+    out = _cli("memory", "--address", address)
+    assert out.returncode == 0
+    assert "Object store" in out.stdout
+
+
+def test_stop_kills_nodes(shell_cluster):
+    address = shell_cluster
+    out = _cli("stop")
+    assert out.returncode == 0
+    # GCS is gone: status against the dead address fails or shows nothing
+    deadline = time.time() + 10
+    dead = False
+    while time.time() < deadline:
+        out = _cli("status", "--address", address)
+        if out.returncode != 0 or "0 alive" in out.stdout:
+            dead = True
+            break
+        time.sleep(0.3)
+    assert dead, "cluster still answering after stop"
+
+
+def test_state_api_in_process(ray_start_regular):
+    ray_tpu = ray_start_regular
+    from ray_tpu.experimental.state import api as state
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return 1
+
+    a = Pinger.remote()
+    assert ray_tpu.get(a.ping.remote()) == 1
+    actors = state.list_actors()
+    assert any(x["State"] == "ALIVE" for x in actors)
+    assert state.list_nodes()
+    ref = ray_tpu.put(list(range(100000)))   # force a store object
+    objs = state.list_objects()
+    del ref
+    assert isinstance(objs, list)
+    summary = state.cluster_status()
+    assert "Nodes: 1 alive" in summary
+
+
+def test_microbenchmark_smoke(ray_start_regular):
+    from ray_tpu._private.ray_perf import main as perf_main
+
+    results = perf_main(min_time=0.05)
+    assert results["single client tasks sync"] > 0
+    assert results["single client actor calls sync"] > 0

@@ -1,0 +1,115 @@
+"""Data internals: columnar blocks, push-based shuffle at scale across a
+multi-node cluster, DatasetPipeline windows.
+
+Reference tier: python/ray/data/tests/ (test_dataset_pipeline,
+push-based-shuffle coverage).
+"""
+import numpy as np
+import pytest
+
+
+def test_million_row_shuffle_across_cluster(ray_start_cluster):
+    """1M rows shuffled over a 3-node in-process cluster: the round-brief
+    done-criterion for the data internals item."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    from ray_tpu import data
+
+    n = 1_000_000
+    ds = data.from_numpy(np.arange(n, dtype=np.int64), parallelism=12)
+    shuffled = ds.random_shuffle(seed=7)
+    # checksum: same multiset of values
+    total = 0
+    seen_order = []
+    for batch in shuffled.iter_batches(batch_size=100_000):
+        total += int(batch.sum())
+        seen_order.append(int(batch[0]))
+    assert total == n * (n - 1) // 2
+    # actually shuffled: the first elements of batches aren't the sorted
+    # prefix starts
+    assert seen_order != sorted(seen_order)
+
+
+def test_columnar_blocks_feed_batches_without_row_python(ray_start_regular):
+    """Dict-rows datasets store columnar blocks; iter_batches slices
+    arrays (never materializing Python row objects)."""
+    import ray_tpu
+    from ray_tpu import data
+    from ray_tpu.data import block as B
+
+    rows = [{"x": float(i), "y": i % 5} for i in range(1000)]
+    ds = data.from_items(rows, parallelism=4)
+    blk = ray_tpu.get(ds._block_refs[0])
+    assert B.is_columnar(blk), f"expected columnar block, got {type(blk)}"
+    batches = list(ds.iter_batches(batch_size=300))
+    assert [len(b["x"]) for b in batches] == [300, 300, 300, 100]
+    assert all(isinstance(b["x"], np.ndarray) for b in batches)
+    np.testing.assert_array_equal(
+        np.concatenate([b["x"] for b in batches]),
+        np.arange(1000, dtype=float))
+
+
+def test_batches_cross_block_boundaries(ray_start_regular):
+    from ray_tpu import data
+
+    ds = data.from_numpy(np.arange(100), parallelism=7)  # ragged blocks
+    batches = list(ds.iter_batches(batch_size=17))
+    assert sum(len(b) for b in batches) == 100
+    np.testing.assert_array_equal(np.concatenate(batches), np.arange(100))
+    assert all(len(b) == 17 for b in batches[:-1])
+
+
+def test_dataset_pipeline_windows(ray_start_regular):
+    from ray_tpu import data
+
+    calls = []
+
+    def stamp(block):
+        return block * 10
+
+    ds = data.from_numpy(np.arange(40), parallelism=8)
+    pipe = ds.window(blocks_per_window=2).map_batches(stamp)
+    assert pipe.num_windows() == 4
+    out = np.concatenate(list(pipe.iter_batches(batch_size=10)))
+    np.testing.assert_array_equal(out, np.arange(40) * 10)
+    assert pipe.count() == 40
+    del calls
+
+
+def test_pipeline_repeat_epochs(ray_start_regular):
+    from ray_tpu import data
+
+    ds = data.from_numpy(np.arange(10), parallelism=2)
+    pipe = ds.repeat(3)
+    rows = [int(r) for r in pipe.iter_rows()]
+    assert len(rows) == 30
+    assert sorted(set(rows)) == list(range(10))
+    # infinite repeat: take() terminates
+    inf = ds.repeat()
+    assert len(inf.take(25)) == 25
+
+
+def test_pipeline_per_window_shuffle(ray_start_regular):
+    from ray_tpu import data
+
+    ds = data.from_numpy(np.arange(100), parallelism=4)
+    pipe = ds.window(blocks_per_window=2).random_shuffle_each_window(seed=3)
+    rows = [int(r) for r in pipe.iter_rows()]
+    assert sorted(rows) == list(range(100))
+    assert rows != list(range(100))
+
+
+def test_distributed_groupby_large(ray_start_regular):
+    from ray_tpu import data
+
+    rows = [{"k": i % 17, "v": i} for i in range(5000)]
+    out = data.from_items(rows, parallelism=8).groupby("k").aggregate(
+        lambda g: sum(int(r["v"]) for r in g)).take_all()
+    got = {int(r["key"]): int(r["value"]) for r in out}
+    want = {}
+    for i in range(5000):
+        want[i % 17] = want.get(i % 17, 0) + i
+    assert got == want

@@ -1,0 +1,78 @@
+"""Observability: timeline spans, metrics registry + aggregation.
+
+Reference tier: `ray timeline` (scripts.py:1757), ray.util.metrics
+(util/metrics.py) → Prometheus text.
+"""
+import json
+import time
+
+
+def test_timeline_records_task_and_actor_spans(ray_start_regular, tmp_path):
+    ray_tpu = ray_start_regular
+
+    @ray_tpu.remote
+    def work(ms):
+        time.sleep(ms / 1000)
+        return ms
+
+    @ray_tpu.remote
+    class Actor:
+        def method(self):
+            time.sleep(0.01)
+            return 1
+
+    assert ray_tpu.get([work.remote(5) for _ in range(3)]) == [5, 5, 5]
+    a = Actor.remote()
+    assert ray_tpu.get(a.method.remote()) == 1
+
+    out = tmp_path / "trace.json"
+    trace = ray_tpu.timeline(str(out))
+    assert len(trace) >= 4
+    cats = {e["cat"] for e in trace}
+    assert "task" in cats and "actor_task" in cats
+    names = [e["name"] for e in trace]
+    assert any("work" in n for n in names)
+    assert any("method" in n for n in names)
+    for e in trace:
+        assert e["ph"] == "X" and e["dur"] >= 0 and e["ts"] > 0
+    # the file is valid chrome trace JSON
+    loaded = json.loads(out.read_text())
+    assert isinstance(loaded, list) and loaded
+
+
+def test_metrics_counter_gauge_histogram(ray_start_regular):
+    ray_tpu = ray_start_regular
+    from ray_tpu.experimental.state.api import metrics_summary
+
+    @ray_tpu.remote
+    class Service:
+        def __init__(self):
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+            self.requests = Counter("svc_requests_total",
+                                    description="requests handled",
+                                    tag_keys=("route",))
+            self.depth = Gauge("svc_queue_depth")
+            self.latency = Histogram("svc_latency_s",
+                                     boundaries=[0.01, 0.1, 1.0])
+
+        def handle(self, route):
+            self.requests.inc(1.0, tags={"route": route})
+            self.depth.set(3)
+            self.latency.observe(0.05)
+            return True
+
+    s = Service.remote()
+    assert ray_tpu.get([s.handle.remote("/a"), s.handle.remote("/a"),
+                        s.handle.remote("/b")]) == [True] * 3
+    snaps = metrics_summary()
+    by_name = {m["name"]: m for m in snaps}
+    assert "svc_requests_total" in by_name
+    vals = {tuple(sorted(v["tags"].items())): v["value"]
+            for v in by_name["svc_requests_total"]["values"]}
+    assert vals[(("route", "/a"),)] == 2.0
+    assert vals[(("route", "/b"),)] == 1.0
+    assert by_name["svc_queue_depth"]["values"][0]["value"] == 3.0
+    text = metrics_summary(prometheus=True)
+    assert "# TYPE svc_requests_total counter" in text
+    assert 'svc_requests_total{route="/a"} 2.0' in text

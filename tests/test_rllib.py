@@ -1,0 +1,64 @@
+"""RLlib tests — PPO on CartPole converges using the framework's actors.
+
+Reference tier: rllib smoke tests over tuned_examples (CartPole PPO is the
+canonical one).
+"""
+import numpy as np
+import pytest
+
+
+def test_cartpole_env_contract():
+    from ray_tpu.rllib import CartPole
+
+    env = CartPole(seed=0)
+    obs, _ = env.reset()
+    assert obs.shape == (4,)
+    total = 0.0
+    for _ in range(10):
+        obs, r, term, trunc, _ = env.step(1)
+        total += r
+        if term or trunc:
+            break
+    assert total >= 1.0
+
+
+def test_rollout_worker_batch_shapes(ray_start_regular):
+    import jax
+
+    from ray_tpu.rllib import RolloutWorker, init_policy
+
+    w = RolloutWorker("CartPole-v1", num_envs=2, seed=0)
+    params = init_policy(jax.random.PRNGKey(0), *w.spaces())
+    batch = w.sample(params, 16)
+    assert batch["obs"].shape == (32, 4)
+    assert batch["actions"].shape == (32,)
+    assert batch["advantages"].shape == (32,)
+    assert np.isfinite(batch["advantages"]).all()
+
+
+def test_ppo_cartpole_converges(ray_start_regular):
+    """The round-brief done-criterion: PPO on CartPole learns using the
+    framework's own actors + object store. Random policy scores ~22;
+    we require a 4x improvement within a bounded budget."""
+    from ray_tpu.rllib import AlgorithmConfig, PPO
+
+    algo = (AlgorithmConfig(PPO)
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_fragment_length=128)
+            .training(lr=3e-4, minibatch_size=128)
+            .build())
+    try:
+        best = 0.0
+        for _ in range(40):
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+            if best >= 120.0:
+                break
+        assert best >= 100.0, f"PPO failed to learn: best reward {best}"
+        # save/restore round-trips
+        state = algo.save()
+        algo.restore(state)
+        assert algo.iteration == state["iteration"]
+    finally:
+        algo.stop()

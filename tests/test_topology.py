@@ -1,0 +1,134 @@
+"""ICI-topology-aware placement group scheduling.
+
+The TPU-native extension of gcs_placement_group_scheduler.h (SURVEY §2.4
+gang row, §7 phase 3): TPU gang bundles land on a contiguous block of hosts
+inside ONE slice so the gang's collectives ride ICI, not DCN.
+"""
+import time
+
+import pytest
+
+
+def _pg_nodes(ray_tpu, pg):
+    worker = ray_tpu._private.api._require_worker()
+    snap = worker.gcs.call("get_placement_group", pg_id=pg.id)
+    return snap["State"], snap["BundleNodes"]
+
+
+@pytest.fixture
+def two_slice_cluster(ray_start_cluster):
+    """Fake 2-slice topology: slice s0 has hosts 0..3, slice s1 hosts 0..1.
+    Each host: 4 TPU chips, 2 CPUs."""
+    cluster = ray_start_cluster
+    cluster.remove_node(cluster.head_node)
+    cluster.head_node = cluster.add_node(num_cpus=2)   # driver-only, no TPU
+    nodes = {}
+    for wid in range(4):
+        nodes[("s0", wid)] = cluster.add_node(
+            num_cpus=2, num_tpus=4,
+            tpu_topology={"slice_id": "s0", "worker_id": wid, "chips": 4})
+    for wid in range(2):
+        nodes[("s1", wid)] = cluster.add_node(
+            num_cpus=2, num_tpus=4,
+            tpu_topology={"slice_id": "s1", "worker_id": wid, "chips": 4})
+    cluster.connect()
+    import ray_tpu
+
+    yield cluster, ray_tpu, nodes
+
+
+def test_strict_pack_lands_on_contiguous_slice_hosts(two_slice_cluster):
+    cluster, ray_tpu, nodes = two_slice_cluster
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"TPU": 4}] * 3, strategy="STRICT_PACK")
+    assert pg.wait(10)
+    state, bundle_nodes = _pg_nodes(ray_tpu, pg)
+    assert state == "CREATED"
+    # all three bundles on slice s0 (only slice with >= 3 hosts), and the
+    # chosen hosts form a contiguous worker_id run
+    by_node = {nodes[k].node_id: k for k in nodes}
+    placed = [by_node[n] for n in bundle_nodes]
+    slices = {s for s, _ in placed}
+    assert slices == {"s0"}, f"gang split across slices: {placed}"
+    wids = sorted(w for _, w in placed)
+    assert wids == list(range(min(wids), min(wids) + 3)), \
+        f"hosts not contiguous: {wids}"
+
+
+def test_gang_avoids_gap_from_busy_host(two_slice_cluster):
+    """With a mid-slice host occupied, a 2-bundle gang must use a
+    contiguous pair, never straddle the gap."""
+    cluster, ray_tpu, nodes = two_slice_cluster
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    # occupy s0 host 1 entirely
+    blocker = placement_group([{"TPU": 4}], strategy="STRICT_PACK")
+    assert blocker.wait(10)
+    _, blocker_nodes = _pg_nodes(ray_tpu, blocker)
+    by_node = {nodes[k].node_id: k for k in nodes}
+    # (the blocker itself goes to the smallest contiguous window; wherever
+    # it landed, the next gang must still be contiguous)
+    gang = placement_group([{"TPU": 4}] * 2, strategy="STRICT_PACK")
+    assert gang.wait(10)
+    _, gang_nodes = _pg_nodes(ray_tpu, gang)
+    placed = [by_node[n] for n in gang_nodes]
+    assert len({s for s, _ in placed}) == 1
+    wids = sorted(w for _, w in placed)
+    assert wids[1] - wids[0] == 1, f"non-adjacent hosts: {placed}"
+    remove_placement_group(blocker)
+    remove_placement_group(gang)
+
+
+def test_two_gangs_get_disjoint_slices(two_slice_cluster):
+    """Two 2-host gangs coexist without sharing chips."""
+    cluster, ray_tpu, nodes = two_slice_cluster
+    from ray_tpu.util.placement_group import placement_group
+
+    a = placement_group([{"TPU": 4}] * 2, strategy="STRICT_PACK")
+    b = placement_group([{"TPU": 4}] * 2, strategy="STRICT_PACK")
+    assert a.wait(10) and b.wait(10)
+    _, a_nodes = _pg_nodes(ray_tpu, a)
+    _, b_nodes = _pg_nodes(ray_tpu, b)
+    assert not (set(a_nodes) & set(b_nodes))
+
+
+def test_tune_trials_gang_scheduled(ray_start_regular):
+    """Every Tune trial runs inside its own placement group (reference:
+    tune/execution/placement_groups.py)."""
+    ray_tpu = ray_start_regular
+    from ray_tpu import tune
+    from ray_tpu.air import session
+
+    seen_pgs = []
+
+    def trainable(config):
+        session.report({"score": config["x"] * 2})
+
+    # snapshot PGs while trials run via a scheduler hook: simplest is to
+    # check the PG table right after fit (trial PGs are removed at stop,
+    # so instead count distinct PG creations via the GCS list during run)
+    worker = ray_tpu._private.api._require_worker()
+
+    import threading
+
+    stop = threading.Event()
+
+    def watch():
+        while not stop.is_set():
+            for snap in worker.gcs.call("list_placement_groups"):
+                if snap["Name"].startswith("trial-"):
+                    seen_pgs.append(snap["Name"])
+            time.sleep(0.01)
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    results = tune.run(trainable, config={"x": tune.grid_search([1, 2, 3])})
+    stop.set()
+    t.join(timeout=5)
+    assert len(results) == 3
+    assert results.get_best_result("score").metrics["score"] == 6
+    assert len(set(seen_pgs)) == 3, f"expected 3 trial PGs, saw {set(seen_pgs)}"

@@ -1,0 +1,168 @@
+"""Workflow tests: durable execution, kill-driver resume, continuations.
+
+Reference tier: python/ray/workflow/tests/ (test_basic_workflows,
+test_recovery). The kill test runs a workflow in a SEPARATE driver process,
+SIGKILLs it mid-step, then resumes from the shared storage in this process
+and checks the completed prefix did not re-execute.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture
+def wf_env(tmp_path, ray_start_regular):
+    import ray_tpu
+
+    yield ray_start_regular, str(tmp_path / "wf_storage"), str(tmp_path)
+
+
+def test_linear_and_diamond_dag(wf_env):
+    ray_tpu, storage, _ = wf_env
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    # diamond: d = (x+1) * (x+2) with shared source
+    src = add.bind(1, 2)                       # 3
+    left = add.bind(src, 1)                    # 4
+    right = add.bind(src, 2)                   # 5
+    out = mul.bind(left, right)                # 20
+    result = workflow.run(out, workflow_id="diamond", storage_dir=storage)
+    assert result == 20
+    assert workflow.get_status("diamond", storage_dir=storage) == "SUCCEEDED"
+    assert workflow.get_output("diamond", storage_dir=storage) == 20
+    assert ("diamond", "SUCCEEDED") in workflow.list_all(storage_dir=storage)
+
+
+def test_failure_then_resume_skips_done_steps(wf_env):
+    ray_tpu, storage, scratch = wf_env
+    from ray_tpu import workflow
+
+    gate = os.path.join(scratch, "gate")
+    counts = os.path.join(scratch, "counts")
+
+    @ray_tpu.remote(max_retries=0)
+    def tracked(x):
+        with open(counts, "a") as f:
+            f.write(f"tracked:{x}\n")
+        return x * 10
+
+    @ray_tpu.remote(max_retries=0)
+    def gated(a, b):
+        if not os.path.exists(gate):
+            raise RuntimeError("gate closed")
+        return a + b
+
+    dag = gated.bind(tracked.bind(1), tracked.bind(2))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="gated", storage_dir=storage)
+    assert workflow.get_status("gated", storage_dir=storage) == "FAILED"
+    # both tracked steps persisted their results before the failure
+    runs = open(counts).read().count("tracked")
+    assert runs == 2
+    open(gate, "w").close()
+    result = workflow.resume("gated", storage_dir=storage)
+    assert result == 30
+    # resume did NOT re-execute the completed steps
+    assert open(counts).read().count("tracked") == 2
+
+
+def test_continuation_expands(wf_env):
+    ray_tpu, storage, _ = wf_env
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def fan(x):
+        from ray_tpu import workflow as wf
+
+        # dynamic: decide the next stage at runtime
+        return wf.continuation(double.bind(x + 1))
+
+    result = workflow.run(fan.bind(10), workflow_id="cont",
+                          storage_dir=storage)
+    assert result == 22
+
+
+def test_kill_driver_then_resume(tmp_path):
+    """The done-criterion test from the round brief: SIGKILL the driver
+    mid-workflow, resume, identical result."""
+    storage = str(tmp_path / "wf")
+    counts = str(tmp_path / "counts")
+    block = str(tmp_path / "block")
+    open(block, "w").close()
+
+    driver = f"""
+import os, sys
+sys.path.insert(0, {os.getcwd()!r})
+os.environ.setdefault("RAY_TPU_TESTING", "1")
+import ray_tpu
+from ray_tpu import workflow
+
+@ray_tpu.remote(max_retries=0)
+def step_a():
+    with open({counts!r}, "a") as f:
+        f.write("a\\n")
+    return 5
+
+@ray_tpu.remote(max_retries=0)
+def step_b(x):
+    # signal readiness, then block until killed
+    import time
+    with open({counts!r}, "a") as f:
+        f.write("b-started\\n")
+    while os.path.exists({block!r}):
+        time.sleep(0.1)
+    return x + 1
+
+ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+workflow.run(step_b.bind(step_a.bind()), workflow_id="killed",
+             storage_dir={storage!r})
+"""
+    proc = subprocess.Popen([sys.executable, "-c", driver],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL,
+                            start_new_session=True)
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        if os.path.exists(counts) and \
+                "b-started" in open(counts).read():
+            break
+        if proc.poll() is not None:
+            raise AssertionError("driver exited early")
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        raise AssertionError("driver never reached step_b")
+    # SIGKILL the whole driver session (driver + its local cluster workers)
+    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    proc.wait(timeout=30)
+
+    os.unlink(block)   # unblock step_b for the resume
+    import ray_tpu
+    from ray_tpu import workflow
+
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        result = workflow.resume("killed", storage_dir=storage)
+        assert result == 6
+        # step_a ran exactly once: its result was persisted pre-kill
+        assert open(counts).read().count("a\n") == 1
+        assert workflow.get_status("killed", storage_dir=storage) == \
+            "SUCCEEDED"
+    finally:
+        ray_tpu.shutdown()
