@@ -254,22 +254,11 @@ def timeline(filename=None):
     (reference: `ray timeline`, scripts.py:1757 over core-worker profiling
     events). Open the written file at chrome://tracing or Perfetto."""
     from ray_tpu._private import profiling
-    from ray_tpu._private.protocol import RpcClient
+    from ray_tpu.experimental.state.api import _each_raylet
 
     worker = _require_worker()
     events = profiling.snapshot()             # this process (driver)
-    for n in worker.gcs.call("get_nodes"):
-        if not n["Alive"]:
-            continue
-        try:
-            c = RpcClient((n["NodeManagerAddress"], n["NodeManagerPort"]),
-                          timeout=5.0)
-            try:
-                events.extend(c.call("profile_events"))
-            finally:
-                c.close()
-        except Exception:
-            continue
+    events.extend(_each_raylet(worker.gcs.call, "profile_events"))
     trace = profiling.to_chrome_trace(events)
     if filename:
         import json

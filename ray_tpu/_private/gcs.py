@@ -47,6 +47,8 @@ class NodeInfo:
         # non-PG load (reference: RaySyncer resource view)
         self.resources_reported: dict | None = None
         self.reported_at: float = 0.0
+        self.pending_demand: list = []   # queued request shapes (autoscaler)
+        self.busy: int = 0               # active leases + actors
 
     def snapshot(self) -> dict:
         return {
@@ -133,7 +135,21 @@ class GcsServer:
 
     def start(self):
         self._server.start()
+        if self._snapshot_path:
+            # periodic durability (the reference's Redis-backed tables
+            # analog): metadata survives a GCS restart
+            t = threading.Thread(target=self._snapshot_loop, daemon=True,
+                                 name="gcs-snapshot")
+            t.start()
         return self
+
+    def _snapshot_loop(self):
+        while not self._server._stopped:
+            time.sleep(5.0)
+            try:
+                self.rpc_save_snapshot()
+            except Exception:
+                pass
 
     @property
     def addr(self):
@@ -211,18 +227,50 @@ class GcsServer:
                                 "snapshot": self.nodes[node_id].snapshot()})
         return {"cluster_id": self.cluster_id}
 
-    def rpc_report_resources(self, conn, node_id: str, available: dict):
+    def rpc_report_resources(self, conn, node_id: str, available: dict,
+                             pending_demand: list | None = None,
+                             busy: int = 0):
         with self._lock:
             node = self.nodes.get(node_id)
             if node is not None:
                 node.resources_reported = dict(available)
                 node.reported_at = time.time()
-            # fresh capacity may unblock pending placement groups
-            pending = [pg for pg in self.placement_groups.values()
-                       if pg.state in ("PENDING", "RESCHEDULING")]
-            for pg in pending:
-                self._try_schedule_pg(pg)
+                node.pending_demand = list(pending_demand or [])
+                node.busy = int(busy)
+            # fresh capacity may unblock pending placement groups (rate-
+            # limited: every raylet gossips ~600ms and the window scan is
+            # O(hosts² · bundles) under the GCS lock)
+            now = time.time()
+            for pg in self.placement_groups.values():
+                if pg.state in ("PENDING", "RESCHEDULING") and \
+                        now - pg.last_sched_attempt > 0.25:
+                    pg.last_sched_attempt = now
+                    self._try_schedule_pg(pg)
         return True
+
+    def rpc_get_cluster_load(self, conn):
+        """The autoscaler's input (reference: LoadMetrics built from GCS
+        resource reports): per-node availability + queued demand shapes +
+        unplaced placement-group bundles."""
+        with self._lock:
+            nodes = []
+            for n in self.nodes.values():
+                nodes.append({
+                    "NodeID": n.node_id,
+                    "Alive": n.alive,
+                    "Resources": dict(n.resources),
+                    "Available": dict(n.resources_reported
+                                      if n.resources_reported is not None
+                                      else n.resources),
+                    "PendingDemand": list(getattr(n, "pending_demand", [])),
+                    "Busy": int(getattr(n, "busy", 0)),
+                    "ReportedAt": n.reported_at,
+                })
+            pending_bundles = []
+            for pg in self.placement_groups.values():
+                if pg.state in ("PENDING", "RESCHEDULING"):
+                    pending_bundles.extend(dict(b) for b in pg.bundles)
+            return {"nodes": nodes, "pending_pg_bundles": pending_bundles}
 
     def rpc_drain_node(self, conn, node_id: str):
         self._mark_node_dead(node_id, "drained")
@@ -492,12 +540,16 @@ class GcsServer:
             if pg.strategy == "STRICT_PACK" and not ici_placed and len(
                     {a for a in assignment if a}) > 1:
                 assignment = [None] * len(pg.bundles)
-                # retry all on one node
+                # retry all on one node — against FRESH availability: the
+                # discarded multi-node pass mutated `avail` via take(), and
+                # judging nodes by those leftovers can wrongly reject a
+                # node that fits the whole gang
+                fresh = {n.node_id: self._node_available_for_pg(n)
+                         for n in alive}
                 for node_id in order:
-                    trial = {k: dict(v) for k, v in avail.items()}
+                    a = dict(fresh[node_id])
                     ok = True
                     for bundle in pg.bundles:
-                        a = trial[node_id]
                         if all(a.get(k, 0) >= v for k, v in bundle.items()):
                             for k, v in bundle.items():
                                 a[k] = a.get(k, 0) - v

@@ -166,6 +166,9 @@ class Raylet:
         self._leases: dict[str, Lease] = {}
         self._pending: list[dict] = []                 # queued lease requests
         self._pg_reserved: dict[tuple, dict] = {}      # (pg_id,bundle) -> res
+        # resource shapes of requests currently queued on this node — the
+        # autoscaler's demand signal (reference: LoadMetrics resource_load)
+        self._queued_demand: list[dict] = []
         self._stopped = False
 
         self._server = RpcServer(self, host, port).start()
@@ -327,8 +330,12 @@ class Raylet:
                 try:
                     with self._lock:
                         avail = dict(self.resources_avail)
+                        demand = [dict(d) for d in self._queued_demand]
+                        busy = len(self._leases) + sum(
+                            1 for w in self._workers.values() if w.is_actor)
                     self._gcs.push("report_resources",
-                                   node_id=self.node_id, available=avail)
+                                   node_id=self.node_id, available=avail,
+                                   pending_demand=demand, busy=busy)
                 except Exception:
                     pass
 
@@ -494,15 +501,24 @@ class Raylet:
         # Queue until local resources free up (reference: lease request stays
         # in ClusterTaskManager queue). Block this handler thread.
         deadline = time.time() + 300.0
-        while time.time() < deadline:
-            if self._try_reserve(resources):
-                return self._grant(resources, lessee)
-            if not self._feasible(resources):
-                raise ValueError(
-                    f"infeasible resource request {resources}: cluster "
-                    f"cannot ever satisfy it")
-            time.sleep(_LEASE_QUEUE_POLL)
-        raise TimeoutError(f"lease request {resources} timed out")
+        with self._lock:
+            self._queued_demand.append(resources)
+        try:
+            while time.time() < deadline:
+                if self._try_reserve(resources):
+                    return self._grant(resources, lessee)
+                if not self._feasible(resources):
+                    raise ValueError(
+                        f"infeasible resource request {resources}: cluster "
+                        f"cannot ever satisfy it")
+                time.sleep(_LEASE_QUEUE_POLL)
+            raise TimeoutError(f"lease request {resources} timed out")
+        finally:
+            with self._lock:
+                try:
+                    self._queued_demand.remove(resources)
+                except ValueError:
+                    pass
 
     def _try_reserve(self, resources: dict) -> bool:
         with self._lock:
@@ -651,14 +667,25 @@ class Raylet:
             return {"spillback": target}
         # queue locally until feasible
         deadline = time.time() + 300.0
-        while time.time() < deadline:
-            if self._try_reserve(resources):
-                return self._create_actor_locally(actor_id, spec,
-                                                  reserved=resources)
-            if not self._feasible(resources):
-                raise ValueError(f"infeasible actor resources {resources}")
-            time.sleep(_LEASE_QUEUE_POLL)
-        raise TimeoutError("actor creation timed out waiting for resources")
+        with self._lock:
+            self._queued_demand.append(resources)
+        try:
+            while time.time() < deadline:
+                if self._try_reserve(resources):
+                    return self._create_actor_locally(actor_id, spec,
+                                                      reserved=resources)
+                if not self._feasible(resources):
+                    raise ValueError(
+                        f"infeasible actor resources {resources}")
+                time.sleep(_LEASE_QUEUE_POLL)
+            raise TimeoutError(
+                "actor creation timed out waiting for resources")
+        finally:
+            with self._lock:
+                try:
+                    self._queued_demand.remove(resources)
+                except ValueError:
+                    pass
 
     def _create_actor_locally(self, actor_id: bytes, spec: dict,
                               reserved: dict | None = None):

@@ -1,0 +1,92 @@
+"""Runtime environments: env_vars + working_dir packaging.
+
+Reference: python/ray/_private/runtime_env/ (working_dir.py, packaging.py —
+directories zipped into the GCS KV, unpacked next to the worker) scoped to
+the two capabilities jobs need most: environment variables and a packaged
+working directory. The package rides the GCS KV (ns="packages") keyed by
+content hash, so resubmitting the same tree uploads nothing.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import zipfile
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+
+
+def package_working_dir(path: str) -> tuple[str, bytes]:
+    """Zip a directory tree → (content-hash key, zip bytes)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"working_dir {path!r} is not a directory")
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+            for name in sorted(files):
+                full = os.path.join(root, name)
+                z.write(full, os.path.relpath(full, path))
+    blob = buf.getvalue()
+    if len(blob) > MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"working_dir package is {len(blob)} bytes "
+            f"(limit {MAX_PACKAGE_BYTES}); exclude large data files")
+    key = "pkg-" + hashlib.sha256(blob).hexdigest()[:24]
+    return key, blob
+
+
+def upload_working_dir(gcs_call, path: str) -> str:
+    """Idempotent upload; returns the package key (URI analog)."""
+    key, blob = package_working_dir(path)
+    if gcs_call("kv_get", ns="packages", key=key.encode()) is None:
+        gcs_call("kv_put", ns="packages", key=key.encode(), value=blob)
+    return key
+
+
+def materialize_working_dir(gcs_call, key: str, dest_root: str) -> str:
+    """Download + extract a package; returns the directory path. Cached per
+    key under dest_root (the per-node URI cache analog, uri_cache.py).
+    Concurrency-safe: extraction happens in a private temp dir and the
+    rename loser simply uses the winner's copy (content-addressed keys
+    make both copies identical)."""
+    import shutil
+    import tempfile
+
+    dest = os.path.join(dest_root, key)
+    if os.path.isdir(dest):
+        return dest
+    blob = gcs_call("kv_get", ns="packages", key=key.encode())
+    if blob is None:
+        raise ValueError(f"package {key!r} not found in GCS")
+    tmp = tempfile.mkdtemp(dir=dest_root, prefix=f".{key}-")
+    try:
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            z.extractall(tmp)
+        os.rename(tmp, dest)
+    except OSError:
+        if not os.path.isdir(dest):   # lost a race we didn't win either
+            raise
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def apply_runtime_env(runtime_env: dict | None, gcs_call,
+                      dest_root: str) -> dict:
+    """Resolve a runtime_env spec into concrete subprocess settings:
+    {"env": merged os.environ overlay, "cwd": working dir or None}."""
+    runtime_env = runtime_env or {}
+    env = dict(os.environ)
+    env.update({str(k): str(v)
+                for k, v in (runtime_env.get("env_vars") or {}).items()})
+    cwd = None
+    wd = runtime_env.get("working_dir")
+    if wd:
+        if wd.startswith("pkg-"):
+            cwd = materialize_working_dir(gcs_call, wd, dest_root)
+        else:
+            cwd = os.path.abspath(wd)
+        env["PYTHONPATH"] = cwd + os.pathsep + env.get("PYTHONPATH", "")
+    return {"env": env, "cwd": cwd}

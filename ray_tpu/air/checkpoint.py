@@ -48,6 +48,32 @@ class Checkpoint:
             tar.extractall(tmp, filter="data")
         return cls(directory=tmp)
 
+    @classmethod
+    def from_jax(cls, pytree, path: str | None = None) -> "Checkpoint":
+        """Write a jax pytree (train state, params, opt state) as an
+        orbax-format directory checkpoint (reference parity: AIR's
+        framework-specific checkpoints; TPU-native form is orbax, the jax
+        ecosystem standard for sharded-array checkpoints)."""
+        import orbax.checkpoint as ocp
+
+        base = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        target = os.path.join(base, "orbax_state")
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        ocp.PyTreeCheckpointer().save(target, pytree)
+        return cls(directory=base)
+
+    def to_jax(self):
+        """Restore the pytree of an orbax-form checkpoint."""
+        import orbax.checkpoint as ocp
+
+        path = self.to_directory()
+        target = os.path.join(path, "orbax_state")
+        if not os.path.isdir(target):
+            raise ValueError("not an orbax-form checkpoint "
+                             "(no orbax_state/ subdirectory)")
+        return ocp.PyTreeCheckpointer().restore(target)
+
     # ---- conversions --------------------------------------------------------
 
     def to_dict(self) -> dict:

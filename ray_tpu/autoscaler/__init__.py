@@ -1,0 +1,16 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Reference: python/ray/autoscaler/ (~21.7k LoC; SURVEY.md §2.2):
+StandardAutoscaler.update (autoscaler.py:162,353) reading LoadMetrics from
+the GCS, ResourceDemandScheduler binpacking demand onto node types
+(resource_demand_scheduler.py:103,171), and the NodeProvider plugin API
+(node_provider.py). Ours keeps the same three pieces: GCS `get_cluster_load`
+is the LoadMetrics source, `StandardAutoscaler.update()` binpacks queued
+demand + pending PG bundles, and providers plug in node create/terminate —
+`LocalNodeProvider` spawns real OS node processes (the fake-multinode test
+analog), a TPU pod provider slots in the same API for GCE/QR.
+"""
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+
+__all__ = ["LocalNodeProvider", "NodeProvider", "StandardAutoscaler"]

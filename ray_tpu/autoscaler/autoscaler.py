@@ -1,0 +1,161 @@
+"""StandardAutoscaler — the update loop.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py:162 (update at
+:353) + resource_demand_scheduler.py:103,171 (binpack demand onto node
+types). Each update():
+
+1. pulls cluster load from the GCS (queued request shapes + pending PG
+   bundles + per-node availability),
+2. binpacks unfulfilled demand onto current headroom; what doesn't fit is
+   matched against available_node_types (first type whose resources cover
+   the shape, respecting per-type and global max_workers) → create_node,
+3. terminates provider nodes idle past idle_timeout_s (no leases/actors,
+   no queued demand), never dropping below min_workers.
+
+Run it via a thread (`start()`) or drive `update()` manually (tests, and
+the reference's monitor.py does the same single-threaded loop).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class StandardAutoscaler:
+    def __init__(self, gcs_address: str, config: dict, provider):
+        """config: {
+            "max_workers": int, "min_workers": int (default 0),
+            "idle_timeout_s": float,
+            "available_node_types": {name: {"resources": {...},
+                                            "max_workers": int}},
+        }"""
+        from ray_tpu._private.protocol import RpcClient
+
+        host, port = gcs_address.rsplit(":", 1)
+        self._gcs = RpcClient((host, int(port)), timeout=10.0)
+        self.config = config
+        self.provider = provider
+        self._idle_since: dict[str, float] = {}   # provider_id -> ts
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ---------------------------------------------------------------- loop
+    def start(self, interval_s: float = 5.0):
+        self._thread = threading.Thread(
+            target=self._loop, args=(interval_s,), daemon=True,
+            name="autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._gcs.close()
+        except Exception:
+            pass
+
+    def _loop(self, interval_s: float):
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception:
+                pass
+            self._stop.wait(interval_s)
+
+    # -------------------------------------------------------------- update
+    def update(self) -> dict:
+        """One reconcile pass. Returns {"launched": [...], "terminated":
+        [...]} for observability/tests."""
+        load = self._gcs.call("get_cluster_load")
+        alive = [n for n in load["nodes"] if n["Alive"]]
+        demand = [d for n in alive for d in n["PendingDemand"]]
+        demand += load["pending_pg_bundles"]
+
+        # 1. subtract what current headroom can absorb
+        headroom = [dict(n["Available"]) for n in alive]
+        unfulfilled = []
+        for shape in demand:
+            placed = False
+            for h in headroom:
+                if all(h.get(k, 0) >= v for k, v in shape.items()):
+                    for k, v in shape.items():
+                        h[k] = h.get(k, 0) - v
+                    placed = True
+                    break
+            if not placed:
+                unfulfilled.append(shape)
+
+        launched = []
+        if unfulfilled:
+            launched = self._launch_for(unfulfilled)
+
+        terminated = []
+        if not unfulfilled:
+            terminated = self._scale_down(alive)
+        return {"launched": launched, "terminated": terminated,
+                "unfulfilled": unfulfilled}
+
+    def _launch_for(self, shapes: list[dict]) -> list[str]:
+        types = self.config.get("available_node_types", {})
+        provider_nodes = self.provider.non_terminated_nodes()
+        total = len(provider_nodes)
+        by_type: dict[str, int] = {}
+        for n in provider_nodes:
+            by_type[n["node_type"]] = by_type.get(n["node_type"], 0) + 1
+        launched = []
+        # plan: first node type that covers each shape (reference binpacking
+        # picks min-cost; first-fit is our simplification), dedup into
+        # counts, honor caps
+        plan: dict[str, int] = {}
+        pending_cover: dict[str, dict] = {}
+        for shape in shapes:
+            for name, spec in types.items():
+                res = spec.get("resources", {})
+                if all(res.get(k, 0) >= v for k, v in shape.items()):
+                    cover = pending_cover.setdefault(name, dict(res))
+                    if all(cover.get(k, 0) >= v for k, v in shape.items()):
+                        # fits in a node we already plan to launch
+                        for k, v in shape.items():
+                            cover[k] = cover.get(k, 0) - v
+                        plan.setdefault(name, max(plan.get(name, 0), 1))
+                    else:
+                        plan[name] = plan.get(name, 0) + 1
+                        pending_cover[name] = dict(res)
+                        for k, v in shape.items():
+                            pending_cover[name][k] = \
+                                pending_cover[name].get(k, 0) - v
+                    break
+        max_workers = self.config.get("max_workers", 8)
+        for name, count in plan.items():
+            spec = types[name]
+            cap = spec.get("max_workers", max_workers)
+            allowed = min(count,
+                          cap - by_type.get(name, 0),
+                          max_workers - total - len(launched))
+            if allowed <= 0:
+                continue
+            launched.extend(self.provider.create_node(name, spec, allowed))
+        return launched
+
+    def _scale_down(self, alive_nodes: list[dict]) -> list[str]:
+        idle_timeout = self.config.get("idle_timeout_s", 60.0)
+        min_workers = self.config.get("min_workers", 0)
+        by_runtime_id = {n["NodeID"]: n for n in alive_nodes}
+        provider_nodes = self.provider.non_terminated_nodes()
+        now = time.time()
+        terminated = []
+        for pn in provider_nodes:
+            n = by_runtime_id.get(pn.get("node_id"))
+            busy = n is None or n["Busy"] > 0 or n["PendingDemand"]
+            if busy:
+                self._idle_since.pop(pn["provider_id"], None)
+                continue
+            first_idle = self._idle_since.setdefault(pn["provider_id"], now)
+            if now - first_idle < idle_timeout:
+                continue
+            if len(provider_nodes) - len(terminated) <= min_workers:
+                break
+            self.provider.terminate_node(pn["provider_id"])
+            self._idle_since.pop(pn["provider_id"], None)
+            terminated.append(pn["provider_id"])
+        return terminated

@@ -1,0 +1,106 @@
+"""NodeProvider plugin API + the local (process-spawning) provider.
+
+Reference: python/ray/autoscaler/node_provider.py (create_node /
+terminate_node / non_terminated_nodes) and the fake_multi_node provider
+used by autoscaler tests. LocalNodeProvider launches real
+`ray_tpu.scripts.node` OS processes joining the GCS — the closest analog
+of a cloud VM on one machine.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+class NodeProvider:
+    """Provider contract. node_type → the key in the autoscaler config's
+    available_node_types whose `resources` the node advertises."""
+
+    def non_terminated_nodes(self) -> list[dict]:
+        """[{provider_id, node_type, node_id (runtime id, once known)}]"""
+        raise NotImplementedError
+
+    def create_node(self, node_type: str, node_config: dict,
+                    count: int) -> list[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_id: str) -> None:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self._nodes: dict[str, dict] = {}   # provider_id -> info
+
+    def non_terminated_nodes(self) -> list[dict]:
+        out = []
+        for pid, info in list(self._nodes.items()):
+            if info["proc"].poll() is not None:
+                del self._nodes[pid]
+                continue
+            out.append({"provider_id": pid,
+                        "node_type": info["node_type"],
+                        "node_id": info.get("node_id")})
+        return out
+
+    def create_node(self, node_type: str, node_config: dict,
+                    count: int) -> list[str]:
+        created = []
+        for _ in range(count):
+            ready = f"/tmp/ray_tpu/asready_{os.getpid()}_{time.time_ns()}"
+            resources = dict(node_config.get("resources") or {})
+            num_cpus = int(resources.pop("CPU", 1))
+            args = [sys.executable, "-m", "ray_tpu.scripts.node",
+                    "--address", self.gcs_address,
+                    "--num-cpus", str(num_cpus),
+                    "--ready-file", ready,
+                    "--object-store-memory",
+                    str(node_config.get("object_store_memory",
+                                        64 * 1024 * 1024))]
+            resources.pop("memory", None)
+            if resources:
+                args += ["--resources", json.dumps(resources)]
+            proc = subprocess.Popen(args, stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL,
+                                    start_new_session=True)
+            deadline = time.time() + 60
+            node_id = None
+            while time.time() < deadline:
+                if os.path.exists(ready):
+                    with open(ready) as f:
+                        node_id = json.load(f)["node_id"]
+                    os.unlink(ready)
+                    break
+                if proc.poll() is not None:
+                    raise RuntimeError("autoscaled node died during start")
+                time.sleep(0.05)
+            provider_id = f"local-{proc.pid}"
+            self._nodes[provider_id] = {"proc": proc,
+                                        "node_type": node_type,
+                                        "node_id": node_id}
+            created.append(provider_id)
+        return created
+
+    def terminate_node(self, provider_id: str) -> None:
+        info = self._nodes.pop(provider_id, None)
+        if info is None:
+            return
+        proc = info["proc"]
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def shutdown(self):
+        for pid in list(self._nodes):
+            self.terminate_node(pid)

@@ -1,0 +1,13 @@
+"""ray_tpu.dashboard — HTTP observability endpoint.
+
+Reference: dashboard/ (aiohttp head server + React frontend, 21.8k LoC;
+SURVEY.md §2.2). Ours serves the same information surface as JSON over a
+stdlib HTTP server — every state-API table, the cluster/memory summaries,
+Prometheus metrics, jobs, and the chrome-trace timeline — without the
+frontend build: point a browser (or curl/Grafana/Prometheus) at it.
+
+    python -m ray_tpu.scripts.cli dashboard --port 8265
+"""
+from ray_tpu.dashboard.server import DashboardServer
+
+__all__ = ["DashboardServer"]

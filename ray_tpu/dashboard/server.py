@@ -1,0 +1,131 @@
+"""Dashboard HTTP server — JSON state + Prometheus metrics endpoints.
+
+Routes (reference modules in parens — dashboard/modules/*):
+    /                       index: route listing (frontend stand-in)
+    /api/nodes              (node)
+    /api/actors             (actor)
+    /api/objects            (state)
+    /api/tasks              (state: lease-level running view)
+    /api/workers            (reporter)
+    /api/placement_groups   (state)
+    /api/jobs               (job)
+    /api/cluster_status     (`ray status`)
+    /api/memory             (`ray memory`)
+    /api/timeline           chrome://tracing JSON (timeline)
+    /metrics                Prometheus text (reporter_agent.py:296)
+    /-/healthz              liveness
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class DashboardServer:
+    def __init__(self, address: str | None, host: str = "127.0.0.1",
+                 port: int = 8265):
+        self.address = address
+        dash = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                dash._handle(self)
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="dashboard")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+
+    # ----------------------------------------------------------------- http
+    def _handle(self, h: BaseHTTPRequestHandler):
+        from ray_tpu.experimental.state import api as state
+
+        path = h.path.split("?")[0]
+        try:
+            if path == "/-/healthz":
+                return self._send(h, 200, b"ok", "text/plain")
+            if path == "/metrics":
+                text = state.metrics_summary(address=self.address,
+                                             prometheus=True)
+                return self._send(h, 200, text.encode(), "text/plain")
+            if path in ("/", "/index.html"):
+                routes = ["/api/nodes", "/api/actors", "/api/objects",
+                          "/api/tasks", "/api/workers",
+                          "/api/placement_groups", "/api/jobs",
+                          "/api/cluster_status", "/api/memory",
+                          "/api/timeline", "/metrics"]
+                body = "<html><body><h2>ray_tpu dashboard</h2><ul>" + "".join(
+                    f'<li><a href="{r}">{r}</a></li>' for r in routes
+                ) + "</ul></body></html>"
+                return self._send(h, 200, body.encode(), "text/html")
+            if path == "/api/cluster_status":
+                payload = {"summary":
+                           state.cluster_status(address=self.address)}
+            elif path == "/api/memory":
+                payload = {"summary":
+                           state.memory_summary(address=self.address)}
+            elif path == "/api/nodes":
+                payload = state.list_nodes(address=self.address)
+            elif path == "/api/actors":
+                payload = state.list_actors(address=self.address)
+            elif path == "/api/objects":
+                payload = state.list_objects(address=self.address)
+            elif path == "/api/tasks":
+                payload = state.list_tasks(address=self.address)
+            elif path == "/api/workers":
+                payload = state.list_workers(address=self.address)
+            elif path == "/api/placement_groups":
+                payload = state.list_placement_groups(address=self.address)
+            elif path == "/api/jobs":
+                payload = self._jobs()
+            elif path == "/api/timeline":
+                payload = self._timeline()
+            else:
+                return self._send(h, 404, b'{"error": "no route"}',
+                                  "application/json")
+            raw = json.dumps(payload, default=str).encode()
+            return self._send(h, 200, raw, "application/json")
+        except Exception as e:
+            self._send(h, 500, json.dumps({"error": str(e)}).encode(),
+                       "application/json")
+
+    def _jobs(self):
+        from ray_tpu.experimental.state.api import _gcs
+
+        with _gcs(self.address) as call:
+            out = []
+            for key in call("kv_keys", ns="jobs"):
+                blob = call("kv_get", ns="jobs", key=key)
+                if blob:
+                    out.append(json.loads(blob))
+            return out
+
+    def _timeline(self):
+        from ray_tpu._private import profiling
+        from ray_tpu.experimental.state.api import _each_raylet, _gcs
+
+        with _gcs(self.address) as call:
+            events = _each_raylet(call, "profile_events")
+        return profiling.to_chrome_trace(events)
+
+    @staticmethod
+    def _send(h, status, raw: bytes, ctype: str):
+        h.send_response(status)
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(raw)))
+        h.end_headers()
+        h.wfile.write(raw)

@@ -1,0 +1,16 @@
+"""Job submission — run an entrypoint command on the cluster.
+
+Reference: dashboard/modules/job/job_manager.py:418 (JobManager spawning a
+detached JobSupervisor actor per job at :133, entrypoint as a subprocess)
++ python/ray/job_submission/ (JobSubmissionClient SDK). Ours folds the
+manager into the client (no dashboard REST hop): the client connects as a
+driver, uploads the working_dir package, and creates the named detached
+supervisor; status/log queries go straight to the supervisor actor, with
+terminal states mirrored into the GCS KV so they outlive it.
+"""
+from ray_tpu.job_submission.job_manager import (
+    JobStatus,
+    JobSubmissionClient,
+)
+
+__all__ = ["JobStatus", "JobSubmissionClient"]

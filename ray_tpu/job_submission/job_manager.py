@@ -1,0 +1,211 @@
+"""JobSupervisor actor + JobSubmissionClient.
+
+Reference: dashboard/modules/job/job_manager.py — JobSupervisor :133 (runs
+the entrypoint as a subprocess, streams logs), JobManager :418 (submit /
+status / stop bookkeeping). The supervisor is a detached named actor so
+jobs survive the submitting client's exit; terminal status + logs are
+mirrored to the GCS KV (ns="jobs") so `list_jobs` works after the
+supervisor is gone.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+
+VALID_STATUSES = ("PENDING", "RUNNING", "SUCCEEDED", "FAILED", "STOPPED")
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class JobSupervisor:
+    """Actor body: one per job (reference: job_manager.py:133)."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 runtime_env: dict | None):
+        from ray_tpu._private.runtime_env import apply_runtime_env
+        from ray_tpu._private.worker_runtime import current_worker
+
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self._status = JobStatus.PENDING
+        self._logs: list[str] = []
+        self._lock = threading.Lock()
+        self._proc = None
+        worker = current_worker()
+        self._gcs_call = worker.gcs.call
+        dest_root = os.path.join("/tmp/ray_tpu", "runtime_envs")
+        os.makedirs(dest_root, exist_ok=True)
+        settings = apply_runtime_env(runtime_env, self._gcs_call, dest_root)
+        threading.Thread(target=self._run, args=(settings,), daemon=True,
+                         name=f"job-{submission_id}").start()
+
+    def _run(self, settings: dict):
+        with self._lock:
+            if self._status == JobStatus.STOPPED:
+                return   # stop() won the race before the subprocess spawned
+            self._status = JobStatus.RUNNING
+        self._persist()
+        try:
+            self._proc = subprocess.Popen(
+                self.entrypoint, shell=True,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=settings["env"], cwd=settings["cwd"], text=True,
+                start_new_session=True,
+            )
+            with self._lock:
+                stopped_mid_spawn = self._status == JobStatus.STOPPED
+            if stopped_mid_spawn:
+                # stop() raced between RUNNING and Popen — it had no
+                # process to kill, so kill it here
+                import signal as _signal
+
+                try:
+                    os.killpg(os.getpgid(self._proc.pid), _signal.SIGTERM)
+                except OSError:
+                    pass
+            for line in self._proc.stdout:
+                with self._lock:
+                    self._logs.append(line)
+                    if len(self._logs) > 10_000:
+                        del self._logs[:5_000]
+            rc = self._proc.wait()
+            with self._lock:
+                if self._status != JobStatus.STOPPED:
+                    self._status = (JobStatus.SUCCEEDED if rc == 0
+                                    else JobStatus.FAILED)
+                    if rc != 0:
+                        self._logs.append(f"[job exited rc={rc}]\n")
+        except BaseException as e:  # noqa: BLE001
+            with self._lock:
+                self._status = JobStatus.FAILED
+                self._logs.append(f"[supervisor error: {e}]\n")
+        self._persist()
+
+    def _persist(self):
+        try:
+            with self._lock:
+                record = {"submission_id": self.submission_id,
+                          "entrypoint": self.entrypoint,
+                          "status": self._status,
+                          "logs_tail": "".join(self._logs[-200:]),
+                          "updated_at": time.time()}
+            self._gcs_call("kv_put", ns="jobs",
+                           key=self.submission_id.encode(),
+                           value=json.dumps(record).encode())
+        except Exception:
+            pass
+
+    def status(self) -> str:
+        with self._lock:
+            return self._status
+
+    def logs(self) -> str:
+        with self._lock:
+            return "".join(self._logs)
+
+    def stop(self) -> bool:
+        import signal
+
+        with self._lock:
+            self._status = JobStatus.STOPPED
+        if self._proc is not None and self._proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+            except OSError:
+                pass
+        self._persist()
+        return True
+
+    def ping(self):
+        return True
+
+
+class JobSubmissionClient:
+    """SDK entry (reference: python/ray/job_submission/JobSubmissionClient;
+    address-based like the REST client, but speaking actor RPC)."""
+
+    def __init__(self, address: str | None = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        self._ray = ray_tpu
+
+    def submit_job(self, *, entrypoint: str, runtime_env: dict | None = None,
+                   submission_id: str | None = None) -> str:
+        from ray_tpu._private.runtime_env import upload_working_dir
+        from ray_tpu._private.worker_runtime import current_worker
+
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        runtime_env = dict(runtime_env or {})
+        wd = runtime_env.get("working_dir")
+        if wd and not wd.startswith("pkg-"):
+            runtime_env["working_dir"] = upload_working_dir(
+                current_worker().gcs.call, wd)
+        supervisor = self._ray.remote(JobSupervisor).options(
+            name=f"_job_supervisor:{submission_id}", namespace="_jobs",
+            lifetime="detached", max_concurrency=8, num_cpus=0,
+        ).remote(submission_id, entrypoint, runtime_env)
+        self._ray.get(supervisor.ping.remote())
+        return submission_id
+
+    def _supervisor(self, submission_id: str):
+        return self._ray.get_actor(f"_job_supervisor:{submission_id}",
+                                   namespace="_jobs")
+
+    def get_job_status(self, submission_id: str) -> str:
+        try:
+            sup = self._supervisor(submission_id)
+            return self._ray.get(sup.status.remote(), timeout=10)
+        except ValueError:
+            record = self._record(submission_id)
+            if record is None:
+                raise ValueError(f"no job {submission_id!r}") from None
+            return record["status"]
+
+    def get_job_logs(self, submission_id: str) -> str:
+        try:
+            sup = self._supervisor(submission_id)
+            return self._ray.get(sup.logs.remote(), timeout=10)
+        except ValueError:
+            record = self._record(submission_id)
+            if record is None:
+                raise ValueError(f"no job {submission_id!r}") from None
+            return record["logs_tail"]
+
+    def stop_job(self, submission_id: str) -> bool:
+        sup = self._supervisor(submission_id)
+        return self._ray.get(sup.stop.remote(), timeout=30)
+
+    def list_jobs(self) -> list[dict]:
+        from ray_tpu._private.worker_runtime import current_worker
+
+        call = current_worker().gcs.call
+        out = []
+        for key in call("kv_keys", ns="jobs"):
+            blob = call("kv_get", ns="jobs", key=key)
+            if blob:
+                out.append(json.loads(blob))
+        return sorted(out, key=lambda r: r.get("updated_at", 0))
+
+    def wait_until_finish(self, submission_id: str,
+                          timeout: float = 300.0) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                          JobStatus.STOPPED):
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"job {submission_id} still {status} after {timeout}s")

@@ -119,14 +119,6 @@ def _current_cluster() -> dict:
         return json.load(f)
 
 
-def _gcs_client(address: str | None):
-    from ray_tpu._private.protocol import RpcClient
-
-    addr = address or _current_cluster()["gcs_address"]
-    host, port = addr.rsplit(":", 1)
-    return RpcClient((host, int(port)), timeout=10.0)
-
-
 def cmd_status(args):
     from ray_tpu.experimental.state.api import cluster_status
 
@@ -154,6 +146,61 @@ def cmd_memory(args):
     from ray_tpu.experimental.state.api import memory_summary
 
     print(memory_summary(address=args.address))
+    return 0
+
+
+def cmd_dashboard(args):
+    import time as _time
+
+    from ray_tpu.dashboard import DashboardServer
+
+    address = args.address or _current_cluster()["gcs_address"]
+    server = DashboardServer(address, host=args.host, port=args.port).start()
+    print(f"dashboard at http://{args.host}:{server.port}")
+    try:
+        while True:
+            _time.sleep(1)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(
+        args.address or _current_cluster()["gcs_address"])
+    if args.action == "submit":
+        if not args.rest:
+            raise SystemExit("job submit needs an entrypoint command")
+        runtime_env = {}
+        if args.working_dir:
+            runtime_env["working_dir"] = args.working_dir
+        if args.env:
+            runtime_env["env_vars"] = dict(kv.split("=", 1)
+                                           for kv in args.env)
+        import shlex
+
+        # re-quote each argv token so argument boundaries survive the
+        # supervisor's shell (a bare join breaks e.g. `python -c "a; b"`)
+        entrypoint = (args.rest[0] if len(args.rest) == 1
+                      else " ".join(shlex.quote(t) for t in args.rest))
+        sid = client.submit_job(entrypoint=entrypoint,
+                                runtime_env=runtime_env or None)
+        print(sid)
+    elif args.action == "list":
+        print(json.dumps(client.list_jobs(), indent=2))
+    else:
+        if not args.rest:
+            raise SystemExit(f"job {args.action} needs a job id")
+        sid = args.rest[0]
+        if args.action == "status":
+            print(client.get_job_status(sid))
+        elif args.action == "logs":
+            print(client.get_job_logs(sid), end="")
+        elif args.action == "stop":
+            client.stop_job(sid)
+            print("stopped")
     return 0
 
 
@@ -199,6 +246,23 @@ def main(argv=None):
     sp = sub.add_parser("microbenchmark",
                         help="core task/actor/object throughput numbers")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_dashboard)
+
+    sp = sub.add_parser("job", help="submit / inspect cluster jobs")
+    sp.add_argument("action", choices=["submit", "status", "logs", "stop",
+                                       "list"])
+    sp.add_argument("rest", nargs="*",
+                    help="submit: entrypoint command; others: job id")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--working-dir", default=None)
+    sp.add_argument("--env", action="append", default=[],
+                    help="KEY=VALUE runtime env var (repeatable)")
+    sp.set_defaults(fn=cmd_job)
 
     args = p.parse_args(argv)
     return args.fn(args)

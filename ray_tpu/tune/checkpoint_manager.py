@@ -1,0 +1,55 @@
+"""Per-trial checkpoint manager: persist, score, keep top-K.
+
+Reference: python/ray/tune/execution/checkpoint_manager.py (top-K by
+checkpoint_score_attribute) + syncer.py's role of getting checkpoints off
+the trial actor (here: into the experiment dir on the shared filesystem).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, trial_dir: str, config: CheckpointConfig | None):
+        self.trial_dir = trial_dir
+        self.config = config or CheckpointConfig()
+        # [(score, iteration, path)] — kept sorted best-last
+        self._kept: list[tuple[float, int, str]] = []
+        self.latest_path: str | None = None
+
+    def on_checkpoint(self, checkpoint: Checkpoint, metrics: dict,
+                      iteration: int) -> str:
+        """Persist a reported checkpoint; enforce num_to_keep. Returns the
+        persisted directory path."""
+        path = os.path.join(self.trial_dir, f"checkpoint_{iteration:06d}")
+        checkpoint.to_directory(path)
+        self.latest_path = path
+        attr = self.config.checkpoint_score_attribute
+        score = float(metrics.get(attr, iteration)) if attr else \
+            float(iteration)
+        if self.config.checkpoint_score_order == "min":
+            score = -score
+        self._kept.append((score, iteration, path))
+        self._kept.sort()
+        keep = self.config.num_to_keep
+        if keep is not None and keep > 0:
+            while len(self._kept) > keep:
+                # evict the worst-scored, but never the latest (resume needs
+                # it — same carve-out as the reference)
+                for i, (_s, _it, p) in enumerate(self._kept):
+                    if p != self.latest_path:
+                        shutil.rmtree(p, ignore_errors=True)
+                        del self._kept[i]
+                        break
+                else:
+                    break
+        return path
+
+    def best_checkpoint(self) -> Checkpoint | None:
+        if not self._kept:
+            return None
+        return Checkpoint.from_directory(self._kept[-1][2])

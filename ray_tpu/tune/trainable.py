@@ -1,0 +1,75 @@
+"""Class-based Trainable API.
+
+Reference: python/ray/tune/trainable/trainable.py:314 (Trainable with
+setup/step/save_checkpoint/load_checkpoint) — the API RLlib's Algorithm
+and long-running experiments use. The runner wraps a Trainable subclass
+into the function-trainable protocol: setup once (restoring from a
+checkpoint if resuming), then report a result per step() until a stop
+condition or scheduler decision ends the trial.
+"""
+from __future__ import annotations
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class Trainable:
+    checkpoint_frequency: int = 1   # steps between checkpoints (0 = never)
+
+    def __init__(self, config: dict | None = None):
+        self.config = dict(config or {})
+        self.iteration = 0
+        self.setup(self.config)
+
+    # -- override these ----------------------------------------------------
+    def setup(self, config: dict):
+        pass
+
+    def step(self) -> dict:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> dict:
+        return {}
+
+    def load_checkpoint(self, checkpoint: dict):
+        pass
+
+    def cleanup(self):
+        pass
+
+    # -- runner protocol ---------------------------------------------------
+    def train(self) -> dict:
+        self.iteration += 1
+        metrics = self.step()
+        metrics.setdefault("training_iteration", self.iteration)
+        return metrics
+
+
+def wrap_trainable_cls(cls):
+    """Trainable subclass → function trainable driving the session loop."""
+
+    def fn(config):
+        from ray_tpu.air import session
+
+        t = cls(config)
+        resume = session.get_checkpoint()
+        if resume is not None:
+            state = resume.to_dict()
+            t.iteration = state.get("_iteration", 0)
+            t.load_checkpoint(state.get("_user", {}))
+        try:
+            while True:
+                metrics = t.train()
+                ckpt = None
+                freq = getattr(t, "checkpoint_frequency", 1)
+                if freq and t.iteration % freq == 0:
+                    ckpt = Checkpoint.from_dict(
+                        {"_iteration": t.iteration,
+                         "_user": t.save_checkpoint()})
+                session.report(metrics, checkpoint=ckpt)
+                if metrics.get("done"):
+                    break
+        finally:
+            t.cleanup()
+
+    fn.__name__ = getattr(cls, "__name__", "trainable")
+    return fn

@@ -111,6 +111,68 @@ class TrialRunner:
         self.resources = resources_per_trial or {"CPU": 1}
         self.scheduler = tune_config.scheduler or sched_mod.FIFOScheduler()
         self._pending_exploits: list[tuple] = []
+        # experiment persistence (reference: trial_runner checkpointing +
+        # tune/execution/experiment_state.py): enabled when the run is named
+        # or given a storage path
+        self.experiment_dir = None
+        if run_config.name or run_config.storage_path:
+            import os
+
+            root = run_config.storage_path or os.path.expanduser(
+                "~/.ray_tpu/results")
+            self.experiment_dir = os.path.join(
+                root, run_config.name or "experiment")
+            os.makedirs(self.experiment_dir, exist_ok=True)
+        self._ckpt_managers: dict = {}
+
+    def _should_stop(self, metrics: dict) -> bool:
+        for key, bound in (self.run_config.stop or {}).items():
+            if key in metrics and metrics[key] >= bound:
+                return True
+        return False
+
+    def _on_trial_checkpoint(self, trial, checkpoint, metrics):
+        """Route reported checkpoints through the top-K manager when the
+        experiment persists to disk; else keep in memory."""
+        if self.experiment_dir is None:
+            trial.latest_checkpoint = checkpoint
+            return
+        import os
+
+        from ray_tpu.air.checkpoint import Checkpoint
+        from ray_tpu.tune.checkpoint_manager import CheckpointManager
+
+        cm = self._ckpt_managers.get(trial.trial_id)
+        if cm is None:
+            cm = CheckpointManager(
+                os.path.join(self.experiment_dir, trial.trial_id),
+                self.run_config.checkpoint_config)
+            self._ckpt_managers[trial.trial_id] = cm
+        path = cm.on_checkpoint(checkpoint, metrics, trial.iteration)
+        trial.latest_checkpoint = Checkpoint.from_directory(path)
+
+    def save_experiment_state(self):
+        if self.experiment_dir is None:
+            return
+        import json
+        import os
+        import tempfile
+
+        state = {"trials": [{
+            "trial_id": t.trial_id,
+            "config": t.config,
+            "status": t.status,
+            "iteration": t.iteration,
+            "last_result": _jsonable(t.last_result),
+            "checkpoint_dir": (self._ckpt_managers[t.trial_id].latest_path
+                               if t.trial_id in self._ckpt_managers
+                               else None),
+        } for t in self.trials]}
+        fd, tmp = tempfile.mkstemp(dir=self.experiment_dir)
+        with os.fdopen(fd, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(self.experiment_dir,
+                                     "experiment_state.json"))
 
     def get_trial(self, trial_id: str) -> Trial | None:
         for t in self.trials:
@@ -126,7 +188,9 @@ class TrialRunner:
     def run(self) -> list[Trial]:
         limit = self.tune_config.max_concurrent_trials or len(self.trials)
         active: list[Trial] = []
-        queue = list(self.trials)
+        # restored experiments carry finished trials — don't re-run them
+        queue = [t for t in self.trials
+                 if t.status not in ("TERMINATED", "STOPPED")]
         while queue or active:
             while queue and len(active) < limit:
                 trial = queue.pop(0)
@@ -144,18 +208,27 @@ class TrialRunner:
                     trial.error = row.get("error")
                     self._stop_actor(trial)
                     active.remove(trial)
+                    self.save_experiment_state()
                     continue
                 trial.iteration = row.get("iteration", trial.iteration + 1)
                 metrics = dict(row["metrics"])
                 metrics.setdefault("training_iteration", trial.iteration)
                 trial.results.append(metrics)
                 if row.get("checkpoint") is not None:
-                    trial.latest_checkpoint = row["checkpoint"]
+                    self._on_trial_checkpoint(trial, row["checkpoint"],
+                                              metrics)
+                if self._should_stop(metrics):
+                    trial.status = "TERMINATED"
+                    self._stop_actor(trial)
+                    active.remove(trial)
+                    self.save_experiment_state()
+                    continue
                 decision = self.scheduler.on_result(trial, metrics, self)
                 if decision == sched_mod.STOP:
                     trial.status = "STOPPED"
                     self._stop_actor(trial)
                     active.remove(trial)
+                self.save_experiment_state()
             for trial, source, new_config in self._pending_exploits:
                 if trial in active:
                     self._stop_actor(trial, release_pg=False)
@@ -230,6 +303,19 @@ class TrialRunner:
             trial.pg = None
 
 
+def _jsonable(d: dict) -> dict:
+    out = {}
+    for k, v in (d or {}).items():
+        try:
+            import json
+
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            out[k] = repr(v)
+    return out
+
+
 class ResultGrid:
     def __init__(self, trials: list[Trial], metric: str | None,
                  mode: str = "max"):
@@ -273,6 +359,12 @@ class Tuner:
                  resources_per_trial: dict | None = None):
         if hasattr(trainable, "as_trainable"):   # a Trainer
             trainable = trainable.as_trainable()
+        import inspect
+
+        from ray_tpu.tune.trainable import Trainable, wrap_trainable_cls
+
+        if inspect.isclass(trainable) and issubclass(trainable, Trainable):
+            trainable = wrap_trainable_cls(trainable)
         self.trainable = trainable
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
@@ -280,15 +372,60 @@ class Tuner:
         self.resources_per_trial = resources_per_trial
 
     def fit(self) -> ResultGrid:
-        configs = BasicVariantGenerator(
-            self.param_space, self.tune_config.num_samples,
-            seed=self.tune_config.seed).generate()
-        trials = [Trial(c) for c in configs]
+        if getattr(self, "_restored_trials", None) is not None:
+            trials = self._restored_trials
+        else:
+            configs = BasicVariantGenerator(
+                self.param_space, self.tune_config.num_samples,
+                seed=self.tune_config.seed).generate()
+            trials = [Trial(c) for c in configs]
         runner = TrialRunner(self.trainable, trials, self.tune_config,
                              self.run_config, self.resources_per_trial)
         runner.run()
         return ResultGrid(trials, self.tune_config.metric,
                           self.tune_config.mode)
+
+    @classmethod
+    def restore(cls, path: str, trainable, *,
+                tune_config: TuneConfig | None = None,
+                run_config: RunConfig | None = None,
+                resources_per_trial: dict | None = None) -> "Tuner":
+        """Resume an experiment from its state file (reference:
+        tuner.py Tuner.restore): finished trials keep their results,
+        unfinished ones re-run from their latest persisted checkpoint.
+        Pass the original run_config to preserve stop criteria and
+        checkpoint policy (they are not serialized in the state file);
+        name/storage_path are overridden to point at `path`."""
+        import dataclasses
+        import json
+        import os
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        base = run_config or RunConfig()
+        run_config = dataclasses.replace(
+            base,
+            name=os.path.basename(path.rstrip("/")),
+            storage_path=os.path.dirname(path.rstrip("/")))
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=run_config,
+                    resources_per_trial=resources_per_trial)
+        trials = []
+        for row in state["trials"]:
+            t = Trial(row["config"], trial_id=row["trial_id"])
+            t.iteration = row.get("iteration", 0)
+            if row.get("checkpoint_dir") and                     os.path.isdir(row["checkpoint_dir"]):
+                t.latest_checkpoint = Checkpoint.from_directory(
+                    row["checkpoint_dir"])
+            if row["status"] in ("TERMINATED", "STOPPED"):
+                t.status = row["status"]
+                if row.get("last_result"):
+                    t.results.append(row["last_result"])
+            trials.append(t)
+        tuner._restored_trials = trials
+        return tuner
 
 
 def run(trainable, *, config: dict | None = None, num_samples: int = 1,

@@ -48,7 +48,8 @@ class _Rendezvous:
         self._epoch = 0
         self._coordinator_port = None
 
-    def join(self, rank: int, addr, timeout: float = 300.0):
+    def join(self, rank: int, addr, timeout: float = 300.0,
+             coordinator_port: int | None = None):
         """Register and block until the full membership is present.
         Returns (members, coordinator_addr)."""
         import time as _time
@@ -59,11 +60,17 @@ class _Rendezvous:
                 # a new worker took this rank (restart): new membership epoch
                 self._epoch += 1
                 self._members = {}
+            if coordinator_port is not None and rank == 0:
+                # rank 0 probed this port as free ON ITS HOST — the only
+                # machine where "free" matters, since jax.distributed's
+                # coordinator binds there (a port probed on the rendezvous
+                # actor's host is wrong on a multi-host pod)
+                self._coordinator_port = coordinator_port
             if self._coordinator_port is None:
                 import socket
 
                 s = socket.socket()
-                s.bind(("127.0.0.1", 0))
+                s.bind(("0.0.0.0", 0))
                 self._coordinator_port = s.getsockname()[1]
                 s.close()
             while True:
@@ -139,8 +146,17 @@ class GroupManager:
             name=f"_collective_{group_name}", get_if_exists=True,
             num_cpus=0, max_concurrency=max(world_size, 2),
         ).remote(world_size)
+        coord_port = None
+        if rank == 0 and backend == "xla":
+            import socket
+
+            probe = socket.socket()
+            probe.bind(("0.0.0.0", 0))
+            coord_port = probe.getsockname()[1]
+            probe.close()
         members, coordinator = ray_tpu.get(
-            handle.join.remote(rank, worker.addr), timeout=330.0)
+            handle.join.remote(rank, worker.addr,
+                               coordinator_port=coord_port), timeout=330.0)
 
         if backend == "xla":
             from ray_tpu.util.collective.xla_backend import XlaGroup

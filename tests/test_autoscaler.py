@@ -1,0 +1,108 @@
+"""Autoscaler tests over the local (process-spawning) provider.
+
+Reference tier: tests/test_autoscaler.py + test_autoscaler_fake_multinode
+(mock providers, demand-driven scale up, idle scale down).
+"""
+import time
+
+import pytest
+
+
+@pytest.fixture
+def scaled_cluster():
+    """Head-only cluster + autoscaler with a worker node type."""
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.raylet import Raylet, detect_resources
+    from ray_tpu.autoscaler import LocalNodeProvider, StandardAutoscaler
+
+    gcs = GcsServer().start()
+    head = Raylet(gcs.addr,
+                  resources=detect_resources(1, 0),
+                  store_size=64 * 1024 * 1024)
+    address = f"{gcs.addr[0]}:{gcs.addr[1]}"
+    provider = LocalNodeProvider(address)
+    autoscaler = StandardAutoscaler(
+        address,
+        {"max_workers": 2, "min_workers": 0, "idle_timeout_s": 1.0,
+         "available_node_types": {
+             "cpu_worker": {"resources": {"CPU": 2, "crunch": 2},
+                            "max_workers": 2,
+                            "object_store_memory": 64 * 1024 * 1024}}},
+        provider)
+
+    from ray_tpu._private.worker_runtime import CoreWorker, set_current_worker
+
+    worker = CoreWorker(gcs.addr, head.addr, mode="driver")
+    set_current_worker(worker)
+    import ray_tpu
+
+    yield ray_tpu, autoscaler, provider, address
+    autoscaler.stop()
+    provider.shutdown()
+    worker.shutdown()
+    set_current_worker(None)
+    head.stop(kill_workers=True)
+    gcs.stop()
+
+
+def test_scale_up_on_demand_then_down_when_idle(scaled_cluster):
+    ray_tpu, autoscaler, provider, _ = scaled_cluster
+
+    @ray_tpu.remote(num_cpus=0, resources={"crunch": 1}, max_retries=0)
+    def crunch(x):
+        return x * 2
+
+    # no node offers "crunch": tasks queue... but the head raylet rejects
+    # infeasible shapes, so demand must come from a feasible-some-day shape.
+    # Submit and let them queue as pending demand on the head? The head has
+    # no "crunch" at all -> infeasible there. So instead we model the real
+    # flow: demand arrives as a pending placement group (gang waiting for
+    # capacity), which the GCS reports to the autoscaler directly.
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"crunch": 1}, {"crunch": 1}],
+                         strategy="PACK")
+    assert not pg.wait(1)          # pending: nothing can host it
+
+    report = autoscaler.update()
+    assert report["launched"], "autoscaler did not launch for PG demand"
+    # the new node registers; PG becomes schedulable; tasks run INSIDE it
+    # (the PG reserved the crunch units, so tasks ride its bundles)
+    assert pg.wait(30)
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    in_pg = crunch.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg))
+    out = ray_tpu.get([in_pg.remote(i) for i in range(4)], timeout=60)
+    assert out == [0, 2, 4, 6]
+
+    from ray_tpu.util.placement_group import remove_placement_group
+
+    remove_placement_group(pg)
+    # idle long enough -> scaled down (head survives; provider nodes gone)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        report = autoscaler.update()
+        if report["terminated"]:
+            break
+        time.sleep(0.5)
+    assert report["terminated"], "idle node was not terminated"
+    assert provider.non_terminated_nodes() == []
+
+
+def test_max_workers_cap(scaled_cluster):
+    ray_tpu, autoscaler, provider, _ = scaled_cluster
+    from ray_tpu.util.placement_group import placement_group
+
+    # demand for 5 nodes' worth of crunch, cap is 2
+    pgs = [placement_group([{"crunch": 2}], strategy="PACK")
+           for _ in range(5)]
+    time.sleep(0.2)
+    launched = []
+    for _ in range(4):
+        launched += autoscaler.update()["launched"]
+    assert 1 <= len(launched) <= 2
+    assert len(provider.non_terminated_nodes()) <= 2
+    del pgs

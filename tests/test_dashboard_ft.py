@@ -1,0 +1,129 @@
+"""Dashboard endpoints, GCS snapshot fault tolerance, distributed Train.
+
+Reference tier: dashboard module tests, test_gcs_fault_tolerance.py, and
+train's process-group setup tests.
+"""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=15) as r:
+        return r.status, r.read()
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    ray_tpu = ray_start_regular
+    from ray_tpu.dashboard import DashboardServer
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    assert ray_tpu.get(work.remote(1)) == 2
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == 1
+
+    server = DashboardServer(address=None, port=0).start()
+    try:
+        status, body = _get(server.port, "/api/nodes")
+        assert status == 200
+        nodes = json.loads(body)
+        assert sum(1 for n in nodes if n["Alive"]) == 1
+        status, body = _get(server.port, "/api/actors")
+        assert any(x["State"] == "ALIVE" for x in json.loads(body))
+        status, body = _get(server.port, "/api/cluster_status")
+        assert "Nodes: 1 alive" in json.loads(body)["summary"]
+        status, body = _get(server.port, "/api/timeline")
+        trace = json.loads(body)
+        assert any(e["cat"] == "task" for e in trace)
+        status, body = _get(server.port, "/metrics")
+        assert status == 200
+        status, body = _get(server.port, "/")
+        assert b"/api/nodes" in body
+        status, _ = _get(server.port, "/-/healthz")
+        assert status == 200
+    finally:
+        server.stop()
+
+
+def test_gcs_snapshot_restart(tmp_path):
+    """Kill the GCS; a restart from its snapshot recovers the KV (function
+    table, jobs), named-actor registry, and cluster identity — the
+    reference's Redis-backed FT scope for metadata."""
+    from ray_tpu._private.gcs import GcsServer
+
+    snap = str(tmp_path / "gcs_snapshot")
+    gcs = GcsServer(snapshot_path=snap).start()
+    from ray_tpu._private.protocol import RpcClient
+
+    c = RpcClient(gcs.addr)
+    c.call("kv_put", ns="funcs", key=b"fn1", value=b"blob-1")
+    c.call("kv_put", ns="jobs", key=b"job1",
+           value=json.dumps({"status": "SUCCEEDED"}).encode())
+    cluster_id = gcs.cluster_id
+    gcs.rpc_save_snapshot()
+    c.close()
+    gcs.stop()
+
+    gcs2 = GcsServer(snapshot_path=snap).start()
+    try:
+        c2 = RpcClient(gcs2.addr)
+        assert c2.call("kv_get", ns="funcs", key=b"fn1") == b"blob-1"
+        job = json.loads(c2.call("kv_get", ns="jobs", key=b"job1"))
+        assert job["status"] == "SUCCEEDED"
+        assert gcs2.cluster_id == cluster_id
+        c2.close()
+    finally:
+        gcs2.stop()
+
+
+def test_train_distributed_two_processes(ray_start_regular):
+    """The Train stack through JaxConfig(distributed=True): two worker
+    processes jointly initialize a jax.distributed world (single-device CPU
+    each) and train data-parallel — the multi-host TPU pod path on the CI
+    substrate (round-2 weak finding #6: this path was never tested)."""
+    import ray_tpu
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.backend_executor import JaxConfig
+    from ray_tpu.train.trainer import JaxTrainer
+
+    def train_loop(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.air import session
+
+        assert jax.process_count() == 2, \
+            f"expected a 2-process jax world, got {jax.process_count()}"
+        rank = jax.process_index()
+        # data-parallel gradient agreement via the collective group
+        from ray_tpu.util import collective as col
+
+        w = np.zeros(4, np.float32)
+        for step in range(2):
+            local_grad = np.full(4, float(rank + 1), np.float32)
+            total = col.allreduce(local_grad, group_name="train_dp")
+            w = w - 0.1 * total / 2
+            session.report({"step": step, "w0": float(w[0]),
+                            "rank": rank})
+
+    trainer = JaxTrainer(
+        train_loop_per_worker=train_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        backend_config=JaxConfig(distributed=True,
+                                 collective_backend="host"),
+    )
+    result = trainer.fit()
+    # grad mean = (1+2)/2 = 1.5 → after 2 steps w0 = -0.3
+    assert abs(result.metrics["w0"] - (-0.3)) < 1e-6

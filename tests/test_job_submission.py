@@ -1,0 +1,95 @@
+"""Job submission + runtime env tests.
+
+Reference tier: dashboard/modules/job/tests (submit/status/logs/stop) and
+runtime_env working_dir tests.
+"""
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture
+def job_client(ray_start_regular):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    yield JobSubmissionClient()
+
+
+def test_submit_and_logs(job_client, tmp_path):
+    out = tmp_path / "out.txt"
+    sid = job_client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello-job'); "
+                   f"open({str(out)!r}, 'w').write('done')\"")
+    status = job_client.wait_until_finish(sid, timeout=60)
+    assert status == "SUCCEEDED"
+    assert "hello-job" in job_client.get_job_logs(sid)
+    assert out.read_text() == "done"
+
+
+def test_env_vars_and_working_dir(job_client, tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mymod.py").write_text("VALUE = 'from-working-dir'\n")
+    (pkg / "main.py").write_text(
+        "import os, mymod\n"
+        "print('mod:', mymod.VALUE)\n"
+        "print('env:', os.environ['JOB_FLAVOR'])\n")
+    sid = job_client.submit_job(
+        entrypoint=f"{sys.executable} main.py",
+        runtime_env={"working_dir": str(pkg),
+                     "env_vars": {"JOB_FLAVOR": "tpu"}})
+    assert job_client.wait_until_finish(sid, timeout=60) == "SUCCEEDED"
+    logs = job_client.get_job_logs(sid)
+    assert "mod: from-working-dir" in logs
+    assert "env: tpu" in logs
+
+
+def test_failed_job_status(job_client):
+    sid = job_client.submit_job(
+        entrypoint=f"{sys.executable} -c \"raise SystemExit(3)\"")
+    assert job_client.wait_until_finish(sid, timeout=60) == "FAILED"
+    assert "[job exited rc=3]" in job_client.get_job_logs(sid)
+
+
+def test_stop_running_job(job_client):
+    sid = job_client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(600)\"")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if job_client.get_job_status(sid) == "RUNNING":
+            break
+        time.sleep(0.1)
+    assert job_client.get_job_status(sid) == "RUNNING"
+    job_client.stop_job(sid)
+    assert job_client.wait_until_finish(sid, timeout=30) == "STOPPED"
+
+
+def test_list_jobs(job_client):
+    sid = job_client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('x')\"",
+        submission_id="listed-job")
+    job_client.wait_until_finish(sid, timeout=60)
+    jobs = job_client.list_jobs()
+    assert any(j["submission_id"] == "listed-job"
+               and j["status"] == "SUCCEEDED" for j in jobs)
+
+
+def test_package_roundtrip(tmp_path):
+    from ray_tpu._private.runtime_env import package_working_dir
+
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.py").write_text("A = 1")
+    (src / "sub" / "b.py").write_text("B = 2")
+    (src / "__pycache__").mkdir()
+    (src / "__pycache__" / "junk.pyc").write_text("x")
+    key1, blob1 = package_working_dir(str(src))
+    key2, blob2 = package_working_dir(str(src))
+    assert key1 == key2 and blob1 == blob2   # deterministic
+    import io
+    import zipfile
+
+    names = zipfile.ZipFile(io.BytesIO(blob1)).namelist()
+    assert "a.py" in names and "sub/b.py" in names
+    assert not any("__pycache__" in n for n in names)
