@@ -1,0 +1,149 @@
+"""Scalability envelope harness — the release/benchmarks port.
+
+Reference: /root/reference/release/benchmarks/ (many_nodes / many_actors /
+many_tasks / many_pgs + object-store limits, the "Ray Scalability
+Envelope" of BASELINE.md). Dimensions are scaled to the current machine
+via --scale (1.0 = the smoke settings CI can afford on one small host;
+raise it on a real cluster).
+
+Run: python benchmarks/scalability_envelope.py [--scale 1.0]
+Prints one JSON line per dimension plus a summary table.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# many_actors spawns every worker process at once; on a small host the
+# spawns serialize on the CPU, so give registration a generous budget
+os.environ.setdefault("RAY_TPU_WORKER_REGISTER_TIMEOUT_S", "600")
+
+
+def bench(name, fn):
+    t0 = time.perf_counter()
+    extra = fn() or {}
+    dt = time.perf_counter() - t0
+    row = {"dimension": name, "seconds": round(dt, 2), **extra}
+    print(json.dumps(row))
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    args = ap.parse_args()
+    s = args.scale
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, object_store_memory=512 * 1024 * 1024)
+    rows = []
+
+    # --- many queued tasks on one node (ref: 1M+ queued) -----------------
+    n_tasks = int(2000 * s)
+
+    @ray_tpu.remote(num_cpus=0, max_retries=0)
+    def noop(i):
+        return i
+
+    def many_tasks():
+        refs = [noop.remote(i) for i in range(n_tasks)]
+        out = ray_tpu.get(refs, timeout=600)
+        assert out == list(range(n_tasks))
+        return {"tasks": n_tasks}
+
+    rows.append(bench("many_queued_tasks", many_tasks))
+
+    # --- many actors (ref: 10k+; each actor is a real OS process, so the
+    # smoke default is sized for a small host — raise --scale on real
+    # machines where process spawn isn't serialized on one core) ----------
+    n_actors = int(40 * s)
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def ping(self):
+            return 1
+
+    def many_actors():
+        actors = [A.remote() for _ in range(n_actors)]
+        out = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+        assert sum(out) == n_actors
+        for a in actors:
+            ray_tpu.kill(a)
+        return {"actors": n_actors}
+
+    rows.append(bench("many_actors", many_actors))
+
+    # --- many placement groups (ref: 1k+) --------------------------------
+    n_pgs = int(100 * s)
+
+    def many_pgs():
+        from ray_tpu.util.placement_group import (
+            placement_group,
+            remove_placement_group,
+        )
+
+        pgs = [placement_group([{"CPU": 0.01}], strategy="PACK")
+               for _ in range(n_pgs)]
+        ready = sum(1 for pg in pgs if pg.wait(60))
+        assert ready == n_pgs, f"{ready}/{n_pgs} PGs became ready"
+        for pg in pgs:
+            remove_placement_group(pg)
+        return {"placement_groups": n_pgs}
+
+    rows.append(bench("many_placement_groups", many_pgs))
+
+    # --- object args to one task (ref: 10k+) ------------------------------
+    n_args = int(1000 * s)
+
+    @ray_tpu.remote(num_cpus=0, max_retries=0)
+    def fan_in(*xs):
+        return len(xs)
+
+    def many_args():
+        refs = [ray_tpu.put(i) for i in range(n_args)]
+        assert ray_tpu.get(fan_in.remote(*refs), timeout=600) == n_args
+        return {"object_args": n_args}
+
+    rows.append(bench("many_object_args", many_args))
+
+    # --- returns from one task (ref: 3k+) ---------------------------------
+    n_returns = int(500 * s)
+
+    def many_returns():
+        @ray_tpu.remote(num_cpus=0, num_returns=n_returns, max_retries=0)
+        def fan_out():
+            return tuple(range(n_returns))
+
+        refs = fan_out.remote()
+        out = ray_tpu.get(refs, timeout=600)
+        assert out == list(range(n_returns))
+        return {"returns": n_returns}
+
+    rows.append(bench("many_task_returns", many_returns))
+
+    # --- large object get (ref: 100 GiB+; scaled to the store) ------------
+    nbytes = int(128 * 1024 * 1024 * s)
+
+    def big_get():
+        arr = np.zeros(nbytes, dtype=np.uint8)
+        ref = ray_tpu.put(arr)
+        out = ray_tpu.get(ref, timeout=600)
+        assert out.nbytes == nbytes
+        return {"gigabytes": round(nbytes / 2**30, 3)}
+
+    rows.append(bench("large_object_get", big_get))
+
+    print(json.dumps({"benchmark": "scalability_envelope", "scale": s,
+                      "results": rows}))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # --- scheduling ---
     "worker_lease_timeout_ms": 30_000,
     "worker_pool_min_size": 0,
+    "worker_register_timeout_s": 60.0,  # worker process spawn+import budget
     "worker_pool_idle_timeout_s": 120.0,
     "max_tasks_in_flight_per_worker": 10,  # lease pipelining depth
     "scheduler_spread_threshold": 0.5,  # hybrid policy pack→spread knob

@@ -652,7 +652,11 @@ class GcsServer:
                  and time.time() - node.reported_at < 5.0)
         if fresh:
             avail = dict(node.resources_reported)
-            cutoff = node.reported_at
+            # Grace period: a report taken shortly AFTER a commit may still
+            # predate the raylet processing the bundle reservation (the
+            # "created" push is async) — treat such commits as unreflected
+            # and subtract them, at worst briefly double-counting.
+            cutoff = node.reported_at - 1.5
         else:
             avail = dict(node.resources)
             cutoff = 0.0

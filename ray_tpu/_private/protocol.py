@@ -347,6 +347,15 @@ class RpcServer:
         except OSError:
             pass
         for conn in list(self._conns.values()):
+            # shutdown BEFORE close: a plain close() while a _serve_conn
+            # thread is blocked in recv on the same socket is deferred by
+            # CPython's fd guard — no FIN is sent and remote clients
+            # (e.g. a lease request to this dying raylet) hang until their
+            # own timeout instead of failing over immediately.
+            try:
+                conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 conn.sock.close()
             except OSError:

@@ -281,7 +281,11 @@ class Raylet:
             self._workers[worker_id] = handle
         return handle
 
-    def _pop_worker(self, timeout: float = 30.0) -> WorkerHandle:
+    def _pop_worker(self, timeout: float | None = None) -> WorkerHandle:
+        if timeout is None:
+            from ray_tpu._private.config import get_config
+
+            timeout = float(get_config("worker_register_timeout_s"))
         with self._lock:
             while self._idle:
                 handle = self._idle.pop()
@@ -444,12 +448,19 @@ class Raylet:
             self.resources_avail[k] = self.resources_avail.get(k, 0) + v
 
     def _pick_spillback(self, resources: dict):
-        """Ask GCS for the cluster view; pick the least-loaded alive node that
-        could ever fit the request (total resources)."""
-        try:
-            nodes = self._gcs.call("get_nodes")
-        except ConnectionLost:
-            return None
+        """Pick an alive node whose totals fit the request, from a briefly
+        cached GCS view (every queued lease/actor waiter re-checks spillback
+        twice a second — one shared snapshot serves them all)."""
+        now = time.time()
+        cached = getattr(self, "_nodes_cache", None)
+        if cached is not None and now - cached[0] < 0.5:
+            nodes = cached[1]
+        else:
+            try:
+                nodes = self._gcs.call("get_nodes")
+            except ConnectionLost:
+                return None
+            self._nodes_cache = (now, nodes)
         best = None
         for n in nodes:
             if not n["Alive"] or n["NodeID"] == self.node_id:
@@ -504,13 +515,31 @@ class Raylet:
         with self._lock:
             self._queued_demand.append(resources)
         try:
+            warned = False
+            next_spill_check = time.time() + 0.5
             while time.time() < deadline:
+                if self._stopped:
+                    raise ConnectionLost("raylet shutting down")
                 if self._try_reserve(resources):
                     return self._grant(resources, lessee)
-                if not self._feasible(resources):
-                    raise ValueError(
-                        f"infeasible resource request {resources}: cluster "
-                        f"cannot ever satisfy it")
+                # Re-evaluate spillback while queued: a node that joined
+                # (autoscaler, chaos replacement) after we started waiting
+                # may be able to serve this request right now.
+                if (not strategy.get("no_spill")
+                        and time.time() >= next_spill_check):
+                    target = self._pick_spillback(resources)
+                    if target is not None:
+                        return {"spillback": target}
+                    next_spill_check = time.time() + 0.5
+                if not self._feasible(resources) and not warned:
+                    # Reference semantics: infeasible work stays PENDING
+                    # (with a warning) rather than failing — the queued
+                    # shape is the autoscaler's scale-up signal, and chaos
+                    # recovery transiently empties resource types.
+                    warned = True
+                    print(f"[raylet {self.node_id[:8]}] warning: request "
+                          f"{resources} is currently infeasible; waiting "
+                          f"for capacity (autoscaler signal)", flush=True)
                 time.sleep(_LEASE_QUEUE_POLL)
             raise TimeoutError(f"lease request {resources} timed out")
         finally:
@@ -662,21 +691,28 @@ class Raylet:
         if self._try_reserve(resources):
             return self._create_actor_locally(actor_id, spec,
                                               reserved=resources)
-        target = self._pick_spillback(resources)
-        if target is not None:
-            return {"spillback": target}
+        if not strategy.get("no_spill"):
+            target = self._pick_spillback(resources)
+            if target is not None:
+                return {"spillback": target}
         # queue locally until feasible
         deadline = time.time() + 300.0
         with self._lock:
             self._queued_demand.append(resources)
         try:
+            next_spill_check = time.time() + 0.5
             while time.time() < deadline:
+                if self._stopped:
+                    raise ConnectionLost("raylet shutting down")
                 if self._try_reserve(resources):
                     return self._create_actor_locally(actor_id, spec,
                                                       reserved=resources)
-                if not self._feasible(resources):
-                    raise ValueError(
-                        f"infeasible actor resources {resources}")
+                if not strategy.get("no_spill") and \
+                        time.time() >= next_spill_check:
+                    target = self._pick_spillback(resources)
+                    if target is not None:
+                        return {"spillback": target}
+                    next_spill_check = time.time() + 0.5
                 time.sleep(_LEASE_QUEUE_POLL)
             raise TimeoutError(
                 "actor creation timed out waiting for resources")

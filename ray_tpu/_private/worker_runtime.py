@@ -244,8 +244,29 @@ class _SchedulingKeyQueue:
             grant = self.worker.request_lease(self.resources, self.strategy)
             client = RpcClient(tuple(grant["worker_addr"]), timeout=None)
             lw = _LeasedWorker(grant, client)
+            self._lease_timeouts = 0
             with self._lock:
                 self.leased.append(lw)
+        except ConnectionLost:
+            # Transient: the raylet we were talking to (or spilled to) died
+            # mid-request. The cluster view heals within a heartbeat —
+            # back off and let the dispatch loop re-request instead of
+            # condemning every queued task (chaos-test finding).
+            self._lease_timeouts = 0
+            time.sleep(0.2)
+        except TimeoutError as e:
+            # A full 300s raylet queue timeout is retried (capacity may be
+            # coming: autoscaler, chaos replacement) — but not forever: two
+            # consecutive exhausted waits mean the demand is going nowhere
+            # (e.g. a typo'd resource name) and the tasks should fail
+            # loudly rather than hang silently.
+            self._lease_timeouts = getattr(self, "_lease_timeouts", 0) + 1
+            if self._lease_timeouts >= 2:
+                with self._lock:
+                    self._lease_error = exc.RayError(
+                        f"no capacity for {self.resources} after "
+                        f"{self._lease_timeouts} full lease-queue waits: "
+                        f"{e}")
         except Exception as e:  # noqa: BLE001
             with self._lock:
                 self._lease_error = e
@@ -494,6 +515,12 @@ class CoreWorker:
         self._pull_lock = threading.Condition()
         self._pull_inflight_bytes = 0
         self._lock = threading.RLock()
+        # __del__-driven frees are deferred to this queue (GC-reentrancy
+        # safety — see _on_local_refs_zero)
+        self._free_queue: queue.SimpleQueue = queue.SimpleQueue()
+        self._free_thread = threading.Thread(
+            target=self._free_loop, daemon=True, name="ref-reaper")
+        self._free_thread.start()
 
         # Actor-side state (populated by become_actor)
         self.actor_id: bytes | None = None
@@ -559,13 +586,29 @@ class CoreWorker:
     # beyond its task's lifetime does not extend the object's life.
 
     def _on_local_refs_zero(self, object_id: bytes):
+        """Called from ObjectRef.__del__ — which the GC can run at ANY
+        bytecode boundary, including while this thread holds the memory
+        store lock or self._lock. Taking any lock here can self-deadlock
+        (observed: GC fired inside submit_task's memory_store.entry() and
+        the free path re-acquired the store's non-reentrant lock). So:
+        only enqueue; the reaper thread does the real work."""
         if self.stopped:
             return
-        with self._lock:
-            if self._arg_pins.get(object_id):
-                self._deferred_free.add(object_id)
+        self._free_queue.put(object_id)
+
+    def _free_loop(self):
+        while True:
+            object_id = self._free_queue.get()
+            if object_id is None or self.stopped:
                 return
-        self._free_object(object_id)
+            try:
+                with self._lock:
+                    if self._arg_pins.get(object_id):
+                        self._deferred_free.add(object_id)
+                        continue
+                self._free_object(object_id)
+            except Exception:
+                pass
 
     def _free_object(self, object_id: bytes):
         self.memory_store.free(object_id)
@@ -1152,7 +1195,13 @@ class CoreWorker:
         try:
             target = self.raylet
             opened = None
-            for _ in range(16):
+            for hop in range(17):
+                if hop == 16:
+                    # saturated cluster: stop bouncing, queue on the current
+                    # raylet (same escape valve as the lease path)
+                    spec = dict(spec)
+                    spec["strategy"] = dict(spec.get("strategy") or {})
+                    spec["strategy"]["no_spill"] = True
                 reply = target.call("create_actor", actor_id=actor_id,
                                     spec=spec, timeout=330.0)
                 if "granted" in reply:
@@ -1456,6 +1505,7 @@ class CoreWorker:
 
     def shutdown(self):
         self.stopped = True
+        self._free_queue.put(None)   # unblock the ref reaper
         self._server.stop()
         for c in (self.gcs, self.raylet):
             try:

@@ -66,8 +66,8 @@ class DashboardServer:
                 routes = ["/api/nodes", "/api/actors", "/api/objects",
                           "/api/tasks", "/api/workers",
                           "/api/placement_groups", "/api/jobs",
-                          "/api/cluster_status", "/api/memory",
-                          "/api/timeline", "/metrics"]
+                          "/api/serve", "/api/cluster_status",
+                          "/api/memory", "/api/timeline", "/metrics"]
                 body = "<html><body><h2>ray_tpu dashboard</h2><ul>" + "".join(
                     f'<li><a href="{r}">{r}</a></li>' for r in routes
                 ) + "</ul></body></html>"
@@ -92,6 +92,8 @@ class DashboardServer:
                 payload = state.list_placement_groups(address=self.address)
             elif path == "/api/jobs":
                 payload = self._jobs()
+            elif path == "/api/serve":
+                payload = self._serve_status()
             elif path == "/api/timeline":
                 payload = self._timeline()
             else:
@@ -113,6 +115,27 @@ class DashboardServer:
                 if blob:
                     out.append(json.loads(blob))
             return out
+
+    def _serve_status(self):
+        """Serve application/deployment status (reference:
+        dashboard/modules/serve). Queries the controller actor if one is
+        running in this cluster."""
+        import ray_tpu
+        from ray_tpu.serve._private.constants import (
+            CONTROLLER_NAME,
+            SERVE_NAMESPACE,
+        )
+
+        if not ray_tpu.is_initialized():
+            return {"error": "dashboard process is not connected as a "
+                             "driver; serve status needs an actor call"}
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                           namespace=SERVE_NAMESPACE)
+        except ValueError:
+            return {"applications": {}}
+        return {"applications":
+                ray_tpu.get(controller.get_app_status.remote(), timeout=10)}
 
     def _timeline(self):
         from ray_tpu._private import profiling

@@ -1,0 +1,10 @@
+"""multiprocessing.Pool API over runtime actors.
+
+Reference: python/ray/util/multiprocessing/ (Pool shim) — lets
+`multiprocessing.Pool` code scale past one machine by swapping the import.
+Pool methods map onto an actor pool; imap/imap_unordered stream results as
+they complete.
+"""
+from ray_tpu.util.multiprocessing.pool import Pool
+
+__all__ = ["Pool"]

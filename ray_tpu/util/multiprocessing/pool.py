@@ -1,0 +1,203 @@
+"""Pool — the multiprocessing.Pool surface on actors.
+
+Contract-faithful to the stdlib subset it mimics: map/imap pass each
+iterable item as ONE argument (tuples included); starmap splats. Work is
+dispatched pull-based — each worker holds at most one chunk in flight and
+idle workers pick up the next chunk as soon as they finish (the stdlib's
+shared-queue behavior; static round-robin would stall a pool behind one
+slow item).
+"""
+from __future__ import annotations
+
+import threading
+
+
+class _PoolWorker:
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_batch(self, fn, chunk, star):
+        if star:
+            return [fn(*item) for item in chunk]
+        return [fn(item) for item in chunk]
+
+
+class AsyncResult:
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def _set(self, value=None, error=None):
+        self._value = value
+        self._error = error
+        self._event.set()
+
+    def get(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def wait(self, timeout: float | None = None):
+        self._event.wait(timeout)
+
+    def ready(self) -> bool:
+        return self._event.is_set()
+
+    def successful(self) -> bool:
+        if not self._event.is_set():
+            raise ValueError("result not ready")
+        return self._error is None
+
+
+class Pool:
+    """Drop-in for multiprocessing.Pool (the commonly used subset):
+    map / map_async / starmap / imap / imap_unordered / apply /
+    apply_async / close / join / terminate; context-manager capable."""
+
+    def __init__(self, processes: int | None = None,
+                 initializer=None, initargs: tuple = ()):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._ray = ray_tpu
+        n = processes or int(ray_tpu.cluster_resources().get("CPU", 2))
+        n = max(1, min(n, 64))
+        # one CPU per worker, like the reference shim: the pool's size then
+        # actually bounds and spreads CPU use across the cluster
+        worker_cls = ray_tpu.remote(_PoolWorker)
+        self._workers = [
+            worker_cls.options(num_cpus=1).remote(initializer, initargs)
+            for _ in range(n)
+        ]
+        self._closed = False
+
+    # ------------------------------------------------------------- dispatch
+    def _chunks(self, items, chunksize):
+        if chunksize is None:
+            chunksize = max(1, len(items) // (len(self._workers) * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def _dispatch(self, fn, chunks, star):
+        """Pull-based scheduling generator: yields (chunk_index, values) as
+        chunks complete; at most one chunk in flight per worker."""
+        ray = self._ray
+        free = list(self._workers)
+        inflight: dict = {}
+        next_chunk = 0
+        while next_chunk < len(chunks) or inflight:
+            while free and next_chunk < len(chunks):
+                w = free.pop()
+                ref = w.run_batch.remote(fn, chunks[next_chunk], star)
+                inflight[ref] = (next_chunk, w)
+                next_chunk += 1
+            done, _ = ray.wait(list(inflight), num_returns=1, timeout=300)
+            if not done:
+                raise TimeoutError("pool chunk made no progress in 300s")
+            for ref in done:
+                idx, w = inflight.pop(ref)
+                free.append(w)
+                yield idx, ray.get(ref)
+
+    def _map_all(self, fn, iterable, chunksize, star):
+        self._check()
+        items = list(iterable)
+        chunks = self._chunks(items, chunksize)
+        results: list = [None] * len(chunks)
+        for idx, values in self._dispatch(fn, chunks, star):
+            results[idx] = values
+        return [v for chunk in results for v in chunk]
+
+    # ------------------------------------------------------------------ api
+    def _check(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def apply(self, fn, args: tuple = (), kwds: dict | None = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args: tuple = (), kwds: dict | None = None):
+        self._check()
+        result = AsyncResult()
+        kwds = dict(kwds or {})
+        call_args = tuple(args)
+
+        def run():
+            try:
+                out = self._map_all(
+                    lambda packed: fn(*packed[0], **packed[1]),
+                    [(call_args, kwds)], 1, star=False)
+                result._set(value=out[0])
+            except BaseException as e:  # noqa: BLE001
+                result._set(error=e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return result
+
+    def map(self, fn, iterable, chunksize: int | None = None):
+        return self._map_all(fn, iterable, chunksize, star=False)
+
+    def starmap(self, fn, iterable, chunksize: int | None = None):
+        return self._map_all(fn, iterable, chunksize, star=True)
+
+    def map_async(self, fn, iterable, chunksize: int | None = None):
+        self._check()
+        result = AsyncResult()
+
+        def run():
+            try:
+                result._set(value=self._map_all(fn, iterable, chunksize,
+                                                star=False))
+            except BaseException as e:  # noqa: BLE001
+                result._set(error=e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return result
+
+    def imap(self, fn, iterable, chunksize: int | None = None):
+        self._check()
+        items = list(iterable)
+        chunks = self._chunks(items, chunksize or 1)
+        buffered: dict = {}
+        emit = 0
+        for idx, values in self._dispatch(fn, chunks, star=False):
+            buffered[idx] = values
+            while emit in buffered:
+                yield from buffered.pop(emit)
+                emit += 1
+
+    def imap_unordered(self, fn, iterable, chunksize: int | None = None):
+        self._check()
+        items = list(iterable)
+        chunks = self._chunks(items, chunksize or 1)
+        for _idx, values in self._dispatch(fn, chunks, star=False):
+            yield from values
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+        for w in self._workers:
+            try:
+                self._ray.kill(w)
+            except Exception:
+                pass
+        self._workers = []
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.terminate()
+        return False

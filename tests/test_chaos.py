@@ -1,0 +1,157 @@
+"""Chaos tests — work completes correctly while nodes die under it.
+
+Reference tier: python/ray/tests/test_chaos.py:52-130
+(_ray_start_chaos_cluster kills raylets on an interval; tasks/actors with
+retries must still produce exact results).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def chaos_cluster(ray_start_cluster):
+    """Head + 2 expendable worker nodes, plus a killer thread that
+    terminates one worker node mid-run and replaces it."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)                       # head: driver only
+    victims = [cluster.add_node(num_cpus=2, resources={"pool": 2})
+               for _ in range(2)]
+    cluster.connect()
+    import ray_tpu
+
+    yield cluster, ray_tpu, victims
+
+
+def test_tasks_survive_node_death(chaos_cluster):
+    cluster, ray_tpu, victims = chaos_cluster
+
+    @ray_tpu.remote(num_cpus=0, resources={"pool": 0.5}, max_retries=5)
+    def work(i):
+        time.sleep(0.05)
+        return i * i
+
+    refs = [work.remote(i) for i in range(40)]
+
+    killed = threading.Event()
+
+    def killer():
+        time.sleep(0.5)           # let work get in flight
+        cluster.remove_node(victims[0])
+        cluster.add_node(num_cpus=2, resources={"pool": 2})
+        killed.set()
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    out = ray_tpu.get(refs, timeout=180)
+    t.join(timeout=30)
+    assert killed.is_set()
+    assert out == [i * i for i in range(40)]
+
+
+def test_actor_restarts_under_churn(chaos_cluster):
+    cluster, ray_tpu, victims = chaos_cluster
+
+    @ray_tpu.remote(num_cpus=0, resources={"pool": 0.5}, max_restarts=5,
+                    max_task_retries=5)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    counters = [Counter.remote() for _ in range(4)]
+    # warm them up so they're placed before the kill
+    assert ray_tpu.get([c.bump.remote() for c in counters], timeout=60) == \
+        [1, 1, 1, 1]
+    cluster.remove_node(victims[1])
+    cluster.add_node(num_cpus=2, resources={"pool": 2})
+    # survivors keep state; restarted ones restart from scratch — but every
+    # call must SUCCEED (retries reroute through the restart)
+    out = ray_tpu.get([c.bump.remote() for c in counters], timeout=120)
+    assert all(v in (1, 2) for v in out)
+    out2 = ray_tpu.get([c.bump.remote() for c in counters], timeout=120)
+    assert [b - a for a, b in zip(out, out2)] == [1, 1, 1, 1]
+
+
+def test_reconstruction_under_churn(chaos_cluster):
+    """Objects produced before the kill are transparently rebuilt for
+    consumers arriving after it."""
+    cluster, ray_tpu, victims = chaos_cluster
+
+    @ray_tpu.remote(num_cpus=0, resources={"pool": 0.5}, max_retries=3)
+    def produce(i):
+        return np.full(150_000, float(i))
+
+    @ray_tpu.remote(num_cpus=0, resources={"pool": 0.5}, max_retries=3)
+    def consume(arr):
+        return float(arr[0]) + float(arr[-1])
+
+    refs = [produce.remote(i) for i in range(6)]
+    done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=120,
+                           fetch_local=False)
+    assert len(done) == len(refs)
+    cluster.remove_node(victims[0])
+    cluster.add_node(num_cpus=2, resources={"pool": 2})
+    out = ray_tpu.get([consume.remote(r) for r in refs], timeout=180)
+    assert out == [2.0 * i for i in range(6)]
+
+
+def test_sigkill_os_node_process_recovery(tmp_path):
+    """The hardest failure mode: SIGKILL a real node OS process (no
+    graceful teardown at all) while tasks queue against its resources; a
+    replacement node joins and every retried task completes. Exercises
+    kernel-FIN connection failure, GCS death detection, transient lease
+    retry, and queue re-spillback to the new node."""
+    import json
+    import signal
+    import subprocess
+    import sys
+
+    cli = [sys.executable, "-m", "ray_tpu.scripts.cli"]
+    out = subprocess.run(cli + ["start", "--head", "--num-cpus", "2"],
+                         capture_output=True, text=True, timeout=90)
+    assert out.returncode == 0, out.stderr
+    address = [line for line in out.stdout.splitlines()
+               if line.startswith("GCS address:")][0].split(": ")[1]
+    out2 = subprocess.run(
+        cli + ["start", "--address", address, "--num-cpus", "2",
+               "--resources", json.dumps({"side": 2})],
+        capture_output=True, text=True, timeout=90)
+    assert out2.returncode == 0, out2.stderr
+    try:
+        import ray_tpu
+
+        ray_tpu.init(address=address)
+
+        @ray_tpu.remote(num_cpus=0, resources={"side": 0.5}, max_retries=5)
+        def work(i):
+            time.sleep(0.05)
+            return i * 3
+
+        refs = [work.remote(i) for i in range(20)]
+        import os as _os
+
+        pid_dir = "/tmp/ray_tpu/node_pids"
+        victim = None
+        for p in sorted(_os.listdir(pid_dir)):
+            info = json.load(open(_os.path.join(pid_dir, p)))
+            if not info.get("head"):
+                victim = int(p)
+        assert victim is not None
+        time.sleep(0.3)
+        _os.killpg(_os.getpgid(victim), signal.SIGKILL)
+        out3 = subprocess.run(
+            cli + ["start", "--address", address, "--num-cpus", "2",
+                   "--resources", json.dumps({"side": 2})],
+            capture_output=True, text=True, timeout=90)
+        assert out3.returncode == 0, out3.stderr
+        result = ray_tpu.get(refs, timeout=120)
+        assert result == [i * 3 for i in range(20)]
+        ray_tpu.shutdown()
+    finally:
+        subprocess.run(cli + ["stop"], capture_output=True, timeout=60)

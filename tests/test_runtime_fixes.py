@@ -283,3 +283,45 @@ def test_actor_ordering_survives_long_method(ray_start_regular):
     r2 = a.fast.remote()
     assert ray.get([r1, r2], timeout=60) == ["slow", "fast"]
     assert ray.get(a.log.remote(), timeout=30) == ["slow", "fast"]
+
+
+def test_ref_del_never_takes_locks(ray_start_regular):
+    """GC-reentrancy regression (scalability-envelope deadlock):
+    ObjectRef.__del__ fires _on_local_refs_zero, which the GC may run while
+    THIS thread holds the memory-store lock or the worker lock. It must
+    only enqueue — never lock — or the free path self-deadlocks."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu._private.worker_runtime import current_worker
+
+    worker = current_worker()
+    ref = ray_tpu.put(123)
+    oid = ref.id
+    # simulate __del__ firing while the allocating thread holds the store
+    # lock (exactly where the envelope run deadlocked)
+    acquired = worker.memory_store._lock.acquire()
+    assert acquired
+    try:
+        done = threading.Event()
+
+        def fire():
+            worker._on_local_refs_zero(oid)
+            done.set()
+
+        t = threading.Thread(target=fire, daemon=True)
+        t.start()
+        assert done.wait(2.0), \
+            "_on_local_refs_zero blocked while the store lock was held"
+    finally:
+        worker.memory_store._lock.release()
+    # and with the lock released, the reaper eventually frees it
+    import time
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if worker.memory_store.get_nowait(oid) is None:
+            break
+        time.sleep(0.05)
+    assert worker.memory_store.get_nowait(oid) is None
+    del ref

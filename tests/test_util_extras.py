@@ -72,3 +72,27 @@ def test_queue_across_tasks(ray_start_regular):
     assert ray.get(p, timeout=60)
     assert sorted(ray.get(c, timeout=60)) == list(range(5))
     q.shutdown()
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def square(x):
+        return x * x
+
+    def add(a, b):
+        return a + b
+
+    with Pool(processes=2) as pool:
+        assert pool.map(square, range(10)) == [x * x for x in range(10)]
+        assert pool.apply(add, (3, 4)) == 7
+        r = pool.apply_async(square, (9,))
+        assert r.get(timeout=30) == 81
+        assert sorted(pool.imap_unordered(square, range(6))) == \
+            [0, 1, 4, 9, 16, 25]
+        assert list(pool.imap(square, range(5))) == [0, 1, 4, 9, 16]
+        assert pool.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        # stdlib contract: map passes tuple items as ONE argument
+        assert pool.map(len, [(1, 2), (3, 4, 5)]) == [2, 3]
+        r = pool.map_async(square, range(4))
+        assert r.get(timeout=60) == [0, 1, 4, 9] and r.successful()
