@@ -127,3 +127,26 @@ def test_train_distributed_two_processes(ray_start_regular):
     result = trainer.fit()
     # grad mean = (1+2)/2 = 1.5 → after 2 steps w0 = -0.3
     assert abs(result.metrics["w0"] - (-0.3)) < 1e-6
+
+
+def test_dashboard_serve_route(ray_start_regular):
+    from ray_tpu import serve
+    from ray_tpu.dashboard import DashboardServer
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+
+    @serve.deployment
+    def hello(_req=None):
+        return "hi"
+
+    serve.run(hello.bind(), name="dashapp", route_prefix="/hello")
+    server = DashboardServer(address=None, port=0).start()
+    try:
+        status, body = _get(server.port, "/api/serve")
+        assert status == 200
+        apps = json.loads(body)["applications"]
+        assert apps["dashapp"]["status"] == "RUNNING"
+        assert "hello" in apps["dashapp"]["deployments"]
+    finally:
+        server.stop()
+        serve.shutdown()
