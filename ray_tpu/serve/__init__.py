@@ -8,6 +8,7 @@ in-flight-capped routing with power-of-two-choices, per-node HTTP proxies,
 long-poll config push, replica autoscaling, graceful drain, and
 model-composition deployment graphs via ``.bind()`` + handle passing.
 """
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.api import (
     Application,
     Deployment,
@@ -35,6 +36,7 @@ __all__ = [
     "HTTPOptions",
     "Request",
     "Response",
+    "batch",
     "delete",
     "deployment",
     "get_app_handle",

@@ -1,0 +1,187 @@
+"""Adaptive request batching for Serve deployments.
+
+Reference: python/ray/serve/batching.py (``@serve.batch`` — an asyncio
+queue that coalesces concurrent single requests into one call of the
+wrapped function on a list). TPU-native motivation is stronger than the
+reference's: a jitted forward pass has a fixed per-dispatch cost and the
+MXU wants large batch dimensions, so serving throughput hinges on running
+one compiled program over many queued requests instead of one program per
+request.
+
+Replica actors in this runtime execute requests on threads
+(``max_concurrency`` > 1, see serve/_private/controller.py), so the
+batcher is thread-based: callers enqueue their item and block; a single
+lazily-started batcher thread drains the queue into lists bounded by
+``max_batch_size``, waiting at most ``batch_wait_timeout_s`` after the
+first item arrives, then invokes the wrapped function once per batch and
+distributes results (or the raised exception) back to the callers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class _Pending:
+    __slots__ = ("item", "event", "result", "error")
+
+    def __init__(self, item):
+        self.item = item
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class _Batcher:
+    """Queue + single worker thread for one bound batch function."""
+
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: list[_Pending] = []
+        self._thread: threading.Thread | None = None
+
+    def submit(self, item):
+        pending = _Pending(item)
+        with self._cond:
+            self._queue.append(pending)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="serve-batcher")
+                self._thread.start()
+            self._cond.notify_all()
+        pending.event.wait()
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    def _take_batch(self) -> list[_Pending] | None:
+        """Block for the first item, then linger up to the wait timeout (or
+        until the batch fills) before cutting the batch. Returns None when
+        idle long enough to let the thread retire."""
+        with self._cond:
+            deadline = time.monotonic() + 10.0
+            while not self._queue:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            cutoff = time.monotonic() + self.batch_wait_timeout_s
+            while (len(self._queue) < self.max_batch_size):
+                remaining = cutoff - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch = self._queue[: self.max_batch_size]
+            del self._queue[: len(batch)]
+            return batch
+
+    def _loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                # Retire quietly; submit() restarts the thread on demand.
+                with self._cond:
+                    if self._queue:
+                        continue
+                    self._thread = None
+                    return
+            try:
+                results = self._fn([p.item for p in batch])
+                if results is None or len(results) != len(batch):
+                    raise TypeError(
+                        f"@serve.batch function must return a list with one "
+                        f"result per input ({len(batch)} expected, got "
+                        f"{None if results is None else len(results)})")
+                for pending, result in zip(batch, results):
+                    pending.result = result
+            except BaseException as exc:  # noqa: BLE001 — fan the error out
+                for pending in batch:
+                    pending.error = exc
+            finally:
+                for pending in batch:
+                    pending.event.set()
+
+
+class _BatchWrapper:
+    """The object ``@serve.batch`` produces. Works as a plain function
+    wrapper and as a method decorator (descriptor protocol binds one
+    batcher per instance, so two replicas in one process never share a
+    queue)."""
+
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max_batch_size = max_batch_size
+        self._batch_wait_timeout_s = batch_wait_timeout_s
+        self._batcher: _Batcher | None = None
+        self._instance_attr = f"__serve_batcher_{id(self)}"
+        self.__name__ = getattr(fn, "__name__", "batched")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def _get_batcher(self, instance=None) -> _Batcher:
+        if instance is None:
+            if self._batcher is None:
+                self._batcher = _Batcher(
+                    self._fn, self._max_batch_size,
+                    self._batch_wait_timeout_s)
+            return self._batcher
+        batcher = getattr(instance, self._instance_attr, None)
+        if batcher is None:
+            bound = self._fn.__get__(instance, type(instance))
+            batcher = _Batcher(bound, self._max_batch_size,
+                               self._batch_wait_timeout_s)
+            setattr(instance, self._instance_attr, batcher)
+        return batcher
+
+    def __call__(self, *args):
+        if len(args) != 1:
+            raise TypeError(
+                "@serve.batch functions take exactly one request argument "
+                f"per call (got {len(args)})")
+        return self._get_batcher().submit(args[0])
+
+    def __get__(self, instance, owner=None):
+        if instance is None:
+            return self
+        batcher = self._get_batcher(instance)
+
+        def bound(item):
+            return batcher.submit(item)
+
+        bound.__name__ = self.__name__
+        bound._serve_batcher = batcher
+        return bound
+
+
+def batch(fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Coalesce concurrent single-item calls into one list-in/list-out call.
+
+    Usage (method or free function)::
+
+        @serve.deployment(max_ongoing_requests=32)
+        class Model:
+            @serve.batch(max_batch_size=16, batch_wait_timeout_s=0.005)
+            def predict(self, inputs: list):
+                return my_jitted_fn(np.stack(inputs)).tolist()
+
+            def __call__(self, x):
+                return self.predict(x)
+
+    Each caller passes ONE item and receives ONE result; the wrapped
+    function always receives a list and must return an equal-length list.
+    """
+    if fn is not None:
+        return _BatchWrapper(fn, max_batch_size, batch_wait_timeout_s)
+
+    def decorate(inner):
+        return _BatchWrapper(inner, max_batch_size, batch_wait_timeout_s)
+
+    return decorate

@@ -5,12 +5,16 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401
+    ConcurrencyLimiter,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
     randint,
     uniform,
 )
+from ray_tpu.tune.trainable import Trainable  # noqa: F401
 from ray_tpu.tune.tuner import (  # noqa: F401
     ResultGrid,
     Trial,

@@ -118,3 +118,245 @@ class BasicVariantGenerator:
             else:
                 out[key] = value
         return out
+
+
+# --------------------------------------------------------------- searchers
+def flatten_domains(space: dict, prefix: str = "") -> dict:
+    """Nested param space → {dotted.path: domain-or-constant}."""
+    flat = {}
+    for key, value in space.items():
+        path = f"{prefix}{key}"
+        if isinstance(value, dict):
+            flat.update(flatten_domains(value, prefix=f"{path}."))
+        else:
+            flat[path] = value
+    return flat
+
+
+def build_config(flat_values: dict, space: dict, prefix: str = "") -> dict:
+    """{dotted.path: value} → nested config shaped like `space`."""
+    out = {}
+    for key, value in space.items():
+        path = f"{prefix}{key}"
+        if isinstance(value, dict):
+            out[key] = build_config(flat_values, value, prefix=f"{path}.")
+        elif isinstance(value, (Domain, GridSearch)):
+            out[key] = flat_values[path]
+        else:
+            out[key] = value
+    return out
+
+
+def flatten_config(config: dict, space: dict, prefix: str = "") -> dict:
+    """Nested config → {dotted.path: value} for the sampled dimensions."""
+    flat = {}
+    for key, value in space.items():
+        path = f"{prefix}{key}"
+        if isinstance(value, dict):
+            flat.update(flatten_config(config[key], value,
+                                       prefix=f"{path}."))
+        elif isinstance(value, (Domain, GridSearch)):
+            flat[path] = config[key]
+    return flat
+
+
+class Searcher:
+    """Adaptive search algorithm interface (reference:
+    tune/search/searcher.py). ``suggest`` returns the next config, or None
+    when no suggestion is currently available, or FINISHED when the search
+    space is exhausted."""
+
+    FINISHED = object()
+
+    def set_search_properties(self, metric: str | None, mode: str | None):
+        """Fill in metric/mode from the TuneConfig — only where the
+        searcher wasn't already configured directly (the reference's
+        set_search_properties returns False for the same reason: the
+        searcher's own settings must not be silently clobbered)."""
+        if getattr(self, "metric", None) is None:
+            self.metric = metric
+        if getattr(self, "mode", None) is None:
+            self.mode = mode or "max"
+
+    def suggest(self, trial_id: str):
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict):
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False):
+        pass
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions (reference:
+    tune/search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set[str] = set()
+
+    def set_search_properties(self, metric, mode):
+        super().set_search_properties(metric, mode)
+        self.searcher.set_search_properties(metric, mode)
+
+    def suggest(self, trial_id):
+        if len(self._live) >= self.max_concurrent:
+            return None
+        config = self.searcher.suggest(trial_id)
+        if config is not None and config is not Searcher.FINISHED:
+            self._live.add(trial_id)
+        return config
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (the Optuna/HyperOpt default;
+    reference integrations: tune/search/optuna/optuna_search.py,
+    tune/search/hyperopt/hyperopt_search.py — implemented natively here
+    since neither library is vendored).
+
+    After ``n_startup_trials`` random draws, observations are split at the
+    ``gamma`` quantile into good/bad sets; per-dimension Parzen (KDE)
+    densities l(x) and g(x) are built over each set and the candidate
+    maximizing l(x)/g(x) among ``n_candidates`` draws from l is suggested.
+    Numeric domains use Gaussian kernels (log-space for LogUniform);
+    Choice/Randint use smoothed categorical counts.
+    """
+
+    def __init__(self, param_space: dict | None = None,
+                 metric: str | None = None, mode: str | None = None,
+                 n_startup_trials: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: int | None = None):
+        self.param_space = param_space
+        self.metric = metric
+        self.mode = mode
+        self.n_startup_trials = n_startup_trials
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        self._observations: list[tuple[dict, float]] = []
+        self._pending: dict[str, dict] = {}
+
+    # -- domain helpers -----------------------------------------------
+    def _random_flat(self):
+        flat = {}
+        for path, dom in flatten_domains(self.param_space).items():
+            if isinstance(dom, GridSearch):
+                flat[path] = self.rng.choice(dom.values)
+            elif isinstance(dom, Domain):
+                flat[path] = dom.sample(self.rng)
+            else:
+                flat[path] = dom
+        return flat
+
+    # -- TPE core ------------------------------------------------------
+    def _sample_dim(self, dom, good_vals):
+        """Draw one value from the Parzen density fit to good_vals."""
+        import math
+
+        if isinstance(dom, (Choice, GridSearch)):
+            cats = dom.categories if isinstance(dom, Choice) else dom.values
+            weights = [1.0 + sum(1 for v in good_vals if v == c)
+                       for c in cats]
+            total = sum(weights)
+            r = self.rng.uniform(0, total)
+            acc = 0.0
+            for cat, w in zip(cats, weights):
+                acc += w
+                if r <= acc:
+                    return cat
+            return cats[-1]
+        if isinstance(dom, Randint):
+            center = self.rng.choice(good_vals)
+            width = max(1, round((dom.high - dom.low) * 0.2))
+            lo = max(dom.low, center - width)
+            hi = min(dom.high, center + width + 1)
+            return self.rng.randrange(lo, hi)
+        if isinstance(dom, LogUniform):
+            center = math.log(self.rng.choice(good_vals))
+            sigma = max((dom.log_high - dom.log_low) * 0.15, 1e-12)
+            val = self.rng.gauss(center, sigma)
+            val = min(max(val, dom.log_low), dom.log_high)
+            return math.exp(val)
+        if isinstance(dom, Uniform):
+            center = self.rng.choice(good_vals)
+            sigma = max((dom.high - dom.low) * 0.15, 1e-12)
+            val = self.rng.gauss(center, sigma)
+            return min(max(val, dom.low), dom.high)
+        return dom
+
+    def _log_density(self, dom, vals, x):
+        import math
+
+        if not vals:
+            return 0.0
+        if isinstance(dom, (Choice, GridSearch)):
+            cats = dom.categories if isinstance(dom, Choice) else dom.values
+            count = 1.0 + sum(1 for v in vals if v == x)
+            return math.log(count / (len(vals) + len(cats)))
+        if isinstance(dom, LogUniform):
+            xs = [math.log(v) for v in vals]
+            xq = math.log(x)
+            sigma = max((dom.log_high - dom.log_low) * 0.15, 1e-12)
+        elif isinstance(dom, Randint):
+            xs = [float(v) for v in vals]
+            xq = float(x)
+            sigma = max((dom.high - dom.low) * 0.2, 1.0)
+        else:
+            xs = [float(v) for v in vals]
+            xq = float(x)
+            sigma = max((dom.high - dom.low) * 0.15, 1e-12)
+        dens = sum(math.exp(-0.5 * ((xq - c) / sigma) ** 2) for c in xs)
+        return math.log(max(dens / (len(xs) * sigma), 1e-300))
+
+    def suggest(self, trial_id):
+        if self.param_space is None:
+            raise ValueError("TPESearcher needs a param_space (pass it to "
+                             "the searcher or via Tuner(param_space=...))")
+        if len(self._observations) < self.n_startup_trials:
+            flat = self._random_flat()
+        else:
+            scored = sorted(self._observations, key=lambda o: o[1],
+                            reverse=((self.mode or "max") == "max"))
+            n_good = max(1, int(len(scored) * self.gamma))
+            good = [flatten_config(c, self.param_space)
+                    for c, _ in scored[:n_good]]
+            bad = [flatten_config(c, self.param_space)
+                   for c, _ in scored[n_good:]]
+            domains = flatten_domains(self.param_space)
+            best_flat, best_score = None, -float("inf")
+            for _ in range(self.n_candidates):
+                cand = {}
+                score = 0.0
+                for path, dom in domains.items():
+                    if not isinstance(dom, (Domain, GridSearch)):
+                        cand[path] = dom
+                        continue
+                    good_vals = [g[path] for g in good]
+                    bad_vals = [b[path] for b in bad]
+                    x = self._sample_dim(dom, good_vals)
+                    cand[path] = x
+                    score += (self._log_density(dom, good_vals, x)
+                              - self._log_density(dom, bad_vals, x))
+                if score > best_score:
+                    best_flat, best_score = cand, score
+            flat = best_flat
+        config = build_config(flat, self.param_space)
+        self._pending[trial_id] = config
+        return config
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        config = self._pending.pop(trial_id, None)
+        if config is None or error or not result:
+            return
+        if self.metric and self.metric in result:
+            self._observations.append((config, float(result[self.metric])))

@@ -24,13 +24,15 @@ from ray_tpu.tune.search import BasicVariantGenerator
 class TuneConfig:
     def __init__(self, num_samples: int = 1, max_concurrent_trials: int = 0,
                  metric: str | None = None, mode: str = "max",
-                 scheduler=None, seed: int | None = None):
+                 scheduler=None, seed: int | None = None,
+                 search_alg=None):
         self.num_samples = num_samples
         self.max_concurrent_trials = max_concurrent_trials
         self.metric = metric
         self.mode = mode
         self.scheduler = scheduler
         self.seed = seed
+        self.search_alg = search_alg
 
 
 class Trial:
@@ -174,6 +176,21 @@ class TrialRunner:
         os.replace(tmp, os.path.join(self.experiment_dir,
                                      "experiment_state.json"))
 
+    def _notify_searcher(self, trial: Trial):
+        searcher = self.tune_config.search_alg
+        if searcher is None:
+            return
+        try:
+            searcher.on_trial_complete(
+                trial.trial_id, result=trial.last_result or None,
+                error=trial.status == "ERROR")
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "searcher.on_trial_complete failed for trial %s",
+                trial.trial_id, exc_info=True)
+
     def get_trial(self, trial_id: str) -> Trial | None:
         for t in self.trials:
             if t.trial_id == trial_id:
@@ -186,12 +203,34 @@ class TrialRunner:
         self._pending_exploits.append((trial, source, new_config))
 
     def run(self) -> list[Trial]:
-        limit = self.tune_config.max_concurrent_trials or len(self.trials)
+        from ray_tpu.tune.search import Searcher as _Searcher
+
+        searcher = self.tune_config.search_alg
+        limit = (self.tune_config.max_concurrent_trials
+                 or (len(self.trials) if searcher is None else 4))
         active: list[Trial] = []
         # restored experiments carry finished trials — don't re-run them
         queue = [t for t in self.trials
                  if t.status not in ("TERMINATED", "STOPPED")]
-        while queue or active:
+        searcher_done = searcher is None
+        while queue or active or not searcher_done:
+            # adaptive mode: ask the searcher for configs while slots free
+            while (not searcher_done and not queue
+                   and len(active) < limit
+                   and len(self.trials) < self.tune_config.num_samples):
+                trial = Trial(None)
+                config = searcher.suggest(trial.trial_id)
+                if config is _Searcher.FINISHED:
+                    searcher_done = True
+                    break
+                if config is None:     # limiter saturated / not ready
+                    break
+                trial.config = config
+                self.trials.append(trial)
+                queue.append(trial)
+            if (not searcher_done
+                    and len(self.trials) >= self.tune_config.num_samples):
+                searcher_done = True
             while queue and len(active) < limit:
                 trial = queue.pop(0)
                 self._start_trial(trial)
@@ -208,12 +247,22 @@ class TrialRunner:
                     trial.error = row.get("error")
                     self._stop_actor(trial)
                     active.remove(trial)
+                    self._notify_searcher(trial)
                     self.save_experiment_state()
                     continue
                 trial.iteration = row.get("iteration", trial.iteration + 1)
                 metrics = dict(row["metrics"])
                 metrics.setdefault("training_iteration", trial.iteration)
                 trial.results.append(metrics)
+                if searcher is not None:
+                    try:
+                        searcher.on_trial_result(trial.trial_id, metrics)
+                    except Exception:
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "searcher.on_trial_result failed for trial %s",
+                            trial.trial_id, exc_info=True)
                 if row.get("checkpoint") is not None:
                     self._on_trial_checkpoint(trial, row["checkpoint"],
                                               metrics)
@@ -221,6 +270,7 @@ class TrialRunner:
                     trial.status = "TERMINATED"
                     self._stop_actor(trial)
                     active.remove(trial)
+                    self._notify_searcher(trial)
                     self.save_experiment_state()
                     continue
                 decision = self.scheduler.on_result(trial, metrics, self)
@@ -228,6 +278,7 @@ class TrialRunner:
                     trial.status = "STOPPED"
                     self._stop_actor(trial)
                     active.remove(trial)
+                    self._notify_searcher(trial)
                 self.save_experiment_state()
             for trial, source, new_config in self._pending_exploits:
                 if trial in active:
@@ -374,6 +425,23 @@ class Tuner:
     def fit(self) -> ResultGrid:
         if getattr(self, "_restored_trials", None) is not None:
             trials = self._restored_trials
+        elif self.tune_config.search_alg is not None:
+            # Adaptive mode: the searcher supplies configs one at a time as
+            # slots free up (reference: trial runner + SearchGenerator).
+            searcher = self.tune_config.search_alg
+            if getattr(searcher, "param_space", None) is None and hasattr(
+                    searcher, "param_space"):
+                searcher.param_space = self.param_space
+            inner = getattr(searcher, "searcher", None)
+            if inner is not None and getattr(inner, "param_space",
+                                             None) is None:
+                inner.param_space = self.param_space
+            searcher.set_search_properties(self.tune_config.metric,
+                                           self.tune_config.mode)
+            # a searcher configured directly wins for result selection too
+            if self.tune_config.metric is None:
+                self.tune_config.metric = getattr(searcher, "metric", None)
+            trials = []
         else:
             configs = BasicVariantGenerator(
                 self.param_space, self.tune_config.num_samples,
@@ -382,7 +450,7 @@ class Tuner:
         runner = TrialRunner(self.trainable, trials, self.tune_config,
                              self.run_config, self.resources_per_trial)
         runner.run()
-        return ResultGrid(trials, self.tune_config.metric,
+        return ResultGrid(runner.trials, self.tune_config.metric,
                           self.tune_config.mode)
 
     @classmethod

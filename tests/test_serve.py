@@ -263,3 +263,70 @@ def test_redeploy_scales_and_deletes(serve_instance):
             break
         time.sleep(0.1)
     assert "scale" not in serve.status()
+
+
+def test_batch_decorator_unit():
+    """@serve.batch coalesces concurrent callers (no cluster needed)."""
+    from ray_tpu.serve.batching import batch
+
+    sizes = []
+
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    def square_all(items):
+        sizes.append(len(items))
+        return [x * x for x in items]
+
+    results = [None] * 8
+    threads = [threading.Thread(target=lambda i=i: results.__setitem__(
+        i, square_all(i))) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [i * i for i in range(8)]
+    assert max(sizes) > 1, f"no coalescing happened: {sizes}"
+    assert all(s <= 4 for s in sizes)
+
+
+def test_batch_decorator_method_and_errors():
+    from ray_tpu.serve.batching import batch
+
+    class Model:
+        @batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+        def run(self, items):
+            if any(x < 0 for x in items):
+                raise ValueError("negative")
+            return [x + 1 for x in items]
+
+    m1, m2 = Model(), Model()
+    assert m1.run(1) == 2
+    assert m2.run(10) == 11  # separate instance, separate batcher
+    with pytest.raises(ValueError):
+        m1.run(-5)
+    # batcher recovers after an error batch
+    assert m1.run(3) == 4
+
+
+def test_batch_in_deployment(serve_instance):
+    serve = serve_instance
+
+    @serve.deployment(max_ongoing_requests=16)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        def predict(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 10 for x in items]
+
+        def __call__(self, x):
+            return self.predict(x)
+
+        def max_seen(self, _=None):
+            return max(self.batch_sizes) if self.batch_sizes else 0
+
+    handle = serve.run(Batched.bind(), name="batched", route_prefix=None)
+    responses = [handle.remote(i) for i in range(12)]
+    assert [r.result() for r in responses] == [i * 10 for i in range(12)]
+    assert handle.max_seen.remote(None).result() > 1

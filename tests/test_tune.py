@@ -130,3 +130,59 @@ def test_trainer_in_tuner(ray_start_regular):
         tune_config=tune.TuneConfig(metric="final", mode="max"),
     ).fit()
     assert grid.get_best_result().metrics["final"] == 21
+
+
+def test_tpe_searcher_unit():
+    """TPE concentrates samples near the optimum after startup trials
+    (pure searcher loop, no cluster)."""
+    from ray_tpu.tune.search import TPESearcher, uniform
+
+    searcher = TPESearcher(
+        param_space={"x": uniform(-10, 10)},
+        metric="score", mode="max", n_startup_trials=8, seed=0)
+    late = []
+    for i in range(60):
+        tid = f"t{i}"
+        config = searcher.suggest(tid)
+        score = -(config["x"] - 3.0) ** 2
+        searcher.on_trial_complete(tid, result={"score": score})
+        if i >= 40:
+            late.append(config["x"])
+    # after exploration the sampler should hover near x=3
+    assert abs(float(np.median(late)) - 3.0) < 2.0, np.median(late)
+
+
+def test_concurrency_limiter_unit():
+    from ray_tpu.tune.search import ConcurrencyLimiter, TPESearcher, uniform
+
+    inner = TPESearcher(param_space={"x": uniform(0, 1)},
+                        metric="m", mode="max", seed=1)
+    limiter = ConcurrencyLimiter(inner, max_concurrent=2)
+    limiter.set_search_properties("m", "max")
+    assert limiter.suggest("a") is not None
+    assert limiter.suggest("b") is not None
+    assert limiter.suggest("c") is None          # saturated
+    limiter.on_trial_complete("a", result={"m": 1.0})
+    assert limiter.suggest("c") is not None       # slot freed
+
+
+def test_tuner_with_search_alg(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu import tune
+
+    def objective(config):
+        from ray_tpu.air import session
+
+        session.report({"score": -(config["x"] - 3) ** 2})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(-10, 10)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=12,
+            max_concurrent_trials=3,
+            search_alg=tune.TPESearcher(n_startup_trials=4, seed=0)),
+    ).fit()
+    assert len(grid) == 12
+    best = grid.get_best_result()
+    assert best.metrics["score"] > -20   # found the neighborhood of x=3
