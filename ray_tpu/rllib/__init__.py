@@ -8,11 +8,20 @@ store — the async sample/learn split of
 rllib/execution/multi_gpu_learner_thread.py:20 with the object store as
 the ring buffer and the compiled jax update as the device step.
 """
-from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, PPO
+from ray_tpu.rllib.algorithm import DQN, Algorithm, AlgorithmConfig, PPO
 from ray_tpu.rllib.env import CartPole, make_env
 from ray_tpu.rllib.models import init_policy, policy_apply
-from ray_tpu.rllib.rollout_worker import RolloutWorker, concat_batches
+from ray_tpu.rllib.replay_buffer import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+from ray_tpu.rllib.rollout_worker import (
+    RolloutWorker,
+    TransitionWorker,
+    concat_batches,
+)
 
-__all__ = ["Algorithm", "AlgorithmConfig", "CartPole", "PPO",
-           "RolloutWorker", "concat_batches", "init_policy", "make_env",
+__all__ = ["Algorithm", "AlgorithmConfig", "CartPole", "DQN", "PPO",
+           "PrioritizedReplayBuffer", "ReplayBuffer", "RolloutWorker",
+           "TransitionWorker", "concat_batches", "init_policy", "make_env",
            "policy_apply"]

@@ -18,7 +18,11 @@ import optax
 
 import ray_tpu
 from ray_tpu.rllib.models import init_policy, policy_apply
-from ray_tpu.rllib.rollout_worker import RolloutWorker, concat_batches
+from ray_tpu.rllib.rollout_worker import (
+    RolloutWorker,
+    TransitionWorker,
+    concat_batches,
+)
 
 
 class AlgorithmConfig:
@@ -39,6 +43,16 @@ class AlgorithmConfig:
         self.vf_coeff = 0.5
         self.entropy_coeff = 0.01
         self.seed = 0
+        # value-based (DQN-family) knobs
+        self.buffer_capacity = 50_000
+        self.learning_starts = 500
+        self.target_update_freq = 4
+        self.num_sgd_steps = 32
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_anneal_iters = 15
+        self.double_q = True
+        self.prioritized_replay = False
 
     def environment(self, env):
         self.env_spec = env
@@ -54,14 +68,10 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         return self
 
-    def training(self, *, lr=None, gamma=None, clip_param=None,
-                 entropy_coeff=None, minibatch_size=None,
-                 train_batch_epochs=None):
-        for name, v in [("lr", lr), ("gamma", gamma),
-                        ("clip_param", clip_param),
-                        ("entropy_coeff", entropy_coeff),
-                        ("minibatch_size", minibatch_size),
-                        ("train_batch_epochs", train_batch_epochs)]:
+    def training(self, **kwargs):
+        for name, v in kwargs.items():
+            if not hasattr(self, name):
+                raise ValueError(f"unknown training option {name!r}")
             if v is not None:
                 setattr(self, name, v)
         return self
@@ -73,9 +83,11 @@ class AlgorithmConfig:
 class Algorithm:
     """Own a WorkerSet of rollout actors + a jax learner state."""
 
+    worker_cls = RolloutWorker
+
     def __init__(self, config: AlgorithmConfig):
         self.config = config
-        worker_cls = ray_tpu.remote(RolloutWorker)
+        worker_cls = ray_tpu.remote(type(self).worker_cls)
         self.workers = [
             worker_cls.options(num_cpus=0).remote(
                 config.env_spec, num_envs=config.num_envs_per_worker,
@@ -92,9 +104,7 @@ class Algorithm:
     def train(self) -> dict:
         t0 = time.time()
         self.iteration += 1
-        batch_refs = [w.sample.remote(self.params,
-                                      self.config.rollout_fragment_length)
-                      for w in self.workers]
+        batch_refs = [self._sample_call(w) for w in self.workers]
         batches = ray_tpu.get(batch_refs, timeout=300)
         batch = concat_batches(batches)
         returns = batch.pop("episode_returns")
@@ -110,6 +120,12 @@ class Algorithm:
             "time_this_iter_s": time.time() - t0,
         })
         return metrics
+
+    def _sample_call(self, worker):
+        """One worker's async sample submission; subclasses override to
+        change the sampling mode (e.g. epsilon-greedy transitions)."""
+        return worker.sample.remote(self.params,
+                                    self.config.rollout_fragment_length)
 
     def training_step(self, batch) -> dict:
         raise NotImplementedError
@@ -183,3 +199,103 @@ class PPO(Algorithm):
                 self.params, self.opt_state, aux = self._update(
                     self.params, self.opt_state, mb)
         return {k: float(v) for k, v in aux.items()}
+
+
+class DQN(Algorithm):
+    """Double DQN with (optionally prioritized) replay (reference:
+    rllib/algorithms/dqn/dqn.py — sampling actors feed a replay buffer,
+    the jitted learner does Q-updates against a periodically-synced
+    target network)."""
+
+    worker_cls = TransitionWorker
+
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        from ray_tpu.rllib.replay_buffer import (
+            PrioritizedReplayBuffer,
+            ReplayBuffer,
+        )
+
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+        self.buffer = (PrioritizedReplayBuffer(config.buffer_capacity,
+                                               seed=config.seed)
+                       if config.prioritized_replay
+                       else ReplayBuffer(config.buffer_capacity,
+                                         seed=config.seed))
+        cfg = config
+
+        def loss_fn(params, target_params, mb):
+            q, _ = policy_apply(params, mb["obs"])
+            q_taken = jnp.take_along_axis(
+                q, mb["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+            q_next_t, _ = policy_apply(target_params, mb["next_obs"])
+            if cfg.double_q:
+                q_next_o, _ = policy_apply(params, mb["next_obs"])
+                next_a = jnp.argmax(q_next_o, axis=-1)
+            else:
+                next_a = jnp.argmax(q_next_t, axis=-1)
+            next_q = jnp.take_along_axis(
+                q_next_t, next_a[:, None], axis=-1)[:, 0]
+            target = mb["rewards"] + cfg.gamma * (1.0 - mb["dones"]) * next_q
+            td = q_taken - jax.lax.stop_gradient(target)
+            huber = jnp.where(jnp.abs(td) <= 1.0, 0.5 * td * td,
+                              jnp.abs(td) - 0.5)
+            weights = mb.get("weights")
+            loss = (jnp.mean(huber * weights) if weights is not None
+                    else jnp.mean(huber))
+            return loss, {"td_error": td, "mean_q": jnp.mean(q_taken)}
+
+        def update(params, target_params, opt_state, mb):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, mb)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            aux["loss"] = loss
+            return params, opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_anneal_iters))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def _sample_call(self, worker):
+        return worker.sample_transitions.remote(
+            self.params, self.config.rollout_fragment_length,
+            self.epsilon())
+
+    def training_step(self, batch) -> dict:
+        self.buffer.add_batch(batch)
+        metrics = {}
+        if len(self.buffer) >= self.config.learning_starts:
+            for _ in range(self.config.num_sgd_steps):
+                mb = self.buffer.sample(self.config.minibatch_size)
+                idx = mb.pop("batch_indexes", None)
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.target_params, self.opt_state, mb)
+                if idx is not None:
+                    self.buffer.update_priorities(
+                        idx, np.asarray(aux["td_error"]))
+            metrics = {"loss": float(aux["loss"]),
+                       "mean_q": float(aux["mean_q"])}
+            if self.iteration % self.config.target_update_freq == 0:
+                self.target_params = jax.tree_util.tree_map(
+                    jnp.copy, self.params)
+        metrics.update({"epsilon": self.epsilon(),
+                        "replay_buffer_size": len(self.buffer)})
+        return metrics
+
+    def save(self) -> dict:
+        return {"params": self.params, "iteration": self.iteration,
+                "target_params": self.target_params}
+
+    def restore(self, state: dict):
+        super().restore(state)
+        self.target_params = state.get("target_params", self.params)

@@ -1,0 +1,84 @@
+"""Replay buffers for off-policy algorithms.
+
+Reference: rllib/utils/replay_buffers/replay_buffer.py (ring storage,
+uniform sampling) and prioritized_episode_replay_buffer.py. Storage here
+is preallocated numpy rings per column — batches slice out without any
+per-row Python, matching the columnar block convention of ray_tpu.data.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Uniform ring-buffer over columnar transition batches."""
+
+    def __init__(self, capacity: int = 100_000, seed: int | None = None):
+        self.capacity = capacity
+        self._cols: dict[str, np.ndarray] | None = None
+        self._size = 0
+        self._next = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, batch: dict):
+        """Append a columnar batch {name: array[N, ...]}; oldest rows are
+        overwritten once capacity is reached."""
+        n = len(next(iter(batch.values())))
+        if self._cols is None:
+            self._cols = {
+                k: np.zeros((self.capacity,) + v.shape[1:], v.dtype)
+                for k, v in batch.items()
+            }
+        idx = (self._next + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._cols[k][idx] = v
+        self._next = (self._next + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def sample(self, batch_size: int) -> dict:
+        if self._size == 0:
+            raise ValueError("cannot sample from an empty replay buffer")
+        idx = self._rng.integers(0, self._size, batch_size)
+        return {k: v[idx] for k, v in self._cols.items()}
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (reference:
+    rllib/utils/replay_buffers/prioritized_replay_buffer.py). Priorities
+    are stored per-row; `sample` returns importance weights and the row
+    indices so the learner can call `update_priorities` with new TD
+    errors."""
+
+    def __init__(self, capacity: int = 100_000, alpha: float = 0.6,
+                 beta: float = 0.4, seed: int | None = None):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self._prios = np.zeros((capacity,), np.float64)
+        self._max_prio = 1.0
+
+    def add_batch(self, batch: dict):
+        n = len(next(iter(batch.values())))
+        idx = (self._next + np.arange(n)) % self.capacity
+        self._prios[idx] = self._max_prio
+        super().add_batch(batch)
+
+    def sample(self, batch_size: int) -> dict:
+        if self._size == 0:
+            raise ValueError("cannot sample from an empty replay buffer")
+        p = self._prios[: self._size] ** self.alpha
+        p = p / p.sum()
+        idx = self._rng.choice(self._size, batch_size, p=p)
+        out = {k: v[idx] for k, v in self._cols.items()}
+        weights = (self._size * p[idx]) ** (-self.beta)
+        out["weights"] = (weights / weights.max()).astype(np.float32)
+        out["batch_indexes"] = idx.astype(np.int64)
+        return out
+
+    def update_priorities(self, indexes: np.ndarray, td_errors: np.ndarray):
+        prios = np.abs(td_errors) + 1e-6
+        self._prios[indexes] = prios
+        self._max_prio = max(self._max_prio, float(prios.max()))

@@ -104,3 +104,55 @@ def _logsumexp(x):
 def concat_batches(batches: list[dict]) -> dict:
     return {k: np.concatenate([b[k] for b in batches])
             for k in batches[0]}
+
+
+class TransitionWorker(RolloutWorker):
+    """Sampling actor for value-based algorithms (DQN family): returns raw
+    (s, a, r, s', done) transitions for a replay buffer instead of
+    GAE-processed on-policy batches (reference:
+    rllib/evaluation/rollout_worker.py used with _disable_preprocessing +
+    ReplayBuffer connectors)."""
+
+    def sample_transitions(self, params, steps_per_env: int,
+                           epsilon: float) -> dict:
+        E = len(self.envs)
+        T = steps_per_env
+        obs = np.zeros((T, E, self.obs_size), np.float32)
+        next_obs = np.zeros((T, E, self.obs_size), np.float32)
+        actions = np.zeros((T, E), np.int32)
+        rewards = np.zeros((T, E), np.float32)
+        dones = np.zeros((T, E), np.float32)
+
+        for t in range(T):
+            stacked = np.stack(self._obs)
+            q, _ = self._fwd(params, stacked)
+            act = np.asarray(np.argmax(q, axis=-1))
+            explore = self._rng.random(E) < epsilon
+            act = np.where(explore,
+                           self._rng.integers(0, self.num_actions, E), act)
+            obs[t] = stacked
+            actions[t] = act
+            for e, env in enumerate(self.envs):
+                nobs, r, terminated, truncated, _ = env.step(int(act[e]))
+                rewards[t, e] = r
+                self._episode_returns[e] += r
+                # truncation is not a true terminal: bootstrapping through
+                # it is correct, so done=terminated only
+                dones[t, e] = 1.0 if terminated else 0.0
+                next_obs[t, e] = nobs
+                if terminated or truncated:
+                    self._completed.append(self._episode_returns[e])
+                    self._episode_returns[e] = 0.0
+                    nobs = env.reset()[0]
+                self._obs[e] = nobs
+
+        flat = lambda a: a.reshape((T * E,) + a.shape[2:])
+        completed, self._completed = self._completed, []
+        return {
+            "obs": flat(obs),
+            "actions": flat(actions),
+            "rewards": flat(rewards),
+            "next_obs": flat(next_obs),
+            "dones": flat(dones),
+            "episode_returns": np.asarray(completed, np.float32),
+        }

@@ -422,25 +422,31 @@ class Tuner:
         self.run_config = run_config or RunConfig()
         self.resources_per_trial = resources_per_trial
 
+    def _init_searcher(self):
+        """Hand the searcher its param space + metric/mode (adaptive mode;
+        reference: trial runner + SearchGenerator). Called for fresh AND
+        restored experiments — a restored run keeps suggesting up to
+        num_samples."""
+        searcher = self.tune_config.search_alg
+        for s in (searcher, getattr(searcher, "searcher", None)):
+            if s is not None and hasattr(s, "param_space") \
+                    and s.param_space is None:
+                s.param_space = self.param_space
+        searcher.set_search_properties(self.tune_config.metric,
+                                       self.tune_config.mode)
+        # a searcher configured directly wins for result selection too
+        if self.tune_config.metric is None:
+            self.tune_config.metric = (
+                getattr(searcher, "metric", None)
+                or getattr(getattr(searcher, "searcher", None),
+                           "metric", None))
+
     def fit(self) -> ResultGrid:
+        if self.tune_config.search_alg is not None:
+            self._init_searcher()
         if getattr(self, "_restored_trials", None) is not None:
             trials = self._restored_trials
         elif self.tune_config.search_alg is not None:
-            # Adaptive mode: the searcher supplies configs one at a time as
-            # slots free up (reference: trial runner + SearchGenerator).
-            searcher = self.tune_config.search_alg
-            if getattr(searcher, "param_space", None) is None and hasattr(
-                    searcher, "param_space"):
-                searcher.param_space = self.param_space
-            inner = getattr(searcher, "searcher", None)
-            if inner is not None and getattr(inner, "param_space",
-                                             None) is None:
-                inner.param_space = self.param_space
-            searcher.set_search_properties(self.tune_config.metric,
-                                           self.tune_config.mode)
-            # a searcher configured directly wins for result selection too
-            if self.tune_config.metric is None:
-                self.tune_config.metric = getattr(searcher, "metric", None)
             trials = []
         else:
             configs = BasicVariantGenerator(

@@ -62,3 +62,60 @@ def test_ppo_cartpole_converges(ray_start_regular):
         assert algo.iteration == state["iteration"]
     finally:
         algo.stop()
+
+
+def test_replay_buffer_ring_and_sampling():
+    from ray_tpu.rllib import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=10, seed=0)
+    buf.add_batch({"x": np.arange(6, dtype=np.float32)})
+    assert len(buf) == 6
+    buf.add_batch({"x": np.arange(6, 14, dtype=np.float32)})
+    assert len(buf) == 10          # capacity-bounded
+    sample = buf.sample(32)
+    assert sample["x"].shape == (32,)
+    # rows 0-3 were overwritten by the wrap-around (values 10-13)
+    assert set(sample["x"].tolist()) <= set(range(4, 14))
+
+
+def test_prioritized_replay_buffer():
+    from ray_tpu.rllib import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(capacity=100, seed=0)
+    buf.add_batch({"x": np.arange(50, dtype=np.float32)})
+    s = buf.sample(16)
+    assert "weights" in s and "batch_indexes" in s
+    # boost one row's priority and confirm it dominates sampling
+    buf.update_priorities(np.array([7]), np.array([100.0]))
+    counts = 0
+    for _ in range(20):
+        counts += int((buf.sample(16)["batch_indexes"] == 7).sum())
+    assert counts > 20, f"prioritized row rarely sampled ({counts})"
+
+
+def test_dqn_cartpole_learns(ray_start_regular):
+    """DQN on CartPole: epsilon-greedy transitions through the object
+    store into a replay buffer; double-Q learner improves the policy
+    well past the random-policy return (~22)."""
+    from ray_tpu.rllib import DQN, AlgorithmConfig
+
+    algo = (AlgorithmConfig(DQN)
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_fragment_length=64)
+            .training(lr=2e-3, minibatch_size=128, num_sgd_steps=64,
+                      learning_starts=256, epsilon_anneal_iters=8,
+                      target_update_freq=2)
+            .build())
+    try:
+        best = 0.0
+        for _ in range(45):
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+            if best >= 80.0:
+                break
+        assert best >= 60.0, f"DQN failed to learn: best reward {best}"
+        state = algo.save()
+        algo.restore(state)
+    finally:
+        algo.stop()
