@@ -79,6 +79,16 @@ def init(address=None, *, num_cpus=None, num_tpus=None, num_gpus=None,
             _namespace = namespace
         if num_tpus is None and num_gpus is not None:
             num_tpus = num_gpus
+        if isinstance(address, str) and address.startswith("ray://"):
+            # client mode: everything proxies through one endpoint
+            # (reference: util/client/, ray.init("ray://...") at
+            # worker.py:1031)
+            from ray_tpu.util.client import connect
+
+            ctx = connect(address[len("ray://"):])
+            set_current_worker(ctx)
+            atexit.register(shutdown)
+            return RayContext(ctx)
         if address in (None, "local"):
             _global_node = _LocalNode(num_cpus, num_tpus, resources,
                                       object_store_memory)
@@ -172,6 +182,10 @@ def kill(actor, *, no_restart=True):
     if not isinstance(actor, ActorHandle):
         raise TypeError("kill() takes an ActorHandle")
     worker = _require_worker()
+    if getattr(worker, "mode", None) == "client":
+        # raylet addresses are cluster-internal; the proxy kills for us
+        worker.kill_actor(actor._actor_id, no_restart=no_restart)
+        return
     info = worker.gcs.call("get_actor", actor_id=actor._actor_id)
     if info is None:
         return
@@ -225,6 +239,8 @@ def cluster_resources():
 
 def available_resources():
     worker = _require_worker()
+    if getattr(worker, "mode", None) == "client":
+        return worker.available_resources()
     from ray_tpu._private.protocol import RpcClient
 
     total = {}
@@ -257,9 +273,12 @@ def timeline(filename=None):
     from ray_tpu.experimental.state.api import _each_raylet
 
     worker = _require_worker()
-    events = profiling.snapshot()             # this process (driver)
-    events.extend(_each_raylet(worker.gcs.call, "profile_events"))
-    trace = profiling.to_chrome_trace(events)
+    if getattr(worker, "mode", None) == "client":
+        trace = worker._rpc.call("client_timeline")
+    else:
+        events = profiling.snapshot()         # this process (driver)
+        events.extend(_each_raylet(worker.gcs.call, "profile_events"))
+        trace = profiling.to_chrome_trace(events)
     if filename:
         import json
 

@@ -34,6 +34,20 @@ class RolloutWorker:
     def spaces(self):
         return self.obs_size, self.num_actions
 
+    def _env_step(self, e: int, action: int):
+        """Step env e, handle episode bookkeeping + auto-reset. Returns
+        (next_obs_before_reset, reward, terminated, truncated); self._obs[e]
+        ends up at the obs the NEXT action should see."""
+        nobs, r, terminated, truncated, _ = self.envs[e].step(int(action))
+        self._episode_returns[e] += r
+        if terminated or truncated:
+            self._completed.append(self._episode_returns[e])
+            self._episode_returns[e] = 0.0
+            self._obs[e] = self.envs[e].reset()[0]
+        else:
+            self._obs[e] = nobs
+        return nobs, r, terminated, truncated
+
     def sample(self, params, steps_per_env: int) -> dict:
         """Collect steps_per_env transitions from every env; returns a
         SampleBatch with GAE advantages and value targets."""
@@ -59,16 +73,11 @@ class RolloutWorker:
             actions[t] = act
             values[t] = v
             logps[t] = logp_all[np.arange(E), act]
-            for e, env in enumerate(self.envs):
-                nobs, r, terminated, truncated, _ = env.step(int(act[e]))
+            for e in range(E):
+                _, r, terminated, truncated = self._env_step(e, act[e])
                 rewards[t, e] = r
-                self._episode_returns[e] += r
                 if terminated or truncated:
                     dones[t, e] = 1.0
-                    self._completed.append(self._episode_returns[e])
-                    self._episode_returns[e] = 0.0
-                    nobs = env.reset()[0]
-                self._obs[e] = nobs
 
         # bootstrap value for the final observation
         _, last_v = self._fwd(params, np.stack(self._obs))
@@ -132,19 +141,13 @@ class TransitionWorker(RolloutWorker):
                            self._rng.integers(0, self.num_actions, E), act)
             obs[t] = stacked
             actions[t] = act
-            for e, env in enumerate(self.envs):
-                nobs, r, terminated, truncated, _ = env.step(int(act[e]))
+            for e in range(E):
+                nobs, r, terminated, _ = self._env_step(e, act[e])
                 rewards[t, e] = r
-                self._episode_returns[e] += r
                 # truncation is not a true terminal: bootstrapping through
                 # it is correct, so done=terminated only
                 dones[t, e] = 1.0 if terminated else 0.0
                 next_obs[t, e] = nobs
-                if terminated or truncated:
-                    self._completed.append(self._episode_returns[e])
-                    self._episode_returns[e] = 0.0
-                    nobs = env.reset()[0]
-                self._obs[e] = nobs
 
         flat = lambda a: a.reshape((T * E,) + a.shape[2:])
         completed, self._completed = self._completed, []

@@ -430,7 +430,9 @@ class Tuner:
         searcher = self.tune_config.search_alg
         for s in (searcher, getattr(searcher, "searcher", None)):
             if s is not None and hasattr(s, "param_space") \
-                    and s.param_space is None:
+                    and s.param_space is None and self.param_space:
+                # only a real space; a searcher left with None fails fast in
+                # suggest() instead of silently proposing empty configs
                 s.param_space = self.param_space
         searcher.set_search_properties(self.tune_config.metric,
                                        self.tune_config.mode)
@@ -461,6 +463,7 @@ class Tuner:
 
     @classmethod
     def restore(cls, path: str, trainable, *,
+                param_space: dict | None = None,
                 tune_config: TuneConfig | None = None,
                 run_config: RunConfig | None = None,
                 resources_per_trial: dict | None = None) -> "Tuner":
@@ -469,7 +472,9 @@ class Tuner:
         unfinished ones re-run from their latest persisted checkpoint.
         Pass the original run_config to preserve stop criteria and
         checkpoint policy (they are not serialized in the state file);
-        name/storage_path are overridden to point at `path`."""
+        name/storage_path are overridden to point at `path`. Pass the
+        original param_space when resuming with a search_alg so it can
+        keep suggesting."""
         import dataclasses
         import json
         import os
@@ -483,8 +488,8 @@ class Tuner:
             base,
             name=os.path.basename(path.rstrip("/")),
             storage_path=os.path.dirname(path.rstrip("/")))
-        tuner = cls(trainable, tune_config=tune_config,
-                    run_config=run_config,
+        tuner = cls(trainable, param_space=param_space,
+                    tune_config=tune_config, run_config=run_config,
                     resources_per_trial=resources_per_trial)
         trials = []
         for row in state["trials"]:

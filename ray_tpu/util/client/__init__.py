@@ -1,0 +1,10 @@
+"""Client mode ("ray://") — drive a cluster through one proxy endpoint.
+
+Reference: python/ray/util/client/ (~6k LoC; SURVEY.md §2.2 "Ray Client").
+Server side: start `ClientServer` (or `serve()`) in any driver process.
+Client side: ``ray_tpu.init(address="ray://host:port")``.
+"""
+from ray_tpu.util.client.client import ClientContext, connect
+from ray_tpu.util.client.server import ClientServer, serve
+
+__all__ = ["ClientContext", "ClientServer", "connect", "serve"]

@@ -1,0 +1,179 @@
+"""Client-mode driver — connects to a ClientServer over one socket.
+
+Reference: python/ray/util/client/ (RayAPIStub / ClientContext,
+architecture in util/client/ARCHITECTURE.md). The context duck-types the
+CoreWorker surface the public API layer uses (put/get/wait,
+register_function, submit_task, create_actor, submit_actor_task,
+cancel_task, `.gcs.call`), so once it is installed via
+``set_current_worker`` every ``ray_tpu.*`` call transparently routes
+through the proxy — the client process needs reachability to exactly one
+host:port.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.object_ref import ObjectRef, ReferenceCounter
+from ray_tpu._private.protocol import RpcClient
+
+
+class _GcsProxy:
+    """`.call()`-compatible stand-in for the worker's GCS client; forwards
+    through the client channel so API helpers (nodes, get_actor, kill)
+    work unchanged in client mode."""
+
+    def __init__(self, ctx: "ClientContext"):
+        self._ctx = ctx
+        self.addr = ctx.server_addr
+
+    def call(self, method: str, **kw):
+        return self._ctx._rpc.call("client_gcs_call", gcs_method=method,
+                                   kw=kw)
+
+    def push(self, method: str, **kw):  # fire-and-forget parity
+        try:
+            self._ctx._rpc.push("client_gcs_call", gcs_method=method, kw=kw)
+        except Exception:
+            pass
+
+
+class ClientContext:
+    """The client-mode 'worker'. Created by
+    ``ray_tpu.init(address="ray://host:port")``."""
+
+    mode = "client"
+
+    def __init__(self, host: str, port: int):
+        self.server_addr = (host, port)
+        self._rpc = RpcClient((host, port))
+        self.reference_counter = ReferenceCounter(on_zero=self._release)
+        self.gcs = _GcsProxy(self)
+        self._func_cache: dict = {}
+        self._closed = False
+        # identity attrs the RayContext/RuntimeContext helpers read
+        import uuid
+
+        self.node_id = f"client-{uuid.uuid4().hex[:8]}"
+        self.worker_id = self.node_id
+        self.job_id = 0
+        self.actor_id = None
+        self._actor_spec = None
+
+    # ------------------------------------------------------------- plumbing
+    def _release(self, object_id: bytes):
+        # fire-and-forget: this runs from ObjectRef.__del__ — a blocking
+        # round trip here would stall whatever thread GC happens on
+        if self._closed:
+            return
+        try:
+            self._rpc.push("client_release", ids=[object_id])
+        except Exception:
+            pass
+
+    def _dumps_args(self, args, kwargs) -> bytes:
+        # cloudpickle, matching direct mode's ser.serialize: lambdas,
+        # closures, and interactively-defined classes must survive transport
+        import cloudpickle
+
+        return cloudpickle.dumps((args, kwargs))
+
+    # ------------------------------------------------------------ object api
+    def put(self, value) -> ObjectRef:
+        import cloudpickle
+
+        blob = cloudpickle.dumps(value)
+        ref_id, owner = self._rpc.call("client_put", blob=blob)
+        return ObjectRef(ref_id, owner, worker=self)
+
+    def get(self, refs, timeout=None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        # the RPC deadline wraps the server-side get timeout (RpcClient.call
+        # consumes `timeout` itself, so the op timeout travels as op_timeout)
+        blob = self._rpc.call("client_get", ids=[r.id for r in ref_list],
+                              op_timeout=timeout,
+                              timeout=(timeout + 30) if timeout else 3600)
+        values = pickle.loads(blob)
+        return values[0] if single else values
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        by_id = {r.id: r for r in refs}
+        ready_ids, rest_ids = self._rpc.call(
+            "client_wait", ids=[r.id for r in refs],
+            num_returns=num_returns, op_timeout=timeout,
+            fetch_local=fetch_local,
+            timeout=(timeout + 30) if timeout else 3600)
+        return ([by_id[i] for i in ready_ids],
+                [by_id[i] for i in rest_ids])
+
+    # -------------------------------------------------------------- task api
+    def register_function(self, fn) -> bytes:
+        import hashlib
+
+        blob = ser.dumps_function(fn)
+        func_hash = hashlib.sha1(blob).digest()  # content-addressed, like
+        if func_hash not in self._func_cache:    # CoreWorker.register_function
+            self._rpc.call("client_register_function", blob=blob)
+            self._func_cache[func_hash] = True
+        return func_hash
+
+    def submit_task(self, func_hash: bytes, args, kwargs, **options):
+        pairs = self._rpc.call(
+            "client_submit_task", func_hash=func_hash,
+            payload=self._dumps_args(args, kwargs), options=options)
+        return [ObjectRef(i, owner, worker=self) for i, owner in pairs]
+
+    def create_actor(self, class_hash: bytes, args, kwargs, *, options):
+        return self._rpc.call(
+            "client_create_actor", class_hash=class_hash,
+            payload=self._dumps_args(args, kwargs), options=options)
+
+    def submit_actor_task(self, actor_id: bytes, method_name: str, args,
+                          kwargs, **options):
+        pairs = self._rpc.call(
+            "client_submit_actor_task", actor_id=actor_id,
+            method_name=method_name,
+            payload=self._dumps_args(args, kwargs), options=options)
+        return [ObjectRef(i, owner, worker=self) for i, owner in pairs]
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False):
+        self._rpc.call("client_cancel", ref_id=ref.id, force=force)
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        """Server-side kill — the client can't dial raylets directly."""
+        self._rpc.call("client_kill", actor_id=actor_id,
+                       no_restart=no_restart)
+
+    def available_resources(self) -> dict:
+        """Server-side aggregation — raylet addresses are cluster-internal."""
+        return self._rpc.call("client_available_resources")
+
+    # ------------------------------------------------------------------ misc
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _wait():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=_wait, daemon=True).start()
+        return fut
+
+    def shutdown(self):
+        self._closed = True
+        try:
+            self._rpc.close()
+        except Exception:
+            pass
+
+
+def connect(address: str) -> ClientContext:
+    """address is "host:port" (without the ray:// scheme)."""
+    host, port = address.rsplit(":", 1)
+    return ClientContext(host, int(port))

@@ -1,0 +1,173 @@
+"""Client-mode proxy server — the `ray://` endpoint.
+
+Reference: python/ray/util/client/server/server.py:96 (RayletServicer) —
+a gRPC proxy that lets an out-of-cluster process drive the cluster through
+ONE endpoint instead of dialing GCS/raylets/peers directly. This server
+runs inside a process that is already a driver (``ray_tpu.init()`` done);
+every client op is executed against the local CoreWorker.
+
+Per-connection bookkeeping: every ObjectRef handed to a client is pinned
+in a per-connection registry so the cluster doesn't GC it while the remote
+client still holds it; the registry is dropped when the client releases
+the ref (its local refcount hit zero) or disconnects (socket EOF — the
+reference's client data channel tracks liveness the same way).
+"""
+from __future__ import annotations
+
+import hashlib
+import pickle
+import threading
+
+from ray_tpu._private.protocol import RpcServer
+
+
+class _ClientHandler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # conn.id -> {ref_id: ObjectRef}
+        self._pinned: dict[str, dict] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    def on_connect(self, conn):
+        with self._lock:
+            self._pinned[conn.id] = {}
+
+    def on_disconnect(self, conn):
+        with self._lock:
+            self._pinned.pop(conn.id, None)
+
+    def _pin(self, conn, refs):
+        with self._lock:
+            store = self._pinned.get(conn.id)
+            if store is not None:
+                for r in refs:
+                    store[r.id] = r
+
+    def _worker(self):
+        from ray_tpu._private.worker_runtime import current_worker
+
+        worker = current_worker()
+        if worker is None:
+            raise RuntimeError("client server host process lost its driver")
+        return worker
+
+    # ------------------------------------------------------------------ ops
+    def rpc_client_put(self, conn, blob: bytes):
+        ref = self._worker().put(pickle.loads(blob))
+        self._pin(conn, [ref])
+        return ref.id, ref.owner_addr
+
+    def rpc_client_get(self, conn, ids: list, op_timeout):
+        from ray_tpu._private.object_ref import ObjectRef
+
+        import cloudpickle
+
+        worker = self._worker()
+        refs = [ObjectRef(i, worker=worker) for i in ids]
+        values = worker.get(refs, timeout=op_timeout)
+        return cloudpickle.dumps(values)
+
+    def rpc_client_wait(self, conn, ids: list, num_returns: int, op_timeout,
+                        fetch_local: bool):
+        from ray_tpu._private.object_ref import ObjectRef
+
+        worker = self._worker()
+        refs = [ObjectRef(i, worker=worker) for i in ids]
+        ready, rest = worker.wait(refs, num_returns=num_returns,
+                                  timeout=op_timeout,
+                                  fetch_local=fetch_local)
+        return [r.id for r in ready], [r.id for r in rest]
+
+    def rpc_client_register_function(self, conn, blob: bytes):
+        worker = self._worker()
+        func_hash = hashlib.sha1(blob).digest()
+        worker.gcs.call("kv_put", ns="funcs", key=func_hash, value=blob,
+                        overwrite=False)
+        return func_hash
+
+    def rpc_client_submit_task(self, conn, func_hash: bytes, payload: bytes,
+                               options: dict):
+        args, kwargs = pickle.loads(payload)
+        refs = self._worker().submit_task(func_hash, args, kwargs, **options)
+        self._pin(conn, refs)
+        # id AND owner travel back: the client re-pickles refs into later
+        # task args, and dependency resolution needs the owner address
+        return [(r.id, r.owner_addr) for r in refs]
+
+    def rpc_client_create_actor(self, conn, class_hash: bytes,
+                                payload: bytes, options: dict):
+        args, kwargs = pickle.loads(payload)
+        return self._worker().create_actor(class_hash, args, kwargs,
+                                           options=options)
+
+    def rpc_client_submit_actor_task(self, conn, actor_id: bytes,
+                                     method_name: str, payload: bytes,
+                                     options: dict):
+        args, kwargs = pickle.loads(payload)
+        refs = self._worker().submit_actor_task(actor_id, method_name,
+                                                args, kwargs, **options)
+        self._pin(conn, refs)
+        return [(r.id, r.owner_addr) for r in refs]
+
+    def rpc_client_cancel(self, conn, ref_id: bytes, force: bool):
+        from ray_tpu._private.object_ref import ObjectRef
+
+        worker = self._worker()
+        worker.cancel_task(ObjectRef(ref_id, worker=worker), force=force)
+
+    def rpc_client_gcs_call(self, conn, gcs_method: str, kw: dict):
+        return self._worker().gcs.call(gcs_method, **kw)
+
+    def rpc_client_kill(self, conn, actor_id: bytes, no_restart: bool):
+        # runs the direct-dial kill from the server, which CAN reach raylets
+        from ray_tpu._private.api import ActorHandle, kill
+
+        kill(ActorHandle(actor_id), no_restart=no_restart)
+
+    def rpc_client_available_resources(self, conn):
+        from ray_tpu._private.api import available_resources
+
+        return available_resources()
+
+    def rpc_client_timeline(self, conn):
+        from ray_tpu._private.api import timeline
+
+        return timeline()
+
+    def rpc_client_release(self, conn, ids: list):
+        with self._lock:
+            store = self._pinned.get(conn.id)
+            if store is not None:
+                for i in ids:
+                    store.pop(i, None)
+
+
+class ClientServer:
+    """Serve the `ray://` protocol from this (already-initialized) driver
+    process. ``ClientServer(port).start()``; clients connect with
+    ``ray_tpu.init(address="ray://host:port")``."""
+
+    def __init__(self, port: int = 10001, host: str = "0.0.0.0"):
+        self._server = RpcServer(_ClientHandler(), host=host, port=port)
+
+    @property
+    def addr(self):
+        return self._server.addr
+
+    def start(self):
+        self._server.start()
+        return self
+
+    def stop(self):
+        self._server.stop()
+
+
+_default_server: ClientServer | None = None
+
+
+def serve(port: int = 10001, host: str = "0.0.0.0") -> ClientServer:
+    """Start the process-wide client server (idempotent)."""
+    global _default_server
+    if _default_server is None:
+        _default_server = ClientServer(port, host).start()
+    return _default_server

@@ -1,0 +1,116 @@
+"""Client mode (`ray://`) tests — a separate OS process drives the cluster
+through one proxy endpoint (reference test tier:
+python/ray/tests/test_client.py, util/client/).
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+_SERVER_SCRIPT = """
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import ray_tpu
+from ray_tpu.util.client import ClientServer
+
+ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+server = ClientServer(port=0, host="127.0.0.1").start()
+with open(sys.argv[1], "w") as f:
+    f.write(str(server.addr[1]))
+while True:
+    time.sleep(1)
+"""
+
+
+@pytest.fixture
+def client_server(tmp_path):
+    port_file = tmp_path / "port"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_TESTING="1")
+    log = open(tmp_path / "server.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SCRIPT, str(port_file)], env=env,
+        stdout=log, stderr=log)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if port_file.exists() and port_file.read_text().strip():
+            break
+        if proc.poll() is not None:
+            raise RuntimeError("client server process died")
+        time.sleep(0.1)
+    else:
+        proc.kill()
+        raise TimeoutError("client server never came up")
+    yield int(port_file.read_text())
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=10)
+
+
+def test_client_mode_end_to_end(client_server):
+    import ray_tpu
+
+    ctx = ray_tpu.init(address=f"ray://127.0.0.1:{client_server}")
+    try:
+        # put/get round-trip
+        ref = ray_tpu.put({"x": 41})
+        assert ray_tpu.get(ref) == {"x": 41}
+
+        # tasks, incl. passing a client-held ref as an argument
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        out = add.remote(1, ray_tpu.get(ref)["x"])
+        assert ray_tpu.get(out) == 42
+        chained = add.remote(out, 8)
+        assert ray_tpu.get(chained) == 50
+
+        # wait
+        refs = [add.remote(i, i) for i in range(4)]
+        ready, rest = ray_tpu.wait(refs, num_returns=4, timeout=30)
+        assert len(ready) == 4 and not rest
+
+        # actors through the proxy
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self, k=1):
+                self.n += k
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.incr.remote()) == 1
+        assert ray_tpu.get(c.incr.remote(5)) == 6
+
+        # named actor lookup via the gcs proxy
+        named = Counter.options(name="client_counter").remote()
+        ray_tpu.get(named.incr.remote())
+        again = ray_tpu.get_actor("client_counter")
+        assert ray_tpu.get(again.incr.remote()) == 2
+
+        # cluster introspection routes through the proxy too
+        assert ray_tpu.cluster_resources().get("CPU", 0) >= 4
+        assert any(n["Alive"] for n in ray_tpu.nodes())
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_client_mode_errors_propagate(client_server):
+    import ray_tpu
+
+    ray_tpu.init(address=f"ray://127.0.0.1:{client_server}")
+    try:
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("client-visible failure")
+
+        with pytest.raises(Exception) as exc_info:
+            ray_tpu.get(boom.remote(), timeout=60)
+        assert "client-visible failure" in str(exc_info.value)
+    finally:
+        ray_tpu.shutdown()
