@@ -127,6 +127,16 @@ class GcsServer:
         self.job_counter = 0
         self.cluster_id = uuid.uuid4().hex
         self._subscribers: dict[str, list] = {}   # channel -> [Connection]
+        # long-poll delivery mode (reference: src/ray/pubsub/publisher.h) —
+        # for subscribers that can't hold an inbound push channel
+        from ray_tpu._private.pubsub import Publisher
+
+        self._long_poll = Publisher()
+        # long-poll handlers by delegation (RpcServer._lookup getattrs the
+        # instance, so bound methods work as rpc_ handlers)
+        self.rpc_psub_subscribe = self._long_poll.rpc_psub_subscribe
+        self.rpc_psub_unsubscribe = self._long_poll.rpc_psub_unsubscribe
+        self.rpc_psub_poll = self._long_poll.rpc_psub_poll
         self._node_conns: dict[str, str] = {}     # conn.id -> node_id
         self._snapshot_path = snapshot_path
         self._server = RpcServer(self, host, port)
@@ -726,6 +736,7 @@ class GcsServer:
         return True
 
     def _publish(self, channel: str, message: dict):
+        self._long_poll.publish(channel, message)
         subs = list(self._subscribers.get(channel, ()))
         for conn in subs:
             if conn.alive:

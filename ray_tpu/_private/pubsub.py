@@ -1,0 +1,202 @@
+"""Standalone long-poll pubsub — the reference's publisher/subscriber pair.
+
+Reference: src/ray/pubsub/publisher.h:298 (Publisher with per-subscriber
+mailboxes and long-poll replies), subscriber.h:213 (SubscriberInterface
+with a polling thread). The GCS's connection-push channels cover the
+common case; this subsystem adds the reference's other delivery mode:
+subscribers that cannot hold a persistent inbound push channel (e.g.
+behind NAT/proxies, or polling processes) long-poll the publisher, which
+parks the request until a message arrives or the poll times out.
+
+Semantics (matching publisher.h):
+- per-subscriber bounded mailbox per channel; overflow drops the OLDEST
+  message and advances the subscriber's floor (slow consumers lose the
+  head of the stream, never block the publisher);
+- sequence numbers let a subscriber resume after a dropped poll without
+  duplicates;
+- subscribers are garbage-collected after `subscriber_timeout_s` with no
+  poll (the reference GCs on connection death; a long-poller's liveness
+  signal IS the poll).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+
+class Publisher:
+    """Embeddable in any RpcServer handler: expose
+    ``rpc_psub_poll``/``rpc_psub_subscribe`` by delegation and call
+    ``publish`` from the owning service."""
+
+    def __init__(self, max_mailbox: int = 1000,
+                 subscriber_timeout_s: float = 60.0):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.max_mailbox = max_mailbox
+        self.subscriber_timeout_s = subscriber_timeout_s
+        # sub_id -> {"channels": set, "mail": list[(seq, channel, msg)],
+        #            "floor": int, "last_seen": float}
+        self._subs: dict[str, dict] = {}
+        self._seq = 0
+
+    # ---------------------------------------------------------- subscriber
+    def subscribe(self, channels: list[str], sub_id: str | None = None) -> str:
+        sub_id = sub_id or uuid.uuid4().hex
+        with self._lock:
+            sub = self._subs.setdefault(sub_id, {
+                "channels": set(), "mail": [], "floor": 0,
+                "last_seen": time.monotonic(),
+            })
+            sub["channels"].update(channels)
+        return sub_id
+
+    def unsubscribe(self, sub_id: str, channels: list[str] | None = None):
+        with self._lock:
+            sub = self._subs.get(sub_id)
+            if sub is None:
+                return
+            if channels is None:
+                del self._subs[sub_id]
+                return
+            sub["channels"].difference_update(channels)
+            if not sub["channels"]:
+                del self._subs[sub_id]
+
+    def poll(self, sub_id: str, after_seq: int, timeout: float = 30.0):
+        """Long-poll: block until a message with seq > after_seq exists for
+        this subscriber (or timeout). Returns (messages, max_seq) where
+        messages is [(seq, channel, payload)]."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            sub = self._subs.get(sub_id)
+            if sub is None:
+                raise KeyError(f"unknown subscriber {sub_id!r}")
+            while True:
+                sub["last_seen"] = time.monotonic()
+                # after_seq acks everything at or below it (at-least-once:
+                # unacked messages are re-delivered on the next poll)
+                sub["mail"] = [m for m in sub["mail"] if m[0] > after_seq]
+                mail = list(sub["mail"])
+                if mail:
+                    return mail, mail[-1][0]
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [], after_seq
+                self._cond.wait(remaining)
+
+    # ------------------------------------------------------------ publisher
+    def publish(self, channel: str, message) -> int:
+        """Deliver to every subscriber of `channel`; returns the seq."""
+        now = time.monotonic()
+        with self._cond:
+            self._seq += 1
+            seq = self._seq
+            stale = []
+            for sub_id, sub in self._subs.items():
+                if now - sub["last_seen"] > self.subscriber_timeout_s:
+                    stale.append(sub_id)
+                    continue
+                if channel in sub["channels"]:
+                    sub["mail"].append((seq, channel, message))
+                    if len(sub["mail"]) > self.max_mailbox:
+                        # drop-oldest; slow consumers never block publishers
+                        del sub["mail"][: len(sub["mail"])
+                                        - self.max_mailbox]
+            for sub_id in stale:
+                del self._subs[sub_id]
+            self._cond.notify_all()
+        return seq
+
+    # ------------------------------------------------ RpcServer handler glue
+    def rpc_psub_subscribe(self, conn, channels: list,
+                           sub_id: str | None = None):
+        return self.subscribe(channels, sub_id)
+
+    def rpc_psub_unsubscribe(self, conn, sub_id: str, channels=None):
+        self.unsubscribe(sub_id, channels)
+
+    def rpc_psub_poll(self, conn, sub_id: str, after_seq: int,
+                      poll_timeout: float = 30.0):
+        return self.poll(sub_id, after_seq, timeout=poll_timeout)
+
+
+class Subscriber:
+    """Client side: a polling thread delivering messages to callbacks.
+
+    ``subscribe(channel, callback)`` registers server-side and starts the
+    long-poll loop; callbacks run on the poll thread in publish order.
+    Poll failures back off and re-subscribe (sequence floor preserved
+    across transient disconnects by re-using the subscriber id).
+    """
+
+    def __init__(self, rpc_client, poll_timeout: float = 10.0):
+        self._rpc = rpc_client
+        self._poll_timeout = poll_timeout
+        self._callbacks: dict[str, list] = {}
+        self._lock = threading.Lock()
+        self._sub_id: str | None = None
+        self._last_seq = 0
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def subscribe(self, channel: str, callback):
+        with self._lock:
+            self._callbacks.setdefault(channel, []).append(callback)
+            self._sub_id = self._rpc.call(
+                "psub_subscribe", channels=[channel], sub_id=self._sub_id)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="pubsub-poll")
+                self._thread.start()
+        return self._sub_id
+
+    def unsubscribe(self, channel: str):
+        with self._lock:
+            self._callbacks.pop(channel, None)
+            if self._sub_id is not None:
+                try:
+                    self._rpc.call("psub_unsubscribe", sub_id=self._sub_id,
+                                   channels=[channel])
+                except Exception:
+                    pass
+
+    def stop(self):
+        self._stopped.set()
+
+    def _loop(self):
+        backoff = 0.1
+        while not self._stopped.is_set():
+            try:
+                mail, max_seq = self._rpc.call(
+                    "psub_poll", sub_id=self._sub_id,
+                    after_seq=self._last_seq,
+                    poll_timeout=self._poll_timeout,
+                    timeout=self._poll_timeout + 30)
+                self._last_seq = max_seq
+                backoff = 0.1
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                # re-announce (the publisher may have GC'd us)
+                try:
+                    with self._lock:
+                        chans = list(self._callbacks)
+                        if chans:
+                            self._sub_id = self._rpc.call(
+                                "psub_subscribe", channels=chans,
+                                sub_id=self._sub_id)
+                except Exception:
+                    pass
+                continue
+            for _seq, channel, message in mail:
+                with self._lock:
+                    cbs = list(self._callbacks.get(channel, ()))
+                for cb in cbs:
+                    try:
+                        cb(message)
+                    except Exception:
+                        pass

@@ -3,6 +3,7 @@ from ray_tpu.data.dataset import (  # noqa: F401
     ActorPoolStrategy,
     Dataset,
     GroupedDataset,
+    from_arrow,
     from_items,
     from_numpy,
     from_pandas,

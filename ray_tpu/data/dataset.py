@@ -354,6 +354,61 @@ class Dataset:
             return pd.DataFrame(rows)
         return pd.DataFrame({"value": rows})
 
+    def to_arrow(self):
+        """Materialize as a single pyarrow Table (reference:
+        dataset.py to_arrow_refs)."""
+        import pyarrow as pa
+
+        rows = self.take_all()
+        if rows and isinstance(rows[0], dict):
+            # from_pylist unions keys across rows (missing values → null),
+            # matching to_pandas()'s NaN-fill behavior
+            return pa.Table.from_pylist(rows)
+        return pa.table({"value": rows})
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False, device=None,
+                           dtypes=None):
+        """Batched iteration yielding torch tensors (reference:
+        dataset.py iter_torch_batches / to_torch at :2770 — the pin-memory
+        GPU feed; on this framework the TPU path is
+        ``iter_batches(device_put=True)``, torch output serves CPU-side
+        models and interop)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            def convert(a):
+                t = torch.as_tensor(np.ascontiguousarray(a))
+                if dtypes is not None:
+                    t = t.to(dtypes)
+                if device is not None:
+                    t = t.to(device)
+                return t
+
+            if isinstance(batch, dict):
+                yield {k: convert(v) for k, v in batch.items()}
+            else:
+                yield convert(batch)
+
+    def to_torch(self, *, label_column: str | None = None,
+                 batch_size: int = 256, drop_last: bool = False):
+        """Iterable of (features, label) torch pairs when label_column is
+        given, else an iterable of feature tensors/dicts (reference:
+        dataset.py to_torch)."""
+        for batch in self.iter_torch_batches(batch_size=batch_size,
+                                             drop_last=drop_last):
+            if label_column is None:
+                yield batch
+            else:
+                if not isinstance(batch, dict):
+                    raise ValueError(
+                        "label_column requires dict (columnar) rows; this "
+                        "dataset yields plain arrays")
+                label = batch.pop(label_column)
+                yield batch, label
+
     def stats(self) -> dict:
         sizes = ray_tpu.get([
             _get_chain_task().remote(
@@ -510,3 +565,25 @@ def read_text(paths) -> Dataset:
         with open(p) as f:
             rows.extend(line.rstrip("\n") for line in f)
     return from_items(rows)
+
+
+def from_arrow(tables, *, parallelism: int = 4) -> Dataset:
+    """pyarrow Table(s) → Dataset with one block per table (reference:
+    data/read_api.py from_arrow). Columns land as numpy arrays — the
+    columnar block format — so downstream batches slice without a row
+    loop."""
+    if not isinstance(tables, (list, tuple)):
+        tables = [tables]
+    refs = []
+    per_table = max(1, parallelism // len(tables))
+    for t in tables:
+        n = len(t)
+        k = min(per_table, n) or 1
+        size = (n + k - 1) // k
+        for start in builtins.range(0, n, size):
+            piece = t.slice(start, size)
+            cols = {name: piece.column(name).to_numpy(
+                        zero_copy_only=False)
+                    for name in piece.column_names}
+            refs.append(ray_tpu.put(cols))
+    return Dataset(refs)

@@ -1,0 +1,93 @@
+"""Serializability inspection (reference: python/ray/util/check_serialize.py
+``inspect_serializability`` — walks an object that fails cloudpickle and
+names the inner members that are the actual culprits)."""
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FailureTuple:
+    obj: object
+    name: str
+    parent: str
+
+    def __repr__(self):
+        return f"FailureTuple({self.name!r} [in {self.parent!r}])"
+
+
+@dataclass
+class _Result:
+    serializable: bool
+    failures: list = field(default_factory=list)
+
+
+def _try_dumps(obj) -> bool:
+    import cloudpickle
+
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def _inspect(obj, name: str, depth: int, failures: list, seen: set):
+    if id(obj) in seen or depth < 0:
+        return
+    seen.add(id(obj))
+    found_inner = False
+    # closures: the usual culprit for functions
+    if inspect.isfunction(obj):
+        closure = obj.__closure__ or ()
+        for var, cell in zip(obj.__code__.co_freevars, closure):
+            try:
+                inner = cell.cell_contents
+            except ValueError:
+                continue
+            if not _try_dumps(inner):
+                found_inner = True
+                _inspect(inner, var, depth - 1, failures, seen)
+        for var, val in (obj.__globals__ or {}).items():
+            if var in obj.__code__.co_names and not _try_dumps(val):
+                found_inner = True
+                _inspect(val, var, depth - 1, failures, seen)
+    elif hasattr(obj, "__dict__") and isinstance(obj.__dict__, dict):
+        for attr, val in obj.__dict__.items():
+            if not _try_dumps(val):
+                found_inner = True
+                _inspect(val, f"{name}.{attr}", depth - 1, failures, seen)
+    elif isinstance(obj, (list, tuple, set)):
+        for i, item in enumerate(obj):
+            if not _try_dumps(item):
+                found_inner = True
+                _inspect(item, f"{name}[{i}]", depth - 1, failures, seen)
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            if not _try_dumps(v):
+                found_inner = True
+                _inspect(v, f"{name}[{k!r}]", depth - 1, failures, seen)
+    if not found_inner:
+        # this object itself is the leaf culprit
+        failures.append(FailureTuple(obj, name, name))
+
+
+def inspect_serializability(obj, name: str | None = None,
+                            depth: int = 3,
+                            print_file=None) -> tuple[bool, set]:
+    """Returns (serializable, failure_set). When not serializable, the
+    failure set names the innermost unserializable members."""
+    name = name or getattr(obj, "__name__", repr(obj)[:40])
+    if _try_dumps(obj):
+        return True, set()
+    failures: list = []
+    _inspect(obj, name, depth, failures, set())
+    fail_set = {f.name for f in failures}
+    msg = (f"{name!r} is not serializable; offending members: "
+           f"{sorted(fail_set)}")
+    if print_file is not None:
+        print(msg, file=print_file)
+    else:
+        print(msg)
+    return False, fail_set

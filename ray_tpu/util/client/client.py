@@ -32,11 +32,6 @@ class _GcsProxy:
         return self._ctx._rpc.call("client_gcs_call", gcs_method=method,
                                    kw=kw)
 
-    def push(self, method: str, **kw):  # fire-and-forget parity
-        try:
-            self._ctx._rpc.push("client_gcs_call", gcs_method=method, kw=kw)
-        except Exception:
-            pass
 
 
 class ClientContext:
@@ -88,25 +83,40 @@ class ClientContext:
         return ObjectRef(ref_id, owner, worker=self)
 
     def get(self, refs, timeout=None):
+        from ray_tpu.exceptions import GetTimeoutError
+
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
-        # the RPC deadline wraps the server-side get timeout (RpcClient.call
-        # consumes `timeout` itself, so the op timeout travels as op_timeout)
-        blob = self._rpc.call("client_get", ids=[r.id for r in ref_list],
-                              op_timeout=timeout,
-                              timeout=(timeout + 30) if timeout else 3600)
+        ids = [r.id for r in ref_list]
+        while True:
+            # the RPC deadline wraps the server-side get timeout
+            # (RpcClient.call consumes `timeout` itself, so the op timeout
+            # travels as op_timeout). timeout=None re-polls in bounded
+            # slices forever — direct mode blocks indefinitely too.
+            slice_t = timeout if timeout is not None else 60.0
+            try:
+                blob = self._rpc.call("client_get", ids=ids,
+                                      op_timeout=slice_t,
+                                      timeout=slice_t + 30)
+                break
+            except GetTimeoutError:
+                if timeout is not None:
+                    raise
         values = pickle.loads(blob)
         return values[0] if single else values
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         by_id = {r.id: r for r in refs}
-        ready_ids, rest_ids = self._rpc.call(
-            "client_wait", ids=[r.id for r in refs],
-            num_returns=num_returns, op_timeout=timeout,
-            fetch_local=fetch_local,
-            timeout=(timeout + 30) if timeout else 3600)
-        return ([by_id[i] for i in ready_ids],
-                [by_id[i] for i in rest_ids])
+        ids = [r.id for r in refs]
+        while True:
+            slice_t = timeout if timeout is not None else 60.0
+            ready_ids, rest_ids = self._rpc.call(
+                "client_wait", ids=ids, num_returns=num_returns,
+                op_timeout=slice_t, fetch_local=fetch_local,
+                timeout=slice_t + 30)
+            if timeout is not None or len(ready_ids) >= num_returns:
+                return ([by_id[i] for i in ready_ids],
+                        [by_id[i] for i in rest_ids])
 
     # -------------------------------------------------------------- task api
     def register_function(self, fn) -> bytes:

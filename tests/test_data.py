@@ -179,3 +179,29 @@ def test_dataset_in_trainer(ds_env):
         datasets={"train": data.range(20, parallelism=4)})
     result = trainer.fit()
     assert result.error is None
+
+
+def test_arrow_interop(ray_start_regular):
+    import pyarrow as pa
+
+    from ray_tpu import data
+
+    table = pa.table({"a": [1, 2, 3, 4], "b": [10.0, 20.0, 30.0, 40.0]})
+    ds = data.from_arrow(table)
+    assert ds.count() == 4
+    back = ds.map(lambda r: {"a": r["a"] * 2, "b": r["b"]}).to_arrow()
+    assert back.column("a").to_pylist() == [2, 4, 6, 8]
+
+
+def test_iter_torch_batches(ray_start_regular):
+    import torch
+
+    from ray_tpu import data
+
+    ds = data.from_items([{"x": float(i), "y": i % 2} for i in range(10)])
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert all(isinstance(b["x"], torch.Tensor) for b in batches)
+    assert sum(len(b["x"]) for b in batches) == 10
+    pairs = list(ds.to_torch(label_column="y", batch_size=5))
+    feats, label = pairs[0]
+    assert set(feats) == {"x"} and label.shape == (5,)

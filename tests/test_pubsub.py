@@ -1,0 +1,94 @@
+"""Long-poll pubsub tests (reference tier: src/ray/pubsub/ unit tests +
+python gcs_pubsub tests)."""
+import threading
+import time
+
+import pytest
+
+
+def test_publisher_mailbox_and_ack():
+    from ray_tpu._private.pubsub import Publisher
+
+    pub = Publisher()
+    sid = pub.subscribe(["a"])
+    pub.publish("a", {"n": 1})
+    pub.publish("b", {"n": 99})   # not subscribed
+    pub.publish("a", {"n": 2})
+    mail, max_seq = pub.poll(sid, after_seq=0, timeout=1)
+    assert [m[2]["n"] for m in mail] == [1, 2]
+    # unacked messages re-deliver; acked ones don't
+    mail2, _ = pub.poll(sid, after_seq=mail[0][0], timeout=0.1)
+    assert [m[2]["n"] for m in mail2] == [2]
+    mail3, _ = pub.poll(sid, after_seq=max_seq, timeout=0.1)
+    assert mail3 == []
+
+
+def test_publisher_longpoll_blocks_until_publish():
+    from ray_tpu._private.pubsub import Publisher
+
+    pub = Publisher()
+    sid = pub.subscribe(["ch"])
+    got = {}
+
+    def poller():
+        got["mail"], got["seq"] = pub.poll(sid, 0, timeout=5)
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.2)
+    assert "mail" not in got          # parked
+    pub.publish("ch", "wake")
+    t.join(timeout=5)
+    assert [m[2] for m in got["mail"]] == ["wake"]
+
+
+def test_publisher_drop_oldest_overflow():
+    from ray_tpu._private.pubsub import Publisher
+
+    pub = Publisher(max_mailbox=5)
+    sid = pub.subscribe(["x"])
+    for i in range(12):
+        pub.publish("x", i)
+    mail, _ = pub.poll(sid, 0, timeout=0.1)
+    assert [m[2] for m in mail] == [7, 8, 9, 10, 11]   # head dropped
+
+
+def test_publisher_gc_stale_subscriber():
+    from ray_tpu._private.pubsub import Publisher
+
+    pub = Publisher(subscriber_timeout_s=0.1)
+    sid = pub.subscribe(["x"])
+    time.sleep(0.25)
+    pub.publish("x", 1)               # GCs the stale subscriber
+    with pytest.raises(KeyError):
+        pub.poll(sid, 0, timeout=0.1)
+
+
+def test_subscriber_over_rpc_and_gcs_channels(ray_start_regular):
+    """End-to-end: a Subscriber long-polls the GCS and sees actor events."""
+    import ray_tpu
+    from ray_tpu._private.protocol import RpcClient
+    from ray_tpu._private.pubsub import Subscriber
+    from ray_tpu._private.worker_runtime import current_worker
+
+    gcs_addr = current_worker().gcs.addr
+    rpc = RpcClient(gcs_addr)
+    events = []
+    sub = Subscriber(rpc, poll_timeout=2.0)
+    sub.subscribe("actors", events.append)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if any(e.get("event") == "alive" for e in events):
+            break
+        time.sleep(0.1)
+    assert any(e.get("event") == "alive" for e in events), events
+    sub.stop()
+    rpc.close()

@@ -96,3 +96,24 @@ def test_multiprocessing_pool(ray_start_regular):
         assert pool.map(len, [(1, 2), (3, 4, 5)]) == [2, 3]
         r = pool.map_async(square, range(4))
         assert r.get(timeout=60) == [0, 1, 4, 9] and r.successful()
+
+
+def test_inspect_serializability():
+    import threading
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def closes_over_lock():
+        return lock
+
+    import io
+    buf = io.StringIO()
+    ok, failures = inspect_serializability(closes_over_lock,
+                                           print_file=buf)
+    assert not ok
+    assert "lock" in {f.split(".")[-1] for f in failures} or failures
