@@ -50,6 +50,9 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # --- misc ---
     "rpc_max_message_bytes": 512 * 1024 * 1024,
     "pubsub_poll_timeout_s": 30.0,
+    "pubsub_max_mailbox": 1000,           # long-poll mailbox bound (drop-oldest)
+    "pubsub_subscriber_timeout_s": 60.0,  # GC long-pollers gone this long
+    "client_poll_slice_s": 60.0,          # ray:// get/wait re-poll granularity
     "event_log_max_bytes": 16 * 1024 * 1024,
     "metrics_report_interval_ms": 2_000,
     "log_to_driver": True,

@@ -9,14 +9,13 @@ behind NAT/proxies, or polling processes) long-poll the publisher, which
 parks the request until a message arrives or the poll times out.
 
 Semantics (matching publisher.h):
-- per-subscriber bounded mailbox per channel; overflow drops the OLDEST
-  message and advances the subscriber's floor (slow consumers lose the
-  head of the stream, never block the publisher);
-- sequence numbers let a subscriber resume after a dropped poll without
-  duplicates;
+- per-subscriber bounded mailbox; overflow drops the OLDEST messages
+  (slow consumers lose the head of the stream, never block publishers);
+- sequence numbers ack delivery: messages at or below the polled
+  `after_seq` are pruned, anything above re-delivers (at-least-once);
 - subscribers are garbage-collected after `subscriber_timeout_s` with no
-  poll (the reference GCs on connection death; a long-poller's liveness
-  signal IS the poll).
+  poll AND no poll currently parked (the reference GCs on connection
+  death; a long-poller's liveness signal IS the poll).
 """
 from __future__ import annotations
 
@@ -30,14 +29,20 @@ class Publisher:
     ``rpc_psub_poll``/``rpc_psub_subscribe`` by delegation and call
     ``publish`` from the owning service."""
 
-    def __init__(self, max_mailbox: int = 1000,
-                 subscriber_timeout_s: float = 60.0):
+    def __init__(self, max_mailbox: int | None = None,
+                 subscriber_timeout_s: float | None = None):
+        from ray_tpu._private.config import get_config
+
+        if max_mailbox is None:
+            max_mailbox = get_config("pubsub_max_mailbox")
+        if subscriber_timeout_s is None:
+            subscriber_timeout_s = get_config("pubsub_subscriber_timeout_s")
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self.max_mailbox = max_mailbox
         self.subscriber_timeout_s = subscriber_timeout_s
         # sub_id -> {"channels": set, "mail": list[(seq, channel, msg)],
-        #            "floor": int, "last_seen": float}
+        #            "last_seen": float, "waiters": int}
         self._subs: dict[str, dict] = {}
         self._seq = 0
 
@@ -46,8 +51,8 @@ class Publisher:
         sub_id = sub_id or uuid.uuid4().hex
         with self._lock:
             sub = self._subs.setdefault(sub_id, {
-                "channels": set(), "mail": [], "floor": 0,
-                "last_seen": time.monotonic(),
+                "channels": set(), "mail": [],
+                "last_seen": time.monotonic(), "waiters": 0,
             })
             sub["channels"].update(channels)
         return sub_id
@@ -73,18 +78,24 @@ class Publisher:
             sub = self._subs.get(sub_id)
             if sub is None:
                 raise KeyError(f"unknown subscriber {sub_id!r}")
-            while True:
+            sub["waiters"] += 1   # a parked poll is proof of life — no GC
+            try:
+                while True:
+                    sub["last_seen"] = time.monotonic()
+                    # after_seq acks everything at or below it
+                    # (at-least-once: unacked messages re-deliver)
+                    sub["mail"] = [m for m in sub["mail"]
+                                   if m[0] > after_seq]
+                    mail = list(sub["mail"])
+                    if mail:
+                        return mail, mail[-1][0]
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return [], after_seq
+                    self._cond.wait(remaining)
+            finally:
+                sub["waiters"] -= 1
                 sub["last_seen"] = time.monotonic()
-                # after_seq acks everything at or below it (at-least-once:
-                # unacked messages are re-delivered on the next poll)
-                sub["mail"] = [m for m in sub["mail"] if m[0] > after_seq]
-                mail = list(sub["mail"])
-                if mail:
-                    return mail, mail[-1][0]
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return [], after_seq
-                self._cond.wait(remaining)
 
     # ------------------------------------------------------------ publisher
     def publish(self, channel: str, message) -> int:
@@ -95,7 +106,9 @@ class Publisher:
             seq = self._seq
             stale = []
             for sub_id, sub in self._subs.items():
-                if now - sub["last_seen"] > self.subscriber_timeout_s:
+                if (sub["waiters"] == 0
+                        and now - sub["last_seen"]
+                        > self.subscriber_timeout_s):
                     stale.append(sub_id)
                     continue
                 if channel in sub["channels"]:

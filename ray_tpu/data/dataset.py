@@ -575,10 +575,12 @@ def from_arrow(tables, *, parallelism: int = 4) -> Dataset:
     if not isinstance(tables, (list, tuple)):
         tables = [tables]
     refs = []
-    per_table = max(1, parallelism // len(tables))
+    per_table = max(1, parallelism // max(1, len(tables)))
     for t in tables:
         n = len(t)
-        k = min(per_table, n) or 1
+        if n == 0:
+            continue
+        k = min(per_table, n)
         size = (n + k - 1) // k
         for start in builtins.range(0, n, size):
             piece = t.slice(start, size)

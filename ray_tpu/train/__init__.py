@@ -10,3 +10,8 @@ from ray_tpu.train.trainer import (  # noqa: F401
     JaxTrainer,
 )
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401
+from ray_tpu.train.predictor import (  # noqa: F401
+    BatchPredictor,
+    JaxPredictor,
+    Predictor,
+)

@@ -1,0 +1,95 @@
+"""Predictors — checkpoint → batch inference callable.
+
+Reference: python/ray/train/predictor.py (Predictor.from_checkpoint /
+predict over numpy|pandas batches) and train/batch_predictor.py
+(BatchPredictor.predict maps a predictor over a Dataset on an actor
+pool). The TPU-shaped default is JaxPredictor: params restored from an
+AIR Checkpoint, a jitted apply function, numpy-in/numpy-out batches
+(device transfer inside the compiled call).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class Predictor:
+    """Base predictor (reference: train/predictor.py:Predictor)."""
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kwargs) -> "Predictor":
+        raise NotImplementedError
+
+    def predict(self, batch):
+        """batch: np.ndarray or {col: np.ndarray} → same-shaped output."""
+        raise NotImplementedError
+
+
+class JaxPredictor(Predictor):
+    """Runs a jitted apply_fn over restored params.
+
+    ``apply_fn(params, batch_array) -> prediction_array``; checkpoints
+    produced by ``session.report(checkpoint=Checkpoint.from_dict(...))``
+    carry the params under ``params_key`` (default "params").
+    """
+
+    def __init__(self, params, apply_fn, jit: bool = True):
+        import jax
+
+        self.params = params
+        self._apply = jax.jit(apply_fn) if jit else apply_fn
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, *, apply_fn,
+                        params_key: str = "params",
+                        jit: bool = True) -> "JaxPredictor":
+        data = checkpoint.to_dict()
+        if params_key not in data:
+            raise KeyError(
+                f"checkpoint has no {params_key!r} entry "
+                f"(keys: {sorted(data)})")
+        return cls(data[params_key], apply_fn, jit=jit)
+
+    def predict(self, batch):
+        if isinstance(batch, dict):
+            return {k: np.asarray(self._apply(self.params, v))
+                    for k, v in batch.items()}
+        return np.asarray(self._apply(self.params, batch))
+
+
+class BatchPredictor:
+    """Map a predictor over a Dataset on a pool of long-lived actors
+    (reference: train/batch_predictor.py — each scoring actor builds the
+    predictor once, then scores many blocks)."""
+
+    def __init__(self, checkpoint: Checkpoint, predictor_cls,
+                 **predictor_kwargs):
+        self.checkpoint = checkpoint
+        self.predictor_cls = predictor_cls
+        self.predictor_kwargs = predictor_kwargs
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, predictor_cls,
+                        **kwargs) -> "BatchPredictor":
+        return cls(checkpoint, predictor_cls, **kwargs)
+
+    def predict(self, dataset, *, num_scoring_workers: int = 2,
+                batch_format: str = "auto"):
+        """Returns a materialized Dataset of predictions."""
+        from ray_tpu.data.dataset import ActorPoolStrategy
+
+        ckpt = self.checkpoint
+        predictor_cls = self.predictor_cls
+        kwargs = self.predictor_kwargs
+        holder: list = []   # per-actor build-once (closure state travels
+                            # to each pool actor with the stage)
+
+        def score(batch):
+            if not holder:
+                holder.append(predictor_cls.from_checkpoint(ckpt, **kwargs))
+            return holder[0].predict(batch)
+
+        return dataset.map_batches(
+            score, batch_format=batch_format,
+        ).materialize(compute=ActorPoolStrategy(num_scoring_workers))

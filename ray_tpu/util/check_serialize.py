@@ -34,7 +34,12 @@ def _try_dumps(obj) -> bool:
 
 
 def _inspect(obj, name: str, depth: int, failures: list, seen: set):
-    if id(obj) in seen or depth < 0:
+    if id(obj) in seen:
+        return
+    if depth <= 0:
+        # depth budget exhausted: name this object rather than reporting
+        # "unserializable" with no culprit at all
+        failures.append(FailureTuple(obj, name, name))
         return
     seen.add(id(obj))
     found_inner = False

@@ -19,6 +19,12 @@ from ray_tpu._private.object_ref import ObjectRef, ReferenceCounter
 from ray_tpu._private.protocol import RpcClient
 
 
+def _poll_slice() -> float:
+    from ray_tpu._private.config import get_config
+
+    return get_config("client_poll_slice_s")
+
+
 class _GcsProxy:
     """`.call()`-compatible stand-in for the worker's GCS client; forwards
     through the client channel so API helpers (nodes, get_actor, kill)
@@ -93,7 +99,7 @@ class ClientContext:
             # (RpcClient.call consumes `timeout` itself, so the op timeout
             # travels as op_timeout). timeout=None re-polls in bounded
             # slices forever — direct mode blocks indefinitely too.
-            slice_t = timeout if timeout is not None else 60.0
+            slice_t = timeout if timeout is not None else _poll_slice()
             try:
                 blob = self._rpc.call("client_get", ids=ids,
                                       op_timeout=slice_t,
@@ -109,7 +115,7 @@ class ClientContext:
         by_id = {r.id: r for r in refs}
         ids = [r.id for r in refs]
         while True:
-            slice_t = timeout if timeout is not None else 60.0
+            slice_t = timeout if timeout is not None else _poll_slice()
             ready_ids, rest_ids = self._rpc.call(
                 "client_wait", ids=ids, num_returns=num_returns,
                 op_timeout=slice_t, fetch_local=fetch_local,

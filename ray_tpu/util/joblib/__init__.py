@@ -1,0 +1,108 @@
+"""joblib backend — scikit-learn parallelism on the runtime's tasks.
+
+Reference: python/ray/util/joblib/ (register_ray + RayBackend over the
+actor pool). ``register_ray()`` then ``joblib.parallel_backend("ray")``
+routes every joblib batch (e.g. a GridSearchCV fit) through remote
+tasks, so sklearn workloads fan out over the cluster.
+"""
+from __future__ import annotations
+
+import threading
+
+
+def register_ray():
+    from joblib.parallel import ParallelBackendBase, register_parallel_backend
+
+    class _AsyncResult:
+        def __init__(self, ref, callback):
+            self._ref = ref
+            self._callback = callback
+            self._value = None
+            self._done = threading.Event()
+
+        def _resolve(self):
+            import ray_tpu
+
+            try:
+                self._value = ray_tpu.get(self._ref)
+            except BaseException as e:  # noqa: BLE001
+                self._value = e
+            self._done.set()
+            if self._callback is not None:
+                self._callback(self._value)
+
+        def get(self, timeout=None):
+            if not self._done.wait(timeout):
+                raise TimeoutError("joblib task timed out")
+            if isinstance(self._value, BaseException):
+                raise self._value
+            return self._value
+
+    class _Waiter:
+        """One shared thread drains completions for every in-flight batch
+        (instead of a blocked thread per batch)."""
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending: dict = {}          # ref -> _AsyncResult
+            self._thread = None
+
+        def add(self, result: "_AsyncResult"):
+            with self._lock:
+                self._pending[result._ref] = result
+                if self._thread is None or not self._thread.is_alive():
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True,
+                        name="joblib-ray-waiter")
+                    self._thread.start()
+
+        def _loop(self):
+            import ray_tpu
+
+            while True:
+                with self._lock:
+                    refs = list(self._pending)
+                    if not refs:
+                        self._thread = None
+                        return
+                ready, _ = ray_tpu.wait(refs,
+                                        num_returns=1, timeout=0.2)
+                for ref in ready:
+                    with self._lock:
+                        result = self._pending.pop(ref, None)
+                    if result is not None:
+                        result._resolve()
+
+    class RayBackend(ParallelBackendBase):
+        supports_timeout = True
+        default_n_jobs = -1
+
+        def configure(self, n_jobs=1, parallel=None, **_):
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                return 1
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+            return cpus if n_jobs in (-1, None) else min(n_jobs, cpus)
+
+        def apply_async(self, func, callback=None):
+            import ray_tpu
+
+            if not hasattr(self, "_task"):
+                self._task = ray_tpu.remote(lambda f: f())
+                self._waiter = _Waiter()
+            result = _AsyncResult(self._task.remote(func), callback)
+            self._waiter.add(result)
+            return result
+
+        def abort_everything(self, ensure_ready=True):
+            pass
+
+    register_parallel_backend("ray", RayBackend)
+
+
+__all__ = ["register_ray"]

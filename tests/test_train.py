@@ -132,3 +132,28 @@ def test_checkpoint_conversions(tmp_path):
     uri = ckpt.to_uri(f"file://{tmp_path}/c2")
     ckpt4 = Checkpoint.from_uri(uri)
     assert (ckpt4.to_dict()["w"] == data["w"]).all()
+
+
+def test_batch_predictor_over_dataset(ray_start_regular):
+    """Checkpoint -> JaxPredictor -> BatchPredictor scores a Dataset on an
+    actor pool (reference: train/batch_predictor.py)."""
+    import jax.numpy as jnp
+
+    from ray_tpu import data
+    from ray_tpu.air import Checkpoint
+    from ray_tpu.train import BatchPredictor, JaxPredictor
+
+    w = np.array([[2.0], [3.0]], np.float32)          # y = 2a + 3b
+    ckpt = Checkpoint.from_dict({"params": {"w": w}})
+
+    def apply_fn(params, x):
+        return jnp.asarray(x) @ params["w"]
+
+    ds = data.from_numpy(
+        np.array([[1.0, 1.0], [2.0, 0.0], [0.0, 2.0]], np.float32),
+        parallelism=3)
+    bp = BatchPredictor.from_checkpoint(ckpt, JaxPredictor,
+                                        apply_fn=apply_fn)
+    out = bp.predict(ds, num_scoring_workers=2)
+    got = np.concatenate([np.asarray(b) for b in out.blocks()]).ravel()
+    assert np.allclose(sorted(got.tolist()), [4.0, 5.0, 6.0])

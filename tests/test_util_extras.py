@@ -117,3 +117,15 @@ def test_inspect_serializability():
                                            print_file=buf)
     assert not ok
     assert "lock" in {f.split(".")[-1] for f in failures} or failures
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray", n_jobs=4):
+        out = joblib.Parallel()(joblib.delayed(lambda x: x * x)(i)
+                                for i in range(8))
+    assert out == [i * i for i in range(8)]
