@@ -145,3 +145,88 @@ def validate_mesh_for_model(mesh: Mesh, *, n_heads: int, n_layers: int) -> List[
     if n_layers % (shape.get("pp", 1)) != 0:
         problems.append(f"n_layers={n_layers} not divisible by pp={shape.get('pp')}")
     return problems
+
+
+def group_devices_by_slice(devices: Sequence[jax.Device]) -> Dict[int, list]:
+    """Group devices by their TPU slice (`slice_index`; single-slice and
+    CPU devices all land in slice 0)."""
+    groups: Dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    return groups
+
+
+def create_hybrid_mesh(
+    config: MeshConfig | None = None,
+    *,
+    dcn_dp: int = -1,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axes: Optional[Dict[str, int]] = None,
+    slice_assignments: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Multi-slice mesh: `dp` spans slices over DCN, every other axis stays
+    inside a slice on ICI (the megascale layout; public recipe:
+    jax mesh_utils.create_hybrid_device_mesh).
+
+    `config`/`axes` describe the WITHIN-slice sharding; `dcn_dp` is the
+    between-slice data-parallel degree (-1 = one dp shard per slice). The
+    returned mesh's dp axis size is ``dcn_dp * config.dp``; gradient psums
+    over dp then hierarchically reduce inside each slice first (ICI) and
+    cross slices (DCN) once — XLA does that decomposition when the axis is
+    laid out slice-major, which this function guarantees.
+
+    `slice_assignments` forces a slice id per device — the CPU-mesh test
+    hook (virtual CPU devices all report slice 0).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if slice_assignments is not None:
+        if len(slice_assignments) != len(devices):
+            raise ValueError(
+                f"slice_assignments has {len(slice_assignments)} entries "
+                f"for {len(devices)} devices")
+        groups: Dict[int, list] = {}
+        for d, s in zip(devices, slice_assignments):
+            groups.setdefault(s, []).append(d)
+    else:
+        groups = group_devices_by_slice(devices)
+    n_slices = len(groups)
+    if dcn_dp == -1:
+        dcn_dp = n_slices
+    if dcn_dp != n_slices:
+        raise ValueError(
+            f"dcn_dp={dcn_dp} but {n_slices} slices present (one dp shard "
+            f"per slice is the supported DCN layout)")
+    sizes = sorted(len(g) for g in groups.values())
+    if sizes[0] != sizes[-1]:
+        raise ValueError(f"uneven slices: {sizes}")
+    per_slice = sizes[0]
+
+    if config is None:
+        config = MeshConfig(**(axes or {"dp": 1}))
+    config = config.resolved(per_slice)
+
+    if devices[0].platform == "tpu" and slice_assignments is None:
+        try:
+            from jax.experimental import mesh_utils
+
+            inner = tuple(config.axis_sizes()[a] for a in AXIS_ORDER)
+            dcn = tuple(dcn_dp if a == "dp" else 1 for a in AXIS_ORDER)
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                inner, dcn, devices=devices)
+            return Mesh(dev_array, AXIS_ORDER)
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "create_hybrid_device_mesh failed (%s: %s); using "
+                "slice-major fallback layout", type(e).__name__, e)
+    # Fallback (CPU tests / degraded TPU path): slice-major ordering makes
+    # dp the slowest-varying axis, so dp index = slice for the DCN part.
+    ordered: list = []
+    for s in sorted(groups):
+        ordered.extend(groups[s])
+    sizes_d = config.axis_sizes()
+    shape = tuple((dcn_dp * sizes_d[a]) if a == "dp" else sizes_d[a]
+                  for a in AXIS_ORDER)
+    dev_array = np.asarray(ordered, dtype=object).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)

@@ -58,6 +58,12 @@ class JaxPredictor(Predictor):
         return np.asarray(self._apply(self.params, batch))
 
 
+# Per-process predictor cache: scoring-pool actors rebuild the predictor
+# at most once per process even though every block task re-deserializes
+# its closure (actor task args are serialized per call).
+_PREDICTOR_CACHE: dict = {}
+
+
 class BatchPredictor:
     """Map a predictor over a Dataset on a pool of long-lived actors
     (reference: train/batch_predictor.py — each scoring actor builds the
@@ -77,19 +83,34 @@ class BatchPredictor:
     def predict(self, dataset, *, num_scoring_workers: int = 2,
                 batch_format: str = "auto"):
         """Returns a materialized Dataset of predictions."""
+        import ray_tpu
         from ray_tpu.data.dataset import ActorPoolStrategy
 
-        ckpt = self.checkpoint
+        # ship the checkpoint through the object store ONCE; block tasks
+        # carry only the small ref, and each scoring process restores the
+        # predictor a single time via the module-level cache
+        ckpt_ref = ray_tpu.put(self.checkpoint)
+        key = self.checkpoint.id
         predictor_cls = self.predictor_cls
         kwargs = self.predictor_kwargs
-        holder: list = []   # per-actor build-once (closure state travels
-                            # to each pool actor with the stage)
 
         def score(batch):
-            if not holder:
-                holder.append(predictor_cls.from_checkpoint(ckpt, **kwargs))
-            return holder[0].predict(batch)
+            import ray_tpu
+            from ray_tpu.train.predictor import _PREDICTOR_CACHE
 
-        return dataset.map_batches(
+            predictor = _PREDICTOR_CACHE.get(key)
+            if predictor is None:
+                ckpt = ray_tpu.get(ckpt_ref)
+                predictor = predictor_cls.from_checkpoint(ckpt, **kwargs)
+                _PREDICTOR_CACHE[key] = predictor
+            return predictor.predict(batch)
+
+        result = dataset.map_batches(
             score, batch_format=batch_format,
         ).materialize(compute=ActorPoolStrategy(num_scoring_workers))
+        # Pin the checkpoint ref to the result: in-flight block tasks hold
+        # it only inside pickled closures, which the owner-based ref
+        # counter can't see — dropping our handle here would free the
+        # object out from under them.
+        result._keep_alive = ckpt_ref
+        return result

@@ -39,6 +39,7 @@ def _inspect(obj, name: str, depth: int, failures: list, seen: set):
     if depth <= 0:
         # depth budget exhausted: name this object rather than reporting
         # "unserializable" with no culprit at all
+        seen.add(id(obj))   # one report per object, however many paths
         failures.append(FailureTuple(obj, name, name))
         return
     seen.add(id(obj))

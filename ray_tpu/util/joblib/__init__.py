@@ -59,19 +59,55 @@ def register_ray():
         def _loop(self):
             import ray_tpu
 
+            consecutive_errors = 0
             while True:
                 with self._lock:
                     refs = list(self._pending)
                     if not refs:
                         self._thread = None
                         return
-                ready, _ = ray_tpu.wait(refs,
-                                        num_returns=1, timeout=0.2)
+                try:
+                    ready, _ = ray_tpu.wait(refs,
+                                            num_returns=1, timeout=0.2)
+                    consecutive_errors = 0
+                except BaseException as e:  # noqa: BLE001
+                    consecutive_errors += 1
+                    if consecutive_errors < 5:
+                        import time as _time
+
+                        _time.sleep(0.2)
+                        continue
+                    # the runtime is gone: fail every pending result so
+                    # joblib.Parallel raises instead of hanging forever
+                    with self._lock:
+                        pending = list(self._pending.values())
+                        self._pending.clear()
+                        self._thread = None
+                    for result in pending:
+                        result._value = e
+                        result._done.set()
+                        if result._callback is not None:
+                            try:
+                                result._callback(e)
+                            except Exception:
+                                pass
+                    return
                 for ref in ready:
                     with self._lock:
                         result = self._pending.pop(ref, None)
                     if result is not None:
                         result._resolve()
+
+        def cancel_all(self):
+            import ray_tpu
+
+            with self._lock:
+                refs = list(self._pending)
+            for ref in refs:
+                try:
+                    ray_tpu.cancel(ref)
+                except Exception:
+                    pass
 
     class RayBackend(ParallelBackendBase):
         supports_timeout = True
@@ -100,7 +136,11 @@ def register_ray():
             return result
 
         def abort_everything(self, ensure_ready=True):
-            pass
+            # cancel outstanding remote batches so a failed fit doesn't
+            # leave hours of work running in the background
+            waiter = getattr(self, "_waiter", None)
+            if waiter is not None:
+                waiter.cancel_all()
 
     register_parallel_backend("ray", RayBackend)
 
