@@ -15,7 +15,7 @@ _BUILD_DIR = os.path.join(_REPO_ROOT, "build")
 _LOCK = threading.Lock()
 
 _LIBS = {
-    "raystore": ["src/store/store.cc"],
+    "raystore": ["src/store/store.cc", "src/store/data_server.cc"],
 }
 
 

@@ -160,6 +160,12 @@ class Raylet:
                                       f"spill_{self.node_id[:8]}")
         self.store = StoreClient(self.store_name, create=True,
                                  size=store_size, spill_dir=self.spill_dir)
+        # native (C++) chunk server: remote pulls stream object bytes out
+        # of the mmap'd segment GIL-free (src/store/data_server.cc)
+        try:
+            self.data_port = self.store.start_data_server()
+        except Exception:
+            self.data_port = None
         self._lock = threading.RLock()
         self._workers: dict[str, WorkerHandle] = {}    # worker_id -> handle
         self._idle: list[WorkerHandle] = []
@@ -181,6 +187,7 @@ class Raylet:
                              "session_dir": self.session_dir,
                              "hostname": os.uname().nodename,
                              "pid": os.getpid(),
+                             "object_data_port": self.data_port,
                              "tpu": self.tpu_topology})
         self._gcs.call("subscribe", channels=["placement_groups"])
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True,

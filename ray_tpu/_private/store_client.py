@@ -58,6 +58,8 @@ def _load():
                "store_delete"):
         getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.store_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64 * 4)]
+    lib.store_data_server_start.restype = ctypes.c_int
+    lib.store_data_server_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
     return lib
 
 
@@ -135,6 +137,15 @@ class StoreClient:
         self.spill_dir = spill_dir
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
+
+    def start_data_server(self, port: int = 0) -> int:
+        """Start the native (C++) chunk server over this segment; returns
+        the bound TCP port. Serving threads read straight from the mmap —
+        no Python/GIL on the data path (src/store/data_server.cc)."""
+        bound = self._libref.store_data_server_start(self._h, port)
+        if bound < 0:
+            raise StoreError(-8, "data_server_start")
+        return bound
 
     # -- core ops -----------------------------------------------------------
 

@@ -478,6 +478,10 @@ class _ActorQueue:
         self.worker._handle_task_reply(spec, reply, None)
 
 
+# sentinel: a pooled data-plane socket died mid-request — retry once fresh
+_RETRY_FRESH = object()
+
+
 class CoreWorker:
     """One per process (driver or worker)."""
 
@@ -817,9 +821,133 @@ class CoreWorker:
         pulled concurrently by this worker is capped."""
         from ray_tpu._private.config import get_config
 
-        addr = (node_snapshot["NodeManagerAddress"],
-                node_snapshot["NodeManagerPort"])
+        host = node_snapshot["NodeManagerAddress"]
         chunk = int(get_config("object_transfer_chunk_bytes"))
+        data = None
+        # fast path: the remote raylet's native (C++) data server streams
+        # the bytes straight out of its shm segment, GIL-free
+        data_port = node_snapshot.get("object_data_port")
+        if data_port:
+            data = self._pull_native(object_id, (host, data_port), chunk)
+        if data is None:
+            data = self._pull_rpc(
+                object_id, (host, node_snapshot["NodeManagerPort"]), chunk)
+        if data is None:
+            return None
+        # Cache locally for future gets (reference: pulled chunks land in
+        # local plasma).
+        try:
+            self.store.put(object_id, data)
+            self.gcs.push("add_object_location", object_id=object_id,
+                          node_id=self.node_id, size=len(data))
+        except Exception:
+            pass
+        return data
+
+    def _data_sock_checkout(self, addr):
+        """Persistent-connection pool for the native data plane (one
+        in-flight request per socket; concurrent pulls each check out
+        their own)."""
+        import socket as _socket
+
+        lock = self.__dict__.setdefault("_data_sock_lock",
+                                        threading.Lock())
+        pool = self.__dict__.setdefault("_data_sock_pool", {})
+        with lock:
+            socks = pool.get(addr)
+            if socks:
+                return socks.pop(), True
+        # short connect probe: an unreachable (firewalled) data port must
+        # fail over to the RPC plane in seconds, not minutes
+        sock = _socket.create_connection(addr, timeout=5.0)
+        sock.settimeout(120.0)
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        return sock, False
+
+    def _data_sock_checkin(self, addr, sock):
+        with self._data_sock_lock:
+            socks = self._data_sock_pool.setdefault(addr, [])
+            if len(socks) < 4:
+                socks.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _pull_native(self, object_id: bytes, addr, chunk: int):
+        """Fetch via the remote store's C++ data server
+        (src/store/data_server.cc). Protocol: 32-byte request (id, offset,
+        max_len) -> 16-byte header (total_size, payload_len) + payload.
+        A pooled (possibly stale) connection gets one retry on a fresh
+        socket before giving up."""
+        result = self._pull_native_once(object_id, addr, chunk)
+        if result is _RETRY_FRESH:
+            result = self._pull_native_once(object_id, addr, chunk)
+        return None if result is _RETRY_FRESH else result
+
+    def _pull_native_once(self, object_id: bytes, addr, chunk: int):
+        import struct as _struct
+
+        missing = (1 << 64) - 1
+        admitted = 0
+        sock = None
+        pooled = False
+        ok = False
+        try:
+            sock, pooled = self._data_sock_checkout(addr)
+
+            def read_into(view):
+                got = 0
+                n = len(view)
+                while got < n:
+                    r = sock.recv_into(view[got:], n - got)
+                    if r == 0:
+                        raise ConnectionError("data server closed")
+                    got += r
+
+            header = bytearray(16)
+            data = None
+            size = None
+            offset = 0
+            while size is None or offset < size:
+                sock.sendall(object_id + _struct.pack("<QQ", offset, chunk))
+                read_into(memoryview(header))
+                total, n = _struct.unpack("<QQ", header)
+                if total == missing:
+                    ok = True            # healthy conversation, no object
+                    return None
+                if size is None:
+                    size = total
+                    admitted = size
+                    self._admit_pull(size)
+                    data = bytearray(size)
+                    if size == 0:
+                        break
+                if n == 0:
+                    ok = True
+                    return None          # evicted/shrunk mid-pull
+                read_into(memoryview(data)[offset:offset + n])
+                offset += n
+            ok = True
+            return bytes(data) if data is not None else None
+        except Exception:
+            # a dead pooled socket deserves one retry on a fresh one
+            return _RETRY_FRESH if pooled else None
+        finally:
+            if admitted:
+                self._release_pull(admitted)
+            if sock is not None:
+                if ok:
+                    self._data_sock_checkin(addr, sock)
+                else:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+    def _pull_rpc(self, object_id: bytes, addr, chunk: int):
+        """Fallback chunk fetch over the Python RPC plane."""
         try:
             client = RpcClient(addr, timeout=120.0)
         except ConnectionLost:
@@ -841,24 +969,13 @@ class CoreWorker:
                 if part is None:   # evicted mid-pull
                     return None
                 data += part["data"]
-            data = bytes(data)
+            return bytes(data)
         except (ConnectionLost, Exception):  # noqa: BLE001
             return None
         finally:
             if admitted:
                 self._release_pull(admitted)
             client.close()
-        if data is None:
-            return None
-        # Cache locally for future gets (reference: pulled chunks land in
-        # local plasma).
-        try:
-            self.store.put(object_id, data)
-            self.gcs.push("add_object_location", object_id=object_id,
-                          node_id=self.node_id, size=len(data))
-        except Exception:
-            pass
-        return data
 
     def _admit_pull(self, nbytes: int):
         """Block until the pull fits the in-flight budget (always admit when

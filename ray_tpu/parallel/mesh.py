@@ -202,7 +202,8 @@ def create_hybrid_mesh(
     per_slice = sizes[0]
 
     if config is None:
-        config = MeshConfig(**(axes or {"dp": 1}))
+        # default: all within-slice devices on tp (dp is the DCN axis here)
+        config = MeshConfig(**(axes or {"tp": -1}))
     config = config.resolved(per_slice)
 
     if devices[0].platform == "tpu" and slice_assignments is None:

@@ -60,8 +60,18 @@ class JaxPredictor(Predictor):
 
 # Per-process predictor cache: scoring-pool actors rebuild the predictor
 # at most once per process even though every block task re-deserializes
-# its closure (actor task args are serialized per call).
+# its closure (actor task args are serialized per call). Keyed by the
+# OWNING BatchPredictor's unique id (not the checkpoint's) so two
+# predictors sharing a checkpoint but differing in apply_fn/kwargs never
+# collide; FIFO-bounded so old params don't pin process memory forever.
 _PREDICTOR_CACHE: dict = {}
+_PREDICTOR_CACHE_MAX = 4
+
+
+def _cache_put(key, predictor):
+    while len(_PREDICTOR_CACHE) >= _PREDICTOR_CACHE_MAX:
+        _PREDICTOR_CACHE.pop(next(iter(_PREDICTOR_CACHE)))
+    _PREDICTOR_CACHE[key] = predictor
 
 
 class BatchPredictor:
@@ -71,9 +81,12 @@ class BatchPredictor:
 
     def __init__(self, checkpoint: Checkpoint, predictor_cls,
                  **predictor_kwargs):
+        import uuid
+
         self.checkpoint = checkpoint
         self.predictor_cls = predictor_cls
         self.predictor_kwargs = predictor_kwargs
+        self._cache_key = uuid.uuid4().hex
 
     @classmethod
     def from_checkpoint(cls, checkpoint: Checkpoint, predictor_cls,
@@ -90,19 +103,19 @@ class BatchPredictor:
         # carry only the small ref, and each scoring process restores the
         # predictor a single time via the module-level cache
         ckpt_ref = ray_tpu.put(self.checkpoint)
-        key = self.checkpoint.id
+        key = self._cache_key
         predictor_cls = self.predictor_cls
         kwargs = self.predictor_kwargs
 
         def score(batch):
             import ray_tpu
-            from ray_tpu.train.predictor import _PREDICTOR_CACHE
+            from ray_tpu.train.predictor import _PREDICTOR_CACHE, _cache_put
 
             predictor = _PREDICTOR_CACHE.get(key)
             if predictor is None:
                 ckpt = ray_tpu.get(ckpt_ref)
                 predictor = predictor_cls.from_checkpoint(ckpt, **kwargs)
-                _PREDICTOR_CACHE[key] = predictor
+                _cache_put(key, predictor)
             return predictor.predict(batch)
 
         result = dataset.map_batches(

@@ -37,9 +37,9 @@ def _inspect(obj, name: str, depth: int, failures: list, seen: set):
     if id(obj) in seen:
         return
     if depth <= 0:
-        # depth budget exhausted: name this object rather than reporting
-        # "unserializable" with no culprit at all
-        seen.add(id(obj))   # one report per object, however many paths
+        # Depth budget exhausted: name this object rather than reporting
+        # "unserializable" with no culprit at all. NOT added to `seen` —
+        # a later visit via a shorter path still deserves a full walk.
         failures.append(FailureTuple(obj, name, name))
         return
     seen.add(id(obj))

@@ -1,0 +1,195 @@
+// Native object data-plane server.
+//
+// Serves sealed objects from the shared-memory store over TCP with zero
+// Python involvement on the serving side — the TPU-native analog of the
+// reference's C++ ObjectManager chunk transfer
+// (/root/reference/src/ray/object_manager/object_manager.h, chunked gRPC
+// push/pull). The Python raylet keeps orchestrating WHICH objects move
+// (locations, admission control); the bytes themselves are read out of
+// the mmap'd segment and written to the socket by these threads, GIL-free.
+//
+// Wire protocol (all integers little-endian):
+//   request : 16-byte object id | uint64 offset | uint64 max_length
+//   response: uint64 total_size | uint64 payload_length | payload bytes
+//             total_size == UINT64_MAX  => object not present (sealed) here
+// Connections are persistent; one request/response at a time per
+// connection (pullers pipeline by chunking sequentially, like the
+// reference's per-chunk gRPC calls).
+
+#include <cerrno>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <new>
+
+struct Store;  // opaque; defined in store.cc (same translation library)
+
+extern "C" {
+int store_get(Store* s, const uint8_t* id, void** out_ptr,
+              uint64_t* out_size);
+int store_release(Store* s, const uint8_t* id);
+}
+
+namespace {
+
+constexpr uint64_t kMissing = ~0ull;
+constexpr size_t kReqSize = 32;  // 16B id + 8B offset + 8B length
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t w = write(fd, p, n);
+    if (w < 0 && errno == EINTR) continue;
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+struct ConnArg {
+  Store* store;
+  int fd;
+};
+
+void* conn_main(void* argp) {
+  ConnArg* arg = static_cast<ConnArg*>(argp);
+  Store* store = arg->store;
+  int fd = arg->fd;
+  delete arg;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // Bounded sends: a hung/stopped puller must not pin the object (the
+  // store_get refcount) forever — after the timeout the write fails, the
+  // pin is released and the thread exits.
+  timeval send_timeout{120, 0};
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &send_timeout,
+             sizeof(send_timeout));
+  uint8_t req[kReqSize];
+  while (read_full(fd, req, kReqSize)) {
+    uint64_t offset, max_len;
+    memcpy(&offset, req + 16, 8);
+    memcpy(&max_len, req + 24, 8);
+    void* ptr = nullptr;
+    uint64_t size = 0;
+    uint64_t hdr[2];
+    if (store_get(store, req, &ptr, &size) != 0) {
+      hdr[0] = kMissing;
+      hdr[1] = 0;
+      if (!write_full(fd, hdr, sizeof(hdr))) break;
+      continue;
+    }
+    uint64_t n = 0;
+    if (offset < size) {
+      n = size - offset;
+      if (n > max_len) n = max_len;
+    }
+    hdr[0] = size;
+    hdr[1] = n;
+    bool ok = write_full(fd, hdr, sizeof(hdr)) &&
+              (n == 0 ||
+               write_full(fd, static_cast<uint8_t*>(ptr) + offset, n));
+    store_release(store, req);
+    if (!ok) break;
+  }
+  close(fd);
+  return nullptr;
+}
+
+struct SrvArg {
+  Store* store;
+  int lfd;
+};
+
+void* accept_main(void* argp) {
+  SrvArg* arg = static_cast<SrvArg*>(argp);
+  for (;;) {
+    int cfd = accept(arg->lfd, nullptr, nullptr);
+    if (cfd < 0) {
+      if (errno == EINTR) continue;
+      break;  // listener closed (process exit)
+    }
+    auto* carg = new (std::nothrow) ConnArg{arg->store, cfd};
+    if (!carg) {
+      close(cfd);
+      continue;
+    }
+    pthread_t tid;
+    pthread_attr_t attr;
+    pthread_attr_init(&attr);
+    pthread_attr_setdetachstate(&attr, PTHREAD_CREATE_DETACHED);
+    if (pthread_create(&tid, &attr, conn_main, carg) != 0) {
+      close(cfd);
+      delete carg;
+    }
+    pthread_attr_destroy(&attr);
+  }
+  close(arg->lfd);
+  delete arg;
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start serving `store` on TCP `port` (0 = ephemeral). Returns the bound
+// port, or -1 on error. The server runs detached until process exit.
+int store_data_server_start(Store* s, int port) {
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return -1;
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(lfd, 64) != 0) {
+    close(lfd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  if (getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen) != 0) {
+    close(lfd);
+    return -1;
+  }
+  auto* arg = new (std::nothrow) SrvArg{s, lfd};
+  if (!arg) {
+    close(lfd);
+    return -1;
+  }
+  pthread_t tid;
+  pthread_attr_t attr;
+  pthread_attr_init(&attr);
+  pthread_attr_setdetachstate(&attr, PTHREAD_CREATE_DETACHED);
+  if (pthread_create(&tid, &attr, accept_main, arg) != 0) {
+    close(lfd);
+    delete arg;
+    pthread_attr_destroy(&attr);
+    return -1;
+  }
+  pthread_attr_destroy(&attr);
+  return ntohs(addr.sin_port);
+}
+
+}  // extern "C"
