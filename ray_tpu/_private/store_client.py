@@ -58,8 +58,11 @@ def _load():
                "store_delete"):
         getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.store_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64 * 4)]
-    lib.store_data_server_start.restype = ctypes.c_int
-    lib.store_data_server_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.store_data_server_start.restype = ctypes.c_void_p
+    lib.store_data_server_start.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.store_data_server_stop.restype = ctypes.c_int
+    lib.store_data_server_stop.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -141,11 +144,23 @@ class StoreClient:
     def start_data_server(self, port: int = 0) -> int:
         """Start the native (C++) chunk server over this segment; returns
         the bound TCP port. Serving threads read straight from the mmap —
-        no Python/GIL on the data path (src/store/data_server.cc)."""
-        bound = self._libref.store_data_server_start(self._h, port)
-        if bound < 0:
+        no Python/GIL on the data path (src/store/data_server.cc). Stopped
+        automatically (before the segment is torn down) in close()."""
+        out_port = ctypes.c_int(0)
+        handle = self._libref.store_data_server_start(
+            self._h, port, ctypes.byref(out_port))
+        if not handle:
             raise StoreError(-8, "data_server_start")
-        return bound
+        self._data_server_handle = handle
+        return out_port.value
+
+    def stop_data_server(self) -> bool:
+        handle = getattr(self, "_data_server_handle", None)
+        if not handle:
+            return True
+        rc = self._libref.store_data_server_stop(handle)
+        self._data_server_handle = None
+        return rc == 0
 
     # -- core ops -----------------------------------------------------------
 
@@ -316,6 +331,12 @@ class StoreClient:
 
     def close(self):
         if self._h:
+            # serving threads must be gone BEFORE the segment is unmapped;
+            # if any are wedged, deliberately LEAK the mapping (a leaked
+            # segment beats a use-after-free crash)
+            if not self.stop_data_server():
+                self._h = None
+                return
             if self._owner:
                 self._libref.store_destroy(self._h)
             else:

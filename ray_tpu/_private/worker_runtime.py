@@ -844,10 +844,12 @@ class CoreWorker:
             pass
         return data
 
-    def _data_sock_checkout(self, addr):
+    def _data_sock_checkout(self, addr, fresh: bool = False):
         """Persistent-connection pool for the native data plane (one
         in-flight request per socket; concurrent pulls each check out
-        their own)."""
+        their own). fresh=True bypasses AND drains the pool for this addr
+        — used by the retry after a pooled socket died, since its siblings
+        are likely dead too (server restart)."""
         import socket as _socket
 
         lock = self.__dict__.setdefault("_data_sock_lock",
@@ -855,7 +857,14 @@ class CoreWorker:
         pool = self.__dict__.setdefault("_data_sock_pool", {})
         with lock:
             socks = pool.get(addr)
-            if socks:
+            if fresh and socks:
+                for s in socks:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                socks.clear()
+            elif socks:
                 return socks.pop(), True
         # short connect probe: an unreachable (firewalled) data port must
         # fail over to the RPC plane in seconds, not minutes
@@ -883,10 +892,12 @@ class CoreWorker:
         socket before giving up."""
         result = self._pull_native_once(object_id, addr, chunk)
         if result is _RETRY_FRESH:
-            result = self._pull_native_once(object_id, addr, chunk)
+            result = self._pull_native_once(object_id, addr, chunk,
+                                            fresh=True)
         return None if result is _RETRY_FRESH else result
 
-    def _pull_native_once(self, object_id: bytes, addr, chunk: int):
+    def _pull_native_once(self, object_id: bytes, addr, chunk: int,
+                          fresh: bool = False):
         import struct as _struct
 
         missing = (1 << 64) - 1
@@ -895,7 +906,7 @@ class CoreWorker:
         pooled = False
         ok = False
         try:
-            sock, pooled = self._data_sock_checkout(addr)
+            sock, pooled = self._data_sock_checkout(addr, fresh=fresh)
 
             def read_into(view):
                 got = 0

@@ -15,3 +15,8 @@ from ray_tpu.train.predictor import (  # noqa: F401
     JaxPredictor,
     Predictor,
 )
+from ray_tpu.train.torch import (  # noqa: F401
+    TorchConfig,
+    TorchTrainer,
+    prepare_model,
+)

@@ -81,12 +81,25 @@ class BatchPredictor:
 
     def __init__(self, checkpoint: Checkpoint, predictor_cls,
                  **predictor_kwargs):
-        import uuid
-
         self.checkpoint = checkpoint
         self.predictor_cls = predictor_cls
         self.predictor_kwargs = predictor_kwargs
-        self._cache_key = uuid.uuid4().hex
+        # content-addressed cache key: identical (checkpoint, class,
+        # kwargs) reuse the cached predictor across jobs; differing
+        # apply_fns/kwargs never collide (cloudpickle is content-based)
+        try:
+            import hashlib
+
+            import cloudpickle
+
+            blob = cloudpickle.dumps(
+                (predictor_cls, sorted(predictor_kwargs.items())))
+            self._cache_key = (checkpoint.id
+                               + hashlib.sha1(blob).hexdigest()[:16])
+        except Exception:
+            import uuid
+
+            self._cache_key = uuid.uuid4().hex
 
     @classmethod
     def from_checkpoint(cls, checkpoint: Checkpoint, predictor_cls,

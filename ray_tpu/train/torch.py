@@ -1,0 +1,87 @@
+"""TorchTrainer — torch.distributed data parallelism over the actor gang.
+
+Reference: python/ray/train/torch/config.py:29,69,123 (_TorchBackend picks
+a TCP rendezvous on rank 0 and calls dist.init_process_group on every
+worker) and torch/torch_trainer.py. On this framework the TPU path is
+JaxTrainer; TorchTrainer serves CPU-side torch workloads and migration
+parity — same WorkerGroup/PG gang, gloo process group (NCCL absent by
+design: GPU collectives are out of scope for a TPU-native build).
+"""
+from __future__ import annotations
+
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.backend_executor import Backend
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+class TorchConfig:
+    """(reference: train/torch/config.py TorchConfig)"""
+
+    def __init__(self, backend: str = "gloo", init_timeout_s: float = 120.0):
+        self.backend = backend
+        self.init_timeout_s = init_timeout_s
+
+    def backend_cls(self):
+        return _TorchBackend(self)
+
+
+class _TorchBackend(Backend):
+    def __init__(self, config: TorchConfig):
+        self.config = config
+
+    def on_start(self, worker_group, scaling: ScalingConfig):
+        # rank 0's host provides the TCP rendezvous (reference:
+        # _setup_torch_process_group, train/torch/config.py:69)
+        addr = worker_group.execute_single(0, "free_coordinator_address")
+        backend = self.config.backend
+        timeout_s = self.config.init_timeout_s
+
+        def _setup(rank, world_size, addr, backend, timeout_s):
+            import datetime
+
+            import torch.distributed as dist
+
+            if not dist.is_initialized():
+                dist.init_process_group(
+                    backend, init_method=f"tcp://{addr}",
+                    rank=rank, world_size=world_size,
+                    timeout=datetime.timedelta(seconds=timeout_s))
+            return rank
+
+        worker_group.execute(
+            "run_setup", (_setup, (addr, backend, timeout_s), {}))
+
+    def on_shutdown(self, worker_group):
+        def _teardown(rank, world_size):
+            import torch.distributed as dist
+
+            if dist.is_initialized():
+                dist.destroy_process_group()
+            return True
+
+        try:
+            worker_group.execute("run_setup", (_teardown, (), {}))
+        except Exception:
+            pass
+
+
+class TorchTrainer(DataParallelTrainer):
+    """(reference: train/torch/torch_trainer.py TorchTrainer)"""
+
+    def __init__(self, train_loop_per_worker, *,
+                 torch_config: TorchConfig | None = None, **kwargs):
+        super().__init__(train_loop_per_worker,
+                         backend_config=torch_config or TorchConfig(),
+                         **kwargs)
+
+
+def prepare_model(model):
+    """Wrap a torch model for data-parallel training (reference:
+    train/torch/train_loop_utils.py prepare_model — DDP wrap; device
+    placement is a no-op on CPU workers)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model

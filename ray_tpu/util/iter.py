@@ -1,0 +1,187 @@
+"""Parallel iterators over actor shards.
+
+Reference: python/ray/util/iter.py (ParallelIterator / LocalIterator —
+sharded lazy iterators held by actors, transformed with for_each/filter/
+batch and consumed via gather_sync/gather_async). Useful as a lightweight
+streaming alternative to Dataset when per-item order/laziness matters
+(e.g. RL sample streams).
+"""
+from __future__ import annotations
+
+import ray_tpu
+
+# shard replies are wrapped tuples, never compared against user values
+# (a plain sentinel compared with == would crash on numpy/pandas values
+# and silently truncate shards that legitimately yield the sentinel)
+_ITEM, _STOP = "item", "stop"
+
+
+class _ShardActor:
+    """Holds one shard's iterator + its transform chain."""
+
+    def __init__(self, make_iter, transforms):
+        self._make_iter = make_iter
+        self._transforms = list(transforms)
+        self._it = None
+
+    def _build(self):
+        it = iter(self._make_iter())
+        for kind, fn in self._transforms:
+            if kind == "for_each":
+                it = map(fn, it)
+            elif kind == "filter":
+                it = filter(fn, it)
+            elif kind == "batch":
+                it = _batched(it, fn)
+            elif kind == "flatten":
+                it = (x for chunk in it for x in chunk)
+        return it
+
+    def next(self):
+        if self._it is None:
+            self._it = self._build()
+        try:
+            return (_ITEM, next(self._it))
+        except StopIteration:
+            return (_STOP, None)
+
+    def reset(self):
+        self._it = None
+
+
+def _batched(it, n):
+    batch = []
+    for x in it:
+        batch.append(x)
+        if len(batch) == n:
+            yield batch
+            batch = []
+    if batch:
+        yield batch
+
+
+class ParallelIterator:
+    """A set of per-shard iterators living in actors; transforms are
+    recorded lazily and run shard-local, only gathered values cross the
+    cluster."""
+
+    def __init__(self, shard_makers, transforms=()):
+        self._shard_makers = list(shard_makers)
+        self._transforms = list(transforms)
+
+    # ------------------------------------------------------- transformations
+    def _with(self, kind, fn) -> "ParallelIterator":
+        return ParallelIterator(self._shard_makers,
+                                self._transforms + [(kind, fn)])
+
+    def for_each(self, fn) -> "ParallelIterator":
+        return self._with("for_each", fn)
+
+    def filter(self, fn) -> "ParallelIterator":
+        return self._with("filter", fn)
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return self._with("batch", n)
+
+    def flatten(self) -> "ParallelIterator":
+        return self._with("flatten", None)
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        if self._transforms != other._transforms:
+            # materialize transforms into the shard makers via actors at
+            # gather time; differing chains can't merge lazily
+            raise ValueError("union requires identical transform chains; "
+                             "call union before transforming, or gather")
+        return ParallelIterator(self._shard_makers + other._shard_makers,
+                                self._transforms)
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._shard_makers)
+
+    # ------------------------------------------------------------- gathering
+    def _spawn(self):
+        actor_cls = ray_tpu.remote(_ShardActor)
+        return [actor_cls.options(num_cpus=0).remote(mk, self._transforms)
+                for mk in self._shard_makers]
+
+    def gather_sync(self):
+        """Round-robin over shards in order; stops when all exhaust."""
+        actors = self._spawn()
+        try:
+            live = list(actors)
+            while live:
+                for actor in list(live):
+                    kind, value = ray_tpu.get(actor.next.remote())
+                    if kind == _STOP:
+                        live.remove(actor)
+                    else:
+                        yield value
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+    def gather_async(self, num_async: int = 1):
+        """Yield values in completion order (reference: gather_async) —
+        keeps `num_async` requests in flight per shard."""
+        actors = self._spawn()
+        try:
+            inflight = {}
+            for actor in actors:
+                for _ in range(max(1, num_async)):
+                    inflight[actor.next.remote()] = actor
+            while inflight:
+                ready, _ = ray_tpu.wait(list(inflight), num_returns=1,
+                                        timeout=30)
+                for ref in ready:
+                    actor = inflight.pop(ref)
+                    kind, value = ray_tpu.get(ref)
+                    if kind == _STOP:
+                        continue
+                    inflight[actor.next.remote()] = actor
+                    yield value
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------- terminals
+    def take(self, n: int) -> list:
+        out = []
+        for x in self.gather_sync():
+            out.append(x)
+            if len(out) >= n:
+                break
+        return out
+
+    def count(self) -> int:
+        return sum(1 for _ in self.gather_sync())
+
+    def __repr__(self):
+        return (f"ParallelIterator(shards={self.num_shards}, "
+                f"transforms={len(self._transforms)})")
+
+
+def from_items(items, num_shards: int = 2) -> ParallelIterator:
+    shards = [list(items[i::num_shards]) for i in range(num_shards)]
+    shards = [s for s in shards if s]
+
+    def maker(shard):
+        return lambda: iter(shard)
+
+    return ParallelIterator([maker(s) for s in shards] or [lambda: iter(())])
+
+
+def from_range(n: int, num_shards: int = 2) -> ParallelIterator:
+    return from_items(list(range(n)), num_shards)
+
+
+def from_iterators(makers) -> ParallelIterator:
+    """Each element is a zero-arg callable returning an iterable — one
+    shard each (generators themselves don't pickle; their factories do)."""
+    return ParallelIterator(list(makers))

@@ -26,7 +26,9 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <new>
+#include <set>
 
 struct Store;  // opaque; defined in store.cc (same translation library)
 
@@ -40,6 +42,18 @@ namespace {
 
 constexpr uint64_t kMissing = ~0ull;
 constexpr size_t kReqSize = 32;  // 16B id + 8B offset + 8B length
+
+// Server handle: tracks live connections so stop() can tear the whole
+// thing down BEFORE the Store segment is destroyed (otherwise detached
+// serving threads would touch unmapped memory — use-after-free).
+struct DataServer {
+  Store* store;
+  int lfd;
+  pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+  std::set<int> conns;
+  std::atomic<int> active{0};
+  std::atomic<bool> stopping{false};
+};
 
 bool read_full(int fd, void* buf, size_t n) {
   auto* p = static_cast<uint8_t*>(buf);
@@ -66,15 +80,24 @@ bool write_full(int fd, const void* buf, size_t n) {
 }
 
 struct ConnArg {
-  Store* store;
+  DataServer* srv;
   int fd;
 };
 
 void* conn_main(void* argp) {
   ConnArg* arg = static_cast<ConnArg*>(argp);
-  Store* store = arg->store;
+  DataServer* srv = arg->srv;
+  Store* store = srv->store;
   int fd = arg->fd;
   delete arg;
+  if (srv->stopping.load()) {
+    close(fd);
+    srv->active.fetch_sub(1);
+    return nullptr;
+  }
+  pthread_mutex_lock(&srv->mu);
+  srv->conns.insert(fd);
+  pthread_mutex_unlock(&srv->mu);
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   // Bounded sends: a hung/stopped puller must not pin the object (the
@@ -85,6 +108,7 @@ void* conn_main(void* argp) {
              sizeof(send_timeout));
   uint8_t req[kReqSize];
   while (read_full(fd, req, kReqSize)) {
+    if (srv->stopping.load()) break;
     uint64_t offset, max_len;
     memcpy(&offset, req + 16, 8);
     memcpy(&max_len, req + 24, 8);
@@ -110,28 +134,33 @@ void* conn_main(void* argp) {
     store_release(store, req);
     if (!ok) break;
   }
+  pthread_mutex_lock(&srv->mu);
+  srv->conns.erase(fd);
+  pthread_mutex_unlock(&srv->mu);
   close(fd);
+  srv->active.fetch_sub(1);
   return nullptr;
 }
 
-struct SrvArg {
-  Store* store;
-  int lfd;
-};
-
 void* accept_main(void* argp) {
-  SrvArg* arg = static_cast<SrvArg*>(argp);
+  DataServer* srv = static_cast<DataServer*>(argp);
+  srv->active.fetch_add(1);
   for (;;) {
-    int cfd = accept(arg->lfd, nullptr, nullptr);
+    int cfd = accept(srv->lfd, nullptr, nullptr);
     if (cfd < 0) {
-      if (errno == EINTR) continue;
-      break;  // listener closed (process exit)
+      if (errno == EINTR && !srv->stopping.load()) continue;
+      break;  // listener closed (stop() or process exit)
     }
-    auto* carg = new (std::nothrow) ConnArg{arg->store, cfd};
+    if (srv->stopping.load()) {
+      close(cfd);
+      break;
+    }
+    auto* carg = new (std::nothrow) ConnArg{srv, cfd};
     if (!carg) {
       close(cfd);
       continue;
     }
+    srv->active.fetch_add(1);
     pthread_t tid;
     pthread_attr_t attr;
     pthread_attr_init(&attr);
@@ -139,11 +168,11 @@ void* accept_main(void* argp) {
     if (pthread_create(&tid, &attr, conn_main, carg) != 0) {
       close(cfd);
       delete carg;
+      srv->active.fetch_sub(1);
     }
     pthread_attr_destroy(&attr);
   }
-  close(arg->lfd);
-  delete arg;
+  srv->active.fetch_sub(1);
   return nullptr;
 }
 
@@ -151,11 +180,12 @@ void* accept_main(void* argp) {
 
 extern "C" {
 
-// Start serving `store` on TCP `port` (0 = ephemeral). Returns the bound
-// port, or -1 on error. The server runs detached until process exit.
-int store_data_server_start(Store* s, int port) {
+// Start serving `store` on TCP `port` (0 = ephemeral). Writes the bound
+// port to *out_port. Returns an opaque handle for store_data_server_stop,
+// or nullptr on error.
+void* store_data_server_start(Store* s, int port, int* out_port) {
   int lfd = socket(AF_INET, SOCK_STREAM, 0);
-  if (lfd < 0) return -1;
+  if (lfd < 0) return nullptr;
   int one = 1;
   setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr;
@@ -166,30 +196,56 @@ int store_data_server_start(Store* s, int port) {
   if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
       listen(lfd, 64) != 0) {
     close(lfd);
-    return -1;
+    return nullptr;
   }
   socklen_t alen = sizeof(addr);
   if (getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen) != 0) {
     close(lfd);
-    return -1;
+    return nullptr;
   }
-  auto* arg = new (std::nothrow) SrvArg{s, lfd};
-  if (!arg) {
+  auto* srv = new (std::nothrow) DataServer{};
+  if (!srv) {
     close(lfd);
-    return -1;
+    return nullptr;
   }
+  srv->store = s;
+  srv->lfd = lfd;
   pthread_t tid;
   pthread_attr_t attr;
   pthread_attr_init(&attr);
   pthread_attr_setdetachstate(&attr, PTHREAD_CREATE_DETACHED);
-  if (pthread_create(&tid, &attr, accept_main, arg) != 0) {
+  if (pthread_create(&tid, &attr, accept_main, srv) != 0) {
     close(lfd);
-    delete arg;
+    delete srv;
     pthread_attr_destroy(&attr);
-    return -1;
+    return nullptr;
   }
   pthread_attr_destroy(&attr);
-  return ntohs(addr.sin_port);
+  if (out_port) *out_port = ntohs(addr.sin_port);
+  return srv;
+}
+
+// Stop the server and JOIN (spin-wait) every serving thread so the Store
+// can be safely destroyed afterwards. Waits at most ~5s; the handle leaks
+// (never freed) if threads are wedged past that — deliberate: freeing it
+// under a live thread would be the use-after-free we're preventing.
+int store_data_server_stop(void* handle) {
+  auto* srv = static_cast<DataServer*>(handle);
+  if (!srv) return -1;
+  srv->stopping.store(true);
+  shutdown(srv->lfd, SHUT_RDWR);
+  close(srv->lfd);
+  pthread_mutex_lock(&srv->mu);
+  for (int fd : srv->conns) shutdown(fd, SHUT_RDWR);
+  pthread_mutex_unlock(&srv->mu);
+  for (int i = 0; i < 5000 && srv->active.load() > 0; ++i) {
+    usleep(1000);
+  }
+  if (srv->active.load() == 0) {
+    delete srv;
+    return 0;
+  }
+  return -1;
 }
 
 }  // extern "C"

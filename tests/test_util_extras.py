@@ -129,3 +129,30 @@ def test_joblib_backend(ray_start_regular):
         out = joblib.Parallel()(joblib.delayed(lambda x: x * x)(i)
                                 for i in range(8))
     assert out == [i * i for i in range(8)]
+
+
+def test_parallel_iterator(ray_start_regular):
+    from ray_tpu.util import iter as par_iter
+
+    it = par_iter.from_range(12, num_shards=3)
+    assert it.num_shards == 3
+    out = sorted(it.for_each(lambda x: x * 2).gather_sync())
+    assert out == [x * 2 for x in range(12)]
+
+    evens = par_iter.from_range(10, num_shards=2).filter(
+        lambda x: x % 2 == 0)
+    assert sorted(evens.gather_sync()) == [0, 2, 4, 6, 8]
+
+    batches = par_iter.from_range(8, num_shards=2).batch(2).gather_sync()
+    assert sorted(x for b in batches for x in b) == list(range(8))
+
+    async_out = sorted(
+        par_iter.from_range(9, num_shards=3).gather_async(num_async=2))
+    assert async_out == list(range(9))
+
+    assert par_iter.from_items([1, 2, 3], num_shards=2).count() == 3
+    assert len(par_iter.from_range(20, num_shards=4).take(5)) == 5
+
+    u = par_iter.from_range(3, num_shards=1).union(
+        par_iter.from_items([10, 11], num_shards=1))
+    assert sorted(u.gather_sync()) == [0, 1, 2, 10, 11]
