@@ -11,8 +11,38 @@ harness still produces a line.
 """
 import dataclasses
 import json
+import os
+import subprocess
 import sys
 import time
+
+
+def _tpu_reachable(timeout_s: float = 120.0) -> bool:
+    """Probe the TPU backend in a SUBPROCESS: a hung tunnel (axon) blocks
+    jax.devices() indefinitely and would wedge this whole run. The main
+    process only imports jax after deciding which platform to use."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform == 'tpu'"],
+            timeout=timeout_s, capture_output=True)
+        return probe.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+if not os.environ.get("RAY_TPU_BENCH_SKIP_PROBE") and not _tpu_reachable():
+    # Fall back to the CPU smoke config rather than hanging forever.
+    # BOTH the env var and the config.update are required: the axon
+    # sitecustomize overrides JAX_PLATFORMS programmatically, so the env
+    # var alone is ignored (same workaround as tests/conftest.py). The
+    # probe's extra jax init on healthy TPU hosts (~20-40s) is the price
+    # of not wedging the whole bench run on a hung tunnel — there is no
+    # cheaper reachability check through the tunnel than a backend init.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import jax
 import jax.numpy as jnp
