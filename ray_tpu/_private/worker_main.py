@@ -17,6 +17,7 @@ def main():
     import faulthandler
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)  # `ray stack`
+    faulthandler.enable()   # SIGSEGV/SIGABRT dump to stderr (worker logs)
     gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].split(":")
     raylet_host, raylet_port = os.environ["RAY_TPU_RAYLET_ADDR"].split(":")
 
@@ -45,12 +46,27 @@ def main():
 
     signal.signal(signal.SIGTERM, _term)
 
-    # The RPC server threads do the work; park the main thread. If the raylet
-    # connection drops the node is gone — exit.
-    while True:
-        time.sleep(0.5)
-        if worker.raylet.closed:
-            os._exit(1)
+    # Liveness watchdog: the main thread may be stuck inside a hung task
+    # when the raylet dies — this thread preserves the old guarantee that
+    # a dead node's workers exit within ~0.5s regardless.
+    import threading
+
+    def _watchdog():
+        while True:
+            time.sleep(0.5)
+            if worker.raylet.closed:
+                os._exit(1)
+
+    threading.Thread(target=_watchdog, daemon=True,
+                     name="raylet-watchdog").start()
+
+    # Serve normal-task execution on THIS (main) thread — the reference's
+    # RunTaskExecutionLoop (core_worker.cc:2188). Some native libraries
+    # (pyarrow submodule init) are unreliable on short-lived dispatch
+    # threads; the main thread is always safe. Returns when the raylet
+    # connection drops — the node is gone.
+    worker.serve_task_loop()
+    os._exit(1)
 
 
 if __name__ == "__main__":

@@ -545,6 +545,10 @@ class CoreWorker:
         # pushed accounts for exactly one task's resources at a time
         # (pipelined pushes queue here, hiding RTT, not stacking execution).
         self._normal_exec_lock = threading.Lock()
+        # main-thread task loop (serve_task_loop) plumbing
+        self._main_jobs: queue.Queue = queue.Queue()
+        self._main_loop_running = False
+        self._main_loop_started = threading.Event()
 
         # Connect out only after all execution state exists: registering with
         # the raylet makes us leasable, and a task can be pushed the moment
@@ -1395,7 +1399,48 @@ class CoreWorker:
         self._ready.wait(30.0)
         if spec.get("actor_id") is not None and self.actor_id is not None:
             return self._execute_actor_task(spec, conn)
+        # Normal tasks run on the worker's MAIN thread when it serves the
+        # task loop (reference: core_worker.cc:2188 RunTaskExecutionLoop is
+        # the worker main thread). Thread-hostile native libraries make
+        # this load-bearing: e.g. pyarrow submodule imports from transient
+        # dispatch threads segfault intermittently (observed in CI).
+        if self.mode == "worker":
+            # a lease can arrive between __init__ registering us and
+            # worker_main entering the loop — wait out that window so the
+            # FIRST task (likeliest to do native imports) isn't the one
+            # that lands on a dispatch thread
+            self._main_loop_started.wait(10.0)
+        if self._main_loop_running:
+            from ray_tpu._private.protocol import _Future
+
+            fut = _Future()
+            self._main_jobs.put((spec, fut))
+            return fut.result(timeout=None)
         return self._execute_normal_task(spec)
+
+    def serve_task_loop(self):
+        """Run normal-task execution on the calling thread (the worker
+        process's main thread). Returns when the raylet connection dies."""
+        import queue as _q
+
+        self._main_loop_running = True
+        self._main_loop_started.set()
+        try:
+            while not self.stopped:
+                try:
+                    spec, fut = self._main_jobs.get(timeout=0.5)
+                except _q.Empty:
+                    if self.raylet.closed:
+                        return
+                    continue
+                try:
+                    fut.set(self._execute_normal_task(spec))
+                except BaseException as e:  # noqa: BLE001 — never wedge
+                    from ray_tpu._private.protocol import _RemoteError
+
+                    fut.set(_RemoteError(e))
+        finally:
+            self._main_loop_running = False
 
     def _resolve_args(self, spec):
         args, kwargs = ser.deserialize(spec["args"], self)

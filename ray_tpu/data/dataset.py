@@ -19,6 +19,7 @@ execution of the reference's DatasetPipeline (data/dataset_pipeline.py).
 from __future__ import annotations
 
 import builtins
+import os
 import random as _random
 
 import numpy as np
@@ -43,17 +44,55 @@ def _get_chain_task():
     return _chain_task
 
 
+def _write_block(stages, block, write_one, out_path):
+    # Runs on the WORKER: create the directory there too — driver and
+    # worker need a shared filesystem for distributed writes (same
+    # assumption as the reference's local-filesystem datasource; use a
+    # network mount for multi-host clusters).
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    write_one(_exec_chain(stages, block), out_path)
+    return out_path
+
+
+_write_task = None
+
+
+def _get_write_task():
+    global _write_task
+    if _write_task is None:
+        _write_task = ray_tpu.remote(_write_block)
+    return _write_task
+
+
 class _ActorPoolStrategy:
     """(reference: compute.py:173 ActorPoolStrategy) map stages run on a
     pool of long-lived actors — amortizes heavyweight per-process state
-    (e.g. a compiled jax program or loaded model) across blocks."""
+    (e.g. a compiled jax program or loaded model) across blocks.
 
-    def __init__(self, size: int = 2):
-        self.size = size
+    With min_size < max_size the pool is sized to the workload when the
+    dataset materializes: min(max_size, max(min_size, n_blocks)) actors —
+    a small job doesn't pay for max_size actor startups, a large one is
+    capped (the work-bound sizing of the reference's autoscaling pool;
+    mid-execution scale-up is not implemented)."""
+
+    def __init__(self, size: int | None = None, *, min_size: int = 2,
+                 max_size: int | None = None):
+        if size is not None:
+            min_size = max_size = size
+        if max_size is not None and max_size < min_size:
+            raise ValueError(
+                f"max_size={max_size} < min_size={min_size}")
+        self.min_size = max(1, min_size)
+        self.max_size = max_size or self.min_size
+
+    @property
+    def size(self):
+        return self.max_size
 
 
-def ActorPoolStrategy(size: int = 2):
-    return _ActorPoolStrategy(size)
+def ActorPoolStrategy(size: int | None = None, *, min_size: int = 2,
+                      max_size: int | None = None):
+    return _ActorPoolStrategy(size, min_size=min_size, max_size=max_size)
 
 
 class _BlockWorker:
@@ -64,14 +103,17 @@ class _BlockWorker:
 
 
 class Dataset:
-    def __init__(self, block_refs: list, stages: list | None = None):
+    def __init__(self, block_refs: list, stages: list | None = None,
+                 compute=None):
         self._block_refs = list(block_refs)
         self._stages = list(stages or [])
+        self._compute = compute   # default strategy for materialize()
 
     # ------------------------------------------------------------ plan
 
-    def _with_stage(self, fn) -> "Dataset":
-        return Dataset(self._block_refs, self._stages + [fn])
+    def _with_stage(self, fn, compute=None) -> "Dataset":
+        return Dataset(self._block_refs, self._stages + [fn],
+                       compute=compute or self._compute)
 
     def materialize(self, compute=None) -> "Dataset":
         """Execute pending stages: one task per block (TaskPoolStrategy) or
@@ -79,9 +121,15 @@ class Dataset:
         if not self._stages:
             return self
         stages = self._stages
+        compute = compute if compute is not None else self._compute
         if isinstance(compute, _ActorPoolStrategy):
             worker_cls = ray_tpu.remote(_BlockWorker)
-            pool = [worker_cls.remote() for _ in builtins.range(compute.size)]
+            n_blocks = len(self._block_refs)
+            # work-bound sizing within [min_size, max_size]
+            n_actors = min(compute.max_size,
+                           max(compute.min_size, n_blocks))
+            pool = [worker_cls.remote()
+                    for _ in builtins.range(n_actors)]
             refs = [
                 pool[i % len(pool)].apply.remote(stages, ref)
                 for i, ref in enumerate(self._block_refs)
@@ -117,10 +165,13 @@ class Dataset:
             lambda block: B.columnarize(
                 [row for row in _rows(block) if fn(row)]))
 
-    def map_batches(self, fn, *, batch_format: str = "auto") -> "Dataset":
+    def map_batches(self, fn, *, batch_format: str = "auto",
+                    compute=None) -> "Dataset":
         """fn: block -> block (numpy array in → numpy array out when the
-        block is an array; list otherwise)."""
-        return self._with_stage(fn)
+        block is an array; list otherwise). `compute=ActorPoolStrategy(...)`
+        runs this (and later) stages on a long-lived actor pool when the
+        dataset materializes (reference: dataset.py:322 map_batches)."""
+        return self._with_stage(fn, compute=compute)
 
     def repartition(self, num_blocks: int) -> "Dataset":
         rows = self.take_all()
@@ -409,6 +460,62 @@ class Dataset:
                 label = batch.pop(label_column)
                 yield batch, label
 
+    def _write_blocks(self, path: str, ext: str, write_one):
+        """One output file per block, written by remote tasks (reference:
+        data/datasource/file_based_datasource.py write path). One cached
+        remote task takes write_one as an argument — the _get_chain_task
+        pattern — so repeated write calls reuse a submitter instead of
+        registering a fresh closure per call."""
+        import os as _os
+
+        _os.makedirs(path, exist_ok=True)
+        task = _get_write_task()
+        return ray_tpu.get([
+            task.remote(self._stages, ref, write_one,
+                        _os.path.join(path, f"part-{i:05d}.{ext}"))
+            for i, ref in enumerate(self._block_refs)])
+
+    def write_parquet(self, path: str) -> list:
+        # pyarrow directly — NOT pandas: constructing a DataFrame (whose
+        # Index uses pyarrow-backed strings in this pandas build) on the
+        # worker's RPC dispatch threads segfaults intermittently inside
+        # pandas/pyarrow; pa.table from numpy columns avoids that path
+        def write_one(block, out_path):
+            import pyarrow.parquet as pq
+
+            pq.write_table(_block_to_arrow_table(block), out_path)
+
+        return self._write_blocks(path, "parquet", write_one)
+
+    def write_csv(self, path: str) -> list:
+        def write_one(block, out_path):
+            import pyarrow.csv as pacsv
+
+            pacsv.write_csv(_block_to_arrow_table(block), out_path)
+
+        return self._write_blocks(path, "csv", write_one)
+
+    def write_json(self, path: str) -> list:
+        def write_one(block, out_path):
+            import json as _json
+
+            def plain(v):
+                if isinstance(v, np.ndarray):
+                    return v.tolist()
+                if isinstance(v, np.generic):
+                    return v.item()
+                return v
+
+            with open(out_path, "w") as f:
+                for row in _rows(block):
+                    if isinstance(row, dict):
+                        row = {k: plain(v) for k, v in row.items()}
+                    else:
+                        row = plain(row)
+                    f.write(_json.dumps(row) + "\n")
+
+        return self._write_blocks(path, "json", write_one)
+
     def stats(self) -> dict:
         sizes = ray_tpu.get([
             _get_chain_task().remote(
@@ -485,6 +592,25 @@ class GroupedDataset:
 
 
 # -------------------------------------------------------------- block utils
+
+def _block_to_arrow_table(block):
+    import pyarrow as pa
+
+    def col(a):
+        arr = np.asarray(a)
+        if arr.ndim > 1:
+            return pa.array(arr.tolist())   # nested lists per row
+        return pa.array(arr)
+
+    if isinstance(block, dict):
+        return pa.table({k: col(v) for k, v in block.items()})
+    if isinstance(block, np.ndarray):
+        return pa.table({"value": col(block)})
+    rows = _rows(block)
+    if rows and isinstance(rows[0], dict):
+        return pa.Table.from_pylist(rows)
+    return pa.table({"value": pa.array(rows)})
+
 
 def _rows(block) -> list:
     return B.to_rows(block)
@@ -589,3 +715,5 @@ def from_arrow(tables, *, parallelism: int = 4) -> Dataset:
                     for name in piece.column_names}
             refs.append(ray_tpu.put(cols))
     return Dataset(refs)
+
+

@@ -517,3 +517,22 @@ def run(trainable, *, config: dict | None = None, num_samples: int = 1,
                                mode=mode, scheduler=scheduler),
         resources_per_trial=resources_per_trial)
     return tuner.fit()
+
+
+def with_parameters(trainable, **heavy_kwargs):
+    """Attach large objects to a trainable WITHOUT baking them into every
+    pickled trial config (reference: tune/trainable/util.py
+    with_parameters — ships them once through the object store; each trial
+    actor fetches the ref instead of a copy per config)."""
+    import functools
+
+    refs = {k: ray_tpu.put(v) for k, v in heavy_kwargs.items()}
+
+    @functools.wraps(trainable)
+    def wrapped(config):
+        # the closure cell over `refs` keeps the driver-side pin alive for
+        # as long as the trainable exists
+        resolved = {k: ray_tpu.get(r) for k, r in refs.items()}
+        return trainable(config, **resolved)
+
+    return wrapped

@@ -205,3 +205,31 @@ def test_iter_torch_batches(ray_start_regular):
     pairs = list(ds.to_torch(label_column="y", batch_size=5))
     feats, label = pairs[0]
     assert set(feats) == {"x"} and label.shape == (5,)
+
+
+def test_write_read_roundtrip(ray_start_regular, tmp_path):
+    from ray_tpu import data
+
+    ds = data.from_items([{"a": i, "b": float(i) / 2} for i in range(20)],
+                         parallelism=3)
+    files = ds.write_parquet(str(tmp_path / "pq"))
+    assert len(files) == 3
+    back = data.read_parquet(files)
+    assert back.count() == 20
+
+    csv_files = ds.write_csv(str(tmp_path / "csv"))
+    assert data.read_csv(csv_files).count() == 20
+
+    json_files = ds.write_json(str(tmp_path / "js"))
+    assert data.read_json(json_files).count() == 20
+
+
+def test_actor_pool_autoscaling_bounds(ray_start_regular):
+    from ray_tpu import data
+    from ray_tpu.data.dataset import ActorPoolStrategy
+
+    ds = data.from_items(list(range(40)), parallelism=8)
+    out = ds.map_batches(
+        lambda b: b, compute=ActorPoolStrategy(min_size=1, max_size=3),
+    ).materialize()
+    assert sorted(x for blk in out.blocks() for x in blk) == list(range(40))

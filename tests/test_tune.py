@@ -186,3 +186,25 @@ def test_tuner_with_search_alg(ray_start_regular):
     assert len(grid) == 12
     best = grid.get_best_result()
     assert best.metrics["score"] > -20   # found the neighborhood of x=3
+
+
+def test_with_parameters(ray_start_regular):
+    import numpy as _np
+
+    from ray_tpu import tune
+    from ray_tpu.tune.tuner import with_parameters
+
+    big = _np.arange(1000, dtype=_np.float64)
+
+    def objective(config, data=None):
+        from ray_tpu.air import session
+
+        session.report({"score": float(data.sum()) * config["scale"]})
+
+    grid = tune.Tuner(
+        with_parameters(objective, data=big),
+        param_space={"scale": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(grid) == 2
+    assert grid.get_best_result().metrics["score"] == big.sum() * 2.0
