@@ -549,6 +549,9 @@ class CoreWorker:
         self._main_jobs: queue.Queue = queue.Queue()
         self._main_loop_running = False
         self._main_loop_started = threading.Event()
+        # pooled connections to object owners (borrowed-value fetches)
+        self._owner_clients: dict[tuple, RpcClient] = {}
+        self._owner_client_lock = threading.Lock()
 
         # Connect out only after all execution state exists: registering with
         # the raylet makes us leasable, and a task can be pushed the moment
@@ -792,6 +795,26 @@ class CoreWorker:
             if ref.owner_addr and tuple(ref.owner_addr) != self.addr:
                 data = self._ask_owner(ref, deadline)
                 if data is not None:
+                    # borrower-side cache: repeat gets of this ref skip the
+                    # owner round trip. Small values ride the heap memory
+                    # store (freed by the same ref-zero path as owned
+                    # entries); big ones go to the shm store like remote
+                    # pulls, so they stay under shm accounting.
+                    from ray_tpu._private.config import get_config
+
+                    if len(data) <= int(get_config(
+                            "inline_object_max_size_bytes")):
+                        if self.reference_counter.count(ref.id) > 0:
+                            self.memory_store.put(ref.id, data)
+                    else:
+                        try:
+                            self.store.put(ref.id, data)
+                            self.gcs.push("add_object_location",
+                                          object_id=ref.id,
+                                          node_id=self.node_id,
+                                          size=len(data))
+                        except Exception:
+                            pass
                     return data
             # The GCS knows it was created and that every copy died with its
             # node. Recovery is the OWNER's job (reference:
@@ -1011,25 +1034,72 @@ class CoreWorker:
                 0, self._pull_inflight_bytes - nbytes)
             self._pull_lock.notify_all()
 
-    def _ask_owner(self, ref: ObjectRef, deadline):
+    def _owner_client(self, addr: tuple) -> RpcClient:
+        """Pooled connection to an object owner (one multiplexed client per
+        owner; a fresh TCP connect per borrowed get was the dominant cost
+        of ref-arg tasks in ray_perf). The connect happens OUTSIDE the pool
+        lock so one unreachable owner can't stall fetches to healthy ones;
+        a losing racer's client is closed, the winner's pooled."""
+        with self._owner_client_lock:
+            client = self._owner_clients.get(addr)
+            if client is not None and not client.closed:
+                return client
+        fresh = RpcClient(addr, timeout=30.0, retry=1)
+        with self._owner_client_lock:
+            current = self._owner_clients.get(addr)
+            if current is not None and not current.closed:
+                winner = current
+            else:
+                self._owner_clients[addr] = fresh
+                return fresh
         try:
-            client = RpcClient(tuple(ref.owner_addr), timeout=30.0)
-        except ConnectionLost:
-            raise exc.ObjectLostError(ref.hex()) from None
+            fresh.close()
+        except Exception:
+            pass
+        return winner
+
+    def _drop_owner_client(self, addr: tuple, client: RpcClient):
+        """Evict `client` from the pool — identity-checked, so a healthy
+        replacement pooled by another thread is never closed by mistake."""
+        with self._owner_client_lock:
+            if self._owner_clients.get(addr) is client:
+                self._owner_clients.pop(addr, None)
         try:
-            reply = client.call("get_owned_value", object_id=ref.id,
-                                timeout=6.0)
-            if isinstance(reply, dict) and "status" in reply:
-                if reply["status"] == "lost":
-                    raise exc.ObjectLostError(ref.hex())
-                return reply.get("data")
-            return reply
-        except TimeoutError:
-            return None
-        except ConnectionLost:
-            raise exc.ObjectLostError(ref.hex()) from None
-        finally:
             client.close()
+        except Exception:
+            pass
+
+    def _ask_owner(self, ref: ObjectRef, deadline):
+        addr = tuple(ref.owner_addr)
+        # one retry on a fresh connection: ConnectionLost/timeouts on a
+        # POOLED client usually mean the cached socket went stale (owner
+        # restart, idle NAT drop), not that the object is gone
+        for attempt in range(2):
+            try:
+                client = self._owner_client(addr)
+            except ConnectionLost:
+                if attempt == 0:
+                    continue
+                raise exc.ObjectLostError(ref.hex()) from None
+            try:
+                reply = client.call("get_owned_value", object_id=ref.id,
+                                    timeout=6.0)
+                if isinstance(reply, dict) and "status" in reply:
+                    if reply["status"] == "lost":
+                        raise exc.ObjectLostError(ref.hex())
+                    return reply.get("data")
+                return reply
+            except TimeoutError:
+                # half-open connections never deliver: evict so the next
+                # round reconnects instead of hanging forever
+                self._drop_owner_client(addr, client)
+                return None
+            except ConnectionLost:
+                self._drop_owner_client(addr, client)
+                if attempt == 0:
+                    continue
+                raise exc.ObjectLostError(ref.hex()) from None
+        return None
 
     def rpc_profile_events(self, conn):
         from ray_tpu._private import profiling
@@ -1147,6 +1217,7 @@ class CoreWorker:
         # nothing); only None means "default 1 CPU".
         resources = {"CPU": 1.0} if resources is None else dict(resources)
         return_ids = [os.urandom(16) for _ in range(num_returns)]
+        args, kwargs = self._inline_small_args(args, kwargs)
         spec = {
             "task_id": os.urandom(16),
             "func_hash": func_hash,
@@ -1178,6 +1249,42 @@ class CoreWorker:
                 self._ref_to_task[rid] = (spec, q)
         q.submit(spec)
         return refs
+
+    def _inline_small_args(self, args, kwargs):
+        """Replace top-level ObjectRef args whose values WE own, already
+        resolved and small, with the values themselves (reference:
+        transport/dependency_resolver.h — the local dependency resolver
+        inlines small args into the TaskSpec, sparing the executor an
+        owner round trip per task). Error payloads are never inlined:
+        getting them must raise on the executor."""
+        from ray_tpu._private.config import get_config
+
+        limit = int(get_config("inline_object_max_size_bytes"))
+
+        def maybe(v):
+            if not isinstance(v, ObjectRef):
+                return v
+            data = self.memory_store.get_nowait(v.id)
+            if data is None:
+                buf = self.store.get(v.id)     # put() objects live in shm
+                if buf is not None:
+                    try:
+                        if len(buf) <= limit:
+                            data = buf.to_bytes()
+                    finally:
+                        buf.release()
+            if data is None or len(data) > limit:
+                return v
+            try:
+                value, meta = ser.deserialize(data, self, with_meta=True)
+            except Exception:
+                return v
+            if meta.get("raised"):
+                return v
+            return value
+
+        return ([maybe(a) for a in args],
+                {k: maybe(v) for k, v in kwargs.items()})
 
     def cancel_task(self, ref: ObjectRef, force: bool = False):
         """Best-effort cancel of the normal task producing `ref` (reference:
@@ -1680,7 +1787,10 @@ class CoreWorker:
         self.stopped = True
         self._free_queue.put(None)   # unblock the ref reaper
         self._server.stop()
-        for c in (self.gcs, self.raylet):
+        with self._owner_client_lock:
+            owner_clients = list(self._owner_clients.values())
+            self._owner_clients.clear()
+        for c in (self.gcs, self.raylet, *owner_clients):
             try:
                 c.close()
             except Exception:
