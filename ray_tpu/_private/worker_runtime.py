@@ -804,17 +804,14 @@ class CoreWorker:
 
                     if len(data) <= int(get_config(
                             "inline_object_max_size_bytes")):
-                        if self.reference_counter.count(ref.id) > 0:
-                            self.memory_store.put(ref.id, data)
+                        self.memory_store.put(ref.id, data)
+                        # put-then-check closes the race with the ref
+                        # reaper: if the last local ref died first, the
+                        # reaper's free already ran — undo our insert
+                        if self.reference_counter.count(ref.id) == 0:
+                            self.memory_store.free(ref.id)
                     else:
-                        try:
-                            self.store.put(ref.id, data)
-                            self.gcs.push("add_object_location",
-                                          object_id=ref.id,
-                                          node_id=self.node_id,
-                                          size=len(data))
-                        except Exception:
-                            pass
+                        self._cache_local(ref.id, data)
                     return data
             # The GCS knows it was created and that every copy died with its
             # node. Recovery is the OWNER's job (reference:
@@ -863,13 +860,18 @@ class CoreWorker:
             return None
         # Cache locally for future gets (reference: pulled chunks land in
         # local plasma).
+        self._cache_local(object_id, data)
+        return data
+
+    def _cache_local(self, object_id: bytes, data: bytes):
+        """Cache fetched bytes in the local shm store and register the new
+        location (best-effort; a full store just skips the cache)."""
         try:
             self.store.put(object_id, data)
             self.gcs.push("add_object_location", object_id=object_id,
                           node_id=self.node_id, size=len(data))
         except Exception:
             pass
-        return data
 
     def _data_sock_checkout(self, addr, fresh: bool = False):
         """Persistent-connection pool for the native data plane (one
@@ -1050,6 +1052,16 @@ class CoreWorker:
             if current is not None and not current.closed:
                 winner = current
             else:
+                # bounded pool: evict the oldest entry beyond the cap so a
+                # long-lived worker borrowing from many ephemeral owners
+                # doesn't accumulate sockets/reader threads forever
+                while len(self._owner_clients) >= 16:
+                    oldest = next(iter(self._owner_clients))
+                    old = self._owner_clients.pop(oldest)
+                    try:
+                        old.close()
+                    except Exception:
+                        pass
                 self._owner_clients[addr] = fresh
                 return fresh
         try:
@@ -1084,15 +1096,21 @@ class CoreWorker:
             try:
                 reply = client.call("get_owned_value", object_id=ref.id,
                                     timeout=6.0)
+                client._timeout_strikes = 0
                 if isinstance(reply, dict) and "status" in reply:
                     if reply["status"] == "lost":
                         raise exc.ObjectLostError(ref.hex())
                     return reply.get("data")
                 return reply
             except TimeoutError:
-                # half-open connections never deliver: evict so the next
-                # round reconnects instead of hanging forever
-                self._drop_owner_client(addr, client)
+                # Do NOT tear down the shared socket on one slow reply —
+                # other threads' in-flight calls on it may be healthy. A
+                # half-open connection times out consistently: evict after
+                # a few consecutive timeouts with no successful call.
+                strikes = getattr(client, "_timeout_strikes", 0) + 1
+                client._timeout_strikes = strikes
+                if strikes >= 3:
+                    self._drop_owner_client(addr, client)
                 return None
             except ConnectionLost:
                 self._drop_owner_client(addr, client)
@@ -1280,6 +1298,11 @@ class CoreWorker:
             except Exception:
                 return v
             if meta.get("raised"):
+                return v
+            if isinstance(value, ObjectRef):
+                # inlining would PROMOTE the inner ref to a top-level arg,
+                # which the executor auto-resolves — the task would receive
+                # the inner value instead of the ObjectRef
                 return v
             return value
 

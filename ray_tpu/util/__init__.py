@@ -1,0 +1,20 @@
+"""ray_tpu.util — cluster utilities layered on the core API
+(reference: python/ray/util/__init__.py)."""
+from __future__ import annotations
+
+
+def list_named_actors(all_namespaces: bool = False) -> list:
+    """Names of live named actors (reference: util/__init__.py
+    list_named_actors). Returns names in the current namespace, or
+    [{"name", "namespace"}] dicts with all_namespaces=True."""
+    from ray_tpu._private.api import _namespace, _require_worker
+
+    rows = _require_worker().gcs.call(
+        "list_named_actors", all_namespaces=all_namespaces,
+        namespace=_namespace)
+    if all_namespaces:
+        return rows
+    return [r["name"] for r in rows]
+
+
+__all__ = ["list_named_actors"]

@@ -344,3 +344,48 @@ def _current_addr():
     from ray_tpu._private.worker_runtime import current_worker
 
     return current_worker().addr
+
+
+def allgather_object(obj, group_name: str = "default") -> list:
+    """Gather arbitrary picklable objects from every rank (reference:
+    collective.py allgather_object / torch.distributed.all_gather_object):
+    pickle → uint8 tensor padded to the max length → allgather → unpickle."""
+    import pickle
+
+    import numpy as np
+
+    blob = np.frombuffer(
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL), dtype=np.uint8)
+    n = np.array([len(blob)], dtype=np.int64)
+    sizes = [int(s[0]) for s in allgather(n, group_name)]
+    padded = np.zeros(max(sizes), dtype=np.uint8)
+    padded[: len(blob)] = blob
+    gathered = allgather(padded, group_name)
+    return [pickle.loads(np.asarray(g)[:size].tobytes())
+            for g, size in zip(gathered, sizes)]
+
+
+def broadcast_object(obj, src_rank: int = 0,
+                     group_name: str = "default"):
+    """Broadcast one picklable object from src_rank to every rank."""
+    import pickle
+
+    import numpy as np
+
+    me = get_rank(group_name)
+    if me == src_rank:
+        blob = np.frombuffer(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+            dtype=np.uint8)
+        n = np.array([len(blob)], dtype=np.int64)
+    else:
+        blob = None
+        n = np.zeros(1, dtype=np.int64)
+    n = np.asarray(broadcast(n, src_rank, group_name))
+    size = int(n[0])
+    payload = (blob if me == src_rank
+               else np.zeros(size, dtype=np.uint8))
+    payload = np.asarray(broadcast(payload, src_rank, group_name))
+    if me == src_rank:
+        return obj
+    return pickle.loads(payload[:size].tobytes())

@@ -333,3 +333,33 @@ def test_xla_device_residency_and_broadcast_src(ray_start_regular):
         assert is_jax, "xla backend returned a host array for a jax input"
         assert reduced == 3.0
         assert bval == 2.0       # src_rank=1's value
+
+
+def test_object_collectives(ray_start_regular):
+    """allgather_object/broadcast_object over the host backend."""
+    import ray_tpu
+    from ray_tpu.util import collective as col
+
+    @ray_tpu.remote
+    class W(col.CollectiveActorMixin):
+        def setup(self, world, rank):
+            col.init_collective_group(world, rank, "host", "objgrp")
+            return rank
+
+        def gather(self, payload):
+            return col.allgather_object(payload, "objgrp")
+
+        def bcast(self, payload):
+            return col.broadcast_object(payload, src_rank=0,
+                                        group_name="objgrp")
+
+    workers = [W.options(num_cpus=0).remote() for _ in range(3)]
+    ray_tpu.get([w.setup.remote(3, i) for i, w in enumerate(workers)])
+    payloads = [{"rank": i, "data": list(range(i + 1))} for i in range(3)]
+    gathered = ray_tpu.get([w.gather.remote(p)
+                            for w, p in zip(workers, payloads)])
+    for g in gathered:
+        assert g == payloads
+    out = ray_tpu.get([w.bcast.remote(payloads[i] if i == 0 else None)
+                       for i, w in enumerate(workers)])
+    assert all(o == payloads[0] for o in out)

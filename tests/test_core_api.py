@@ -407,3 +407,26 @@ def test_cluster_resources(ray_start_regular):
     assert total["CPU"] == 4.0
     nodes = ray.nodes()
     assert len(nodes) == 1 and nodes[0]["Alive"]
+
+
+def test_nested_ref_arg_not_promoted(ray_start_regular):
+    """A ref-to-a-ref arg must deliver the INNER ObjectRef to the task
+    (arg inlining must not promote it to a top-level auto-resolved arg)."""
+    import ray_tpu
+    from ray_tpu import ObjectRef
+
+    inner = ray_tpu.put(41)
+
+    @ray_tpu.remote
+    def make_outer(lst):
+        return lst[0]     # nested refs aren't auto-resolved: returns the
+                          # ObjectRef itself
+
+    outer = make_outer.remote([inner])
+
+    @ray_tpu.remote
+    def check(x):
+        assert isinstance(x, ObjectRef), f"got {type(x).__name__}"
+        return ray_tpu.get(x) + 1
+
+    assert ray_tpu.get(check.remote(outer), timeout=30) == 42

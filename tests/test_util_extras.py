@@ -156,3 +156,19 @@ def test_parallel_iterator(ray_start_regular):
     u = par_iter.from_range(3, num_shards=1).union(
         par_iter.from_items([10, 11], num_shards=1))
     assert sorted(u.gather_sync()) == [0, 1, 2, 10, 11]
+
+
+def test_list_named_actors(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import list_named_actors
+
+    @ray_tpu.remote
+    class N:
+        def ping(self):
+            return 1
+
+    a = N.options(name="named_one").remote()
+    ray_tpu.get(a.ping.remote())
+    assert "named_one" in list_named_actors()
+    rows = list_named_actors(all_namespaces=True)
+    assert any(r["name"] == "named_one" for r in rows)
