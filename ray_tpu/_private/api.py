@@ -345,8 +345,9 @@ _TASK_DEFAULTS = dict(num_cpus=1.0, num_tpus=0.0, memory=None, resources=None,
                       scheduling_strategy=None)
 _ACTOR_DEFAULTS = dict(num_cpus=1.0, num_tpus=0.0, memory=None, resources=None,
                        max_restarts=0, max_task_retries=0, max_concurrency=1,
-                       name=None, namespace=None, lifetime=None,
-                       get_if_exists=False, scheduling_strategy=None)
+                       concurrency_groups=None, name=None, namespace=None,
+                       lifetime=None, get_if_exists=False,
+                       scheduling_strategy=None)
 
 
 def _build_resources(opts: dict) -> dict:
@@ -529,6 +530,7 @@ class ActorClass:
                 "max_restarts": opts["max_restarts"],
                 "max_task_retries": opts["max_task_retries"],
                 "max_concurrency": opts["max_concurrency"],
+                "concurrency_groups": opts["concurrency_groups"],
                 "name": opts["name"],
                 "namespace": opts["namespace"] or _namespace,
                 "lifetime": opts["lifetime"],
@@ -567,10 +569,14 @@ def remote(*args, **kwargs):
 
 
 def method(**opts):
-    """@ray_tpu.method(num_returns=...) decorator for actor methods."""
+    """@ray_tpu.method(num_returns=..., concurrency_group=...) decorator
+    for actor methods (reference: actor.py method + concurrency groups,
+    transport/concurrency_group_manager.h)."""
 
     def decorator(fn):
         fn.__ray_num_returns__ = opts.get("num_returns", 1)
+        if "concurrency_group" in opts:
+            fn.__ray_concurrency_group__ = opts["concurrency_group"]
         return fn
 
     return decorator

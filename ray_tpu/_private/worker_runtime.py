@@ -1045,6 +1045,10 @@ class CoreWorker:
         with self._owner_client_lock:
             client = self._owner_clients.get(addr)
             if client is not None and not client.closed:
+                # LRU reorder: eviction takes the front, so keep hot
+                # clients at the back
+                self._owner_clients.pop(addr)
+                self._owner_clients[addr] = client
                 return client
         fresh = RpcClient(addr, timeout=30.0, retry=1)
         with self._owner_client_lock:
@@ -1052,16 +1056,19 @@ class CoreWorker:
             if current is not None and not current.closed:
                 winner = current
             else:
-                # bounded pool: evict the oldest entry beyond the cap so a
-                # long-lived worker borrowing from many ephemeral owners
-                # doesn't accumulate sockets/reader threads forever
+                # bounded pool: evict the LEAST-RECENTLY-USED entry beyond
+                # the cap (checkouts reorder to the back). An evicted
+                # client with calls still in flight is left open — its
+                # reader thread ends with the connection; closing it would
+                # abort healthy calls.
                 while len(self._owner_clients) >= 16:
                     oldest = next(iter(self._owner_clients))
                     old = self._owner_clients.pop(oldest)
-                    try:
-                        old.close()
-                    except Exception:
-                        pass
+                    if not old._pending:
+                        try:
+                            old.close()
+                        except Exception:
+                            pass
                 self._owner_clients[addr] = fresh
                 return fresh
         try:
@@ -1096,21 +1103,25 @@ class CoreWorker:
             try:
                 reply = client.call("get_owned_value", object_id=ref.id,
                                     timeout=6.0)
-                client._timeout_strikes = 0
                 if isinstance(reply, dict) and "status" in reply:
                     if reply["status"] == "lost":
                         raise exc.ObjectLostError(ref.hex())
                     return reply.get("data")
                 return reply
             except TimeoutError:
-                # Do NOT tear down the shared socket on one slow reply —
-                # other threads' in-flight calls on it may be healthy. A
-                # half-open connection times out consistently: evict after
-                # a few consecutive timeouts with no successful call.
-                strikes = getattr(client, "_timeout_strikes", 0) + 1
-                client._timeout_strikes = strikes
-                if strikes >= 3:
-                    self._drop_owner_client(addr, client)
+                # Possibly half-open: evict from the pool NOW (the next
+                # fetch reconnects within one round), but only CLOSE the
+                # socket if no other thread has calls in flight on it —
+                # closing would abort their healthy calls; an orphaned
+                # client dies with its connection.
+                with self._owner_client_lock:
+                    if self._owner_clients.get(addr) is client:
+                        self._owner_clients.pop(addr, None)
+                if not client._pending:
+                    try:
+                        client.close()
+                    except Exception:
+                        pass
                 return None
             except ConnectionLost:
                 self._drop_owner_client(addr, client)
@@ -1433,6 +1444,7 @@ class CoreWorker:
             "max_restarts": options.get("max_restarts", 0),
             "max_task_retries": options.get("max_task_retries", 0),
             "max_concurrency": options.get("max_concurrency", 1),
+            "concurrency_groups": options.get("concurrency_groups") or {},
             "name": options.get("name"),
             "namespace": options.get("namespace", "default"),
             "lifetime": options.get("lifetime"),
@@ -1617,6 +1629,7 @@ class CoreWorker:
         # nowhere anyway — advisor finding on the old 60s deadline).
         caller = f"{spec.get('caller_id', '')}:{spec.get('caller_epoch', 0)}"
         seq = spec.get("seq", 0)
+        sem = self._actor_semaphore_for(spec["method_name"])
         with self._seq_cond:
             while seq > self._next_seq_to_run.get(caller, 0):
                 if conn is not None and not conn.alive:
@@ -1624,18 +1637,37 @@ class CoreWorker:
                 self._seq_cond.wait(timeout=0.5)
             # our turn (or dead caller): let the next seq through as soon as
             # we are in line for a concurrency slot
-            ticket = self._actor_concurrency.enqueue()
+            ticket = sem.enqueue()
             cur = self._next_seq_to_run.get(caller, 0)
             if seq >= cur:
                 self._next_seq_to_run[caller] = seq + 1
             self._seq_cond.notify_all()
-        return self._run_actor_method(spec, ticket)
+        return self._run_actor_method(spec, ticket, sem)
 
-    def _run_actor_method(self, spec: dict, ticket=None) -> dict:
+    def _actor_semaphore_for(self, method_name: str) -> FifoSemaphore:
+        """The concurrency gate for a method: its declared group's, else
+        the actor-wide default (reference: concurrency_group_manager.h)."""
+        method = getattr(self._actor_instance, method_name, None)
+        group = getattr(method, "__ray_concurrency_group__", None)
+        if group is not None:
+            sem = (getattr(self, "_actor_groups", None) or {}).get(group)
+            if sem is None:
+                # a misspelled/undeclared group silently serializing
+                # through the default gate would be undebuggable — fail the
+                # call instead (the reference validates at definition time)
+                raise ValueError(
+                    f"method {method_name!r} declares concurrency group "
+                    f"{group!r}, but the actor was created with groups "
+                    f"{sorted((getattr(self, '_actor_groups', None) or {}))}")
+            return sem
+        return self._actor_concurrency
+
+    def _run_actor_method(self, spec: dict, ticket=None, sem=None) -> dict:
         import asyncio
         import inspect
 
         method_name = spec["method_name"]
+        sem = sem if sem is not None else self._actor_concurrency
         acquired = False
         try:
             if method_name == "__ray_terminate__":
@@ -1644,10 +1676,10 @@ class CoreWorker:
                 return self._package_results(spec, None)
             method = getattr(self._actor_instance, method_name)
             args, kwargs = self._resolve_args(spec)
-            # max_concurrency gate: the FIFO semaphore (default 1 slot)
-            # restores the serial-execution guarantee across ALL callers in
-            # dispatch order (reference: concurrency_group_manager.h).
-            self._actor_concurrency.wait(ticket)
+            # concurrency gate: the method's group semaphore (or the
+            # actor-wide default, 1 slot) admits executions in dispatch
+            # order (reference: concurrency_group_manager.h).
+            sem.wait(ticket)
             acquired = True
             from ray_tpu._private.profiling import record_span
 
@@ -1665,13 +1697,13 @@ class CoreWorker:
                     else:
                         result = method(*args, **kwargs)
             finally:
-                self._actor_concurrency.release()
+                sem.release()
             return self._package_results(spec, result)
         except BaseException as e:  # noqa: BLE001
             return self._package_error(spec, e)
         finally:
             if not acquired:
-                self._actor_concurrency.cancel(ticket)
+                sem.cancel(ticket)
 
     def _ensure_async_loop(self):
         import asyncio
@@ -1728,6 +1760,13 @@ class CoreWorker:
         self._actor_spec = spec
         self._actor_concurrency = FifoSemaphore(
             max(1, int(spec.get("max_concurrency", 1) or 1)))
+        # named concurrency groups: independent FIFO gates per group
+        # (reference: transport/concurrency_group_manager.h — methods
+        # declared in a group don't contend with the default group)
+        self._actor_groups = {
+            name: FifoSemaphore(max(1, int(n)))
+            for name, n in (spec.get("concurrency_groups") or {}).items()
+        }
         cls = self._load_function(spec["class_hash"])
         args, kwargs = ser.deserialize(spec["args"], self)
         args = [self.get(a) if isinstance(a, ObjectRef) else a for a in args]

@@ -9,9 +9,16 @@ def list_named_actors(all_namespaces: bool = False) -> list:
     [{"name", "namespace"}] dicts with all_namespaces=True."""
     from ray_tpu._private.api import _namespace, _require_worker
 
-    rows = _require_worker().gcs.call(
+    worker = _require_worker()
+    # inside an actor, the driver's init(namespace=...) never ran in this
+    # process — the actor's own spec carries the effective namespace
+    ns = _namespace
+    spec = getattr(worker, "_actor_spec", None)
+    if spec and spec.get("namespace"):
+        ns = spec["namespace"]
+    rows = worker.gcs.call(
         "list_named_actors", all_namespaces=all_namespaces,
-        namespace=_namespace)
+        namespace=ns)
     if all_namespaces:
         return rows
     return [r["name"] for r in rows]

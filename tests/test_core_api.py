@@ -430,3 +430,58 @@ def test_nested_ref_arg_not_promoted(ray_start_regular):
         return ray_tpu.get(x) + 1
 
     assert ray_tpu.get(check.remote(outer), timeout=30) == 42
+
+
+def test_concurrency_groups(ray_start_regular):
+    """Methods in a named concurrency group don't contend with the default
+    group (reference: transport/concurrency_group_manager.h)."""
+    import time as _time
+
+    import ray_tpu
+
+    @ray_tpu.remote(max_concurrency=1, concurrency_groups={"io": 2})
+    class Mixed:
+        def __init__(self):
+            self.events = []
+
+        def slow_default(self):
+            self.events.append("default_start")
+            _time.sleep(1.0)
+            self.events.append("default_end")
+            return "slow"
+
+        @ray_tpu.method(concurrency_group="io")
+        def fast_io(self):
+            self.events.append("io")
+            return "io"
+
+        @ray_tpu.method(concurrency_group="io")
+        def get_events(self):
+            return list(self.events)
+
+    a = Mixed.options(max_concurrency=8).remote()
+    ray_tpu.get(a.get_events.remote(), timeout=60)   # actor is up
+    slow = a.slow_default.remote()
+    _time.sleep(0.2)              # slow task is now running
+    t0 = _time.time()
+    assert ray_tpu.get(a.fast_io.remote(), timeout=30) == "io"
+    io_latency = _time.time() - t0
+    assert io_latency < 0.8, (
+        f"io-group call waited {io_latency:.2f}s behind the default group")
+    assert ray_tpu.get(slow, timeout=30) == "slow"
+    events = ray_tpu.get(a.get_events.remote(), timeout=30)
+    assert events.index("io") < events.index("default_end")
+
+
+def test_undeclared_concurrency_group_fails(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote(concurrency_groups={"io": 2})
+    class Typo:
+        @ray_tpu.method(concurrency_group="oi")   # misspelled
+        def call(self):
+            return 1
+
+    t = Typo.remote()
+    with pytest.raises(Exception, match="concurrency group"):
+        ray_tpu.get(t.call.remote(), timeout=30)
