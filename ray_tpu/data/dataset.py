@@ -64,6 +64,57 @@ def _get_write_task():
     return _write_task
 
 
+def _column_values(block, on) -> np.ndarray:
+    """Extract the numeric column/values from a block, validating that
+    `on` matches the block shape (silently ignoring a bogus column name
+    would produce plausible-looking nonsense)."""
+    if isinstance(block, dict):
+        if on is None:
+            raise ValueError(
+                f"dataset has named columns {sorted(block)}; pass on=...")
+        return np.asarray(block[on], dtype=np.float64)
+    if isinstance(block, np.ndarray):
+        if on is not None:
+            raise ValueError(
+                f"on={on!r} given but the dataset has plain values, "
+                f"not named columns")
+        return block.astype(np.float64, copy=False)
+    rows = _rows(block)
+    if rows and isinstance(rows[0], dict):
+        if on is None:
+            raise ValueError(
+                f"dataset has named columns {sorted(rows[0])}; pass on=...")
+        return np.asarray([row[on] for row in rows], dtype=np.float64)
+    if on is not None:
+        raise ValueError(
+            f"on={on!r} given but the dataset has plain values, "
+            f"not named columns")
+    return np.asarray(rows, dtype=np.float64)
+
+
+def _agg_block(stages, block, on):
+    """(count, sum, min, max, mean, M2) for one block's column/values —
+    M2 = sum((x-mean)^2), so variance merges with Chan's algorithm
+    instead of the cancellation-prone sum-of-squares; None for an empty
+    block."""
+    vals = _column_values(_exec_chain(stages, block), on)
+    if vals.size == 0:
+        return None
+    mean = float(vals.mean())
+    return (int(vals.size), float(vals.sum()), float(vals.min()),
+            float(vals.max()), mean, float(np.square(vals - mean).sum()))
+
+
+_agg_task = None
+
+
+def _get_agg_task():
+    global _agg_task
+    if _agg_task is None:
+        _agg_task = ray_tpu.remote(_agg_block)
+    return _agg_task
+
+
 class _ActorPoolStrategy:
     """(reference: compute.py:173 ActorPoolStrategy) map stages run on a
     pool of long-lived actors — amortizes heavyweight per-process state
@@ -516,6 +567,45 @@ class Dataset:
 
         return self._write_blocks(path, "json", write_one)
 
+    def _numeric_partials(self, on=None):
+        """Per-block (count, sum, min, max, mean, M2) partials via remote
+        tasks; merged driver-side with Chan's parallel-variance algorithm
+        (reference: dataset.py sum/mean/std over AggregateFn partials)."""
+        task = _get_agg_task()
+        parts = ray_tpu.get([task.remote(self._stages, ref, on)
+                             for ref in self._block_refs])
+        parts = [p for p in parts if p is not None]
+        if not parts:
+            raise ValueError("aggregation over an empty dataset")
+        count, total, mn, mx, mean, m2 = parts[0]
+        for n_b, tot_b, mn_b, mx_b, mean_b, m2_b in parts[1:]:
+            delta = mean_b - mean
+            merged = count + n_b
+            mean = mean + delta * n_b / merged
+            m2 = m2 + m2_b + delta * delta * count * n_b / merged
+            count, total = merged, total + tot_b
+            mn, mx = min(mn, mn_b), max(mx, mx_b)
+        return count, total, mn, mx, mean, m2
+
+    def sum(self, on=None) -> float:  # noqa: A003
+        return self._numeric_partials(on)[1]
+
+    def mean(self, on=None) -> float:
+        count, total, *_ = self._numeric_partials(on)
+        return total / count
+
+    def min(self, on=None) -> float:  # noqa: A003
+        return self._numeric_partials(on)[2]
+
+    def max(self, on=None) -> float:  # noqa: A003
+        return self._numeric_partials(on)[3]
+
+    def std(self, on=None, ddof: int = 1) -> float:
+        count, _, _, _, _, m2 = self._numeric_partials(on)
+        if count <= ddof:
+            return 0.0
+        return float(np.sqrt(m2 / (count - ddof)))
+
     def stats(self) -> dict:
         sizes = ray_tpu.get([
             _get_chain_task().remote(
@@ -589,6 +679,35 @@ class GroupedDataset:
     def map_groups(self, fn) -> Dataset:
         return self._reduce(lambda groups: [
             out for _, v in groups.items() for out in fn(v)])
+
+    def _column_agg(self, on, combine, out_name: str) -> Dataset:
+        """Per-group column aggregation (reference: grouped_dataset.py
+        sum/mean/min/max)."""
+        def agg(groups):
+            out = []
+            for k, rows in groups.items():
+                if rows and not isinstance(rows[0], dict):
+                    raise ValueError(
+                        f"on={on!r} given but grouped rows are plain "
+                        f"values, not named columns")
+                vals = [row[on] for row in rows]
+                out.append({"key": k, out_name: combine(vals)})
+            return out
+
+        return self._reduce(agg)
+
+    def sum(self, on) -> Dataset:  # noqa: A003
+        return self._column_agg(on, lambda v: float(np.sum(v)), f"sum({on})")
+
+    def mean(self, on) -> Dataset:
+        return self._column_agg(on, lambda v: float(np.mean(v)),
+                                f"mean({on})")
+
+    def min(self, on) -> Dataset:  # noqa: A003
+        return self._column_agg(on, lambda v: float(np.min(v)), f"min({on})")
+
+    def max(self, on) -> Dataset:  # noqa: A003
+        return self._column_agg(on, lambda v: float(np.max(v)), f"max({on})")
 
 
 # -------------------------------------------------------------- block utils

@@ -233,3 +233,47 @@ def test_actor_pool_autoscaling_bounds(ray_start_regular):
         lambda b: b, compute=ActorPoolStrategy(min_size=1, max_size=3),
     ).materialize()
     assert sorted(x for blk in out.blocks() for x in blk) == list(range(40))
+
+
+def test_dataset_aggregations(ray_start_regular):
+    import math
+
+    from ray_tpu import data
+
+    ds = data.from_items([{"v": float(i)} for i in range(10)],
+                         parallelism=3)
+    assert ds.sum("v") == 45.0
+    assert ds.mean("v") == 4.5
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 9.0
+    vals = list(range(10))
+    expected_std = math.sqrt(
+        sum((x - 4.5) ** 2 for x in vals) / 9)
+    assert abs(ds.std("v") - expected_std) < 1e-9
+
+    plain = data.from_numpy(np.arange(8.0), parallelism=2)
+    assert plain.sum() == 28.0
+
+    grouped = data.from_items(
+        [{"g": i % 2, "v": float(i)} for i in range(8)],
+        parallelism=2).groupby("g")
+    rows = sorted(grouped.mean("v").take_all(), key=lambda r: r["key"])
+    assert rows[0] == {"key": 0, "mean(v)": 3.0}
+    assert rows[1] == {"key": 1, "mean(v)": 4.0}
+
+
+def test_aggregation_numerics_and_errors(ray_start_regular):
+    from ray_tpu import data
+
+    # large mean offset: the naive sum-of-squares formula returns 0 here
+    ds = data.from_items([{"v": 1e8}, {"v": 1e8 + 1}, {"v": 1e8 + 2}],
+                         parallelism=2)
+    assert abs(ds.std("v") - 1.0) < 1e-6
+
+    with pytest.raises(Exception, match="named columns"):
+        data.from_items([{"v": 1.0}]).sum()       # on= required
+    with pytest.raises(Exception, match="plain values"):
+        data.from_numpy(np.arange(4.0)).sum("nope")
+    with pytest.raises(Exception, match="plain values"):
+        data.from_items([1.0, 2.0]).groupby(
+            lambda r: 0).sum("price").take_all()
