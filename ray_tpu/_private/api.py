@@ -520,6 +520,7 @@ class ActorClass:
             self._class_hash = worker.register_function(self._cls)
             self._registered_with = worker
         opts = dict(self._options)
+        _validate_concurrency_groups(self._cls, opts["concurrency_groups"])
         resources = _build_resources(opts)   # {} = explicit zero request
         actor_id, existed = worker.create_actor(
             self._class_hash, args, kwargs,
@@ -547,6 +548,22 @@ class ActorClass:
             return ClassNode(self, args, kwargs)
 
         return _bind
+
+
+def _validate_concurrency_groups(cls, groups):
+    """Reject a @method(concurrency_group=...) naming an undeclared group at
+    actor-creation time (reference: actor.py validates at definition time).
+    Catching it here — not at dispatch — keeps a misspelled group from
+    failing mid-stream after earlier calls already ran."""
+    declared = set(groups or {})
+    for attr_name in dir(cls):
+        attr = inspect.getattr_static(cls, attr_name, None)
+        group = getattr(attr, "__ray_concurrency_group__", None)
+        if group is not None and group not in declared:
+            raise ValueError(
+                f"method {cls.__name__}.{attr_name!r} declares concurrency "
+                f"group {group!r}, but the actor is being created with "
+                f"groups {sorted(declared)}")
 
 
 def remote(*args, **kwargs):

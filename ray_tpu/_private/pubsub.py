@@ -48,13 +48,17 @@ class Publisher:
 
     # ---------------------------------------------------------- subscriber
     def subscribe(self, channels: list[str], sub_id: str | None = None) -> str:
-        sub_id = sub_id or uuid.uuid4().hex
         with self._lock:
-            sub = self._subs.setdefault(sub_id, {
-                "channels": set(), "mail": [],
-                "last_seen": time.monotonic(), "waiters": 0,
-            })
-            sub["channels"].update(channels)
+            return self._register_locked(channels, sub_id)
+
+    def _register_locked(self, channels, sub_id) -> str:
+        sub_id = sub_id or uuid.uuid4().hex
+        sub = self._subs.setdefault(sub_id, {
+            "channels": set(), "mail": [],
+            "last_seen": time.monotonic(), "waiters": 0,
+        })
+        sub["channels"].update(channels)
+        sub["last_seen"] = time.monotonic()
         return sub_id
 
     def unsubscribe(self, sub_id: str, channels: list[str] | None = None):
@@ -125,7 +129,16 @@ class Publisher:
     # ------------------------------------------------ RpcServer handler glue
     def rpc_psub_subscribe(self, conn, channels: list,
                            sub_id: str | None = None):
-        return self.subscribe(channels, sub_id)
+        """Returns (sub_id, current_seq, existed): `existed` tells a
+        re-subscribing client whether its mailbox survived (False after a
+        publisher-side GC — anything since its last ack is gone). Snapshot
+        and registration happen under ONE lock hold so a concurrent
+        publish/GC can't invalidate the answer."""
+        with self._lock:
+            existed = sub_id is not None and sub_id in self._subs
+            cur = self._seq
+            sub_id = self._register_locked(channels, sub_id)
+        return sub_id, cur, existed
 
     def rpc_psub_unsubscribe(self, conn, sub_id: str, channels=None):
         self.unsubscribe(sub_id, channels)
@@ -141,29 +154,76 @@ class Subscriber:
     ``subscribe(channel, callback)`` registers server-side and starts the
     long-poll loop; callbacks run on the poll thread in publish order.
     Poll failures back off and re-subscribe (sequence floor preserved
-    across transient disconnects by re-using the subscriber id).
+    across transient disconnects by re-using the subscriber id). If the
+    publisher GC'd the mailbox while we were away, the messages between
+    our last ack and the re-subscribe are gone — that discontinuity is
+    surfaced through ``on_gap(n_missed_upper_bound)`` and counted in
+    ``gap_count`` so consumers can re-sync state instead of silently
+    believing the stream was contiguous (advisor finding, round 3).
     """
 
-    def __init__(self, rpc_client, poll_timeout: float = 10.0):
+    def __init__(self, rpc_client, poll_timeout: float = 10.0, on_gap=None):
         self._rpc = rpc_client
         self._poll_timeout = poll_timeout
         self._callbacks: dict[str, list] = {}
         self._lock = threading.Lock()
         self._sub_id: str | None = None
         self._last_seq = 0
+        self._on_gap = on_gap
+        self.gap_count = 0
+        # bumped by every _announce_locked resync: a poll that was already
+        # in flight when the floor moved must not write its stale max_seq
+        # back over the resynced _last_seq
+        self._floor_epoch = 0
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
 
     def subscribe(self, channel: str, callback):
         with self._lock:
             self._callbacks.setdefault(channel, []).append(callback)
-            self._sub_id = self._rpc.call(
-                "psub_subscribe", channels=[channel], sub_id=self._sub_id)
+            # announce ALL channels: if the publisher GC'd our mailbox
+            # since the last poll, registering only the new channel would
+            # silently drop the earlier subscriptions server-side
+            gap = self._announce_locked()
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._loop, daemon=True, name="pubsub-poll")
                 self._thread.start()
+        self._note_gap(gap)
         return self._sub_id
+
+    def _announce_locked(self) -> int:
+        """(Re-)register every subscribed channel; returns the detected
+        gap size (0 = contiguous). Caller holds self._lock."""
+        prior = self._sub_id
+        self._sub_id, cur_seq, existed = self._rpc.call(
+            "psub_subscribe", channels=list(self._callbacks),
+            sub_id=prior)
+        if prior is None:
+            # subscribe-from-now: the new mailbox is empty, so acking the
+            # publisher's current seq is exact, not lossy
+            self._last_seq = cur_seq
+            self._floor_epoch += 1
+            return 0
+        if not existed and cur_seq != self._last_seq:
+            # mailbox dropped: anything after our last ack is gone.
+            # cur_seq < _last_seq means the publisher itself restarted
+            # (fresh seq space) — resync or every future message would be
+            # pruned as already-acked.
+            gap = max(1, cur_seq - self._last_seq)
+            self._last_seq = cur_seq
+            self._floor_epoch += 1
+            return gap
+        return 0
+
+    def _note_gap(self, gap: int):
+        if gap:
+            self.gap_count += 1
+            if self._on_gap is not None:
+                try:
+                    self._on_gap(gap)
+                except Exception:
+                    pass
 
     def unsubscribe(self, channel: str):
         with self._lock:
@@ -182,12 +242,20 @@ class Subscriber:
         backoff = 0.1
         while not self._stopped.is_set():
             try:
+                with self._lock:
+                    sub_id = self._sub_id
+                    after = self._last_seq
+                    epoch = self._floor_epoch
                 mail, max_seq = self._rpc.call(
-                    "psub_poll", sub_id=self._sub_id,
-                    after_seq=self._last_seq,
+                    "psub_poll", sub_id=sub_id,
+                    after_seq=after,
                     poll_timeout=self._poll_timeout,
                     timeout=self._poll_timeout + 30)
-                self._last_seq = max_seq
+                with self._lock:
+                    # a resync while this poll was in flight makes its
+                    # max_seq meaningless in the new seq space
+                    if self._floor_epoch == epoch:
+                        self._last_seq = max_seq
                 backoff = 0.1
             except Exception:
                 if self._stopped.is_set():
@@ -195,15 +263,14 @@ class Subscriber:
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
                 # re-announce (the publisher may have GC'd us)
+                gap = 0
                 try:
                     with self._lock:
-                        chans = list(self._callbacks)
-                        if chans:
-                            self._sub_id = self._rpc.call(
-                                "psub_subscribe", channels=chans,
-                                sub_id=self._sub_id)
+                        if self._callbacks:
+                            gap = self._announce_locked()
                 except Exception:
                     pass
+                self._note_gap(gap)
                 continue
             for _seq, channel, message in mail:
                 with self._lock:

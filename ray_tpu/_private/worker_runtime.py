@@ -1629,19 +1629,28 @@ class CoreWorker:
         # nowhere anyway — advisor finding on the old 60s deadline).
         caller = f"{spec.get('caller_id', '')}:{spec.get('caller_epoch', 0)}"
         seq = spec.get("seq", 0)
-        sem = self._actor_semaphore_for(spec["method_name"])
         with self._seq_cond:
             while seq > self._next_seq_to_run.get(caller, 0):
                 if conn is not None and not conn.alive:
                     break
                 self._seq_cond.wait(timeout=0.5)
-            # our turn (or dead caller): let the next seq through as soon as
-            # we are in line for a concurrency slot
-            ticket = sem.enqueue()
+            # Resolve the gate INSIDE the seq block: if the lookup fails
+            # (undeclared group — normally caught at creation time, api.py
+            # _validate_concurrency_groups), the seq must still be consumed
+            # or every later call from this caller wedges in the wait loop
+            # above (advisor finding, round 3).
+            gate_error = None
+            try:
+                sem = self._actor_semaphore_for(spec["method_name"])
+                ticket = sem.enqueue()
+            except ValueError as e:
+                gate_error = e
             cur = self._next_seq_to_run.get(caller, 0)
             if seq >= cur:
                 self._next_seq_to_run[caller] = seq + 1
             self._seq_cond.notify_all()
+        if gate_error is not None:
+            return self._package_error(spec, gate_error)
         return self._run_actor_method(spec, ticket, sem)
 
     def _actor_semaphore_for(self, method_name: str) -> FifoSemaphore:

@@ -159,12 +159,31 @@ class Dataset:
         self._block_refs = list(block_refs)
         self._stages = list(stages or [])
         self._compute = compute   # default strategy for materialize()
+        # Objects that must outlive this dataset's in-flight tasks but are
+        # referenced only inside pickled closures (invisible to the
+        # owner-based ref counter) — e.g. BatchPredictor's checkpoint ref.
+        # Every Dataset derived from this one (via _derive) carries them
+        # (advisor finding).
+        self._keep_alive: tuple = ()
+
+    def _pin(self, obj) -> "Dataset":
+        self._keep_alive = self._keep_alive + (obj,)
+        return self
+
+    def _derive(self, block_refs, stages=None, compute=None,
+                extra_pins=()) -> "Dataset":
+        """Construct a Dataset downstream of this one, carrying the pins:
+        the new blocks may be futures of tasks whose closures still need
+        the pinned objects."""
+        out = Dataset(block_refs, stages, compute=compute)
+        out._keep_alive = self._keep_alive + tuple(extra_pins)
+        return out
 
     # ------------------------------------------------------------ plan
 
     def _with_stage(self, fn, compute=None) -> "Dataset":
-        return Dataset(self._block_refs, self._stages + [fn],
-                       compute=compute or self._compute)
+        return self._derive(self._block_refs, self._stages + [fn],
+                            compute=compute or self._compute)
 
     def materialize(self, compute=None) -> "Dataset":
         """Execute pending stages: one task per block (TaskPoolStrategy) or
@@ -188,7 +207,7 @@ class Dataset:
         else:
             task = _get_chain_task()
             refs = [task.remote(stages, ref) for ref in self._block_refs]
-        return Dataset(refs)
+        return self._derive(refs)
 
     def _materialized_refs(self, compute=None):
         return self.materialize(compute)._block_refs
@@ -264,7 +283,7 @@ class Dataset:
                 i, *[part_refs[b][i] for b in builtins.range(n)])
             for i in builtins.range(n)
         ]
-        return Dataset(reduced)
+        return self._derive(reduced)
 
     def sort(self, key=None, descending: bool = False) -> "Dataset":
         """Sample-partition-sort (reference: _internal/sort.py): sample
@@ -308,11 +327,12 @@ class Dataset:
         ]
         if descending:
             ordered = ordered[::-1]
-        return Dataset(ordered)
+        return self._derive(ordered)
 
     def union(self, other: "Dataset") -> "Dataset":
-        return Dataset(self._materialized_refs()
-                       + other._materialized_refs())
+        return self._derive(self._materialized_refs()
+                            + other._materialized_refs(),
+                            extra_pins=other._keep_alive)
 
     def zip(self, other: "Dataset") -> "Dataset":
         mine, theirs = self.take_all(), other.take_all()
@@ -325,7 +345,8 @@ class Dataset:
         refs = self._materialized_refs()
         if len(refs) >= n and len(refs) % n == 0:
             per = len(refs) // n
-            return [Dataset(refs[i * per:(i + 1) * per]) for i in builtins.range(n)]
+            return [self._derive(refs[i * per:(i + 1) * per])
+                    for i in builtins.range(n)]
         rows = self.take_all()
         chunk = (len(rows) + n - 1) // n
         return [from_items(rows[i * chunk:(i + 1) * chunk] or [],
@@ -343,8 +364,8 @@ class Dataset:
         windows = []
         refs = self._block_refs
         for i in builtins.range(0, len(refs), blocks_per_window):
-            windows.append(Dataset(refs[i:i + blocks_per_window],
-                                   self._stages))
+            windows.append(self._derive(refs[i:i + blocks_per_window],
+                                        self._stages))
         return DatasetPipeline(windows)
 
     def repeat(self, times: int | None = None) -> "DatasetPipeline":
@@ -666,7 +687,7 @@ class GroupedDataset:
                                  for b in builtins.range(n)])
             for i in builtins.range(n)
         ]
-        return Dataset(reduced)
+        return ds._derive(reduced)
 
     def count(self) -> Dataset:
         return self._reduce(lambda groups: [

@@ -131,12 +131,13 @@ class BatchPredictor:
                 _cache_put(key, predictor)
             return predictor.predict(batch)
 
-        result = dataset.map_batches(
+        # Pin the checkpoint ref before deriving: in-flight block tasks
+        # hold it only inside pickled closures, which the owner-based ref
+        # counter can't see — dropping every pinned handle would free the
+        # object out from under them. _pin propagates through
+        # _with_stage/materialize, so chained .map(...) datasets keep the
+        # checkpoint alive too.
+        return dataset.map_batches(
             score, batch_format=batch_format,
-        ).materialize(compute=ActorPoolStrategy(num_scoring_workers))
-        # Pin the checkpoint ref to the result: in-flight block tasks hold
-        # it only inside pickled closures, which the owner-based ref
-        # counter can't see — dropping our handle here would free the
-        # object out from under them.
-        result._keep_alive = ckpt_ref
-        return result
+        )._pin(ckpt_ref).materialize(
+            compute=ActorPoolStrategy(num_scoring_workers))

@@ -94,16 +94,30 @@ class ClientContext:
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
         ids = [r.id for r in ref_list]
+        # timeout=None re-polls in bounded slices forever — direct mode
+        # blocks indefinitely too. Each slice issues ONE RPC; if the reply
+        # (possibly a huge pickle) outlives the wait window, keep waiting
+        # on the SAME in-flight future with a growing window rather than
+        # reissuing the op — a reissue would queue another full-size reply
+        # behind the first on the same socket (advisor + review, round 4).
         while True:
-            # the RPC deadline wraps the server-side get timeout
-            # (RpcClient.call consumes `timeout` itself, so the op timeout
-            # travels as op_timeout). timeout=None re-polls in bounded
-            # slices forever — direct mode blocks indefinitely too.
             slice_t = timeout if timeout is not None else _poll_slice()
+            fut = self._rpc.call_async("client_get", ids=ids,
+                                       op_timeout=slice_t)
+            wait = slice_t + 30.0
             try:
-                blob = self._rpc.call("client_get", ids=ids,
-                                      op_timeout=slice_t,
-                                      timeout=slice_t + 30)
+                while True:
+                    try:
+                        blob = fut.result(wait)
+                        break
+                    except GetTimeoutError:
+                        # server-side: object not ready within op_timeout
+                        raise
+                    except TimeoutError:
+                        # RPC-layer: reply still in transit
+                        if timeout is not None:
+                            raise
+                        wait = min(wait * 2, 3600.0)
                 break
             except GetTimeoutError:
                 if timeout is not None:

@@ -482,6 +482,37 @@ def test_undeclared_concurrency_group_fails(ray_start_regular):
         def call(self):
             return 1
 
-    t = Typo.remote()
+    # caught at actor creation, before any call can run (advisor round 3:
+    # a dispatch-time failure left the caller's seq unconsumed and wedged
+    # every later call on that handle)
     with pytest.raises(Exception, match="concurrency group"):
-        ray_tpu.get(t.call.remote(), timeout=30)
+        Typo.remote()
+
+
+def test_dispatch_time_group_failure_does_not_wedge(ray_start_regular):
+    """Defense-in-depth path: a group lookup failing at dispatch must
+    consume the seq so later calls from the same handle still run."""
+    import ray_tpu
+    from ray_tpu._private import api as api_mod
+
+    @ray_tpu.remote(concurrency_groups={"io": 2})
+    class Typo:
+        @ray_tpu.method(concurrency_group="oi")   # misspelled
+        def bad(self):
+            return 1
+
+        def good(self):
+            return 2
+
+    # bypass creation-time validation to exercise the executor guard
+    orig = api_mod._validate_concurrency_groups
+    api_mod._validate_concurrency_groups = lambda cls, groups: None
+    try:
+        t = Typo.remote()
+    finally:
+        api_mod._validate_concurrency_groups = orig
+    bad_ref = t.bad.remote()
+    # the failed call errors, and the NEXT seq from this caller proceeds
+    assert ray_tpu.get(t.good.remote(), timeout=30) == 2
+    with pytest.raises(Exception, match="concurrency group"):
+        ray_tpu.get(bad_ref, timeout=30)

@@ -92,3 +92,49 @@ def test_subscriber_over_rpc_and_gcs_channels(ray_start_regular):
     assert any(e.get("event") == "alive" for e in events), events
     sub.stop()
     rpc.close()
+
+
+def test_subscriber_gap_detection_after_publisher_gc():
+    """Publisher-side GC drops the mailbox; the subscriber must surface
+    the discontinuity instead of silently resuming (advisor, round 3)."""
+    from ray_tpu._private.pubsub import Publisher, Subscriber
+
+    pub = Publisher()
+
+    class _LocalRpc:
+        def call(self, method, **kw):
+            kw.pop("timeout", None)
+            if method == "psub_subscribe":
+                return pub.rpc_psub_subscribe(None, kw["channels"],
+                                              kw.get("sub_id"))
+            if method == "psub_poll":
+                return pub.rpc_psub_poll(None, kw["sub_id"],
+                                         kw["after_seq"],
+                                         kw.get("poll_timeout", 1))
+            raise AssertionError(method)
+
+    got, gaps = [], []
+    sub = Subscriber(_LocalRpc(), poll_timeout=0.3, on_gap=gaps.append)
+    sub.subscribe("ch", got.append)
+    pub.publish("ch", "a")
+    deadline = time.monotonic() + 10
+    while "a" not in got and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert got == ["a"]
+
+    # simulate publisher-side GC, then a publish the subscriber misses
+    pub.unsubscribe(sub._sub_id)
+    pub.publish("ch", "lost")
+    deadline = time.monotonic() + 10
+    while sub.gap_count == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert sub.gap_count == 1
+    assert gaps and gaps[0] >= 1
+
+    # stream continues after re-sync
+    pub.publish("ch", "c")
+    deadline = time.monotonic() + 10
+    while "c" not in got and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert got == ["a", "c"]          # "lost" is gone, and reported as a gap
+    sub.stop()
