@@ -12,23 +12,11 @@ harness still produces a line.
 import dataclasses
 import json
 import os
-import subprocess
 import sys
 import time
 
 
-def _tpu_reachable_once(timeout_s: float = 120.0) -> bool:
-    """Probe the TPU backend in a SUBPROCESS: a hung tunnel (axon) blocks
-    jax.devices() indefinitely and would wedge this whole run. The main
-    process only imports jax after deciding which platform to use."""
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; assert jax.devices()[0].platform == 'tpu'"],
-            timeout=timeout_s, capture_output=True)
-        return probe.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+from ray_tpu._private.tpu_probe import tpu_reachable_once as _tpu_reachable_once
 
 
 def _tpu_reachable(window_s: float = None) -> bool:

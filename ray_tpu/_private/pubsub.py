@@ -55,7 +55,7 @@ class Publisher:
         sub_id = sub_id or uuid.uuid4().hex
         sub = self._subs.setdefault(sub_id, {
             "channels": set(), "mail": [],
-            "last_seen": time.monotonic(), "waiters": 0,
+            "last_seen": time.monotonic(), "waiters": 0, "dropped": 0,
         })
         sub["channels"].update(channels)
         sub["last_seen"] = time.monotonic()
@@ -118,9 +118,12 @@ class Publisher:
                 if channel in sub["channels"]:
                     sub["mail"].append((seq, channel, message))
                     if len(sub["mail"]) > self.max_mailbox:
-                        # drop-oldest; slow consumers never block publishers
-                        del sub["mail"][: len(sub["mail"])
-                                        - self.max_mailbox]
+                        # drop-oldest; slow consumers never block
+                        # publishers — but the loss is COUNTED so the
+                        # subscriber can surface it as a gap
+                        n_drop = len(sub["mail"]) - self.max_mailbox
+                        sub["dropped"] = sub.get("dropped", 0) + n_drop
+                        del sub["mail"][:n_drop]
             for sub_id in stale:
                 del self._subs[sub_id]
             self._cond.notify_all()
@@ -145,7 +148,18 @@ class Publisher:
 
     def rpc_psub_poll(self, conn, sub_id: str, after_seq: int,
                       poll_timeout: float = 30.0):
-        return self.poll(sub_id, after_seq, timeout=poll_timeout)
+        """Returns (mail, max_seq, dropped): `dropped` counts messages
+        lost to mailbox overflow since the previous poll, so slow
+        consumers see the discontinuity instead of a silently thinned
+        stream (review finding, round 4)."""
+        mail, max_seq = self.poll(sub_id, after_seq, timeout=poll_timeout)
+        with self._lock:
+            sub = self._subs.get(sub_id)
+            dropped = 0
+            if sub is not None:
+                dropped = sub.get("dropped", 0)
+                sub["dropped"] = 0
+        return mail, max_seq, dropped
 
 
 class Subscriber:
@@ -160,6 +174,8 @@ class Subscriber:
     surfaced through ``on_gap(n_missed_upper_bound)`` and counted in
     ``gap_count`` so consumers can re-sync state instead of silently
     believing the stream was contiguous (advisor finding, round 3).
+    Mailbox-overflow drops at the publisher (slow consumer) are reported
+    the same way via the poll reply's dropped count.
     """
 
     def __init__(self, rpc_client, poll_timeout: float = 10.0, on_gap=None):
@@ -246,7 +262,7 @@ class Subscriber:
                     sub_id = self._sub_id
                     after = self._last_seq
                     epoch = self._floor_epoch
-                mail, max_seq = self._rpc.call(
+                mail, max_seq, dropped = self._rpc.call(
                     "psub_poll", sub_id=sub_id,
                     after_seq=after,
                     poll_timeout=self._poll_timeout,
@@ -256,6 +272,7 @@ class Subscriber:
                     # max_seq meaningless in the new seq space
                     if self._floor_epoch == epoch:
                         self._last_seq = max_seq
+                self._note_gap(dropped)   # mailbox-overflow losses
                 backoff = 0.1
             except Exception:
                 if self._stopped.is_set():

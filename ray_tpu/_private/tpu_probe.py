@@ -1,0 +1,23 @@
+"""TPU reachability probe, shared by bench/benchmark entry points.
+
+The axon tunnel can hang for hours and a hung tunnel blocks
+``jax.devices()`` FOREVER in any process that touches the TPU backend —
+so the probe runs in a SUBPROCESS with a timeout, and callers decide the
+platform before their own first jax import (see bench.py for the
+retry-with-backoff policy layered on top).
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def tpu_reachable_once(timeout_s: float = 120.0) -> bool:
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform == 'tpu'"],
+            timeout=timeout_s, capture_output=True)
+        return probe.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False

@@ -1,0 +1,32 @@
+"""Smoke tests for the collective bus-bandwidth harness
+(benchmarks/collective_bench.py — BASELINE.md north-star metric #2;
+reference shape: python/ray/util/collective/examples/)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "benchmarks"))
+
+import collective_bench as cb  # noqa: E402
+
+
+def test_bus_factor_conventions():
+    assert cb.bus_factor("allreduce", 8) == 2 * 7 / 8
+    assert cb.bus_factor("allgather", 8) == 7 / 8
+    assert cb.bus_factor("reducescatter", 4) == 3 / 4
+    assert cb.bus_factor("allreduce", 1) == 1.0
+
+
+def test_xla_local_bench_smoke():
+    rows = cb.run_xla_local(sizes=[64 * 1024], repeats=1, force_cpu=True)
+    assert {r["op"] for r in rows} == set(cb.OPS)
+    for r in rows:
+        assert r["busbw_GBps"] > 0
+        assert r["world"] == 8          # conftest's virtual CPU mesh
+
+
+def test_host_bench_smoke():
+    rows = cb.run_host(world=2, sizes=[64 * 1024], repeats=1)
+    assert {r["op"] for r in rows} == set(cb.OPS)
+    for r in rows:
+        assert r["busbw_GBps"] > 0 and r["world"] == 2

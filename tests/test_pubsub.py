@@ -138,3 +138,42 @@ def test_subscriber_gap_detection_after_publisher_gc():
         time.sleep(0.05)
     assert got == ["a", "c"]          # "lost" is gone, and reported as a gap
     sub.stop()
+
+
+def test_subscriber_gap_on_mailbox_overflow():
+    """Drop-oldest overflow at the publisher must surface as a gap, not a
+    silently thinned stream (review finding, round 4)."""
+    from ray_tpu._private.pubsub import Publisher, Subscriber
+
+    pub = Publisher(max_mailbox=3)
+
+    class _LocalRpc:
+        def call(self, method, **kw):
+            kw.pop("timeout", None)
+            if method == "psub_subscribe":
+                return pub.rpc_psub_subscribe(None, kw["channels"],
+                                              kw.get("sub_id"))
+            if method == "psub_poll":
+                return pub.rpc_psub_poll(None, kw["sub_id"],
+                                         kw["after_seq"],
+                                         kw.get("poll_timeout", 1))
+            raise AssertionError(method)
+
+    got, gaps = [], []
+    sub = Subscriber(_LocalRpc(), poll_timeout=0.2, on_gap=gaps.append)
+    # register WITHOUT starting delivery yet: park the poll thread by
+    # publishing a burst immediately, before the first poll drains
+    sub_id = pub.subscribe(["ch"])
+    sub._sub_id = sub_id
+    for i in range(10):                       # 7 of these overflow out
+        pub.publish("ch", i)
+    sub.subscribe("ch", got.append)           # now start polling
+    deadline = time.monotonic() + 10
+    while len(got) < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert got == [7, 8, 9]
+    deadline = time.monotonic() + 10
+    while not gaps and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert sum(gaps) == 7, gaps               # every dropped message counted
+    sub.stop()
