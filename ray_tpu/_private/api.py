@@ -27,6 +27,7 @@ from ray_tpu._private.worker_runtime import (
 _global_lock = threading.RLock()
 _global_node = None     # _LocalNode for locally started clusters
 _namespace = "default"
+_log_printer = None     # DriverLogPrinter while connected as driver
 
 
 class _LocalNode:
@@ -79,6 +80,15 @@ def init(address=None, *, num_cpus=None, num_tpus=None, num_gpus=None,
             _namespace = namespace
         if num_tpus is None and num_gpus is not None:
             num_tpus = num_gpus
+        # init(system_config=...) beats env beats defaults (config.py
+        # contract; reference: ray.init(_system_config=...)). Applied
+        # before any component starts so the in-process GCS/raylet (and
+        # their monitors) see the overrides.
+        from ray_tpu._private.config import GlobalConfig
+
+        GlobalConfig.apply_system_config(
+            kwargs.pop("system_config", None)
+            or kwargs.pop("_system_config", None))
         if isinstance(address, str) and address.startswith("ray://"):
             # client mode: everything proxies through one endpoint
             # (reference: util/client/, ray.init("ray://...") at
@@ -102,6 +112,20 @@ def init(address=None, *, num_cpus=None, num_tpus=None, num_gpus=None,
             raylet_addr = _find_raylet(gcs_addr)
         worker = CoreWorker(gcs_addr, raylet_addr, mode="driver")
         set_current_worker(worker)
+        # Stream worker stdout/stderr to this console (reference:
+        # worker.py:1733 print_worker_logs; disable with
+        # log_to_driver=False or RAY_TPU_LOG_TO_DRIVER=0).
+        from ray_tpu._private.config import get_config
+
+        global _log_printer
+        if kwargs.get("log_to_driver", get_config("log_to_driver")) \
+                and not os.environ.get("RAY_TPU_QUIET"):
+            from ray_tpu._private.log_monitor import DriverLogPrinter
+
+            try:
+                _log_printer = DriverLogPrinter(gcs_addr)
+            except Exception:
+                _log_printer = None
         atexit.register(shutdown)
         return RayContext(worker)
 
@@ -125,8 +149,14 @@ def _find_raylet(gcs_addr):
 
 
 def shutdown():
-    global _global_node
+    global _global_node, _log_printer
     with _global_lock:
+        if _log_printer is not None:
+            try:
+                _log_printer.stop()
+            except Exception:
+                pass
+            _log_printer = None
         worker = current_worker()
         if worker is not None:
             worker.shutdown()
@@ -134,6 +164,9 @@ def shutdown():
         if _global_node is not None:
             _global_node.stop()
             _global_node = None
+        from ray_tpu._private.config import GlobalConfig
+
+        GlobalConfig.reset_system_config()
         try:
             atexit.unregister(shutdown)
         except Exception:

@@ -83,6 +83,16 @@ class _Config:
             self._values[k] = v
             self._system_overrides.add(k)
 
+    def reset_system_config(self):
+        """Drop init(system_config=...) overrides (called at shutdown so
+        one driver's overrides don't leak into the next init in the same
+        process — test isolation depends on this)."""
+        for k in self._system_overrides:
+            env = os.environ.get("RAY_TPU_" + k.upper())
+            self._values[k] = (_parse(env, _CONFIG_DEFS[k])
+                               if env is not None else _CONFIG_DEFS[k])
+        self._system_overrides.clear()
+
     def snapshot(self) -> Dict[str, Any]:
         return dict(self._values)
 

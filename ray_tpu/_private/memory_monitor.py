@@ -1,0 +1,149 @@
+"""Node memory monitor + worker-killing policy (OOM protection).
+
+Reference: src/ray/common/memory_monitor.h:48,88 (MemoryMonitor polls
+/proc meminfo/cgroup usage on an interval and fires a callback above a
+usage threshold) and src/ray/raylet/worker_killing_policy.h:30 (pick a
+victim worker — newest-task-first, so long-running work survives and
+the likely culprit dies) — the raylet kills the victim with a
+RETRIABLE error instead of letting the kernel OOM-killer take down the
+whole node (or the raylet itself).
+
+The raylet owns one Monitor; the victim's task fails with
+OutOfMemoryError naming the culprit and its RSS, and normal task retry
+(retries_left) gives the resubmitted task its chance on a quieter node.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+
+def node_memory_usage() -> tuple[int, int]:
+    """(used_bytes, total_bytes) for this node. Cgroup-aware: in a
+    container the cgroup limit is the real ceiling, not the host total
+    (memory_monitor.h reads both and takes the tighter bound)."""
+    total = used = None
+    try:
+        with open("/proc/meminfo") as f:
+            info = {}
+            for line in f:
+                parts = line.split()
+                info[parts[0].rstrip(":")] = int(parts[1]) * 1024
+        total = info["MemTotal"]
+        used = total - info.get("MemAvailable",
+                                info.get("MemFree", 0))
+    except (OSError, KeyError):
+        total, used = 8 << 30, 0
+    for limit_path, usage_path in (
+            ("/sys/fs/cgroup/memory.max",
+             "/sys/fs/cgroup/memory.current"),
+            ("/sys/fs/cgroup/memory/memory.limit_in_bytes",
+             "/sys/fs/cgroup/memory/memory.usage_in_bytes")):
+        try:
+            with open(limit_path) as f:
+                raw = f.read().strip()
+            if raw == "max":
+                continue
+            limit = int(raw)
+            if 0 < limit < total:
+                with open(usage_path) as f:
+                    cg_used = int(f.read().strip())
+                return cg_used, limit
+        except (OSError, ValueError):
+            continue
+    return used, total
+
+
+def process_rss(pid: int) -> int:
+    """Resident set size of one process in bytes (0 if gone)."""
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def pick_victim(workers: list[dict]) -> dict | None:
+    """Newest-task-first (worker_killing_policy.h:30): among workers
+    currently running a task, kill the one whose task started LAST —
+    retrying young work wastes the least progress, and the most recent
+    arrival is the likeliest cause of the spike. Ties (no task-start
+    info) break toward the largest RSS.
+
+    Each entry: {"pid", "task_started_at" (float|None), ...}; returns the
+    chosen entry (caller kills + packages the error).
+    """
+    candidates = [w for w in workers if w.get("pid")]
+    if not candidates:
+        return None
+    running = [w for w in candidates if w.get("task_started_at")]
+    if running:
+        return max(running, key=lambda w: w["task_started_at"])
+    return max(candidates, key=lambda w: process_rss(w["pid"]))
+
+
+class MemoryMonitor:
+    """Polls node usage; above `threshold` of capacity, calls
+    `on_pressure(usage, total)` (the raylet's kill hook) once per
+    crossing, re-armed after usage falls below the threshold minus
+    `hysteresis` (no kill storms while usage hovers at the line) —
+    OR after `cooldown_s` with usage still above the threshold: one
+    kill may not relieve the pressure (another worker still growing),
+    and the reference keeps killing while over the line
+    (memory_monitor.h fires per monitoring interval)."""
+
+    def __init__(self, on_pressure, threshold: float | None = None,
+                 interval_s: float | None = None,
+                 hysteresis: float = 0.05,
+                 cooldown_s: float = 5.0,
+                 usage_fn=node_memory_usage):
+        from ray_tpu._private.config import get_config
+
+        self.threshold = (threshold if threshold is not None
+                          else get_config("memory_usage_threshold"))
+        self.interval_s = (interval_s if interval_s is not None
+                           else get_config("memory_monitor_refresh_ms")
+                           / 1000.0)
+        self.hysteresis = hysteresis
+        self.cooldown_s = cooldown_s
+        self._on_pressure = on_pressure
+        self._usage_fn = usage_fn
+        self._armed = True
+        self._last_fire = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        if self.interval_s <= 0:      # disabled by config
+            return self
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="memory-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def tick(self):
+        """One poll step (exposed for tests; the thread calls this)."""
+        import time
+
+        used, total = self._usage_fn()
+        if total <= 0:
+            return
+        frac = used / total
+        if frac >= self.threshold:
+            now = time.monotonic()
+            if self._armed or now - self._last_fire >= self.cooldown_s:
+                self._armed = False
+                self._last_fire = now
+                try:
+                    self._on_pressure(used, total)
+                except Exception:
+                    pass
+        elif frac < self.threshold - self.hysteresis:
+            self._armed = True
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.tick()

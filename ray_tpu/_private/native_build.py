@@ -16,6 +16,7 @@ _LOCK = threading.Lock()
 
 _LIBS = {
     "raystore": ["src/store/store.cc", "src/store/data_server.cc"],
+    "rayrpc": ["src/rpc/rpc_core.cc"],
 }
 
 

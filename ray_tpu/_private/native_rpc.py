@@ -1,0 +1,438 @@
+"""ctypes bindings for the native RPC core (src/rpc/rpc_core.cc).
+
+Drop-in replacements for protocol.PyRpcClient / PyRpcServer: framing,
+connection management, reply correlation and the request queue run in
+C++ threads with no GIL involvement; Python handles pickle and handler
+dispatch. Reference split: src/ray/rpc/ GrpcServer + ClientCallManager
+under a thin Cython shim (_raylet.pyx) — compiled transport, interpreted
+policy.
+
+Selection happens in protocol.RpcClient/RpcServer (env
+RAY_TPU_NATIVE_RPC=0 forces the pure-Python path).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import threading
+import time
+
+_REQUEST, _REPLY, _PUSH = 0, 1, 2
+_EV_DISCONNECT, _EV_CONNECT = -1, -2
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        from ray_tpu._private.native_build import ensure_lib
+
+        lib = ctypes.CDLL(ensure_lib("rayrpc"))
+        lib.rpc_buf_free.restype = None
+        # free() must see the ORIGINAL pointer, so buffers travel as
+        # c_void_p and are cast for reading
+        lib.rpc_buf_free.argtypes = [ctypes.c_void_p]
+
+        lib.rpc_cl_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int]
+        lib.rpc_cl_connect.restype = ctypes.c_void_p
+        lib.rpc_cl_send.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.c_longlong, ctypes.c_char_p,
+                                    ctypes.c_size_t, ctypes.c_int]
+        lib.rpc_cl_send.restype = ctypes.c_int
+        lib.rpc_cl_wait.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                    ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_void_p),
+                                    ctypes.POINTER(ctypes.c_size_t)]
+        lib.rpc_cl_wait.restype = ctypes.c_int
+        lib.rpc_cl_abandon.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.rpc_cl_abandon.restype = None
+        lib.rpc_cl_poll_async.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.rpc_cl_poll_async.restype = ctypes.c_int
+        lib.rpc_cl_closed.argtypes = [ctypes.c_void_p]
+        lib.rpc_cl_closed.restype = ctypes.c_int
+        lib.rpc_cl_close.argtypes = [ctypes.c_void_p]
+        lib.rpc_cl_close.restype = None
+
+        lib.rpc_sv_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.rpc_sv_start.restype = ctypes.c_void_p
+        lib.rpc_sv_port.argtypes = [ctypes.c_void_p]
+        lib.rpc_sv_port.restype = ctypes.c_int
+        lib.rpc_sv_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.rpc_sv_next.restype = ctypes.c_int
+        lib.rpc_sv_send.argtypes = [ctypes.c_void_p, ctypes.c_ulonglong,
+                                    ctypes.c_int, ctypes.c_longlong,
+                                    ctypes.c_char_p, ctypes.c_size_t]
+        lib.rpc_sv_send.restype = ctypes.c_int
+        lib.rpc_sv_conn_alive.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_ulonglong]
+        lib.rpc_sv_conn_alive.restype = ctypes.c_int
+        lib.rpc_sv_close_conn.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_ulonglong]
+        lib.rpc_sv_close_conn.restype = None
+        lib.rpc_sv_stop.argtypes = [ctypes.c_void_p]
+        lib.rpc_sv_stop.restype = None
+        _lib = lib
+        return lib
+
+
+def _take_buf(lib, ptr, length) -> bytes:
+    try:
+        return ctypes.string_at(ptr, length) if length else b""
+    finally:
+        lib.rpc_buf_free(ptr)
+
+
+class NativeRpcClient:
+    """protocol.PyRpcClient-compatible client over the C core."""
+
+    def __init__(self, addr, timeout: float = 30.0, on_push=None,
+                 retry: int = 3):
+        from ray_tpu._private.protocol import ConnectionLost
+
+        self.addr = tuple(addr)
+        self._timeout = timeout   # None = calls block until reply/close
+        self._on_push = on_push
+        self._lib = load_lib()
+        connect_ms = int((timeout if timeout is not None else 30.0) * 1000)
+        handle = None
+        for attempt in range(retry):
+            handle = self._lib.rpc_cl_connect(
+                str(self.addr[0]).encode(), int(self.addr[1]), connect_ms)
+            if handle:
+                break
+            time.sleep(0.05 * (2 ** attempt))
+        if not handle:
+            raise ConnectionLost(f"cannot connect to {self.addr}")
+        self._h = handle
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._pending: dict[int, object] = {}
+        self._pending_lock = threading.Lock()
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._pump = None
+        if on_push is not None:
+            self._ensure_pump()
+
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    # ------------------------------------------------------------- sync path
+    def call(self, method: str, timeout: float | None = None, **kwargs):
+        from ray_tpu._private.protocol import ConnectionLost, _RemoteError
+
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.addr} closed")
+        seq = self._next_seq()
+        payload = pickle.dumps((method, kwargs),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        rc = self._lib.rpc_cl_send(self._h, _REQUEST, seq, payload,
+                                   len(payload), 1)
+        if rc != 0:
+            self._closed = True
+            raise ConnectionLost(f"connection to {self.addr} lost")
+        t = timeout if timeout is not None else self._timeout
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_size_t()
+        rc = self._lib.rpc_cl_wait(
+            self._h, seq, -1 if t is None else int(t * 1000),
+            ctypes.byref(out), ctypes.byref(out_len))
+        if rc == 1:
+            self._lib.rpc_cl_abandon(self._h, seq)
+            raise TimeoutError("rpc call timed out")
+        if rc != 0:
+            self._closed = True
+            raise ConnectionLost(f"connection to {self.addr} lost")
+        result = pickle.loads(_take_buf(self._lib, out, out_len.value))
+        if isinstance(result, _RemoteError):
+            raise result.exc
+        return result
+
+    # ------------------------------------------------------------ async path
+    def call_async(self, method: str, **kwargs):
+        from ray_tpu._private.protocol import (ConnectionLost, _Future,
+                                               _RemoteError)
+
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.addr} closed")
+        self._ensure_pump()
+        seq = self._next_seq()
+        fut = _Future()
+        with self._pending_lock:
+            self._pending[seq] = fut
+        payload = pickle.dumps((method, kwargs),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        rc = self._lib.rpc_cl_send(self._h, _REQUEST, seq, payload,
+                                   len(payload), 0)
+        if rc != 0:
+            with self._pending_lock:
+                self._pending.pop(seq, None)
+            self._closed = True
+            raise ConnectionLost(f"connection to {self.addr} lost")
+        # the pump may already have resolved+removed it; re-check closed to
+        # avoid an unresolvable future registered after pump exit
+        if self._closed:
+            with self._pending_lock:
+                if self._pending.pop(seq, None) is not None:
+                    fut.set(_RemoteError(
+                        ConnectionLost(f"connection to {self.addr} lost")))
+        return fut
+
+    def push(self, method: str, **kwargs):
+        from ray_tpu._private.protocol import ConnectionLost
+
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.addr} closed")
+        payload = pickle.dumps((method, kwargs),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        rc = self._lib.rpc_cl_send(self._h, _PUSH, 0, payload,
+                                   len(payload), 0)
+        if rc != 0:
+            self._closed = True
+            raise ConnectionLost(f"connection to {self.addr} lost")
+
+    # ----------------------------------------------------------------- pump
+    def _ensure_pump(self):
+        if self._pump is None:
+            with self._close_lock:
+                if self._pump is None and not self._closed:
+                    self._pump = threading.Thread(
+                        target=self._pump_loop, daemon=True,
+                        name=f"rpc-pump-{self.addr}")
+                    self._pump.start()
+
+    def _pump_loop(self):
+        from ray_tpu._private.protocol import ConnectionLost, _RemoteError
+
+        kind = ctypes.c_int()
+        seq = ctypes.c_longlong()
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_size_t()
+        while True:
+            rc = self._lib.rpc_cl_poll_async(
+                self._h, -1, ctypes.byref(kind), ctypes.byref(seq),
+                ctypes.byref(out), ctypes.byref(out_len))
+            if rc == 2:
+                break
+            if rc != 0:
+                continue
+            data = _take_buf(self._lib, out, out_len.value)
+            try:
+                payload = pickle.loads(data)
+            except Exception:
+                continue
+            if kind.value == _REPLY:
+                with self._pending_lock:
+                    fut = self._pending.pop(seq.value, None)
+                if fut is not None:
+                    fut.set(payload)
+            elif kind.value == _PUSH and self._on_push is not None:
+                try:
+                    self._on_push(payload)
+                except Exception:
+                    pass
+        self._closed = True
+        err = _RemoteError(ConnectionLost(f"connection to {self.addr} lost"))
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            fut.set(err)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed or bool(self._lib.rpc_cl_closed(self._h))
+
+    def close(self):
+        # rpc_cl_close shuts the socket, joins the C reader, drains queued
+        # buffers and notifies all waiters; the handle itself stays valid
+        # forever (intentional ~bytes-sized leak) so racing call/wait
+        # threads can never use-after-free — they just observe "closed".
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._lib.rpc_cl_close(self._h)
+        pump = self._pump
+        if pump is not None and pump is not threading.current_thread():
+            pump.join(timeout=10.0)
+
+
+class NativeConnection:
+    """Server-side connection facade (protocol.Connection surface)."""
+
+    def __init__(self, server: "NativeRpcServer", conn_id: int):
+        self._server = server
+        self._conn_id = conn_id
+        self.id = f"native-{conn_id}"
+        self.meta: dict = {}
+        self.alive = True
+        self.peer = ("native", conn_id)
+
+    def push(self, method: str, **kwargs):
+        payload = pickle.dumps((method, kwargs),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        rc = self._server._lib.rpc_sv_send(
+            self._server._h, self._conn_id, _PUSH, 0, payload,
+            len(payload))
+        if rc != 0:
+            self.alive = False
+
+    def reply(self, seq: int, result):
+        """Send a (possibly deferred) reply; pairs with NO_REPLY handlers."""
+        from ray_tpu._private.protocol import _RemoteError
+
+        try:
+            blob = pickle.dumps(result, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:  # unpicklable result: report, don't hang
+            blob = pickle.dumps(_RemoteError(RuntimeError(
+                f"unpicklable rpc result: {e}")))
+        rc = self._server._lib.rpc_sv_send(
+            self._server._h, self._conn_id, _REPLY, seq, blob, len(blob))
+        if rc != 0:
+            self.alive = False
+
+
+_NO_REPLY = object()
+
+
+class NativeRpcServer:
+    """protocol.PyRpcServer-compatible server over the C core.
+
+    Dispatch policy matches the Python server: REQUESTs run on a fresh
+    thread (handlers may block — long-polls, task execution); PUSHes run
+    inline on the pump. Methods named in the handler's ``INLINE_RPC``
+    set run inline too (must be non-blocking); an inline handler may
+    return ``protocol.NO_REPLY`` and later answer via ``conn.reply``.
+    """
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._lib = load_lib()
+        self._h = self._lib.rpc_sv_start(host.encode(), port)
+        if not self._h:
+            raise OSError(f"cannot bind rpc server on {host}:{port}")
+        self.addr = (host, self._lib.rpc_sv_port(self._h))
+        self._conns: dict[int, NativeConnection] = {}
+        self._stopped = False
+        self._inline = getattr(handler, "INLINE_RPC", frozenset())
+        self._deferred = getattr(handler, "DEFERRED_RPC", frozenset())
+        self._pump = threading.Thread(
+            target=self._pump_loop, daemon=True,
+            name=f"rpc-sv-pump-{self.addr[1]}")
+
+    def start(self):
+        self._pump.start()
+        return self
+
+    def connections(self):
+        return list(self._conns.values())
+
+    def _lookup(self, method: str):
+        from ray_tpu._private.protocol import RpcError
+
+        fn = getattr(self._handler, f"rpc_{method}", None)
+        if fn is None:
+            raise RpcError(f"no such rpc method: {method}")
+        return fn
+
+    def _run_handler(self, conn, seq, method, kwargs):
+        from ray_tpu._private.protocol import NO_REPLY, _RemoteError
+
+        try:
+            if method in self._deferred:
+                result = self._lookup(method)(conn, seq, **kwargs)
+            else:
+                result = self._lookup(method)(conn, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — ship handler errors back
+            result = _RemoteError(e)
+        if result is NO_REPLY:
+            return
+        conn.reply(seq, result)
+
+    def _pump_loop(self):
+        conn_id = ctypes.c_ulonglong()
+        kind = ctypes.c_int()
+        seq = ctypes.c_longlong()
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_size_t()
+        while True:
+            rc = self._lib.rpc_sv_next(
+                self._h, -1, ctypes.byref(conn_id), ctypes.byref(kind),
+                ctypes.byref(seq), ctypes.byref(out), ctypes.byref(out_len))
+            if rc == 2:
+                break
+            if rc != 0:
+                continue
+            data = _take_buf(self._lib, out, out_len.value)
+            cid = conn_id.value
+            if kind.value == _EV_CONNECT:
+                conn = NativeConnection(self, cid)
+                self._conns[cid] = conn
+                cb = getattr(self._handler, "on_connect", None)
+                if cb is not None:
+                    try:
+                        cb(conn)
+                    except Exception:
+                        pass
+                continue
+            if kind.value == _EV_DISCONNECT:
+                conn = self._conns.pop(cid, None)
+                if conn is not None:
+                    conn.alive = False
+                    cb = getattr(self._handler, "on_disconnect", None)
+                    if cb is not None:
+                        try:
+                            cb(conn)
+                        except Exception:
+                            pass
+                continue
+            conn = self._conns.get(cid)
+            if conn is None:
+                continue
+            try:
+                method, kwargs = pickle.loads(data)
+            except Exception:
+                continue
+            if kind.value == _PUSH:
+                try:
+                    self._lookup(method)(conn, **kwargs)
+                except Exception:
+                    pass
+            elif kind.value == _REQUEST:
+                if method in self._inline:
+                    self._run_handler(conn, seq.value, method, kwargs)
+                else:
+                    threading.Thread(
+                        target=self._run_handler,
+                        args=(conn, seq.value, method, kwargs),
+                        daemon=True).start()
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        self._lib.rpc_sv_stop(self._h)
+        if self._pump.is_alive() and \
+                threading.current_thread() is not self._pump:
+            self._pump.join(timeout=5.0)
+        for conn in list(self._conns.values()):
+            conn.alive = False
+        self._conns.clear()

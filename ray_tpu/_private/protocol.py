@@ -1,29 +1,41 @@
 """Control-plane RPC: length-prefixed pickle frames over TCP.
 
 The reference's control plane is gRPC (/root/reference/src/ray/rpc/ —
-GrpcServer, ClientCall); ours is a minimal threaded socket RPC with the same
-shape: persistent bidirectional connections, request/reply correlation ids,
-and one-way pushes. Pickle is safe here because every endpoint belongs to the
-same trust domain (one cluster, one user), exactly like the reference's
+GrpcServer, ClientCall); ours has the same shape: persistent
+bidirectional connections, request/reply correlation ids, and one-way
+pushes. Pickle is safe here because every endpoint belongs to the same
+trust domain (one cluster, one user), exactly like the reference's
 cloudpickled task specs.
 
-Wire format: 8-byte big-endian length, then a pickled (kind, seq, payload)
-tuple. kind is REQUEST/REPLY/PUSH.
+Wire format (shared with the native C++ core, src/rpc/rpc_core.cc):
+``[len: u64 BE] [kind: u8] [seq: i64 BE] [payload: len-9 bytes]`` where
+payload is an opaque pickle. kind is REQUEST/REPLY/PUSH.
+
+Two interoperable implementations: the native C++ core (framing,
+correlation and queueing off-GIL — the default; see native_rpc.py) and
+the pure-Python classes below (fallback, and the semantic reference).
+``RAY_TPU_NATIVE_RPC=0`` forces pure Python.
 """
 from __future__ import annotations
 
-import io
 import os
 import pickle
 import socket
 import struct
 import threading
 import time
+import traceback
 import uuid
 
 REQUEST, REPLY, PUSH = 0, 1, 2
 
-_HDR = struct.Struct(">Q")
+_HDR = struct.Struct(">QBq")   # total-after-len, kind, seq
+
+# Sentinel a handler returns to suppress the automatic reply; it must
+# then answer later via conn.reply(seq, result) (deferred replies let
+# e.g. the worker main loop answer task pushes without parking a
+# dispatch thread per in-flight task).
+NO_REPLY = object()
 
 
 class RpcError(Exception):
@@ -36,13 +48,10 @@ class ConnectionLost(RpcError):
 
 def _send_frame(sock: socket.socket, kind: int, seq: int, payload,
                 lock: threading.Lock):
-    buf = io.BytesIO()
-    buf.write(b"\0" * 8)
-    pickle.dump((kind, seq, payload), buf, protocol=pickle.HIGHEST_PROTOCOL)
-    data = buf.getbuffer()
-    _HDR.pack_into(data, 0, len(data) - 8)
+    data = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    hdr = _HDR.pack(len(data) + 9, kind, seq)
     with lock:
-        sock.sendall(data)
+        sock.sendall(hdr + data)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -57,8 +66,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_frame(sock: socket.socket):
-    (length,) = _HDR.unpack(_recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, length))
+    length, kind, seq = _HDR.unpack(_recv_exact(sock, 17))
+    return kind, seq, pickle.loads(_recv_exact(sock, length - 9))
 
 
 class _RemoteError:
@@ -68,7 +77,7 @@ class _RemoteError:
         self.exc = exc
 
 
-class RpcClient:
+class PyRpcClient:
     """A persistent connection to one RpcServer. Thread-safe; many in-flight
     calls multiplex on the connection (like the reference's ClientCallManager,
     rpc/client_call.h)."""
@@ -185,13 +194,45 @@ class _Future:
     def __init__(self):
         self._ev = threading.Event()
         self._value = None
+        self._cb = None
+        self._cb_lock = threading.Lock()
 
     def set(self, value):
         self._value = value
-        self._ev.set()
+        # the lock makes the set-flag/claim-callback pair atomic against
+        # add_done_callback — without it the two sides can BOTH observe
+        # "flag set, callback present" and fire cb twice (double
+        # _task_done corrupts in_flight accounting)
+        with self._cb_lock:
+            self._ev.set()
+            cb, self._cb = self._cb, None
+        if cb is not None:
+            try:
+                cb(value)
+            except Exception:
+                # a reply-path callback failure would otherwise hang the
+                # caller's get() with zero diagnostics (the old
+                # thread-per-reply pattern at least hit threading.excepthook)
+                traceback.print_exc()
 
     def done(self) -> bool:
         return self._ev.is_set()
+
+    def add_done_callback(self, cb):
+        """Run ``cb(raw_value)`` when the reply lands — on the transport's
+        reader/pump thread, so cb MUST NOT block and MUST NOT issue a sync
+        call over the same connection (the thread that would deliver that
+        reply is the one running cb). A _RemoteError value arrives
+        UNWRAPPED; callers unwrap instead of raising. Replaces the
+        thread-per-in-flight-call reply pattern on the task hot path."""
+        with self._cb_lock:
+            if not self._ev.is_set():
+                self._cb = cb      # set() will run it
+                return
+        try:
+            cb(self._value)
+        except Exception:
+            traceback.print_exc()
 
     def result(self, timeout: float | None = None):
         if not self._ev.wait(timeout):
@@ -218,16 +259,30 @@ class Connection:
         except OSError:
             self.alive = False
 
+    def reply(self, seq: int, result):
+        """Send a deferred reply (pairs with a handler returning NO_REPLY)."""
+        try:
+            _send_frame(self.sock, REPLY, seq, result, self.wlock)
+        except OSError:
+            self.alive = False
 
-class RpcServer:
+
+class PyRpcServer:
     """Threaded RPC server. A handler object exposes `rpc_<method>` callables;
     each gets (conn, **kwargs). Raising inside a handler propagates the
     exception to the caller. A handler may also expose `on_connect(conn)` /
     `on_disconnect(conn)` for liveness tracking (the reference tracks client
-    death via socket EOF the same way, common/client_connection.h)."""
+    death via socket EOF the same way, common/client_connection.h), an
+    ``INLINE_RPC`` set naming non-blocking methods dispatched inline on the
+    connection's reader thread, and handlers may return NO_REPLY to answer
+    later via conn.reply."""
 
     def __init__(self, handler, host: str = "127.0.0.1", port: int = 0):
         self._handler = handler
+        self._inline = getattr(handler, "INLINE_RPC", frozenset())
+        # methods that take (conn, seq, **kwargs) so they can answer
+        # later via conn.reply(seq, ...) after returning NO_REPLY
+        self._deferred = getattr(handler, "DEFERRED_RPC", frozenset())
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -276,9 +331,13 @@ class RpcServer:
                 kind, seq, payload = _recv_frame(conn.sock)
                 method, kwargs = payload
                 if kind == REQUEST:
-                    threading.Thread(
-                        target=self._dispatch, args=(conn, seq, method, kwargs),
-                        daemon=True).start()
+                    if method in self._inline:
+                        self._dispatch(conn, seq, method, kwargs)
+                    else:
+                        threading.Thread(
+                            target=self._dispatch,
+                            args=(conn, seq, method, kwargs),
+                            daemon=True).start()
                 elif kind == PUSH:
                     try:
                         self._lookup(method)(conn, **kwargs)
@@ -312,9 +371,14 @@ class RpcServer:
 
     def _dispatch(self, conn: Connection, seq: int, method: str, kwargs):
         try:
-            result = self._lookup(method)(conn, **kwargs)
+            if method in self._deferred:
+                result = self._lookup(method)(conn, seq, **kwargs)
+            else:
+                result = self._lookup(method)(conn, **kwargs)
         except BaseException as e:  # noqa: BLE001 — ship handler errors back
             result = _RemoteError(e)
+        if result is NO_REPLY:
+            return
         try:
             _send_frame(conn.sock, REPLY, seq, result, conn.wlock)
         except OSError:
@@ -360,3 +424,41 @@ class RpcServer:
                 conn.sock.close()
             except OSError:
                 pass
+
+
+# --------------------------------------------------------------- selection
+
+_native_state: list = []   # [] = undecided, [True/False] = decided
+
+
+def _use_native() -> bool:
+    if not _native_state:
+        use = os.environ.get("RAY_TPU_NATIVE_RPC", "1") == "1"
+        if use:
+            try:
+                from ray_tpu._private.native_rpc import load_lib
+
+                load_lib()
+            except Exception:
+                use = False   # toolchain missing: pure Python still works
+        _native_state.append(use)
+    return _native_state[0]
+
+
+def RpcClient(addr, timeout: float = 30.0, on_push=None, retry: int = 3):
+    """Factory: native C++ transport when available, else pure Python."""
+    if _use_native():
+        from ray_tpu._private.native_rpc import NativeRpcClient
+
+        return NativeRpcClient(addr, timeout=timeout, on_push=on_push,
+                               retry=retry)
+    return PyRpcClient(addr, timeout=timeout, on_push=on_push, retry=retry)
+
+
+def RpcServer(handler, host: str = "127.0.0.1", port: int = 0):
+    """Factory: native C++ transport when available, else pure Python."""
+    if _use_native():
+        from ray_tpu._private.native_rpc import NativeRpcServer
+
+        return NativeRpcServer(handler, host=host, port=port)
+    return PyRpcServer(handler, host=host, port=port)

@@ -65,21 +65,17 @@ def detect_tpu_topology() -> dict | None:
     if slice_id:
         info["slice_id"] = slice_id
     if _chip_detection_enabled():
-        try:
-            import jax
+        # SUBPROCESS probe with a timeout: an in-process jax.devices()
+        # hangs FOREVER on a wedged axon tunnel, which would wedge
+        # raylet startup (and with it ray_tpu.init) on any box where the
+        # tunnel is down — learned the hard way in rounds 3-4.
+        from ray_tpu._private.tpu_probe import probe_chips
 
-            chips = [d for d in jax.devices() if d.platform == "tpu"]
-            if chips:
-                info["chips"] = len(chips)
-                coords = [tuple(getattr(d, "coords", ()) or ())
-                          for d in chips]
-                if any(coords):
-                    info["coords"] = coords
-                si = getattr(chips[0], "slice_index", None)
-                if si is not None and "slice_id" not in info:
-                    info["slice_id"] = f"slice-{si}"
-        except Exception:
-            pass
+        chips = probe_chips(timeout_s=float(
+            os.environ.get("RAY_TPU_CHIP_PROBE_TIMEOUT_S", "60")))
+        if chips:
+            for k, v in chips.items():
+                info.setdefault(k, v)   # env-derived identity wins
     if not info:
         return None
     info.setdefault("slice_id", "slice-0")
@@ -94,13 +90,14 @@ def detect_resources(num_cpus=None, num_tpus=None, memory=None,
     if num_tpus is None:
         num_tpus = 0
         if _chip_detection_enabled():
-            try:
-                import jax
+            # SUBPROCESS probe (shared with detect_tpu_topology): an
+            # in-process jax.devices() hangs forever on a wedged axon
+            # tunnel, which would hang ray_tpu.init itself.
+            from ray_tpu._private.tpu_probe import probe_chips
 
-                num_tpus = len([d for d in jax.devices()
-                                if d.platform == "tpu"])
-            except Exception:
-                num_tpus = 0
+            chips = probe_chips(timeout_s=float(
+                os.environ.get("RAY_TPU_CHIP_PROBE_TIMEOUT_S", "60")))
+            num_tpus = (chips or {}).get("chips", 0)
     if num_tpus:
         out["TPU"] = float(num_tpus)
     if memory is None:
@@ -130,6 +127,7 @@ class Lease:
         self.lease_id = lease_id
         self.resources = resources
         self.worker = worker
+        self.granted_at = time.time()   # OOM victim ranking (newest first)
         # (worker_id, addr) of the requesting core worker: leases die with
         # their lessee (reference: leases are tied to the lease client's
         # connection; a dead lessee's resources must be reclaimed)
@@ -177,6 +175,29 @@ class Raylet:
         self._queued_demand: list[dict] = []
         self._stopped = False
 
+        # Monitors are CONSTRUCTED before the RPC server starts: the
+        # moment the server is up (and register_node lands), a remote
+        # driver can send request_lease → _spawn_worker, which needs
+        # logs_dir/_log_monitor. Their threads start only after the GCS
+        # connection exists (their publish/kill hooks ride it).
+        from ray_tpu._private.log_monitor import LogMonitor
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+
+        self.logs_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(self.logs_dir, exist_ok=True)
+        # Worker log capture → GCS pubsub → driver console (reference:
+        # _private/log_monitor.py as a thread instead of a process).
+        self._log_monitor = LogMonitor(
+            lambda ch, msg: self._gcs.push("publish", channel=ch,
+                                           message=msg),
+            node_id=self.node_id)
+        # OOM protection: poll node memory; above the threshold kill the
+        # newest-task worker with a retriable OutOfMemoryError instead of
+        # letting the kernel OOM-killer take the node (reference:
+        # common/memory_monitor.h:88 + raylet/worker_killing_policy.h:30).
+        self._oom_reasons: dict[str, str] = {}   # worker_id -> message
+        self._mem_monitor = MemoryMonitor(self._on_memory_pressure)
+
         self._server = RpcServer(self, host, port).start()
         self.addr = self._server.addr
         self._gcs = RpcClient(self.gcs_addr, on_push=self._on_gcs_push)
@@ -193,22 +214,54 @@ class Raylet:
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
                                         name=f"raylet-reap-{self.node_id[:6]}")
         self._reaper.start()
+        self._log_monitor.start()
+        self._mem_monitor.start()
         # Warm pool: prestart workers so the first leases don't eat Python
-        # startup latency (reference: worker_pool.h PrestartWorkers).
-        n_prestart = min(int(self.resources_total.get("CPU", 1)),
-                         _IDLE_WORKER_CAP,
-                         int(os.environ.get("RAY_TPU_PRESTART_WORKERS", "4")))
-        if n_prestart > 0:
-            threading.Thread(target=self._prestart, args=(n_prestart,),
-                             daemon=True).start()
+        # startup latency, and REFILL toward this watermark whenever the
+        # pool is drawn down (reference: worker_pool.h PrestartWorkers +
+        # idle-pool maintenance) — on-demand cold spawns under load cost
+        # ~300ms each of lease-grant latency (profiled round 4).
+        self._prestart_target = min(
+            int(self.resources_total.get("CPU", 1)), _IDLE_WORKER_CAP,
+            int(os.environ.get("RAY_TPU_PRESTART_WORKERS", "4")))
+        self._spawning = 0
+        if self._prestart_target > 0:
+            self._maybe_refill()
 
-    def _prestart(self, n: int):
-        handles = [self._spawn_worker() for _ in range(n)]
-        for h in handles:
-            if h.registered.wait(30.0) and h.proc.poll() is None:
-                with self._lock:
-                    if h.assigned_lease is None and h not in self._idle:
-                        self._idle.append(h)
+    def _maybe_refill(self):
+        """Top the idle pool back up to the prestart watermark in the
+        background (never blocks a grant)."""
+        if self._stopped:
+            return
+        with self._lock:
+            deficit = (self._prestart_target - len(self._idle)
+                       - self._spawning)
+            if deficit <= 0:
+                return
+            self._spawning += deficit
+        threading.Thread(target=self._refill, args=(deficit,),
+                         daemon=True).start()
+
+    def _refill(self, n: int):
+        try:
+            handles = [self._spawn_worker() for _ in range(n)]
+            for h in handles:
+                if h.registered.wait(30.0) and h.proc.poll() is None:
+                    with self._lock:
+                        if (h.assigned_lease is None
+                                and h not in self._idle
+                                and len(self._idle) < _IDLE_WORKER_CAP):
+                            self._idle.append(h)
+                        elif h.assigned_lease is None:
+                            # pool refilled concurrently (returned leases
+                            # beat us): a worker neither idle nor leased
+                            # would be an orphan process — kill it
+                            self._kill_worker(h)
+        except Exception:
+            pass   # raylet stopping mid-refill
+        finally:
+            with self._lock:
+                self._spawning -= n
 
     # ---- GCS pushes ---------------------------------------------------------
 
@@ -278,12 +331,19 @@ class Raylet:
         if repo_root not in parts:
             parts.insert(0, repo_root)
         env["PYTHONPATH"] = os.pathsep.join(parts)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, cwd=os.getcwd(),
-            stdout=subprocess.DEVNULL if env.get("RAY_TPU_QUIET") else None,
-            stderr=None)
+        # Workers log to per-worker files in the session dir (reference:
+        # workers write session_latest/logs/worker-*.out/.err, tailed by
+        # the log monitor); the raylet's LogMonitor streams new lines to
+        # the driver over pubsub.
+        out_path = os.path.join(self.logs_dir, f"worker-{worker_id}.out")
+        err_path = os.path.join(self.logs_dir, f"worker-{worker_id}.err")
+        with open(out_path, "ab") as out_f, open(err_path, "ab") as err_f:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=env, cwd=os.getcwd(),
+                stdout=out_f, stderr=err_f)
         handle = WorkerHandle(proc, worker_id)
+        self._log_monitor.track(worker_id, proc.pid, out_path, err_path)
         with self._lock:
             self._workers[worker_id] = handle
         return handle
@@ -297,8 +357,14 @@ class Raylet:
             while self._idle:
                 handle = self._idle.pop()
                 if handle.proc.poll() is None:
-                    return handle
+                    break
+            else:
+                handle = None
+        if handle is not None:
+            self._maybe_refill()   # keep the next burst warm
+            return handle
         handle = self._spawn_worker()
+        self._maybe_refill()
         if not handle.registered.wait(timeout):
             raise TimeoutError(
                 f"worker {handle.worker_id} failed to register in {timeout}s")
@@ -350,6 +416,66 @@ class Raylet:
                 except Exception:
                     pass
 
+    def _on_memory_pressure(self, used: int, total: int):
+        """Kill one worker to relieve node memory pressure. Victim choice
+        is newest-task-first (memory_monitor.pick_victim); the kill reason
+        is recorded in GCS KV *before* the SIGKILL so the task's owner —
+        observing the dropped connection — can surface OutOfMemoryError
+        instead of a generic WorkerCrashedError."""
+        from ray_tpu._private.memory_monitor import pick_victim, process_rss
+
+        with self._lock:
+            cands = []
+            for h in self._workers.values():
+                if h.proc is None or h.proc.poll() is not None:
+                    continue
+                started = None
+                if h.assigned_lease:
+                    lease = self._leases.get(h.assigned_lease)
+                    started = lease.granted_at if lease else None
+                cands.append({"pid": h.proc.pid, "task_started_at": started,
+                              "worker_id": h.worker_id, "addr": h.addr,
+                              "handle": h})
+        # Leases outlive tasks (they pipeline many), so the grant time
+        # ranks by LEASE age. Ask each candidate what it is actually
+        # running — task_state answers inline, so this stays fast even
+        # under pressure. Actors keep the lease (creation) time: killing
+        # an old actor loses state, and newest-first already deprioritizes
+        # them. Probe failures fall back to the lease age.
+        for c in cands:
+            if c["addr"] is None or c["handle"].is_actor:
+                continue
+            try:
+                client = RpcClient(tuple(c["addr"]), timeout=1.0, retry=1)
+                try:
+                    state = client.call("task_state", timeout=1.0)
+                finally:
+                    client.close()
+                c["task_started_at"] = state.get("task_started_at")
+            except Exception:
+                pass
+        victim = pick_victim(cands)
+        if victim is None:
+            return
+        rss = process_rss(victim["pid"])
+        msg = (f"Worker {victim['worker_id']} (pid {victim['pid']}) on node "
+               f"{self.node_id} was killed due to the node running low on "
+               f"memory: worker RSS {rss / 2**30:.2f} GB, node usage "
+               f"{used / 2**30:.2f}/{total / 2**30:.2f} GB above threshold "
+               f"{self._mem_monitor.threshold:.0%}. The task is retriable; "
+               f"reduce its memory footprint or lower task parallelism.")
+        self._oom_reasons[victim["worker_id"]] = msg
+        try:
+            self._gcs.call("kv_put", ns="oom_kill",
+                           key=victim["worker_id"].encode(),
+                           value=msg.encode(), timeout=5.0)
+        except Exception:
+            pass   # owners fall back to WorkerCrashedError
+        try:
+            os.kill(victim["pid"], signal.SIGKILL)
+        except OSError:
+            pass
+
     def _release_leases_of_lessee(self, lessee_id: str):
         with self._lock:
             doomed = [lease for lease in self._leases.values()
@@ -374,15 +500,24 @@ class Raylet:
                       if lease.lessee_addr is not None
                       and lease.lessee_id not in self._workers]
         for lessee_id, addr in {(i, a) for i, a in remote}:
-            alive = True
-            try:
-                client = RpcClient(addr, timeout=2.0, retry=1)
+            # Reclaiming a LIVE lessee's leases kills its workers mid-task,
+            # so this probe errs toward patience: the lessee answers ping
+            # inline on its transport pump (no GIL-bound dispatch thread),
+            # but a loaded single-core host can still stall a reply for
+            # seconds — probe twice with generous timeouts before the
+            # verdict.
+            alive = False
+            for _ in range(2):
                 try:
-                    client.call("ping", timeout=2.0)
-                finally:
-                    client.close()
-            except Exception:
-                alive = False
+                    client = RpcClient(addr, timeout=5.0, retry=1)
+                    try:
+                        client.call("ping", timeout=5.0)
+                        alive = True
+                        break
+                    finally:
+                        client.close()
+                except Exception:
+                    time.sleep(0.2)
             if not alive:
                 self._release_leases_of_lessee(lessee_id)
 
@@ -401,6 +536,7 @@ class Raylet:
         # Leases this worker REQUESTED (as lessee) die with it: its
         # submission queues can never return them.
         self._release_leases_of_lessee(worker_id)
+        self._log_monitor.mark_dead(worker_id)
         if handle.is_actor and handle.actor_id is not None:
             self._handle_actor_death(handle)
         self._pump_pending()
@@ -410,10 +546,12 @@ class Raylet:
             # Node teardown: GCS sees our disconnect and re-drives restarts
             # on a surviving node — restarting here would race the shutdown.
             return
+        reason = (self._oom_reasons.pop(handle.worker_id, None)
+                  or "worker process died")
         try:
             decision = self._gcs.call("actor_failed",
                                       actor_id=handle.actor_id,
-                                      reason="worker process died")
+                                      reason=reason)
         except ConnectionLost:
             return
         if decision and decision.get("restart"):
@@ -760,6 +898,9 @@ class Raylet:
                             timeout=spec.get("creation_timeout", 60.0))
             finally:
                 client.close()
+            self._log_monitor.set_actor_name(
+                worker.worker_id,
+                spec.get("name") or spec.get("class_name"))
         except BaseException:
             # Failed creation must not leak the reservation (or the worker —
             # a half-initialized actor process is not reusable). If the
@@ -906,6 +1047,11 @@ class Raylet:
 
     def stop(self, kill_workers: bool = True):
         self._stopped = True
+        self._mem_monitor.stop()
+        try:
+            self._log_monitor.stop()   # final drain rides the live GCS conn
+        except Exception:
+            pass
         # Drop the GCS connection first: node-death handling (including actor
         # failover to surviving nodes) starts before local worker reaping can
         # misreport deaths as per-worker failures.

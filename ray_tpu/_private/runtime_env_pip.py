@@ -1,0 +1,195 @@
+"""Per-environment pip venvs with a ref-counted URI cache.
+
+Reference: python/ray/_private/runtime_env/pip.py (a venv per pip-spec
+hash, created on first use by the node's agent) + uri_cache.py (cached
+envs are ref-counted by the workers using them; unreferenced envs are
+evicted LRU when the cache exceeds its budget).
+
+TPU-native simplifications, documented as design deltas:
+- an "env" is a ``pip install --target`` tree, not a full venv:
+  activation is sys.path injection of that tree (plus py_modules
+  paths). Same interpreter, so pure-Python and C-extension wheels both
+  import, the baked-in stack (jax, numpy, ...) stays visible
+  underneath, and a worker can switch envs without a process swap.
+  (A real venv is also wrong here mechanically: the image's
+  interpreter is itself a venv, and a nested ``python -m venv
+  --system-site-packages`` resolves "system" past it, losing
+  setuptools et al.)
+- installs run with --no-index by default unless the spec names
+  requirement URLs: this image has no network egress, and hermetic
+  installs from local wheels/sdists are the supported path.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+DEFAULT_CACHE_ROOT = "/tmp/ray_tpu/runtime_envs"
+_MARKER = "RAY_TPU_ENV_OK"
+
+
+def env_hash(pip: list[str] | None, py_modules: list[str] | None) -> str:
+    """Content hash identifying one environment (the cache URI)."""
+    spec = {"pip": sorted(pip or []),
+            "py_modules": sorted(os.path.abspath(p)
+                                 for p in (py_modules or []))}
+    return "pipenv-" + hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:20]
+
+
+class PipEnvCache:
+    """Node-local venv cache. One instance per process; the directory
+    layout is shared across processes (creation is marker-file guarded,
+    losers of a concurrent-create race reuse the winner's env)."""
+
+    def __init__(self, root: str = DEFAULT_CACHE_ROOT,
+                 max_cached: int = 8):
+        self.root = root
+        self.max_cached = max_cached
+        self._refs: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.creations = 0        # diagnostics: cache-miss installs
+        os.makedirs(root, exist_ok=True)
+
+    # ------------------------------------------------------------ lifecycle
+    def get_or_create(self, pip: list[str] | None = None,
+                      py_modules: list[str] | None = None,
+                      timeout_s: float = 300.0) -> dict:
+        """Ensure the env exists; returns
+        {"uri", "site_dirs": [paths to prepend to sys.path]}."""
+        uri = env_hash(pip, py_modules)
+        env_dir = os.path.join(self.root, uri)
+        marker = os.path.join(env_dir, _MARKER)
+        if not os.path.exists(marker):
+            self._create(env_dir, marker, pip or [], py_modules or [],
+                         timeout_s)
+        site_dirs = []
+        venv_site = self._site_dir(env_dir)
+        if venv_site and os.path.isdir(venv_site):
+            site_dirs.append(venv_site)
+        mod_root = os.path.join(env_dir, "py_modules")
+        if os.path.isdir(mod_root):
+            site_dirs.append(mod_root)
+        return {"uri": uri, "site_dirs": site_dirs}
+
+    def _create(self, env_dir: str, marker: str, pip: list[str],
+                py_modules: list[str], timeout_s: float):
+        lock_dir = env_dir + ".lock"
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                os.makedirs(lock_dir)
+                break               # we are the creator
+            except FileExistsError:
+                if os.path.exists(marker):
+                    return          # another process finished it
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"runtime env creation stuck: {lock_dir}")
+                time.sleep(0.2)
+        try:
+            if os.path.exists(marker):
+                return
+            self.creations += 1
+            import shutil
+
+            shutil.rmtree(env_dir, ignore_errors=True)  # half-built prior
+            os.makedirs(env_dir, exist_ok=True)
+            if pip:
+                cmd = [sys.executable, "-m", "pip", "install",
+                       "--no-build-isolation", "--target",
+                       os.path.join(env_dir, "site")]
+                if not any(r.startswith(("http://", "https://"))
+                           for r in pip):
+                    cmd.append("--no-index")
+                p = subprocess.run(cmd + list(pip), capture_output=True,
+                                   timeout=timeout_s, text=True)
+                if p.returncode != 0:
+                    from ray_tpu.exceptions import RuntimeEnvSetupError
+
+                    raise RuntimeEnvSetupError(
+                        f"pip install failed for {pip}:\n{p.stderr[-2000:]}")
+            if py_modules:
+                mod_root = os.path.join(env_dir, "py_modules")
+                os.makedirs(mod_root, exist_ok=True)
+                import shutil
+
+                for src in py_modules:
+                    src = os.path.abspath(src)
+                    dst = os.path.join(mod_root, os.path.basename(src))
+                    if os.path.isdir(src):
+                        shutil.copytree(src, dst, dirs_exist_ok=True)
+                    else:
+                        shutil.copy2(src, dst)
+            with open(marker, "w") as f:
+                f.write(str(time.time()))
+        finally:
+            try:
+                os.rmdir(lock_dir)
+            except OSError:
+                pass
+
+    def _site_dir(self, env_dir: str) -> str | None:
+        cand = os.path.join(env_dir, "site")
+        return cand if os.path.isdir(cand) else None
+
+    # ----------------------------------------------------------- refcounts
+    def acquire(self, uri: str):
+        with self._lock:
+            self._refs[uri] = self._refs.get(uri, 0) + 1
+
+    def release(self, uri: str):
+        with self._lock:
+            n = self._refs.get(uri, 0) - 1
+            if n <= 0:
+                self._refs.pop(uri, None)
+            else:
+                self._refs[uri] = n
+        self._maybe_evict()
+
+    def _maybe_evict(self):
+        """LRU-evict unreferenced envs beyond max_cached (uri_cache.py's
+        do-not-evict-while-referenced rule)."""
+        try:
+            entries = []
+            for name in os.listdir(self.root):
+                if not name.startswith("pipenv-"):
+                    continue
+                marker = os.path.join(self.root, name, _MARKER)
+                if not os.path.exists(marker):
+                    continue
+                entries.append((os.path.getmtime(marker), name))
+        except OSError:
+            return
+        if len(entries) <= self.max_cached:
+            return
+        import shutil
+
+        entries.sort()              # oldest first
+        with self._lock:
+            referenced = set(self._refs)
+        for _, name in entries[:len(entries) - self.max_cached]:
+            if name in referenced:
+                continue
+            shutil.rmtree(os.path.join(self.root, name),
+                          ignore_errors=True)
+
+
+_node_cache: PipEnvCache | None = None
+_node_cache_lock = threading.Lock()
+
+
+def node_env_cache() -> PipEnvCache:
+    """Process-wide cache instance (one per worker/raylet process)."""
+    global _node_cache
+    with _node_cache_lock:
+        if _node_cache is None:
+            _node_cache = PipEnvCache(
+                os.environ.get("RAY_TPU_RUNTIME_ENV_DIR",
+                               DEFAULT_CACHE_ROOT))
+        return _node_cache

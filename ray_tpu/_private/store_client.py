@@ -15,6 +15,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 
 from ray_tpu._private.native_build import ensure_lib
 
@@ -28,6 +29,7 @@ _ERRORS = {
     -6: "IN_USE",
     -7: "SYS",
     -8: "BAD_SEGMENT",
+    -9: "CLOSED",
 }
 
 
@@ -111,6 +113,21 @@ class PinnedBuffer:
             pass
 
 
+
+
+def _guarded(fn):
+    """Count the thread into the segment for the duration of the C calls
+    (close() waits for the count to drain before unmapping)."""
+    def wrapper(self, *args, **kwargs):
+        self._enter()
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            self._exit()
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
 class StoreClient:
     """Connects to (or creates) one node's shm segment. Thread-safe: the
     native layer serializes via the in-segment robust mutex."""
@@ -140,6 +157,29 @@ class StoreClient:
         self.spill_dir = spill_dir
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
+        # In-flight guard: close() must not unmap the segment while other
+        # threads are inside a C call on it, or while PinnedBuffers still
+        # point into it — either is a use-after-munmap segfault (observed
+        # in cluster teardown: a dispatch thread serving get_owned_value
+        # raced worker.shutdown's store close). _active counts C calls,
+        # _pins counts outstanding PinnedBuffers.
+        self._guard = threading.Condition()
+        self._active = 0
+        self._pins = 0
+        self._closing = False
+
+    def _enter(self):
+        with self._guard:
+            if self._closing or not self._h:
+                raise StoreError(-9, "closed")
+            self._active += 1
+            return self._h
+
+    def _exit(self):
+        with self._guard:
+            self._active -= 1
+            if self._active == 0:
+                self._guard.notify_all()
 
     def start_data_server(self, port: int = 0) -> int:
         """Start the native (C++) chunk server over this segment; returns
@@ -169,6 +209,7 @@ class StoreClient:
         if len(object_id) != 16:
             raise ValueError(f"object id must be 16 bytes, got {len(object_id)}")
 
+    @_guarded
     def put(self, object_id: bytes, data) -> bool:
         """Store `data` (bytes-like). Returns False if the object already
         exists (puts are idempotent — including objects that only exist
@@ -204,6 +245,7 @@ class StoreClient:
             raise
         return True
 
+    @_guarded
     def create(self, object_id: bytes, size: int):
         """Reserve a writable buffer; caller fills it then calls seal().
         Returns a ctypes array or None if the object exists."""
@@ -217,11 +259,13 @@ class StoreClient:
             raise StoreError(rc, "create")
         return (ctypes.c_ubyte * size).from_address(ptr.value)
 
+    @_guarded
     def seal(self, object_id: bytes):
         rc = self._libref.store_seal(self._h, object_id)
         if rc != 0:
             raise StoreError(rc, "seal")
 
+    @_guarded
     def get(self, object_id: bytes) -> PinnedBuffer | None:
         """Pin + return a sealed object, restoring from spill if needed.
 
@@ -253,8 +297,11 @@ class StoreClient:
                 raise StoreError(rc, "get")
         elif rc != 0:
             raise StoreError(rc, "get")
+        with self._guard:
+            self._pins += 1   # close() waits for pins: the buffer's view
         return PinnedBuffer(self, object_id, ptr.value, size.value)
 
+    @_guarded
     def contains(self, object_id: bytes) -> bool:
         self._check_id(object_id)
         rc = self._libref.store_contains(self._h, object_id)
@@ -264,6 +311,7 @@ class StoreClient:
             return self._spilled_path_if_exists(object_id) is not None
         raise StoreError(rc, "contains")
 
+    @_guarded
     def delete(self, object_id: bytes):
         self._check_id(object_id)
         self._libref.store_delete(self._h, object_id)  # best-effort
@@ -274,6 +322,7 @@ class StoreClient:
             except OSError:
                 pass
 
+    @_guarded
     def stats(self) -> dict:
         out = (ctypes.c_uint64 * 4)()
         rc = self._libref.store_stats(self._h, ctypes.byref(out))
@@ -287,8 +336,17 @@ class StoreClient:
         }
 
     def _release(self, object_id: bytes):
-        if self._h:  # no-op once the client is closed
+        with self._guard:
+            self._pins = max(0, self._pins - 1)
+            if self._pins == 0:
+                self._guard.notify_all()
+            if self._closing or not self._h:
+                return   # unpin bookkeeping only; segment may be gone
+            self._active += 1
+        try:
             self._libref.store_release(self._h, object_id)
+        finally:
+            self._exit()
 
     # -- spilling -----------------------------------------------------------
 
@@ -329,19 +387,35 @@ class StoreClient:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def close(self):
-        if self._h:
-            # serving threads must be gone BEFORE the segment is unmapped;
-            # if any are wedged, deliberately LEAK the mapping (a leaked
-            # segment beats a use-after-free crash)
-            if not self.stop_data_server():
-                self._h = None
+    def close(self, drain_timeout_s: float = 1.0):
+        """Unmap the segment once every in-flight C call and pinned buffer
+        is gone. If they don't drain within the timeout (wedged dispatch
+        thread, leaked pin), deliberately LEAK the mapping — a few MB of
+        leaked shm beats a use-after-munmap segfault in whatever thread
+        was still reading (seen: cluster teardown racing a borrower
+        fetch)."""
+        with self._guard:
+            if self._closing or not self._h:
                 return
-            if self._owner:
-                self._libref.store_destroy(self._h)
-            else:
-                self._libref.store_disconnect(self._h)
+            self._closing = True
+        # serving threads (C data server) must also be gone first
+        if not self.stop_data_server():
             self._h = None
+            return
+        with self._guard:
+            deadline = time.monotonic() + drain_timeout_s
+            while self._active > 0 or self._pins > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._guard.wait(remaining)
+            leak = self._active > 0 or self._pins > 0
+            h, self._h = self._h, None
+        if h and not leak:
+            if self._owner:
+                self._libref.store_destroy(h)
+            else:
+                self._libref.store_disconnect(h)
 
     def __del__(self):
         try:

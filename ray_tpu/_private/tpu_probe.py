@@ -8,6 +8,7 @@ retry-with-backoff policy layered on top).
 """
 from __future__ import annotations
 
+import json
 import subprocess
 import sys
 
@@ -21,3 +22,53 @@ def tpu_reachable_once(timeout_s: float = 120.0) -> bool:
         return probe.returncode == 0
     except (subprocess.TimeoutExpired, OSError):
         return False
+
+
+_CHIP_PROBE_SRC = """
+import json, jax
+chips = [d for d in jax.devices() if d.platform == "tpu"]
+info = {}
+if chips:
+    info["chips"] = len(chips)
+    coords = [list(getattr(d, "coords", ()) or ()) for d in chips]
+    if any(coords):
+        info["coords"] = coords
+    si = getattr(chips[0], "slice_index", None)
+    if si is not None:
+        info["slice_id"] = f"slice-{si}"
+print(json.dumps(info))
+"""
+
+
+_chip_probe_cache: list = []   # [] = never probed; [result] = cached
+
+
+def probe_chips(timeout_s: float = 60.0) -> dict | None:
+    """Chip count / coords / slice id via a SUBPROCESS jax.devices() call
+    (same hang rationale as above — the raylet must never block its own
+    init on the tunnel). None = no chips or probe failed/timed out.
+    Memoized per process: detect_resources and detect_tpu_topology both
+    call this during raylet init, and a wedged tunnel should cost one
+    timeout, not two."""
+    if _chip_probe_cache:
+        return _chip_probe_cache[0]
+    result = _probe_chips_once(timeout_s)
+    _chip_probe_cache.append(result)
+    return result
+
+
+def _probe_chips_once(timeout_s: float) -> dict | None:
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", _CHIP_PROBE_SRC],
+            timeout=timeout_s, capture_output=True, text=True)
+        if probe.returncode != 0:
+            return None
+        info = json.loads(probe.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, OSError, ValueError, IndexError):
+        return None
+    if not info.get("chips"):
+        return None
+    if "coords" in info:
+        info["coords"] = [tuple(c) for c in info["coords"]]
+    return info

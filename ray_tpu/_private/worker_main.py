@@ -55,6 +55,8 @@ def main():
         while True:
             time.sleep(0.5)
             if worker.raylet.closed:
+                print("[worker] raylet connection closed; exiting",
+                      file=sys.stderr, flush=True)
                 os._exit(1)
 
     threading.Thread(target=_watchdog, daemon=True,

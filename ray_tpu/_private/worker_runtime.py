@@ -13,6 +13,7 @@ pushed tasks).
 from __future__ import annotations
 
 import collections
+import functools
 import hashlib
 import os
 import queue
@@ -36,6 +37,19 @@ INLINE_RESULT_LIMIT = 100 * 1024
 # (reference pipelines to leased workers in OnWorkerIdle,
 # direct_task_transport.cc:174).
 PIPELINE_DEPTH = 2
+
+
+def _lease_soft_cap(worker=None) -> int:
+    """Soft bound on leases per scheduling key. Scales with CLUSTER CPU
+    capacity (reference: per-node worker_pool soft limits sum to cluster
+    capacity), not this process's core count — a laptop driver submitting
+    to a 100-core cluster must not throttle it. Cached with a TTL on the
+    worker; env RAY_TPU_LEASE_SOFT_CAP (read live) overrides."""
+    env = os.environ.get("RAY_TPU_LEASE_SOFT_CAP")
+    if env:
+        return int(env)
+    cluster = worker._cluster_cpu_total() if worker is not None else 0
+    return max(4, 2 * (os.cpu_count() or 1), int(2 * cluster))
 
 
 class _PendingValue:
@@ -192,7 +206,14 @@ class _SchedulingKeyQueue:
                     continue
                 lw = self._pick_worker()
                 if lw is not None:
+                    self._last_dispatch = time.monotonic()
                     dispatched = self._push(lw, spec)
+                    continue
+                if not self._may_grow():
+                    # at the soft lease cap with live dispatches — wait for
+                    # an in-flight slot instead of growing the fleet
+                    self._wakeup.wait(timeout=0.05)
+                    self._wakeup.clear()
                     continue
                 err = self._maybe_request_lease()
                 if err is not None:
@@ -210,10 +231,17 @@ class _SchedulingKeyQueue:
                 self._wakeup.clear()
 
     def _pick_worker(self):
-        # Depth-1 unless there's real backlog: with a short queue, distinct
-        # leases maximize cluster parallelism; with a long queue, pipelining
-        # depth 2 hides push RTT (execution on the worker is serial either
-        # way — a lease represents ONE task's worth of resources).
+        # Depth-1 unless there's real QUEUE pressure: with a short queue,
+        # distinct leases maximize cluster parallelism; with a long queue,
+        # pipelining depth 2 hides push RTT (execution on the worker is
+        # serial either way — a lease represents ONE task's worth of
+        # resources). Deliberately NOT counting in-flight work as
+        # pressure: queue depth signals the caller is out-running
+        # dispatch (pipelining helps), while in-flight-only signals work
+        # that may be BLOCKED — stacking a task behind a blocked one on a
+        # serial worker deadlocks rendezvous patterns (4 tasks gating on
+        # each other inside an actor, test_runtime_fixes). The fleet
+        # ratchet this used to cause is bounded by _may_grow instead.
         depth = PIPELINE_DEPTH if self.tasks.qsize() > 2 else 1
         with self._lock:
             alive = [lw for lw in self.leased if not lw.dead]
@@ -224,6 +252,21 @@ class _SchedulingKeyQueue:
                 lw.in_flight += 1
                 return lw
             return None
+
+    def _may_grow(self) -> bool:
+        """Soft cap on leases per scheduling key: beyond it, prefer waiting
+        for an in-flight slot over spawning another worker — one worker
+        process per queued zero-cpu task thrashes small hosts (observed:
+        18 workers on 1 core). The cap is SOFT for liveness: if nothing
+        has dispatched for a second (e.g. every leased worker is blocked
+        inside a nested `get`), growth resumes — the reference keeps the
+        same escape via worker-pool soft limits + blocked-on-get CPU
+        release (worker_pool.h num_workers_soft_limit)."""
+        with self._lock:
+            n = len(self.leased)
+        if n < _lease_soft_cap(self.worker):
+            return True
+        return time.monotonic() - getattr(self, "_last_dispatch", 0.0) > 1.0
 
     def _maybe_request_lease(self):
         """Kick off an async lease request if none is in flight. Returns a
@@ -276,27 +319,41 @@ class _SchedulingKeyQueue:
             self._wakeup.set()
 
     def _push(self, lw: _LeasedWorker, spec: dict) -> bool:
-        fut = None
         try:
             fut = lw.client.call_async("push_task", spec=self.worker._strip_spec(spec))
         except ConnectionLost:
-            self._on_worker_death(lw, spec)
+            # The task never left this process — the lease was stale (its
+            # worker died with a removed node). Requeue WITHOUT charging
+            # retries_left: the retry budget is for attempts that may have
+            # executed (side effects), not for dispatch failures. Charging
+            # here made a task bounce across N stale leases after a node
+            # death and exhaust its budget without ever running (chaos
+            # suite). Reference: lease invalidation re-requests, it does
+            # not count as a task attempt.
+            with self._lock:
+                lw.dead = True
+                lw.in_flight -= 1
+            self.submit(spec)
             return True
-        threading.Thread(target=self._await_reply,
-                         args=(lw, spec, fut), daemon=True).start()
+        # Reply lands as a callback on the client's reader/pump thread —
+        # no parked thread per in-flight task (the reference's reply path
+        # is a ClientCallManager completion-queue callback the same way).
+        # _handle_task_reply/_task_done are non-blocking; the death path
+        # may make short RPCs on OTHER connections, which is safe there.
+        fut.add_done_callback(lambda value: self._on_reply(lw, spec, value))
         return True
 
-    def _await_reply(self, lw: _LeasedWorker, spec: dict, fut):
-        try:
-            reply = fut.result(timeout=None)
-        except (ConnectionLost, Exception) as e:  # noqa: BLE001
-            if isinstance(e, ConnectionLost):
+    def _on_reply(self, lw: _LeasedWorker, spec: dict, value):
+        from ray_tpu._private.protocol import _RemoteError
+
+        if isinstance(value, _RemoteError):
+            if isinstance(value.exc, ConnectionLost):
                 self._on_worker_death(lw, spec)
             else:
-                self.worker._fail_task(spec, e)
+                self.worker._fail_task(spec, value.exc)
                 self._task_done(lw)
             return
-        self.worker._handle_task_reply(spec, reply, lw.node_id)
+        self.worker._handle_task_reply(spec, value, lw.node_id)
         self._task_done(lw)
 
     def _task_done(self, lw: _LeasedWorker):
@@ -316,9 +373,8 @@ class _SchedulingKeyQueue:
             spec["retries_left"] = retries - 1
             self.submit(spec)
         else:
-            self.worker._fail_task(
-                spec, exc.WorkerCrashedError(
-                    f"worker {lw.worker_id} died executing task"))
+            self.worker._fail_task(spec, self.worker._worker_death_error(
+                lw.worker_id))
 
     def _maybe_return_leases(self):
         """Return idle leases so the raylet can free resources."""
@@ -446,36 +502,42 @@ class _ActorQueue:
                         f"actor {self.actor_id.hex()} unavailable"))
                     return
                 continue
-            threading.Thread(target=self._await_reply,
-                             args=(spec, fut), daemon=True).start()
+            # reply runs as a reader/pump-thread callback (no parked thread
+            # per in-flight call); the rare failure paths hop to fresh
+            # threads because they block (GCS lookup, resubmit)
+            fut.add_done_callback(lambda value: self._on_reply(spec, value))
             return
 
-    def _await_reply(self, spec, fut):
-        try:
-            reply = fut.result(timeout=None)
-        except ConnectionLost:
-            self._on_connection_lost()
-            retries = spec.get("retries_left", 0)
-            if retries > 0:
-                spec["retries_left"] = retries - 1
-                spec.pop("seq", None)   # re-sequenced in the new epoch
-                threading.Thread(target=self.submit, args=(spec,),
-                                 daemon=True).start()
+    def _on_reply(self, spec, value):
+        from ray_tpu._private.protocol import _RemoteError
+
+        if isinstance(value, _RemoteError):
+            if isinstance(value.exc, ConnectionLost):
+                self._on_connection_lost()
+                retries = spec.get("retries_left", 0)
+                if retries > 0:
+                    spec["retries_left"] = retries - 1
+                    spec.pop("seq", None)   # re-sequenced in the new epoch
+                    threading.Thread(target=self.submit, args=(spec,),
+                                     daemon=True).start()
+                else:
+                    threading.Thread(target=self._fail_dead, args=(spec,),
+                                     daemon=True).start()
             else:
-                # Distinguish died vs restarting for the error type.
-                try:
-                    info = self.worker.gcs.call("get_actor",
-                                                actor_id=self.actor_id)
-                except ConnectionLost:
-                    info = None
-                reason = (info or {}).get("death_cause") or "connection lost"
-                self.worker._fail_task(
-                    spec, exc.ActorDiedError(self.actor_id.hex(), reason))
+                self.worker._fail_task(spec, value.exc)
             return
-        except Exception as e:  # noqa: BLE001
-            self.worker._fail_task(spec, e)
-            return
-        self.worker._handle_task_reply(spec, reply, None)
+        self.worker._handle_task_reply(spec, value, None)
+
+    def _fail_dead(self, spec):
+        # Distinguish died vs restarting for the error type.
+        try:
+            info = self.worker.gcs.call("get_actor",
+                                        actor_id=self.actor_id)
+        except ConnectionLost:
+            info = None
+        reason = (info or {}).get("death_cause") or "connection lost"
+        self.worker._fail_task(
+            spec, exc.ActorDiedError(self.actor_id.hex(), reason))
 
 
 # sentinel: a pooled data-plane socket died mid-request — retry once fresh
@@ -576,6 +638,42 @@ class CoreWorker:
 
     def _strip_spec(self, spec: dict) -> dict:
         return {k: v for k, v in spec.items() if not k.startswith("_")}
+
+    def _cluster_cpu_total(self) -> float:
+        """Sum of CPU across alive nodes, cached for 10 s (feeds the
+        per-key lease soft cap — growth decisions tolerate staleness)."""
+        now = time.monotonic()
+        cached = getattr(self, "_cluster_cpu_cache", None)
+        if cached is not None and now - cached[0] < 10.0:
+            return cached[1]
+        total = 0.0
+        try:
+            for n in self.gcs.call("get_nodes", timeout=5.0):
+                if n.get("Alive"):
+                    total += float(n.get("Resources", {}).get("CPU", 0))
+        except Exception:
+            if cached is not None:
+                return cached[1]
+        self._cluster_cpu_cache = (now, total)
+        return total
+
+    def _worker_death_error(self, worker_id: str):
+        """Error for a task whose executing worker died. The raylet records
+        OOM kills in GCS KV *before* delivering SIGKILL (raylet.py
+        _on_memory_pressure), so by the time the owner observes the dropped
+        connection the verdict is already readable — an OOM death surfaces
+        as a retriable OutOfMemoryError naming the culprit, anything else
+        as WorkerCrashedError."""
+        try:
+            blob = self.gcs.call("kv_get", ns="oom_kill",
+                                 key=worker_id.encode(), timeout=5.0)
+        except Exception:
+            blob = None
+        if blob:
+            return exc.OutOfMemoryError(
+                blob.decode() if isinstance(blob, bytes) else str(blob))
+        return exc.WorkerCrashedError(
+            f"worker {worker_id} died executing task")
 
     # ---------------------------------------------------------------- put/get
 
@@ -1534,18 +1632,55 @@ class CoreWorker:
             t.start()
             self._exec_threads.append(t)
 
-    def rpc_push_task(self, conn, spec: dict):
-        """Executed on the receiving worker. Blocking handler: the reply is
-        sent when the task finishes (the submitter pipelines via concurrent
-        RPCs, so blocking here is fine and gives natural backpressure)."""
+    # Hot-path dispatch policy for this process's RpcServer: push_task is
+    # handled INLINE on the transport's reader/pump thread (it never
+    # blocks — see rpc_push_task) and replies are DEFERRED (sent by
+    # whichever thread finishes the task), so a task in flight parks no
+    # dispatch thread. This is the split the reference gets from its C++
+    # core worker: compiled transport + completion callbacks,
+    # interpreter only for execution (core_worker.cc:2188).
+    # ping is inline for LIVENESS, not speed: raylets probe lessees with
+    # a short deadline (_gc_remote_lessee_leases), and a ping that must
+    # win a GIL slot for a fresh dispatch thread under load can miss it —
+    # the raylet then "reclaims" a live driver's leases, killing its
+    # workers mid-task (observed as WorkerCrashedError storms in the
+    # chaos suite).
+    INLINE_RPC = frozenset({"push_task", "ping", "task_state"})
+    DEFERRED_RPC = frozenset({"push_task"})
+
+    def rpc_push_task(self, conn, seq, spec: dict):
+        """Runs inline on the transport pump — MUST NOT block. Normal
+        tasks enqueue straight to the main-thread task loop (reference:
+        core_worker.cc:2188 RunTaskExecutionLoop is the worker main
+        thread; thread-hostile native libraries — pyarrow submodule
+        imports — make main-thread execution load-bearing, see CI
+        segfault note in serve_task_loop's history). Actor tasks and the
+        rare pre-ready window hop to a thread because they gate on seq
+        order / concurrency slots / startup events."""
+        from ray_tpu._private.protocol import NO_REPLY
+
+        if (spec.get("actor_id") is None and self._ready.is_set()
+                and self._main_loop_running):
+            self._main_jobs.put(
+                (spec, lambda result: conn.reply(seq, result)))
+            return NO_REPLY
+        threading.Thread(target=self._push_task_thread,
+                         args=(conn, seq, spec), daemon=True).start()
+        return NO_REPLY
+
+    def _push_task_thread(self, conn, seq, spec: dict):
+        from ray_tpu._private.protocol import _RemoteError
+
+        try:
+            result = self._push_task_blocking(conn, spec)
+        except BaseException as e:  # noqa: BLE001 — ship errors back
+            result = _RemoteError(e)
+        conn.reply(seq, result)
+
+    def _push_task_blocking(self, conn, spec: dict):
         self._ready.wait(30.0)
         if spec.get("actor_id") is not None and self.actor_id is not None:
             return self._execute_actor_task(spec, conn)
-        # Normal tasks run on the worker's MAIN thread when it serves the
-        # task loop (reference: core_worker.cc:2188 RunTaskExecutionLoop is
-        # the worker main thread). Thread-hostile native libraries make
-        # this load-bearing: e.g. pyarrow submodule imports from transient
-        # dispatch threads segfault intermittently (observed in CI).
         if self.mode == "worker":
             # a lease can arrive between __init__ registering us and
             # worker_main entering the loop — wait out that window so the
@@ -1556,13 +1691,15 @@ class CoreWorker:
             from ray_tpu._private.protocol import _Future
 
             fut = _Future()
-            self._main_jobs.put((spec, fut))
+            self._main_jobs.put((spec, fut.set))
             return fut.result(timeout=None)
         return self._execute_normal_task(spec)
 
     def serve_task_loop(self):
         """Run normal-task execution on the calling thread (the worker
-        process's main thread). Returns when the raylet connection dies."""
+        process's main thread). Each job is (spec, done) where done
+        delivers the result — directly to the requester's connection for
+        inline-dispatched tasks. Returns when the raylet connection dies."""
         import queue as _q
 
         self._main_loop_running = True
@@ -1570,17 +1707,17 @@ class CoreWorker:
         try:
             while not self.stopped:
                 try:
-                    spec, fut = self._main_jobs.get(timeout=0.5)
+                    spec, done = self._main_jobs.get(timeout=0.5)
                 except _q.Empty:
                     if self.raylet.closed:
                         return
                     continue
                 try:
-                    fut.set(self._execute_normal_task(spec))
+                    done(self._execute_normal_task(spec))
                 except BaseException as e:  # noqa: BLE001 — never wedge
                     from ray_tpu._private.protocol import _RemoteError
 
-                    fut.set(_RemoteError(e))
+                    done(_RemoteError(e))
         finally:
             self._main_loop_running = False
 
@@ -1602,6 +1739,7 @@ class CoreWorker:
                 return {"cancelled": True}
             self._current_task_id = task_id
             self._current_task_thread = threading.get_ident()
+            self._current_task_started = time.time()   # OOM victim ranking
             from ray_tpu._private.profiling import record_span
 
             try:
@@ -1616,6 +1754,15 @@ class CoreWorker:
             finally:
                 self._current_task_id = None
                 self._current_task_thread = None
+                self._current_task_started = None
+
+    def rpc_task_state(self, conn):
+        """Non-blocking probe of what this worker is running (inline —
+        the raylet's OOM victim ranking queries it under memory
+        pressure; the lease grant time it would otherwise use is the age
+        of the LEASE, not of the current task)."""
+        return {"task_started_at": getattr(self, "_current_task_started",
+                                           None)}
 
     def _execute_actor_task(self, spec: dict, conn=None) -> dict:
         # Per-caller ordering: DISPATCH tasks in seq order for each caller

@@ -1,0 +1,208 @@
+"""Shape-aware demand binpacking for the autoscaler.
+
+Reference: python/ray/autoscaler/_private/resource_demand_scheduler.py —
+`get_nodes_to_launch` (:103) binpacks queued resource shapes (including
+placement-group bundles with their strategies, :171) onto current node
+headroom, then onto virtual nodes of the configured types, scoring
+candidate types by utilization so the cheapest-fitting type wins.
+
+TPU-native extension: a node type may describe a multi-host TPU slice
+(``"tpu_slice": {"topology": "4x4", "hosts": 4}``) — its `resources` are
+PER-HOST and the slice is created as a unit (QR-style "give me a slice
+of topology X"), so plans count slice types in slice units and the
+provider's ``create_slice`` launches all member hosts atomically. This
+is what the reference's flat `resources: {"TPU": 4}` GCP config
+(autoscaler/gcp/tpu.yaml:29) cannot express.
+
+Pure functions — no cluster dependencies; the StandardAutoscaler feeds
+them GCS load and executes the returned plan.
+"""
+from __future__ import annotations
+
+
+def expand_pg_demand(pending_pgs: list[dict]) -> list[dict]:
+    """Flatten pending placement groups into placeable shapes with
+    placement constraints (reference: resource_demand_scheduler.py:171
+    placement_groups_to_resource_demands):
+
+    - STRICT_PACK: all bundles must land on ONE node -> a single summed
+      shape.
+    - STRICT_SPREAD: each bundle on a DISTINCT node -> shapes sharing an
+      ``anti_affinity`` group id.
+    - PACK / SPREAD: best-effort -> plain shapes.
+
+    Returns [{"shape": {...}, "anti_affinity": str|None}].
+    """
+    out = []
+    for i, pg in enumerate(pending_pgs):
+        strategy = pg.get("strategy", "PACK")
+        bundles = [dict(b) for b in pg.get("bundles", []) if b]
+        if not bundles:
+            continue
+        if strategy == "STRICT_PACK":
+            combined: dict = {}
+            for b in bundles:
+                for k, v in b.items():
+                    combined[k] = combined.get(k, 0) + v
+            out.append({"shape": combined, "anti_affinity": None})
+        elif strategy == "STRICT_SPREAD":
+            gid = pg.get("pg_id", f"pg-{i}")
+            for b in bundles:
+                out.append({"shape": b, "anti_affinity": gid})
+        else:
+            for b in bundles:
+                out.append({"shape": b, "anti_affinity": None})
+    return out
+
+
+def _fits(avail: dict, shape: dict) -> bool:
+    return all(avail.get(k, 0) + 1e-9 >= v for k, v in shape.items())
+
+
+def _take(avail: dict, shape: dict):
+    for k, v in shape.items():
+        avail[k] = avail.get(k, 0) - v
+
+
+def utilization_score(node_resources: dict, shapes: list[dict]):
+    """Score a node type for hosting `shapes` (higher wins). Reference
+    `_utilization_score`: prefer types the demand utilizes tightly, and
+    avoid parking non-TPU work on TPU nodes (the reference's GPU
+    avoidance, scheduler flavor) so accelerator capacity stays free for
+    accelerator demand. Returns None if the type fits none of them."""
+    avail = dict(node_resources)
+    placed = []
+    for entry in sorted(shapes, key=_shape_size, reverse=True):
+        if _fits(avail, entry):
+            _take(avail, entry)
+            placed.append(entry)
+    if not placed:
+        return None
+    wants_tpu = any("TPU" in s for s in placed)
+    if node_resources.get("TPU", 0) > 0 and not wants_tpu:
+        return (0, 0.0, 0.0)   # feasible, but a last resort
+    util = []
+    for k, total in node_resources.items():
+        if total <= 0:
+            continue
+        used = total - avail.get(k, 0)
+        if used > 0:
+            util.append(used / total)
+    score = (len(placed),
+             min(util) if util else 0.0,
+             sum(util) / len(util) if util else 0.0)
+    return score
+
+
+def _shape_size(entry) -> tuple:
+    shape = entry["shape"] if "shape" in entry else entry
+    return (shape.get("TPU", 0), shape.get("CPU", 0),
+            sum(shape.values()))
+
+
+def get_nodes_to_launch(task_shapes: list[dict],
+                        pending_pgs: list[dict],
+                        headroom: list[dict],
+                        node_types: dict[str, dict],
+                        counts_by_type: dict[str, int] | None = None,
+                        max_workers: int = 8):
+    """Plan node launches covering unfulfilled demand.
+
+    Returns (plan, infeasible): plan is {node_type: count} — count in
+    SLICE units for slice types, hosts otherwise; infeasible lists
+    shapes no configured type can ever host (surfaced to the user, as
+    the reference logs them).
+    """
+    counts_by_type = dict(counts_by_type or {})
+    demands = [{"shape": dict(s), "anti_affinity": None}
+               for s in task_shapes if s]
+    demands += expand_pg_demand(pending_pgs)
+    demands.sort(key=_shape_size, reverse=True)
+
+    # 1. absorb into existing headroom (anti-affinity groups need
+    #    distinct nodes, so remember which group used which node)
+    nodes = [{"avail": dict(h), "groups": set()} for h in headroom]
+    unfulfilled = []
+    for entry in demands:
+        placed = False
+        for node in nodes:
+            if (entry["anti_affinity"] is not None
+                    and entry["anti_affinity"] in node["groups"]):
+                continue
+            if _fits(node["avail"], entry["shape"]):
+                _take(node["avail"], entry["shape"])
+                if entry["anti_affinity"] is not None:
+                    node["groups"].add(entry["anti_affinity"])
+                placed = True
+                break
+        if not placed:
+            unfulfilled.append(entry)
+
+    # 2. binpack the rest onto virtual nodes of the best-scoring types
+    plan: dict[str, int] = {}
+    virtual: list[dict] = []   # {"type", "avail", "groups"}
+    infeasible = []
+
+    def _hosts_per_unit(tname):
+        if tname not in node_types:
+            return 1
+        return int((node_types[tname].get("tpu_slice") or {})
+                   .get("hosts", 1))
+
+    # counts/caps are in provider units (slices for slice types); the
+    # global max_workers budget is in HOSTS
+    total_existing = sum(c * _hosts_per_unit(t)
+                         for t, c in counts_by_type.items())
+
+    def _planned_hosts():
+        return sum(c * _hosts_per_unit(t) for t, c in plan.items())
+
+    for entry in unfulfilled:
+        placed = False
+        for node in virtual:
+            if (entry["anti_affinity"] is not None
+                    and entry["anti_affinity"] in node["groups"]):
+                continue
+            if _fits(node["avail"], entry["shape"]):
+                _take(node["avail"], entry["shape"])
+                if entry["anti_affinity"] is not None:
+                    node["groups"].add(entry["anti_affinity"])
+                placed = True
+                break
+        if placed:
+            continue
+        # pick the best feasible type for this shape (score it together
+        # with everything else still unplaced of the same look — cheap
+        # approximation of the reference's per-type utilization pass)
+        best = None
+        for tname, spec in node_types.items():
+            res = spec.get("resources", {})
+            score = utilization_score(res, [entry["shape"]])
+            if score is None:
+                continue
+            cap = spec.get("max_workers", max_workers)
+            planned_units = plan.get(tname, 0)
+            if counts_by_type.get(tname, 0) + planned_units >= cap:
+                continue
+            if (total_existing + _planned_hosts()
+                    + _hosts_per_unit(tname)) > max_workers:
+                continue
+            if best is None or score > best[0]:
+                best = (score, tname)
+        if best is None:
+            infeasible.append(entry["shape"])
+            continue
+        tname = best[1]
+        spec = node_types[tname]
+        plan[tname] = plan.get(tname, 0) + 1
+        # slice units contribute every member host's headroom
+        for _ in range(_hosts_per_unit(tname)):
+            virtual.append({"type": tname,
+                            "avail": dict(spec.get("resources", {})),
+                            "groups": set()})
+        node = next(v for v in reversed(virtual)
+                    if _fits(v["avail"], entry["shape"]))
+        _take(node["avail"], entry["shape"])
+        if entry["anti_affinity"] is not None:
+            node["groups"].add(entry["anti_affinity"])
+    return plan, infeasible

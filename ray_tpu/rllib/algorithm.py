@@ -53,6 +53,9 @@ class AlgorithmConfig:
         self.epsilon_anneal_iters = 15
         self.double_q = True
         self.prioritized_replay = False
+        # IMPALA (async learner) knobs
+        self.learner_queue_size = 8
+        self.learner_min_step_s = 0.0   # test hook: artificial step floor
 
     def environment(self, env):
         self.env_spec = env

@@ -1,0 +1,271 @@
+"""IMPALA — decoupled async sampling / V-trace learner.
+
+Reference: rllib/algorithms/impala/impala.py:549 and
+rllib/execution/multi_gpu_learner_thread.py:20,187 — rollout actors
+sample continuously with whatever (stale) policy they last received,
+batches flow through a bounded host queue into a learner thread that
+double-buffers device transfers, and the off-policy gap is corrected by
+V-trace importance weighting (Espeholt et al. 2018). TPU-native shape:
+the learner is one jitted update (V-trace is a `lax.scan`, so the whole
+step compiles to a single XLA program); the host ring buffer of the
+reference's pinned-memory loader threads becomes a queue.Queue of numpy
+batches with `jax.device_put` prefetch — on TPU the transfer overlaps
+the previous step's compute exactly like the reference's CUDA streams.
+
+Decoupling invariant (what "async" buys): samplers are resubmitted the
+moment their batch is collected, BEFORE the learner consumes it, so a
+slow learner never idles the samplers — the queue absorbs the skew and
+`sampled_while_learning` counts the overlap as proof.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import policy_apply
+from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+
+class VTraceWorker(RolloutWorker):
+    """Sampler returning time-major trajectories for V-trace (behavior
+    log-probs + bootstrap obs instead of GAE postprocessing)."""
+
+    def sample_vtrace(self, params, steps_per_env: int) -> dict:
+        E = len(self.envs)
+        T = steps_per_env
+        obs = np.zeros((T, E, self.obs_size), np.float32)
+        actions = np.zeros((T, E), np.int32)
+        rewards = np.zeros((T, E), np.float32)
+        dones = np.zeros((T, E), np.float32)
+        logps = np.zeros((T, E), np.float32)
+
+        for t in range(T):
+            stacked = np.stack(self._obs)
+            logits, _ = self._fwd(params, stacked)
+            logits = np.asarray(logits)
+            z = self._rng.gumbel(size=logits.shape)
+            act = np.argmax(logits + z, axis=-1)
+            m = logits.max(axis=-1, keepdims=True)
+            logp_all = logits - (
+                m + np.log(np.exp(logits - m).sum(axis=-1, keepdims=True)))
+            obs[t] = stacked
+            actions[t] = act
+            logps[t] = logp_all[np.arange(E), act]
+            for e in range(E):
+                _, r, terminated, truncated = self._env_step(e, act[e])
+                rewards[t, e] = r
+                if terminated or truncated:
+                    dones[t, e] = 1.0
+
+        completed, self._completed = self._completed, []
+        return {
+            "obs": obs, "actions": actions, "rewards": rewards,
+            "dones": dones, "behavior_logp": logps,
+            "bootstrap_obs": np.stack(self._obs).astype(np.float32),
+            "episode_returns": np.asarray(completed, np.float32),
+        }
+
+
+def vtrace_returns(target_logp, behavior_logp, rewards, dones, values,
+                   bootstrap_v, gamma, rho_bar=1.0, c_bar=1.0):
+    """V-trace targets vs_t and policy-gradient advantages (time-major
+    (T, E) arrays). One backward `lax.scan` — compiles into the learner's
+    XLA program rather than a host loop."""
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_bar)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_bar)
+    nonterminal = 1.0 - dones
+    values_tp1 = jnp.concatenate([values[1:], bootstrap_v[None]], axis=0)
+    deltas = rho * (rewards + gamma * nonterminal * values_tp1 - values)
+
+    def backward(carry, xs):
+        delta_t, c_t, nt_t = xs
+        acc = delta_t + gamma * nt_t * c_t * carry
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_v),
+        (deltas, c, nonterminal), reverse=True)
+    vs = vs_minus_v + values
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_v[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * nonterminal * vs_tp1 - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALA(Algorithm):
+    """Async learner: samplers feed a bounded queue; a learner thread
+    consumes it with device-transfer double-buffering."""
+
+    worker_cls = VTraceWorker
+
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        cfg = config
+        self.optimizer = optax.rmsprop(cfg.lr, decay=0.99, eps=1e-5)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, batch):
+            T, E = batch["actions"].shape
+            obs_flat = batch["obs"].reshape(T * E, -1)
+            logits, values = policy_apply(params, obs_flat)
+            logits = logits.reshape(T, E, -1)
+            values = values.reshape(T, E)
+            _, bootstrap_v = policy_apply(params, batch["bootstrap_obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            vs, pg_adv = vtrace_returns(
+                target_logp, batch["behavior_logp"], batch["rewards"],
+                batch["dones"], values, bootstrap_v, cfg.gamma)
+            pi_loss = -jnp.mean(target_logp * pg_adv)
+            vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
+            total = (pi_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._update = jax.jit(update)
+
+        # learner plumbing
+        self._queue: queue.Queue = queue.Queue(
+            maxsize=getattr(cfg, "learner_queue_size", 8))
+        self._params_lock = threading.Lock()
+        self._learner_stop = threading.Event()
+        self._learner_error: BaseException | None = None
+        self._learner_steps = 0
+        self._learner_busy = False
+        self._sampled_while_learning = 0
+        self._last_aux: dict = {}
+        # test/diagnostic hook: artificial per-step learner latency, to
+        # demonstrate samplers keep running while the learner lags
+        self._learner_min_step_s = getattr(cfg, "learner_min_step_s", 0.0)
+        self._learner = threading.Thread(
+            target=self._learner_loop, daemon=True, name="impala-learner")
+        self._learner.start()
+        self._in_flight: dict = {}
+
+    # ------------------------------------------------------------- learner
+    def _learner_loop(self):
+        pending = None   # device-resident next batch (double buffer)
+        try:
+            while not self._learner_stop.is_set():
+                if pending is None:
+                    try:
+                        host = self._queue.get(timeout=0.2)
+                    except queue.Empty:
+                        continue
+                    pending = jax.device_put(host)
+                dev, pending = pending, None
+                try:
+                    # start the NEXT transfer before this update blocks:
+                    # on TPU device_put is async, so H2D rides under the
+                    # current step (the reference's pinned-memory double
+                    # buffer, multi_gpu_learner_thread.py:187)
+                    nxt = self._queue.get_nowait()
+                    pending = jax.device_put(nxt)
+                except queue.Empty:
+                    pass
+                self._learner_busy = True
+                t0 = time.perf_counter()
+                with self._params_lock:
+                    params, opt_state = self.params, self.opt_state
+                params, opt_state, aux = self._update(params, opt_state, dev)
+                aux = {k: float(v) for k, v in aux.items()}
+                with self._params_lock:
+                    self.params, self.opt_state = params, opt_state
+                if self._learner_min_step_s:
+                    spare = self._learner_min_step_s - (
+                        time.perf_counter() - t0)
+                    if spare > 0:
+                        time.sleep(spare)
+                self._learner_busy = False
+                self._last_aux = aux
+                self._learner_steps += 1
+        except BaseException as e:  # noqa: BLE001 — surface in train()
+            self._learner_error = e
+            self._learner_busy = False
+
+    # ------------------------------------------------------------- sampling
+    def _submit(self, worker):
+        with self._params_lock:
+            params = self.params
+        return worker.sample_vtrace.remote(
+            params, self.config.rollout_fragment_length)
+
+    def train(self) -> dict:
+        """One iteration = `num_sgd_steps` learner steps of continuous
+        sampling. Samplers are resubmitted the moment their batch lands
+        in the queue — never gated on the learner."""
+        t0 = time.time()
+        self.iteration += 1
+        target = self._learner_steps + max(1, self.config.num_sgd_steps)
+        if not self._in_flight:
+            self._in_flight = {self._submit(w): w for w in self.workers}
+        samples = 0
+        while self._learner_steps < target:
+            if self._learner_error is not None:
+                raise self._learner_error
+            ready, _ = ray_tpu.wait(list(self._in_flight),
+                                    num_returns=1, timeout=1.0)
+            for ref in ready:
+                worker = self._in_flight.pop(ref)
+                batch = ray_tpu.get(ref)
+                returns = batch.pop("episode_returns")
+                self._recent_returns.extend(returns.tolist())
+                self._recent_returns = self._recent_returns[-100:]
+                # resubmit FIRST: the sampler must never wait on the
+                # learner-side queue put below
+                self._in_flight[self._submit(worker)] = worker
+                samples += 1
+                if self._learner_busy:
+                    self._sampled_while_learning += 1
+                while True:
+                    try:
+                        self._queue.put(batch, timeout=5.0)
+                        break
+                    except queue.Full:
+                        if self._learner_error is not None:
+                            raise self._learner_error
+        metrics = dict(self._last_aux)
+        metrics.update({
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else 0.0),
+            "learner_steps": self._learner_steps,
+            "sample_batches_this_iter": samples,
+            "sampled_while_learning": self._sampled_while_learning,
+            "learner_queue_size": self._queue.qsize(),
+            "time_this_iter_s": time.time() - t0,
+        })
+        return metrics
+
+    def training_step(self, batch) -> dict:  # pragma: no cover — unused
+        raise NotImplementedError("IMPALA trains via its learner thread")
+
+    def save(self) -> dict:
+        with self._params_lock:
+            return {"params": self.params, "iteration": self.iteration}
+
+    def stop(self):
+        self._learner_stop.set()
+        self._learner.join(timeout=10.0)
+        super().stop()

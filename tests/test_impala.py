@@ -1,0 +1,89 @@
+"""IMPALA async-learner tests (reference tier: rllib/algorithms/impala
+tuned_examples smoke + multi_gpu_learner_thread decoupling).
+
+Convergence bar mirrors tests/test_rllib.py's PPO bar; the decoupling
+test slows the learner artificially and asserts sampling continues
+while it is busy (the whole point of the IMPALA architecture).
+"""
+import numpy as np
+import pytest
+
+
+def test_vtrace_matches_monte_carlo_on_policy():
+    """With rho=c=1 and on-policy logps, vs_t must equal the discounted
+    n-step return bootstrapped at the horizon (V-trace reduces to the
+    on-policy Bellman evaluation)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.impala import vtrace_returns
+
+    T, E = 5, 1
+    rng = np.random.default_rng(0)
+    rewards = jnp.asarray(rng.normal(size=(T, E)).astype(np.float32))
+    values = jnp.asarray(rng.normal(size=(T, E)).astype(np.float32))
+    bootstrap = jnp.asarray(rng.normal(size=(E,)).astype(np.float32))
+    dones = jnp.zeros((T, E), jnp.float32)
+    logp = jnp.zeros((T, E), jnp.float32)      # target == behavior
+    gamma = 0.9
+    vs, _ = vtrace_returns(logp, logp, rewards, dones, values,
+                           bootstrap, gamma)
+    # reference recursion computed in plain numpy
+    expect = np.zeros((T, E), np.float32)
+    nxt = np.asarray(bootstrap)
+    for t in reversed(range(T)):
+        expect[t] = np.asarray(rewards)[t] + gamma * nxt
+        nxt = expect[t]
+    assert np.allclose(np.asarray(vs), expect, atol=1e-5)
+
+
+def test_impala_converges_cartpole(ray_start_regular):
+    from ray_tpu.rllib import AlgorithmConfig
+    from ray_tpu.rllib.impala import IMPALA
+
+    algo = (AlgorithmConfig(IMPALA)
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_fragment_length=64)
+            .training(lr=3e-3, num_sgd_steps=8, entropy_coeff=0.01)
+            .build())
+    try:
+        best = 0.0
+        for _ in range(12):
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+            if best >= 60.0:
+                break
+        assert best >= 60.0, f"IMPALA failed to learn: best={best}"
+    finally:
+        algo.stop()
+
+
+def test_impala_samplers_not_blocked_on_learner(ray_start_regular):
+    """Slow the learner to 0.3 s/step; sampling must continue while it is
+    busy (queue decoupling — multi_gpu_learner_thread.py pattern)."""
+    from ray_tpu.rllib import AlgorithmConfig
+    from ray_tpu.rllib.impala import IMPALA
+
+    algo = (AlgorithmConfig(IMPALA)
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=1,
+                      rollout_fragment_length=16)
+            .training(num_sgd_steps=6, learner_min_step_s=0.3)
+            .build())
+    try:
+        result = algo.train()
+        assert result["learner_steps"] >= 6
+        # with a 0.3s learner floor and ~ms-scale sampling, most batches
+        # must arrive while the learner is mid-step
+        assert result["sampled_while_learning"] >= 2, result
+        # and the samplers outpace the learner (decoupled, not lockstep)
+        assert result["sample_batches_this_iter"] >= \
+            result["learner_steps"] - algo.config.learner_queue_size
+    finally:
+        algo.stop()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -1,0 +1,121 @@
+"""Worker-log streaming tests (reference: _private/log_monitor.py tail →
+pubsub → worker.py:1733 print_worker_logs on the driver)."""
+import os
+import time
+
+import pytest
+
+
+def test_collapse_repeats_dedup():
+    from ray_tpu._private.log_monitor import _collapse_repeats
+
+    assert _collapse_repeats([]) == []
+    assert _collapse_repeats(["a", "b"]) == ["a", "b"]
+    assert _collapse_repeats(["x"] * 50) == ["x [repeated 50 times]"]
+    assert _collapse_repeats(["a", "a", "b", "a"]) == [
+        "a [repeated 2 times]", "b", "a"]
+
+
+def test_log_monitor_tails_batches_and_drains(tmp_path):
+    from ray_tpu._private.log_monitor import LogMonitor
+
+    out_path = tmp_path / "w1.out"
+    err_path = tmp_path / "w1.err"
+    out_path.write_text("")
+    err_path.write_text("")
+    batches = []
+    mon = LogMonitor(lambda ch, msg: batches.append((ch, msg)),
+                     node_id="node0123abcd")
+    mon.track("w1", 4242, str(out_path), str(err_path))
+
+    with open(out_path, "a") as f:
+        f.write("first line\npartial")
+    mon.tick()
+    assert len(batches) == 1
+    ch, msg = batches[0]
+    assert ch == "worker_logs"
+    assert msg["lines"] == ["first line"]      # partial line held back
+    assert msg["pid"] == 4242 and msg["stream"] == "out"
+
+    with open(out_path, "a") as f:
+        f.write(" continued\nsecond\n")
+    mon.tick()
+    assert batches[-1][1]["lines"] == ["partial continued", "second"]
+
+    # stderr goes out with stream="err"
+    with open(err_path, "a") as f:
+        f.write("oops\n")
+    mon.tick()
+    errs = [m for _, m in batches if m["stream"] == "err"]
+    assert errs and errs[-1]["lines"] == ["oops"]
+
+    # death: the unterminated tail is flushed, then the tail is dropped
+    with open(out_path, "a") as f:
+        f.write("last words")
+    mon.mark_dead("w1")
+    mon.tick()
+    assert batches[-1][1]["lines"] == ["last words"]
+    mon.tick()          # empty drain removes the tails
+    n = len(batches)
+    with open(out_path, "a") as f:
+        f.write("ghost\n")
+    mon.tick()
+    assert len(batches) == n    # untracked after death
+
+
+def test_format_log_batch_prefixes():
+    from ray_tpu._private.log_monitor import format_log_batch
+
+    lines = format_log_batch({
+        "node_id": "deadbeefcafe0123", "worker_id": "w", "pid": 7,
+        "actor_name": None, "stream": "out", "lines": ["hi", "there"]})
+    assert lines == ["(pid=7, node=deadbeef) hi",
+                     "(pid=7, node=deadbeef) there"]
+    named = format_log_batch({
+        "node_id": "deadbeefcafe0123", "worker_id": "w", "pid": 7,
+        "actor_name": "Counter", "stream": "err", "lines": ["x"]})
+    assert named == ["(Counter pid=7, node=deadbeef) x"]
+
+
+def test_remote_print_streams_to_driver(capfd):
+    """End to end: a remote print lands on the driver's console with the
+    (pid=, node=) prefix."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def shout():
+            print("hello-from-worker-xyz")
+            import sys
+
+            print("err-from-worker-xyz", file=sys.stderr)
+            return 1
+
+        assert ray_tpu.get(shout.remote(), timeout=60) == 1
+        acc_out, acc_err = "", ""
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            out, err = capfd.readouterr()
+            acc_out += out
+            acc_err += err
+            if ("hello-from-worker-xyz" in acc_out
+                    and "err-from-worker-xyz" in acc_err):
+                break
+            time.sleep(0.2)
+        assert "hello-from-worker-xyz" in acc_out, acc_out[-2000:]
+        out_line = next(ln for ln in acc_out.splitlines()
+                        if "hello-from-worker-xyz" in ln)
+        assert out_line.startswith("(pid="), out_line
+        assert "err-from-worker-xyz" in acc_err, acc_err[-2000:]
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v", "-x"]))

@@ -1,0 +1,124 @@
+"""Autoscaler demand-binpacking tests (reference:
+resource_demand_scheduler.py get_nodes_to_launch + the PG bundle
+expansion at :171)."""
+import pytest
+
+from ray_tpu.autoscaler.resource_demand import (
+    expand_pg_demand,
+    get_nodes_to_launch,
+    utilization_score,
+)
+
+
+CPU4 = {"resources": {"CPU": 4}}
+TPU_HOST = {"resources": {"CPU": 8, "TPU": 4}}
+
+
+def test_expand_strict_pack_sums_bundles():
+    shapes = expand_pg_demand([{
+        "strategy": "STRICT_PACK",
+        "bundles": [{"CPU": 2}, {"CPU": 2, "TPU": 1}],
+    }])
+    assert shapes == [{"shape": {"CPU": 4, "TPU": 1},
+                       "anti_affinity": None}]
+
+
+def test_expand_strict_spread_tags_anti_affinity():
+    shapes = expand_pg_demand([{
+        "strategy": "STRICT_SPREAD", "pg_id": "g1",
+        "bundles": [{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+    }])
+    assert len(shapes) == 3
+    assert all(s["anti_affinity"] == "g1" for s in shapes)
+
+
+def test_headroom_absorbs_before_launch():
+    plan, infeasible = get_nodes_to_launch(
+        [{"CPU": 2}, {"CPU": 2}], [], headroom=[{"CPU": 4}],
+        node_types={"cpu4": CPU4})
+    assert plan == {} and infeasible == []
+
+
+def test_binpacks_remaining_shapes_min_nodes():
+    # 6 one-CPU shapes, 2 absorbed by headroom, 4 need exactly one cpu4
+    plan, infeasible = get_nodes_to_launch(
+        [{"CPU": 1}] * 6, [], headroom=[{"CPU": 2}],
+        node_types={"cpu4": CPU4})
+    assert plan == {"cpu4": 1} and infeasible == []
+
+
+def test_strict_spread_needs_distinct_nodes():
+    pg = {"strategy": "STRICT_SPREAD", "pg_id": "g",
+          "bundles": [{"CPU": 1}] * 3}
+    # one existing empty node can host ONE bundle; two more nodes needed
+    plan, infeasible = get_nodes_to_launch(
+        [], [pg], headroom=[{"CPU": 4}], node_types={"cpu4": CPU4})
+    assert plan == {"cpu4": 2} and infeasible == []
+
+
+def test_strict_pack_launches_one_covering_node():
+    pg = {"strategy": "STRICT_PACK",
+          "bundles": [{"CPU": 2, "TPU": 1}, {"CPU": 2, "TPU": 2}]}
+    plan, infeasible = get_nodes_to_launch(
+        [], [pg], headroom=[{"CPU": 4}],            # no TPU headroom
+        node_types={"cpu4": CPU4, "tpu_host": TPU_HOST})
+    assert plan == {"tpu_host": 1} and infeasible == []
+
+
+def test_cpu_demand_avoids_tpu_nodes():
+    plan, _ = get_nodes_to_launch(
+        [{"CPU": 4}], [], headroom=[],
+        node_types={"tpu_host": TPU_HOST, "cpu4": CPU4})
+    assert plan == {"cpu4": 1}
+    # but a TPU node is still used when it is the only feasible type
+    plan, _ = get_nodes_to_launch(
+        [{"CPU": 8}], [], headroom=[],
+        node_types={"tpu_host": TPU_HOST, "cpu4": CPU4})
+    assert plan == {"tpu_host": 1}
+
+
+def test_infeasible_shape_reported_not_planned():
+    plan, infeasible = get_nodes_to_launch(
+        [{"CPU": 64}], [], headroom=[], node_types={"cpu4": CPU4})
+    assert plan == {} and infeasible == [{"CPU": 64}]
+
+
+def test_max_workers_and_per_type_caps():
+    plan, infeasible = get_nodes_to_launch(
+        [{"CPU": 4}] * 5, [], headroom=[],
+        node_types={"cpu4": dict(CPU4, max_workers=2)},
+        counts_by_type={"cpu4": 1}, max_workers=8)
+    # per-type cap 2 with 1 existing -> only 1 more node, which absorbs
+    # exactly one CPU:4 shape; the rest are unservable under the caps
+    assert plan == {"cpu4": 1}
+    assert len(infeasible) == 4
+
+
+def test_tpu_slice_launched_as_unit():
+    slice_type = {"resources": {"CPU": 8, "TPU": 4},
+                  "tpu_slice": {"topology": "2x4", "hosts": 2}}
+    pg = {"strategy": "STRICT_SPREAD", "pg_id": "ring",
+          "bundles": [{"TPU": 4}, {"TPU": 4}]}
+    plan, infeasible = get_nodes_to_launch(
+        [], [pg], headroom=[], node_types={"v5e_2x4": slice_type})
+    # ONE slice unit covers both anti-affinity bundles (2 hosts)
+    assert plan == {"v5e_2x4": 1} and infeasible == []
+    # max_workers counts HOSTS: a 2-host slice cannot launch if only
+    # one host slot remains
+    plan, infeasible = get_nodes_to_launch(
+        [], [pg], headroom=[], node_types={"v5e_2x4": slice_type},
+        counts_by_type={"v5e_2x4": 3}, max_workers=7)
+    assert plan == {} and len(infeasible) == 2
+
+
+def test_utilization_prefers_tight_fit():
+    big = {"CPU": 16}
+    small = {"CPU": 4}
+    shape = [{"CPU": 4}]
+    assert utilization_score(small, shape) > utilization_score(big, shape)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v", "-x"]))
