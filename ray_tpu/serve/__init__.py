@@ -24,7 +24,8 @@ from ray_tpu.serve.api import (
 )
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
-from ray_tpu.serve._private.proxy import Request, Response
+from ray_tpu.serve._private.proxy import Request, Response, StreamingResponse
+from ray_tpu.serve.http_adapters import ingress
 
 __all__ = [
     "Application",
@@ -36,7 +37,9 @@ __all__ = [
     "HTTPOptions",
     "Request",
     "Response",
+    "StreamingResponse",
     "batch",
+    "ingress",
     "delete",
     "deployment",
     "get_app_handle",

@@ -9,6 +9,17 @@ DEFAULT_APP_NAME = "default"
 ROUTE_TABLE_KEY = "route_table"
 
 
+def stream_chunk_timeout_s() -> float:
+    """Max wait for one streamed chunk (one generator step). Generous by
+    default: the FIRST next() of a TPU serving generator may trigger XLA
+    compilation (tens of seconds); killing the stream for that would
+    truncate a healthy response."""
+    import os
+
+    return float(os.environ.get("RAY_TPU_SERVE_STREAM_CHUNK_TIMEOUT_S",
+                                "300"))
+
+
 def replicas_key(deployment_id: str) -> str:
     return f"replicas::{deployment_id}"
 

@@ -59,6 +59,28 @@ class Response:
                            self.headers))
 
 
+class StreamingResponse:
+    """A chunked/streaming HTTP response: ``body`` is an iterator (or
+    iterable) of str/bytes chunks. Reference:
+    serve/_private/http_proxy.py streams starlette StreamingResponses;
+    here the replica keeps the generator and the proxy pulls chunk
+    batches over actor calls, relaying them with HTTP chunked transfer
+    encoding — each batch reaches the client as soon as it is produced
+    (token streaming for TPU model serving is the motivating case).
+
+    A bare generator returned from a deployment streams too, with
+    default status/headers.
+    """
+
+    def __init__(self, body, status_code: int = 200,
+                 content_type: str = "text/plain",
+                 headers: dict | None = None):
+        self.body = body
+        self.status_code = status_code
+        self.content_type = content_type
+        self.headers = headers or {}
+
+
 def _encode_response(result) -> tuple[int, bytes, str, dict]:
     if isinstance(result, Response):
         body = result.body
@@ -158,6 +180,11 @@ class HTTPProxyActor:
             router = _get_router(info["ingress_deployment"])
             response = DeploymentResponse(router, "__call__", (request,), {})
             result = response.result(timeout_s=60.0)
+            from ray_tpu.serve.handle import _StreamChunkIterator
+
+            if isinstance(result, _StreamChunkIterator):
+                self._send_stream(h, result)
+                return
             status, raw, ctype, headers = _encode_response(result)
             self._send(h, status, raw, ctype, headers)
         except Exception as e:
@@ -169,6 +196,39 @@ class HTTPProxyActor:
                            "application/json", {})
             except Exception:
                 pass
+
+    @staticmethod
+    def _send_stream(h, it):
+        """Relay a replica-held generator (surfaced by the handle layer
+        as a _StreamChunkIterator) with HTTP chunked transfer encoding:
+        each chunk flushes to the socket the moment the replica yields
+        it. Mid-stream failures can only truncate the chunked body
+        (status already went out) — the client's decoder reports the
+        missing terminator instead of a silent short read."""
+        h.send_response(it.status_code)
+        h.send_header("Content-Type", it.content_type or "text/plain")
+        h.send_header("Transfer-Encoding", "chunked")
+        for k, v in (getattr(it, "headers", None) or {}).items():
+            h.send_header(k, v)
+        h.end_headers()
+        try:
+            for c in it:
+                if c:
+                    h.wfile.write(f"{len(c):x}\r\n".encode() + c + b"\r\n")
+                    h.wfile.flush()
+            h.wfile.write(b"0\r\n\r\n")
+            h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            h.close_connection = True
+            it.cancel()   # client went away: drop the replica's generator
+        except Exception:
+            # generator raised mid-stream: a 500 is impossible now —
+            # terminate the chunked body abnormally (no 0-chunk) AND close
+            # the keep-alive socket, or the client would block waiting for
+            # the next chunk on an open connection
+            h.close_connection = True
+            traceback.print_exc()
+            it.cancel()
 
     @staticmethod
     def _send(h, status, raw: bytes, ctype: str, headers: dict):

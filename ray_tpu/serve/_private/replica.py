@@ -29,6 +29,8 @@ class ReplicaActor:
         self._num_ongoing = 0
         self._num_total = 0
         self._shutdown = False
+        # live streaming responses: stream_id -> (iterator, last_pull_ts)
+        self._streams: dict[str, tuple] = {}
         if isinstance(user_callable, type):
             self._user = user_callable(*init_args, **(init_kwargs or {}))
         else:
@@ -47,10 +49,20 @@ class ReplicaActor:
         import ray_tpu
         from ray_tpu import ObjectRef
 
-        args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
-                     for a in args)
-        kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef) else v
-                  for k, v in (kwargs or {}).items()}
+        def _resolve(v):
+            if not isinstance(v, ObjectRef):
+                return v
+            out = ray_tpu.get(v)
+            if isinstance(out, dict) and "__serve_stream__" in out:
+                # upstream deployment streamed: hand the composing user
+                # code a chunk iterator, not the raw relay marker
+                from ray_tpu.serve.handle import _StreamChunkIterator
+
+                return _StreamChunkIterator(out)
+            return out
+
+        args = tuple(_resolve(a) for a in args)
+        kwargs = {k: _resolve(v) for k, v in (kwargs or {}).items()}
         with self._lock:
             if self._shutdown:
                 raise RuntimeError(
@@ -59,10 +71,88 @@ class ReplicaActor:
             self._num_total += 1
         try:
             target = self._resolve_method(method_name)
-            return target(*args, **(kwargs or {}))
+            result = target(*args, **(kwargs or {}))
+            return self._maybe_register_stream(result)
         finally:
             with self._lock:
                 self._num_ongoing -= 1
+
+    # ------------------------------------------------------------ streaming
+    def _maybe_register_stream(self, result):
+        """A generator result (or StreamingResponse wrapping one) stays
+        HERE; the caller gets a marker it pulls chunks through
+        (stream_next). Reference: http_proxy.py relays starlette
+        StreamingResponse bodies; an actor reply is one value, so the
+        replica holds the iterator and the proxy long-pulls it."""
+        from ray_tpu.serve._private.proxy import StreamingResponse
+
+        status, ctype, headers = 200, "text/plain", {}
+        body = result
+        if isinstance(result, StreamingResponse):
+            status = result.status_code
+            ctype = result.content_type
+            headers = result.headers
+            body = result.body
+        if not (hasattr(body, "__next__")
+                or (hasattr(body, "__iter__")
+                    and isinstance(result, StreamingResponse))):
+            return result
+        import time as _time
+        import uuid as _uuid
+
+        sid = _uuid.uuid4().hex
+        with self._lock:
+            # lazy sweep: drop streams nothing pulled for 10 minutes
+            # (their proxy died mid-stream)
+            now = _time.monotonic()
+            for k in [k for k, (_, ts) in self._streams.items()
+                      if now - ts > 600]:
+                self._streams.pop(k, None)
+            self._streams[sid] = (iter(body), now)
+        return {"__serve_stream__": sid,
+                "replica_actor": f"SERVE_REPLICA::{self._replica_id}",
+                "status": status, "content_type": ctype,
+                "headers": headers}
+
+    def stream_next(self, stream_id: str):
+        """Pull the next chunk: ([bytes] or [], done). One chunk per
+        call, latency-first: next() on a generator RUNS it to its next
+        yield (for token streaming that is a model step), so batching
+        ahead would delay the first chunk by the compute of all the
+        others. The ~1 ms actor RTT per chunk is the price of
+        immediacy; large transfers should yield large chunks."""
+        import time as _time
+
+        with self._lock:
+            entry = self._streams.get(stream_id)
+        if entry is None:
+            return [], True
+        it = entry[0]
+        try:
+            chunk = next(it)
+        except StopIteration:
+            with self._lock:
+                self._streams.pop(stream_id, None)
+            return [], True
+        if isinstance(chunk, str):
+            chunk = chunk.encode()
+        elif not isinstance(chunk, (bytes, bytearray)):
+            chunk = str(chunk).encode()
+        with self._lock:
+            if stream_id in self._streams:
+                self._streams[stream_id] = (it, _time.monotonic())
+        return [bytes(chunk)], False
+
+    def stream_cancel(self, stream_id: str):
+        with self._lock:
+            it = self._streams.pop(stream_id, (None, None))[0]
+        close = getattr(it, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                pass
+        return True
 
     def _resolve_method(self, method_name: str):
         if method_name in (None, "", "__call__"):
@@ -95,8 +185,12 @@ class ReplicaActor:
 
     def get_metrics(self) -> dict:
         with self._lock:
+            # live streams ARE ongoing work: the request isn't done until
+            # its generator drains (else the autoscaler downscales a
+            # replica mid-token-stream)
             return {"replica_id": self._replica_id,
-                    "num_ongoing_requests": self._num_ongoing,
+                    "num_ongoing_requests": (self._num_ongoing
+                                             + len(self._streams)),
                     "num_total_requests": self._num_total}
 
     def prepare_for_shutdown(self, timeout_s: float = 5.0) -> bool:
@@ -107,7 +201,7 @@ class ReplicaActor:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             with self._lock:
-                if self._num_ongoing == 0:
+                if self._num_ongoing == 0 and not self._streams:
                     break
             time.sleep(0.02)
         fn = getattr(self._user, "__serve_shutdown__", None)
@@ -117,7 +211,7 @@ class ReplicaActor:
             except Exception:
                 traceback.print_exc()
         with self._lock:
-            return self._num_ongoing == 0
+            return self._num_ongoing == 0 and not self._streams
 
     def ready(self) -> bool:
         """Liveness probe used by the controller while STARTING."""

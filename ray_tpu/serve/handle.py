@@ -82,7 +82,13 @@ class DeploymentResponse:
 
         for attempt in range(self.MAX_REPLICA_RETRIES + 1):
             try:
-                return ray_tpu.get(self._ref, timeout=remaining())
+                result = ray_tpu.get(self._ref, timeout=remaining())
+                if isinstance(result, dict) and "__serve_stream__" in result:
+                    # streaming deployment: hand back an iterator pulling
+                    # chunks from the replica (HTTP callers get chunked
+                    # transfer encoding via the proxy instead)
+                    return _StreamChunkIterator(result)
+                return result
             except ActorDiedError:
                 self._router.mark_replica_dead(self._replica_id)
                 if attempt == self.MAX_REPLICA_RETRIES:
@@ -94,6 +100,45 @@ class DeploymentResponse:
 
     def _to_object_ref(self):
         return self._ref
+
+
+class _StreamChunkIterator:
+    """Iterates a replica-held streaming response chunk by chunk (the
+    handle-call analog of the proxy's chunked-transfer relay)."""
+
+    def __init__(self, marker: dict):
+        import ray_tpu
+
+        self._sid = marker["__serve_stream__"]
+        self._actor = ray_tpu.get_actor(marker["replica_actor"],
+                                        namespace="serve")
+        self.status_code = marker.get("status", 200)
+        self.content_type = marker.get("content_type")
+        self.headers = marker.get("headers") or {}
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        import ray_tpu
+        from ray_tpu.serve._private.constants import stream_chunk_timeout_s
+
+        while not self._done:
+            chunks, done = ray_tpu.get(
+                self._actor.stream_next.remote(self._sid),
+                timeout=stream_chunk_timeout_s())
+            self._done = done
+            if chunks:
+                return chunks[0]
+        raise StopIteration
+
+    def cancel(self):
+        self._done = True
+        try:
+            self._actor.stream_cancel.remote(self._sid)
+        except Exception:
+            pass
 
 
 class DeploymentHandle:

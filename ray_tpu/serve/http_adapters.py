@@ -1,0 +1,220 @@
+"""ASGI integration: mount any ASGI app (FastAPI, Starlette, raw ASGI
+callables) as a Serve deployment.
+
+Reference: python/ray/serve/_private/http_proxy.py:10-12 (uvicorn
+fronting starlette) + serve/api.py `@serve.ingress(app)` (FastAPI apps
+mounted into a deployment class). The proxy here is stdlib, so instead
+of running uvicorn we drive the ASGI protocol directly: one event loop
+per replica, scope built from the proxy's Request, response events
+collected — and when the app streams (`more_body=True`), chunks are
+surfaced as a generator, which rides Serve's streaming response path
+(replica → proxy chunk pull → HTTP chunked transfer encoding).
+
+FastAPI itself is an optional dependency: anything implementing the
+ASGI 3.0 callable signature works, which is what the tests exercise
+hermetically.
+"""
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+from urllib.parse import urlencode
+
+
+class ASGIAppWrapper:
+    """Deployment body wrapping an ASGI app. Use via ``serve.ingress``:
+
+        app = FastAPI()
+        @serve.deployment
+        @serve.ingress(app)
+        class Api: ...
+    """
+
+    def __init__(self, asgi_app):
+        self._app = asgi_app
+        # One long-lived loop thread per replica: ASGI apps assume a
+        # stable loop (startup/shutdown lifespan, background tasks).
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True,
+            name="serve-asgi-loop")
+        self._loop_thread.start()
+        self._lifespan_rx = None     # asyncio.Queue feeding the lifespan
+        self._start_lifespan()
+
+    def _start_lifespan(self):
+        """Best-effort lifespan protocol. The lifespan task STAYS ALIVE
+        for the wrapper's lifetime: FastAPI/Starlette run startup and
+        shutdown inside one `async with`, parked awaiting the shutdown
+        message — cancelling after startup would run the app's shutdown
+        logic immediately (closing startup-created pools/model handles
+        before the first request). shutdown() delivers the message."""
+        async def _install():
+            rx = asyncio.Queue()
+            started = asyncio.Event()
+
+            async def receive():
+                return await rx.get()
+
+            async def send(event):
+                if event["type"].startswith("lifespan.startup"):
+                    started.set()
+
+            asyncio.ensure_future(self._app(
+                {"type": "lifespan", "asgi": {"version": "3.0"}},
+                receive, send))
+            await rx.put({"type": "lifespan.startup"})
+            try:
+                await asyncio.wait_for(started.wait(), timeout=10.0)
+            except asyncio.TimeoutError:
+                pass
+            return rx
+
+        try:
+            self._lifespan_rx = asyncio.run_coroutine_threadsafe(
+                _install(), self._loop).result(timeout=15.0)
+        except Exception:
+            self._lifespan_rx = None  # lifespan unsupported — fine
+
+    def __serve_shutdown__(self):
+        """Called by the replica's graceful drain: deliver
+        lifespan.shutdown so the app's teardown runs exactly once."""
+        rx = self._lifespan_rx
+        if rx is not None:
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    rx.put({"type": "lifespan.shutdown"}),
+                    self._loop).result(timeout=5.0)
+            except Exception:
+                pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+
+    def __call__(self, request):
+        """Serve ingress entry: translate Request → ASGI scope, run the
+        app, return either a full Response or a chunk generator."""
+        scope = {
+            "type": "http",
+            "asgi": {"version": "3.0", "spec_version": "2.3"},
+            "http_version": "1.1",
+            "method": request.method,
+            "path": request.path,
+            "raw_path": request.path.encode(),
+            "root_path": "",
+            "query_string": urlencode(request.query_params).encode(),
+            "headers": [(k.lower().encode(), str(v).encode())
+                        for k, v in request.headers.items()],
+            "client": ("127.0.0.1", 0),
+            "server": ("127.0.0.1", 80),
+        }
+        events: queue.Queue = queue.Queue()
+        body = request.body or b""
+
+        async def _run():
+            rx = [
+                {"type": "http.request", "body": body, "more_body": False}]
+
+            async def receive():
+                if rx:
+                    return rx.pop(0)
+                return {"type": "http.disconnect"}
+
+            async def send(event):
+                events.put(event)
+
+            try:
+                await self._app(scope, receive, send)
+            except BaseException as e:  # noqa: BLE001 — surface app crashes
+                events.put({"type": "__error__", "error": e})
+            finally:
+                events.put({"type": "__done__"})
+
+        asyncio.run_coroutine_threadsafe(_run(), self._loop)
+
+        start = None
+        first_chunks: list[bytes] = []
+        while True:
+            ev = events.get(timeout=60.0)
+            if ev["type"] == "__error__":
+                raise ev["error"]
+            if ev["type"] == "__done__":
+                return self._full_response(start, first_chunks)
+            if ev["type"] == "http.response.start":
+                start = ev
+            elif ev["type"] == "http.response.body":
+                first_chunks.append(ev.get("body", b""))
+                if ev.get("more_body"):
+                    # streaming app → generator response (rides Serve's
+                    # chunked streaming path)
+                    return self._stream(start, first_chunks, events)
+
+    @staticmethod
+    def _headers(start) -> tuple[int, dict, str]:
+        status = (start or {}).get("status", 200)
+        headers = {}
+        ctype = "application/octet-stream"
+        for k, v in (start or {}).get("headers", []):
+            name = k.decode().lower()
+            if name == "content-type":
+                ctype = v.decode()
+            elif name != "content-length":   # recomputed by the proxy
+                headers[name.title()] = v.decode()
+        return status, headers, ctype
+
+    def _full_response(self, start, chunks):
+        from ray_tpu.serve._private.proxy import Response
+
+        status, headers, ctype = self._headers(start)
+        return Response(b"".join(chunks), status_code=status,
+                        content_type=ctype, headers=headers)
+
+    def _stream(self, start, first_chunks, events):
+        from ray_tpu.serve._private.proxy import StreamingResponse
+
+        def gen():
+            for c in first_chunks:
+                if c:
+                    yield c
+            while True:
+                ev = events.get(timeout=60.0)
+                if ev["type"] == "__error__":
+                    raise ev["error"]
+                if ev["type"] == "__done__":
+                    return
+                if ev["type"] == "http.response.body":
+                    c = ev.get("body", b"")
+                    if c:
+                        yield c
+                    if not ev.get("more_body"):
+                        return
+
+        status, headers, ctype = self._headers(start)
+        return StreamingResponse(gen(), status_code=status,
+                                 content_type=ctype, headers=headers)
+
+
+def ingress(asgi_app):
+    """Class decorator mounting an ASGI app on a deployment class
+    (reference: serve.ingress). Methods of the decorated class remain
+    available for handle calls; HTTP requests go to the ASGI app; the
+    replica's graceful drain delivers the app's lifespan.shutdown."""
+    def decorator(cls):
+        class Ingress(cls):
+            def __init__(self, *args, **kwargs):
+                super().__init__(*args, **kwargs)
+                self.__asgi__ = ASGIAppWrapper(asgi_app)
+
+            def __call__(self, request):
+                return self.__asgi__(request)
+
+            def __serve_shutdown__(self):
+                parent = getattr(super(), "__serve_shutdown__", None)
+                if callable(parent):
+                    parent()
+                self.__asgi__.__serve_shutdown__()
+
+        Ingress.__name__ = cls.__name__
+        Ingress.__qualname__ = cls.__qualname__
+        return Ingress
+
+    return decorator
