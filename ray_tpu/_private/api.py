@@ -375,12 +375,12 @@ def get_runtime_context() -> RuntimeContext:
 
 _TASK_DEFAULTS = dict(num_cpus=1.0, num_tpus=0.0, memory=None, resources=None,
                       num_returns=1, max_retries=3, retry_exceptions=False,
-                      scheduling_strategy=None)
+                      scheduling_strategy=None, runtime_env=None)
 _ACTOR_DEFAULTS = dict(num_cpus=1.0, num_tpus=0.0, memory=None, resources=None,
                        max_restarts=0, max_task_retries=0, max_concurrency=1,
                        concurrency_groups=None, name=None, namespace=None,
                        lifetime=None, get_if_exists=False,
-                       scheduling_strategy=None)
+                       scheduling_strategy=None, runtime_env=None)
 
 
 def _build_resources(opts: dict) -> dict:
@@ -454,6 +454,7 @@ class RemoteFunction:
             resources=_build_resources(opts),
             strategy=_build_strategy(opts),
             max_retries=opts["max_retries"],
+            runtime_env=opts.get("runtime_env"),
             task_desc=f"task {self._fn.__name__}()",
         )
         if opts["num_returns"] == 1:
@@ -569,6 +570,7 @@ class ActorClass:
                 "namespace": opts["namespace"] or _namespace,
                 "lifetime": opts["lifetime"],
                 "get_if_exists": opts["get_if_exists"],
+                "runtime_env": opts.get("runtime_env"),
             })
         return ActorHandle(actor_id,
                            max_task_retries=opts["max_task_retries"])

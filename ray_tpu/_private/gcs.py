@@ -277,10 +277,20 @@ class GcsServer:
                     "ReportedAt": n.reported_at,
                 })
             pending_bundles = []
+            pending_pgs = []
             for pg in self.placement_groups.values():
                 if pg.state in ("PENDING", "RESCHEDULING"):
                     pending_bundles.extend(dict(b) for b in pg.bundles)
-            return {"nodes": nodes, "pending_pg_bundles": pending_bundles}
+                    # strategy-aware form: the demand binpacker needs to
+                    # know STRICT_PACK must co-locate and STRICT_SPREAD
+                    # must anti-affine (resource_demand_scheduler.py:171)
+                    pending_pgs.append({
+                        "pg_id": pg.pg_id.hex(),
+                        "strategy": pg.strategy,
+                        "bundles": [dict(b) for b in pg.bundles],
+                    })
+            return {"nodes": nodes, "pending_pg_bundles": pending_bundles,
+                    "pending_pgs": pending_pgs}
 
     def rpc_drain_node(self, conn, node_id: str):
         self._mark_node_dead(node_id, "drained")

@@ -657,6 +657,196 @@ class CoreWorker:
         self._cluster_cpu_cache = (now, total)
         return total
 
+    # -------------------------------------------------------- runtime envs
+
+    def _normalize_runtime_env(self, runtime_env: dict | None):
+        """Driver-side normalization: local paths (working_dir, py_modules
+        dirs, pip sdist dirs/wheel files) are packaged and uploaded to GCS
+        KV once, so the spec carries only content keys that any node can
+        materialize (reference: runtime_env/packaging.py). Without this,
+        a spec naming /home/me/mylib would only work on nodes sharing the
+        driver's filesystem. Uploads are content-addressed AND memoized
+        per local path for 10 s, so a submit loop doesn't re-zip the tree
+        per task."""
+        if not runtime_env:
+            return None
+        runtime_env = dict(runtime_env)
+        wd = runtime_env.get("working_dir")
+        if wd and not wd.startswith("pkg-"):
+            runtime_env["working_dir"] = self._upload_env_path(wd)
+        if runtime_env.get("py_modules"):
+            # keep_name: a py_module's directory name IS its import name
+            runtime_env["py_modules"] = [
+                self._upload_env_path(m, keep_name=True)
+                if os.path.exists(str(m)) else m
+                for m in runtime_env["py_modules"]]
+        if runtime_env.get("pip"):
+            runtime_env["pip"] = [
+                self._upload_env_path(r) if os.path.exists(str(r)) else r
+                for r in runtime_env["pip"]]
+        return runtime_env
+
+    def _upload_env_path(self, path: str, keep_name: bool = False) -> str:
+        path = os.path.abspath(str(path))
+        cache = getattr(self, "_env_upload_cache", None)
+        if cache is None:
+            cache = self._env_upload_cache = {}
+        hit = cache.get((path, keep_name))
+        if hit is not None and time.monotonic() - hit[0] < 10.0:
+            return hit[1]
+        if os.path.isdir(path):
+            from ray_tpu._private.runtime_env import upload_working_dir
+
+            key = upload_working_dir(self.gcs.call, path)
+            if keep_name:
+                key = f"{key}/{os.path.basename(path)}"
+        else:
+            with open(path, "rb") as f:
+                data = f.read()
+            key = "blob-" + hashlib.sha256(data).hexdigest()[:24]
+            if self.gcs.call("kv_get", ns="packages",
+                             key=key.encode()) is None:
+                self.gcs.call("kv_put", ns="packages", key=key.encode(),
+                              value=data)
+            key = f"{key}/{os.path.basename(path)}"
+        cache[(path, keep_name)] = (time.monotonic(), key)
+        return key
+
+    def _apply_runtime_env(self, runtime_env: dict | None):
+        """Make `runtime_env` current in THIS process before running its
+        task: pip/py_modules site dirs prepend sys.path, env_vars overlay
+        os.environ, working_dir materializes and becomes cwd. A worker
+        keeps its env between tasks (the scheduling key separates envs,
+        so swaps happen only when the raylet reuses an idle worker across
+        keys); swapping reverts the previous overlay (incl. cwd) first.
+        Failure-safe: all fallible resolution happens BEFORE any state
+        mutates, and a failed apply leaves the worker env-less (key None)
+        so the next task re-applies from scratch rather than trusting a
+        half-applied overlay. Design delta vs the reference's
+        dedicated-worker-per-env: modules already imported from a
+        previous env stay cached in sys.modules."""
+        import sys as _sys
+
+        key = _freeze(runtime_env)
+        if key == getattr(self, "_env_applied_key", None):
+            return
+        # ---- resolve the NEW env fully before touching process state
+        paths, uri, cache = [], None, None
+        runtime_env = runtime_env or {}
+        pip = runtime_env.get("pip")
+        py_modules = runtime_env.get("py_modules")
+        if pip or py_modules:
+            from ray_tpu._private.runtime_env_pip import node_env_cache
+
+            cache = node_env_cache()
+            pip = [self._localize_env_entry(e) for e in (pip or [])]
+            py_modules = [self._localize_env_entry(m)
+                          for m in (py_modules or [])]
+            info = cache.get_or_create(pip=pip, py_modules=py_modules)
+            uri = info["uri"]
+            paths.extend(info["site_dirs"])
+        wd = runtime_env.get("working_dir")
+        wd_path = None
+        if wd:
+            wd_path = self._localize_env_entry(wd)
+            paths.append(wd_path)
+        # ---- point of no return: revert old overlay, install new
+        for p in getattr(self, "_env_paths", ()):
+            try:
+                _sys.path.remove(p)
+            except ValueError:
+                pass
+        for k, old in getattr(self, "_env_vars_prev", {}).items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        if getattr(self, "_env_orig_cwd", None):
+            try:
+                os.chdir(self._env_orig_cwd)
+            except OSError:
+                pass
+            self._env_orig_cwd = None
+        prev_uri = getattr(self, "_env_uri", None)
+        self._env_paths = ()
+        self._env_vars_prev = {}
+        self._env_uri = None
+        self._env_applied_key = None
+        vars_prev = {}
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            vars_prev[k] = os.environ.get(k)
+            os.environ[str(k)] = str(v)
+        if wd_path:
+            try:
+                self._env_orig_cwd = os.getcwd()
+            except OSError:
+                self._env_orig_cwd = None
+            try:
+                os.chdir(wd_path)
+            except OSError:
+                pass
+        _sys.path[:0] = paths
+        if uri is not None:
+            cache.acquire(uri)
+        if prev_uri:
+            from ray_tpu._private.runtime_env_pip import node_env_cache
+
+            node_env_cache().release(prev_uri)
+        self._env_paths = paths
+        self._env_vars_prev = vars_prev
+        self._env_uri = uri
+        self._env_applied_key = key
+
+    def _localize_env_entry(self, entry: str) -> str:
+        """Turn a runtime-env entry into a path valid on THIS node:
+        content keys (pkg-/blob-, uploaded by the driver's normalization)
+        materialize from GCS KV into the node's package cache; anything
+        else (package names, URLs, paths that exist locally) passes
+        through."""
+        if not isinstance(entry, str):
+            return entry
+        dest_root = os.path.join("/tmp/ray_tpu", "pkg_cache")
+        if entry.startswith("pkg-"):
+            from ray_tpu._private.runtime_env import materialize_working_dir
+
+            os.makedirs(dest_root, exist_ok=True)
+            key, _, name = entry.partition("/")
+            extracted = materialize_working_dir(self.gcs.call, key,
+                                                dest_root)
+            if not name:
+                return extracted
+            # "pkg-<hash>/<name>": the packaged tree must surface under
+            # its ORIGINAL directory name (a py_module's dir name is its
+            # import name; zipping strips it)
+            named_root = os.path.join(dest_root, key + ".named")
+            target = os.path.join(named_root, name)
+            if not os.path.exists(target):
+                os.makedirs(named_root, exist_ok=True)
+                try:
+                    os.symlink(extracted, target)
+                except OSError:
+                    pass   # raced another worker: target now exists
+            return target
+        if entry.startswith("blob-"):
+            # "blob-<hash>/<basename>": a single file (e.g. a wheel) —
+            # materialized under its REAL basename because pip parses
+            # name/version out of wheel filenames
+            key, _, basename = entry.partition("/")
+            blob_dir = os.path.join(dest_root, key)
+            os.makedirs(blob_dir, exist_ok=True)
+            path = os.path.join(blob_dir, basename or "blob.bin")
+            if not os.path.exists(path):
+                data = self.gcs.call("kv_get", ns="packages",
+                                     key=key.encode())
+                if data is None:
+                    raise ValueError(f"package {entry!r} not found in GCS")
+                tmp = path + f".tmp{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+            return path
+        return entry
+
     def _worker_death_error(self, worker_id: str):
         """Error for a task whose executing worker died. The raylet records
         OOM kills in GCS KV *before* delivering SIGKILL (raylet.py
@@ -1339,10 +1529,11 @@ class CoreWorker:
 
     def submit_task(self, func_hash: bytes, args, kwargs, *, num_returns=1,
                     resources=None, strategy=None, max_retries=0,
-                    task_desc="task") -> list[ObjectRef]:
+                    runtime_env=None, task_desc="task") -> list[ObjectRef]:
         # {} is a legitimate request (num_cpus=0: schedule anywhere, consume
         # nothing); only None means "default 1 CPU".
         resources = {"CPU": 1.0} if resources is None else dict(resources)
+        runtime_env = self._normalize_runtime_env(runtime_env)
         return_ids = [os.urandom(16) for _ in range(num_returns)]
         args, kwargs = self._inline_small_args(args, kwargs)
         spec = {
@@ -1360,13 +1551,18 @@ class CoreWorker:
             "task_desc": task_desc,
             "job_id": self.job_id,
         }
+        if runtime_env:
+            spec["runtime_env"] = runtime_env
         self._pin_args(spec, args, kwargs)
         self._owned.update(return_ids)
         refs = [ObjectRef(rid, self.addr, self) for rid in return_ids]
         for rid in return_ids:
             self.memory_store.entry(rid)  # pre-create pending futures
+        # runtime_env joins the scheduling key: workers apply an env once
+        # and keep it (reference: envs bind to dedicated workers), so
+        # different envs must not share leases
         key = (func_hash, tuple(sorted(resources.items())),
-               _freeze(strategy))
+               _freeze(strategy), _freeze(runtime_env))
         with self._lock:
             q = self._sched_queues.get(key)
             if q is None:
@@ -1549,6 +1745,8 @@ class CoreWorker:
             "get_if_exists": options.get("get_if_exists", False),
             "owner_addr": self.addr,
             "job_id": self.job_id,
+            "runtime_env": self._normalize_runtime_env(
+                options.get("runtime_env")),
         }
         reg = self.gcs.call("register_actor", actor_id=actor_id, spec=spec)
         if reg.get("existing"):
@@ -1745,6 +1943,7 @@ class CoreWorker:
             try:
                 with record_span("task", spec.get("task_desc", "task"),
                                  {"task_id": task_id.hex()}):
+                    self._apply_runtime_env(spec.get("runtime_env"))
                     fn = self._load_function(spec["func_hash"])
                     args, kwargs = self._resolve_args(spec)
                     result = fn(*args, **kwargs)
@@ -1923,6 +2122,12 @@ class CoreWorker:
             name: FifoSemaphore(max(1, int(n)))
             for name, n in (spec.get("concurrency_groups") or {}).items()
         }
+        try:
+            self._apply_runtime_env(spec.get("runtime_env"))
+        except BaseException as e:  # noqa: BLE001 — env setup is fatal
+            self.gcs.call("actor_failed", actor_id=actor_id,
+                          reason=f"runtime_env setup failed: {e}")
+            raise
         cls = self._load_function(spec["class_hash"])
         args, kwargs = ser.deserialize(spec["args"], self)
         args = [self.get(a) if isinstance(a, ObjectRef) else a for a in args]

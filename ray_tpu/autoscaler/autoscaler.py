@@ -66,76 +66,50 @@ class StandardAutoscaler:
     def update(self) -> dict:
         """One reconcile pass. Returns {"launched": [...], "terminated":
         [...]} for observability/tests."""
+        from ray_tpu.autoscaler.resource_demand import get_nodes_to_launch
+
         load = self._gcs.call("get_cluster_load")
         alive = [n for n in load["nodes"] if n["Alive"]]
-        demand = [d for n in alive for d in n["PendingDemand"]]
-        demand += load["pending_pg_bundles"]
+        task_demand = [d for n in alive for d in n["PendingDemand"]]
+        # strategy-aware PG demand when the GCS provides it; flat bundles
+        # (no co-location/anti-affinity constraints) otherwise
+        pending_pgs = load.get("pending_pgs")
+        if pending_pgs is None:
+            pending_pgs = [{"strategy": "PACK",
+                            "bundles": load["pending_pg_bundles"]}]
 
-        # 1. subtract what current headroom can absorb
-        headroom = [dict(n["Available"]) for n in alive]
-        unfulfilled = []
-        for shape in demand:
-            placed = False
-            for h in headroom:
-                if all(h.get(k, 0) >= v for k, v in shape.items()):
-                    for k, v in shape.items():
-                        h[k] = h.get(k, 0) - v
-                    placed = True
-                    break
-            if not placed:
-                unfulfilled.append(shape)
-
-        launched = []
-        if unfulfilled:
-            launched = self._launch_for(unfulfilled)
-
-        terminated = []
-        if not unfulfilled:
-            terminated = self._scale_down(alive)
-        return {"launched": launched, "terminated": terminated,
-                "unfulfilled": unfulfilled}
-
-    def _launch_for(self, shapes: list[dict]) -> list[str]:
         types = self.config.get("available_node_types", {})
         provider_nodes = self.provider.non_terminated_nodes()
-        total = len(provider_nodes)
         by_type: dict[str, int] = {}
         for n in provider_nodes:
             by_type[n["node_type"]] = by_type.get(n["node_type"], 0) + 1
+
+        plan, infeasible = get_nodes_to_launch(
+            task_demand, pending_pgs,
+            headroom=[dict(n["Available"]) for n in alive],
+            node_types=types,
+            counts_by_type=by_type,
+            max_workers=self.config.get("max_workers", 8))
+
         launched = []
-        # plan: first node type that covers each shape (reference binpacking
-        # picks min-cost; first-fit is our simplification), dedup into
-        # counts, honor caps
-        plan: dict[str, int] = {}
-        pending_cover: dict[str, dict] = {}
-        for shape in shapes:
-            for name, spec in types.items():
-                res = spec.get("resources", {})
-                if all(res.get(k, 0) >= v for k, v in shape.items()):
-                    cover = pending_cover.setdefault(name, dict(res))
-                    if all(cover.get(k, 0) >= v for k, v in shape.items()):
-                        # fits in a node we already plan to launch
-                        for k, v in shape.items():
-                            cover[k] = cover.get(k, 0) - v
-                        plan.setdefault(name, max(plan.get(name, 0), 1))
-                    else:
-                        plan[name] = plan.get(name, 0) + 1
-                        pending_cover[name] = dict(res)
-                        for k, v in shape.items():
-                            pending_cover[name][k] = \
-                                pending_cover[name].get(k, 0) - v
-                    break
-        max_workers = self.config.get("max_workers", 8)
         for name, count in plan.items():
             spec = types[name]
-            cap = spec.get("max_workers", max_workers)
-            allowed = min(count,
-                          cap - by_type.get(name, 0),
-                          max_workers - total - len(launched))
-            if allowed <= 0:
-                continue
-            launched.extend(self.provider.create_node(name, spec, allowed))
-        return launched
+            slice_cfg = spec.get("tpu_slice")
+            if slice_cfg:
+                # multi-host TPU slices launch as a UNIT (QR-style "give
+                # me a slice of topology X"); provider decides how
+                for _ in range(count):
+                    launched.extend(self.provider.create_slice(
+                        name, spec, slice_cfg.get("topology", "")))
+            else:
+                launched.extend(self.provider.create_node(name, spec,
+                                                          count))
+
+        terminated = []
+        if not plan and not infeasible:
+            terminated = self._scale_down(alive)
+        return {"launched": launched, "terminated": terminated,
+                "unfulfilled": infeasible}
 
     def _scale_down(self, alive_nodes: list[dict]) -> list[str]:
         idle_timeout = self.config.get("idle_timeout_s", 60.0)

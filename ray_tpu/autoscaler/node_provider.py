@@ -28,6 +28,19 @@ class NodeProvider:
                     count: int) -> list[str]:
         raise NotImplementedError
 
+    def create_slice(self, node_type: str, node_config: dict,
+                     topology: str) -> list[str]:
+        """Create one multi-host TPU slice as a unit — the QR-style
+        "give me a slice of topology X" call (reference: the GCP
+        provider's flat tpu.yaml cannot express this; queued-resources
+        APIs can). The DEFAULT merely launches the member hosts as
+        ordinary nodes (correct count, no shared slice identity): real
+        TPU providers must override this with their slice/QR API, which
+        is what stamps TPU_NAME/TPU_WORKER_ID/TPU_TOPOLOGY on the VMs
+        (detect_tpu_topology reads those to advertise slice structure)."""
+        hosts = int((node_config.get("tpu_slice") or {}).get("hosts", 1))
+        return self.create_node(node_type, node_config, hosts)
+
     def terminate_node(self, provider_id: str) -> None:
         raise NotImplementedError
 

@@ -149,10 +149,10 @@ def get_nodes_to_launch(task_shapes: list[dict],
         return int((node_types[tname].get("tpu_slice") or {})
                    .get("hosts", 1))
 
-    # counts/caps are in provider units (slices for slice types); the
-    # global max_workers budget is in HOSTS
-    total_existing = sum(c * _hosts_per_unit(t)
-                         for t, c in counts_by_type.items())
+    # counts, per-type caps and the global max_workers budget are all in
+    # HOSTS (what provider.non_terminated_nodes lists); the returned plan
+    # counts slice types in SLICE units (what create_slice launches)
+    total_existing = sum(counts_by_type.values())
 
     def _planned_hosts():
         return sum(c * _hosts_per_unit(t) for t, c in plan.items())
@@ -180,9 +180,10 @@ def get_nodes_to_launch(task_shapes: list[dict],
             score = utilization_score(res, [entry["shape"]])
             if score is None:
                 continue
-            cap = spec.get("max_workers", max_workers)
-            planned_units = plan.get(tname, 0)
-            if counts_by_type.get(tname, 0) + planned_units >= cap:
+            cap = spec.get("max_workers", max_workers)   # hosts
+            planned_hosts_t = plan.get(tname, 0) * _hosts_per_unit(tname)
+            if (counts_by_type.get(tname, 0) + planned_hosts_t
+                    + _hosts_per_unit(tname)) > cap:
                 continue
             if (total_existing + _planned_hosts()
                     + _hosts_per_unit(tname)) > max_workers:

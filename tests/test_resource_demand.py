@@ -103,11 +103,12 @@ def test_tpu_slice_launched_as_unit():
         [], [pg], headroom=[], node_types={"v5e_2x4": slice_type})
     # ONE slice unit covers both anti-affinity bundles (2 hosts)
     assert plan == {"v5e_2x4": 1} and infeasible == []
-    # max_workers counts HOSTS: a 2-host slice cannot launch if only
-    # one host slot remains
+    # max_workers and counts_by_type are in HOSTS: with 6 member hosts
+    # (3 slices) already up, a 2-host slice cannot launch if only one
+    # host slot remains in the budget
     plan, infeasible = get_nodes_to_launch(
         [], [pg], headroom=[], node_types={"v5e_2x4": slice_type},
-        counts_by_type={"v5e_2x4": 3}, max_workers=7)
+        counts_by_type={"v5e_2x4": 6}, max_workers=7)
     assert plan == {} and len(infeasible) == 2
 
 
