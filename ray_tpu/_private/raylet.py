@@ -197,6 +197,15 @@ class Raylet:
         # common/memory_monitor.h:88 + raylet/worker_killing_policy.h:30).
         self._oom_reasons: dict[str, str] = {}   # worker_id -> message
         self._mem_monitor = MemoryMonitor(self._on_memory_pressure)
+        # worker-pool spawn state — must exist before the server starts
+        # accepting lease requests (they reach _spawn_worker)
+        self._prestart_target = min(
+            int(self.resources_total.get("CPU", 1)), _IDLE_WORKER_CAP,
+            int(os.environ.get("RAY_TPU_PRESTART_WORKERS", "4")))
+        self._spawning = 0
+        self._spawn_gate = threading.BoundedSemaphore(
+            max(2, int(os.environ.get("RAY_TPU_MAX_STARTUP_CONCURRENCY",
+                                      str(os.cpu_count() or 2)))))
 
         self._server = RpcServer(self, host, port).start()
         self.addr = self._server.addr
@@ -221,10 +230,6 @@ class Raylet:
         # pool is drawn down (reference: worker_pool.h PrestartWorkers +
         # idle-pool maintenance) — on-demand cold spawns under load cost
         # ~300ms each of lease-grant latency (profiled round 4).
-        self._prestart_target = min(
-            int(self.resources_total.get("CPU", 1)), _IDLE_WORKER_CAP,
-            int(os.environ.get("RAY_TPU_PRESTART_WORKERS", "4")))
-        self._spawning = 0
         if self._prestart_target > 0:
             self._maybe_refill()
 
@@ -311,6 +316,29 @@ class Raylet:
     def _spawn_worker(self) -> WorkerHandle:
         if self._stopped:
             raise RuntimeError("raylet is stopped")
+        # Bound concurrent process STARTUPS (reference: worker_pool.h
+        # maximum_startup_concurrency = num_cpus): 400 actors creating at
+        # once means 400 interpreters importing simultaneously on however
+        # many cores exist — everything times out. The gate is held from
+        # fork until the worker registers (or 30 s), so at most gate-width
+        # workers are mid-startup; callers keep their own registered.wait.
+        self._spawn_gate.acquire()
+        try:
+            handle = self._spawn_worker_inner()
+        except BaseException:
+            self._spawn_gate.release()
+            raise
+
+        def _release_when_up():
+            try:
+                handle.registered.wait(30.0)
+            finally:
+                self._spawn_gate.release()
+
+        threading.Thread(target=_release_when_up, daemon=True).start()
+        return handle
+
+    def _spawn_worker_inner(self) -> WorkerHandle:
         worker_id = uuid.uuid4().hex[:16]
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id
@@ -1042,6 +1070,20 @@ class Raylet:
 
     def rpc_ping(self, conn):
         return "pong"
+
+    def rpc_physical_stats(self, conn):
+        """Reporter-agent sample for this node (reference:
+        dashboard/modules/reporter/reporter_agent.py:296 — here the
+        raylet plays the per-node agent; the dashboard fans this out at
+        /api/reporter)."""
+        from ray_tpu.dashboard.reporter import collect_stats
+
+        with self._lock:
+            pids = [h.proc.pid for h in self._workers.values()
+                    if h.proc is not None and h.proc.poll() is None]
+        stats = collect_stats(pids)
+        stats["node_id"] = self.node_id
+        return stats
 
     # ---- lifecycle ----------------------------------------------------------
 

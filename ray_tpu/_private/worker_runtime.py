@@ -436,9 +436,17 @@ class _ActorQueue:
                 # receiver doesn't wait for seqs lost with the old process
                 self._on_connection_lost()
         deadline = time.time() + timeout
+        poll = 0.05
         while True:
-            info = self.worker.gcs.call("get_actor",
-                                        actor_id=self.actor_id)
+            try:
+                info = self.worker.gcs.call("get_actor",
+                                            actor_id=self.actor_id)
+            except TimeoutError:
+                # GCS overloaded (e.g. hundreds of actors creating at
+                # once): a transient RPC timeout is not a verdict on the
+                # actor — back off and re-poll instead of killing this
+                # submit thread (which would strand its queued call)
+                info = {"state": "PENDING_CREATION", "addr": None}
             if info is None:
                 raise exc.ActorDiedError(self.actor_id.hex(),
                                          "actor not found")
@@ -464,7 +472,10 @@ class _ActorQueue:
             elif time.time() > deadline:
                 raise exc.GetTimeoutError(
                     f"actor {self.actor_id.hex()} not ready in {timeout}s")
-            time.sleep(0.05)
+            time.sleep(poll)
+            # with N pending handles this loop is N pollers against one
+            # GCS; constant 50 ms polling melted it at N=400 — back off
+            poll = min(poll * 1.5, 1.0)
 
     def assign_seq(self, spec: dict):
         """Must be called in program submission order (caller thread)."""

@@ -54,9 +54,13 @@ class CheckpointConfig:
 @dataclass
 class RunConfig:
     name: str | None = None
+    # a local path is used directly; a URI with a scheme (file://...)
+    # stages locally and mirrors through tune.syncer (cloud-sync analog)
     storage_path: str | None = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(
         default_factory=CheckpointConfig)
     stop: dict | None = None
     verbose: int = 1
+    callbacks: list | None = None      # tune.Callback instances
+    sync_config: object | None = None  # tune.syncer.SyncConfig

@@ -1,0 +1,60 @@
+"""Grafana dashboard factory (reference:
+dashboard/modules/metrics/grafana_dashboard_factory.py — generates the
+default Grafana dashboard JSON over Ray's Prometheus metrics so
+operators import one file instead of hand-building panels).
+
+`generate_default_dashboard()` returns importable Grafana JSON wired to
+the /metrics exposition this framework serves (util/metrics.py +
+dashboard/server.py); write it with `save_default_dashboard(path)` or
+fetch it from the dashboard at /api/grafana_dashboard."""
+from __future__ import annotations
+
+import json
+
+_PANELS = [
+    # (title, promql expr, unit)
+    ("Node CPU %", "ray_tpu_node_cpu_percent", "percent"),
+    ("Node memory used", "ray_tpu_node_mem_used_bytes", "bytes"),
+    ("Object store bytes", "ray_tpu_object_store_bytes_used", "bytes"),
+    ("Object store evictions", "rate(ray_tpu_object_store_evictions[5m])",
+     "ops"),
+    ("Tasks finished", "rate(ray_tpu_tasks_finished_total[1m])", "ops"),
+    ("Task failures", "rate(ray_tpu_tasks_failed_total[5m])", "ops"),
+    ("Live actors", "ray_tpu_actors_alive", "short"),
+    ("Pending lease requests", "ray_tpu_lease_requests_pending", "short"),
+    ("Serve QPS", "rate(ray_tpu_serve_requests_total[1m])", "reqps"),
+    ("Serve p50 latency",
+     "histogram_quantile(0.5, rate(ray_tpu_serve_latency_seconds_bucket"
+     "[5m]))", "s"),
+]
+
+
+def generate_default_dashboard(datasource: str = "Prometheus") -> dict:
+    panels = []
+    for i, (title, expr, unit) in enumerate(_PANELS):
+        panels.append({
+            "id": i + 1,
+            "title": title,
+            "type": "timeseries",
+            "datasource": datasource,
+            "gridPos": {"h": 8, "w": 12,
+                        "x": 12 * (i % 2), "y": 8 * (i // 2)},
+            "fieldConfig": {"defaults": {"unit": unit}},
+            "targets": [{"expr": expr, "refId": "A",
+                         "legendFormat": "{{instance}}"}],
+        })
+    return {
+        "title": "ray_tpu",
+        "uid": "ray-tpu-default",
+        "timezone": "browser",
+        "refresh": "10s",
+        "schemaVersion": 36,
+        "time": {"from": "now-30m", "to": "now"},
+        "panels": panels,
+    }
+
+
+def save_default_dashboard(path: str, datasource: str = "Prometheus"):
+    with open(path, "w") as f:
+        json.dump(generate_default_dashboard(datasource), f, indent=2)
+    return path

@@ -1,0 +1,104 @@
+"""Per-node physical stats collection (reference:
+dashboard/modules/reporter/reporter_agent.py:296 — each node's agent
+samples cpu/mem/disk/network/per-worker usage and publishes it for the
+dashboard). Here the raylet plays the agent: it calls collect_stats()
+on demand (rpc_physical_stats) and the dashboard aggregates across
+nodes at /api/reporter.
+
+Pure /proc readers — no psutil dependency (not bundled)."""
+from __future__ import annotations
+
+import os
+import time
+
+
+def _read_file(path: str) -> str | None:
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+_last_cpu: dict = {}
+
+
+def cpu_percent() -> float | None:
+    """System-wide CPU utilization since the previous call (first call
+    returns None — no interval yet)."""
+    raw = _read_file("/proc/stat")
+    if not raw:
+        return None
+    fields = raw.splitlines()[0].split()[1:]
+    vals = [int(x) for x in fields[:8]]
+    idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
+    total = sum(vals)
+    prev = _last_cpu.get("v")
+    _last_cpu["v"] = (total, idle)
+    if prev is None or total == prev[0]:
+        return None
+    dt_total = total - prev[0]
+    dt_idle = idle - prev[1]
+    return round(100.0 * (1.0 - dt_idle / dt_total), 1)
+
+
+def memory_stats() -> dict:
+    from ray_tpu._private.memory_monitor import node_memory_usage
+
+    used, total = node_memory_usage()
+    return {"used_bytes": used, "total_bytes": total,
+            "percent": round(100.0 * used / total, 1) if total else 0.0}
+
+
+def disk_stats(path: str = "/") -> dict:
+    try:
+        st = os.statvfs(path)
+    except OSError:
+        return {}
+    total = st.f_blocks * st.f_frsize
+    free = st.f_bavail * st.f_frsize
+    return {"total_bytes": total, "free_bytes": free,
+            "percent": round(100.0 * (total - free) / total, 1)
+            if total else 0.0}
+
+
+def load_avg() -> list[float]:
+    try:
+        return [round(x, 2) for x in os.getloadavg()]
+    except OSError:
+        return []
+
+
+def worker_stats(pids: list[int]) -> list[dict]:
+    """RSS + cpu time per worker pid (reporter_agent's workers table)."""
+    from ray_tpu._private.memory_monitor import process_rss
+
+    out = []
+    tick = os.sysconf("SC_CLK_TCK")
+    for pid in pids:
+        raw = _read_file(f"/proc/{pid}/stat")
+        if raw is None:
+            continue
+        # fields after the (comm) parens; utime/stime are 14/15 (1-based)
+        rest = raw.rsplit(")", 1)[-1].split()
+        try:
+            cpu_s = (int(rest[11]) + int(rest[12])) / tick
+        except (IndexError, ValueError):
+            cpu_s = None
+        out.append({"pid": pid, "rss_bytes": process_rss(pid),
+                    "cpu_seconds": cpu_s})
+    return out
+
+
+def collect_stats(worker_pids: list[int] | None = None) -> dict:
+    """One reporter sample (the rpc_physical_stats payload)."""
+    return {
+        "timestamp": time.time(),
+        "hostname": os.uname().nodename,
+        "cpu_percent": cpu_percent(),
+        "cpus": os.cpu_count(),
+        "memory": memory_stats(),
+        "disk": disk_stats(),
+        "load_avg": load_avg(),
+        "workers": worker_stats(worker_pids or []),
+    }

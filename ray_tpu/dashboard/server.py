@@ -9,6 +9,8 @@ Routes (reference modules in parens — dashboard/modules/*):
     /api/workers            (reporter)
     /api/placement_groups   (state)
     /api/jobs               (job)
+    /api/reporter           per-node physical stats (reporter_agent)
+    /api/grafana_dashboard  importable Grafana JSON (dashboard factory)
     /api/cluster_status     (`ray status`)
     /api/memory             (`ray memory`)
     /api/timeline           chrome://tracing JSON (timeline)
@@ -67,7 +69,8 @@ class DashboardServer:
                           "/api/tasks", "/api/workers",
                           "/api/placement_groups", "/api/jobs",
                           "/api/serve", "/api/cluster_status",
-                          "/api/memory", "/api/timeline", "/metrics"]
+                          "/api/memory", "/api/timeline", "/api/reporter",
+                          "/api/grafana_dashboard", "/metrics"]
                 body = "<html><body><h2>ray_tpu dashboard</h2><ul>" + "".join(
                     f'<li><a href="{r}">{r}</a></li>' for r in routes
                 ) + "</ul></body></html>"
@@ -90,6 +93,14 @@ class DashboardServer:
                 payload = state.list_workers(address=self.address)
             elif path == "/api/placement_groups":
                 payload = state.list_placement_groups(address=self.address)
+            elif path == "/api/reporter":
+                payload = self._reporter()
+            elif path == "/api/grafana_dashboard":
+                from ray_tpu.dashboard.grafana import (
+                    generate_default_dashboard,
+                )
+
+                payload = generate_default_dashboard()
             elif path == "/api/jobs":
                 payload = self._jobs()
             elif path == "/api/serve":
@@ -104,6 +115,28 @@ class DashboardServer:
         except Exception as e:
             self._send(h, 500, json.dumps({"error": str(e)}).encode(),
                        "application/json")
+
+    def _reporter(self):
+        """One physical-stats row per alive node (head + per-node agent
+        view; the raylet is the agent — reporter_agent.py:296)."""
+        from ray_tpu._private.protocol import RpcClient
+        from ray_tpu.experimental.state.api import _gcs
+
+        rows = []
+        with _gcs(self.address) as call:
+            for n in call("get_nodes"):
+                if not n["Alive"]:
+                    continue
+                try:
+                    c = RpcClient((n["NodeManagerAddress"],
+                                   n["NodeManagerPort"]), timeout=5.0)
+                    try:
+                        rows.append(c.call("physical_stats"))
+                    finally:
+                        c.close()
+                except Exception:
+                    continue
+        return rows
 
     def _jobs(self):
         from ray_tpu.experimental.state.api import _gcs

@@ -14,6 +14,16 @@ from ray_tpu.tune.search import (  # noqa: F401
     randint,
     uniform,
 )
+from ray_tpu.tune.callback import Callback  # noqa: F401
+from ray_tpu.tune.logger import (  # noqa: F401
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    LoggerCallback,
+    MLflowLoggerCallback,
+    TBXLoggerCallback,
+    WandbLoggerCallback,
+)
+from ray_tpu.tune.syncer import SyncConfig, Syncer  # noqa: F401
 from ray_tpu.tune.trainable import Trainable  # noqa: F401
 from ray_tpu.tune.tuner import (  # noqa: F401
     ResultGrid,

@@ -1,0 +1,59 @@
+"""Tune user callbacks (reference: python/ray/tune/callback.py).
+
+Callbacks observe the experiment loop: RunConfig(callbacks=[...]) wires
+them into the TrialRunner, which invokes each hook synchronously on the
+driver. LoggerCallbacks (tune/logger.py here) build on this surface —
+exactly the reference's split between Callback and LoggerCallback.
+"""
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class Callback:
+    """Base class; override any subset of hooks. Hook failures are
+    logged, never fatal to the experiment (reference behavior)."""
+
+    def setup(self, experiment_dir: str | None):
+        """Called once before the first trial starts."""
+
+    def on_trial_start(self, iteration: int, trial):
+        pass
+
+    def on_trial_result(self, iteration: int, trial, result: dict):
+        pass
+
+    def on_checkpoint(self, iteration: int, trial, checkpoint_path: str):
+        pass
+
+    def on_trial_complete(self, iteration: int, trial):
+        pass
+
+    def on_trial_error(self, iteration: int, trial):
+        pass
+
+    def on_experiment_end(self, trials: list):
+        pass
+
+
+class _CallbackList:
+    """Fans hooks out to every callback, isolating failures."""
+
+    def __init__(self, callbacks):
+        self._callbacks = list(callbacks or [])
+
+    def __bool__(self):
+        return bool(self._callbacks)
+
+    def fire(self, hook: str, *args, **kwargs):
+        for cb in self._callbacks:
+            fn = getattr(cb, hook, None)
+            if fn is None:
+                continue
+            try:
+                fn(*args, **kwargs)
+            except Exception:
+                logger.warning("tune callback %s.%s failed",
+                               type(cb).__name__, hook, exc_info=True)

@@ -1,0 +1,233 @@
+"""Result logger callbacks (reference: python/ray/tune/logger/ —
+json.py, csv.py, tensorboardx.py, plus the W&B / MLflow integrations
+under air/integrations/).
+
+Each trial gets a logdir under the experiment directory; loggers write
+per-trial artifacts there as results stream in, so standard dashboards
+(TensorBoard pointed at the experiment dir) work out of the box. On a
+run without persistence (no name/storage_path), loggers no-op — there
+is nowhere durable to write.
+"""
+from __future__ import annotations
+
+import csv
+import json
+import numbers
+import os
+
+from ray_tpu.tune.callback import Callback
+
+
+class LoggerCallback(Callback):
+    """Per-trial file logger base (reference: logger.py LoggerCallback):
+    subclasses implement log_trial_start/result/end against an open
+    trial logdir."""
+
+    def __init__(self):
+        self._trial_dirs: dict[str, str] = {}
+
+    def setup(self, experiment_dir: str | None):
+        self._experiment_dir = experiment_dir
+
+    def _logdir(self, trial) -> str | None:
+        if getattr(self, "_experiment_dir", None) is None:
+            return None
+        d = self._trial_dirs.get(trial.trial_id)
+        if d is None:
+            d = os.path.join(self._experiment_dir, trial.trial_id)
+            os.makedirs(d, exist_ok=True)
+            self._trial_dirs[trial.trial_id] = d
+        return d
+
+    # subclass surface -----------------------------------------------------
+    def log_trial_start(self, trial, logdir: str):
+        pass
+
+    def log_trial_result(self, trial, logdir: str, result: dict):
+        pass
+
+    def log_trial_end(self, trial, logdir: str):
+        pass
+
+    # Callback plumbing ----------------------------------------------------
+    def on_trial_start(self, iteration: int, trial):
+        d = self._logdir(trial)
+        if d is not None:
+            self.log_trial_start(trial, d)
+
+    def on_trial_result(self, iteration: int, trial, result: dict):
+        d = self._logdir(trial)
+        if d is not None:
+            self.log_trial_result(trial, d, result)
+
+    def on_trial_complete(self, iteration: int, trial):
+        d = self._logdir(trial)
+        if d is not None:
+            self.log_trial_end(trial, d)
+
+    on_trial_error = on_trial_complete
+
+
+class JsonLoggerCallback(LoggerCallback):
+    """result.json: one JSON line per reported result (reference:
+    logger/json.py), plus params.json with the trial config."""
+
+    def log_trial_start(self, trial, logdir):
+        with open(os.path.join(logdir, "params.json"), "w") as f:
+            json.dump(_jsonable(trial.config), f)
+
+    def log_trial_result(self, trial, logdir, result):
+        with open(os.path.join(logdir, "result.json"), "a") as f:
+            f.write(json.dumps(_jsonable(result)) + "\n")
+
+
+class CSVLoggerCallback(LoggerCallback):
+    """progress.csv with a stable header union (reference: logger/csv.py
+    keys are fixed at first result; later unseen keys are dropped)."""
+
+    def __init__(self):
+        super().__init__()
+        self._fields: dict[str, list] = {}
+
+    def log_trial_result(self, trial, logdir, result):
+        flat = {k: v for k, v in result.items()
+                if isinstance(v, (numbers.Number, str, bool))}
+        path = os.path.join(logdir, "progress.csv")
+        fields = self._fields.get(trial.trial_id)
+        if fields is None:
+            fields = sorted(flat)
+            self._fields[trial.trial_id] = fields
+            with open(path, "w", newline="") as f:
+                csv.DictWriter(f, fieldnames=fields).writeheader()
+        with open(path, "a", newline="") as f:
+            csv.DictWriter(f, fieldnames=fields,
+                           extrasaction="ignore").writerow(flat)
+
+
+class TBXLoggerCallback(LoggerCallback):
+    """TensorBoard events via torch.utils.tensorboard (the torch CPU
+    wheel ships a SummaryWriter; reference: logger/tensorboardx.py).
+    Point `tensorboard --logdir <experiment_dir>` at the run."""
+
+    def __init__(self):
+        super().__init__()
+        self._writers: dict[str, object] = {}
+
+    def log_trial_start(self, trial, logdir):
+        from torch.utils.tensorboard import SummaryWriter
+
+        self._writers[trial.trial_id] = SummaryWriter(log_dir=logdir)
+
+    def log_trial_result(self, trial, logdir, result):
+        w = self._writers.get(trial.trial_id)
+        if w is None:
+            self.log_trial_start(trial, logdir)
+            w = self._writers[trial.trial_id]
+        step = int(result.get("training_iteration", 0))
+        for k, v in result.items():
+            if isinstance(v, numbers.Number) and not isinstance(v, bool):
+                w.add_scalar(k, float(v), global_step=step)
+        w.flush()
+
+    def log_trial_end(self, trial, logdir):
+        w = self._writers.pop(trial.trial_id, None)
+        if w is not None:
+            w.close()
+
+
+class WandbLoggerCallback(LoggerCallback):
+    """Weights & Biases streaming (reference:
+    air/integrations/wandb.py). Requires the `wandb` package; raises at
+    construction when absent so a misconfigured experiment fails before
+    burning trial compute."""
+
+    def __init__(self, project: str, **init_kwargs):
+        super().__init__()
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "WandbLoggerCallback requires the `wandb` package "
+                "(not bundled with ray_tpu)") from e
+        self._project = project
+        self._init_kwargs = init_kwargs
+        self._runs: dict[str, object] = {}
+
+    def log_trial_start(self, trial, logdir):
+        import wandb
+
+        self._runs[trial.trial_id] = wandb.init(
+            project=self._project, name=trial.trial_id,
+            config=trial.config, dir=logdir, reinit=True,
+            **self._init_kwargs)
+
+    def log_trial_result(self, trial, logdir, result):
+        run = self._runs.get(trial.trial_id)
+        if run is not None:
+            run.log({k: v for k, v in result.items()
+                     if isinstance(v, numbers.Number)})
+
+    def log_trial_end(self, trial, logdir):
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish()
+
+
+class MLflowLoggerCallback(LoggerCallback):
+    """MLflow tracking (reference: air/integrations/mlflow.py). Requires
+    the `mlflow` package; raises at construction when absent."""
+
+    def __init__(self, tracking_uri: str | None = None,
+                 experiment_name: str = "ray_tpu"):
+        super().__init__()
+        try:
+            import mlflow  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "MLflowLoggerCallback requires the `mlflow` package "
+                "(not bundled with ray_tpu)") from e
+        self._tracking_uri = tracking_uri
+        self._experiment_name = experiment_name
+        self._runs: dict[str, object] = {}
+
+    def log_trial_start(self, trial, logdir):
+        import mlflow
+
+        if self._tracking_uri:
+            mlflow.set_tracking_uri(self._tracking_uri)
+        mlflow.set_experiment(self._experiment_name)
+        run = mlflow.start_run(run_name=trial.trial_id, nested=True)
+        self._runs[trial.trial_id] = run
+        mlflow.log_params({k: v for k, v in (trial.config or {}).items()
+                           if isinstance(v, (numbers.Number, str, bool))})
+
+    def log_trial_result(self, trial, logdir, result):
+        import mlflow
+
+        if trial.trial_id in self._runs:
+            step = int(result.get("training_iteration", 0))
+            mlflow.log_metrics(
+                {k: float(v) for k, v in result.items()
+                 if isinstance(v, numbers.Number)
+                 and not isinstance(v, bool)}, step=step)
+
+    def log_trial_end(self, trial, logdir):
+        import mlflow
+
+        if self._runs.pop(trial.trial_id, None) is not None:
+            mlflow.end_run()
+
+
+DEFAULT_LOGGERS = (JsonLoggerCallback, CSVLoggerCallback)
+
+
+def _jsonable(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {str(k): _jsonable(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [_jsonable(v) for v in obj]
+        return repr(obj)

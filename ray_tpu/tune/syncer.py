@@ -1,0 +1,83 @@
+"""Experiment/checkpoint sync to external storage (reference:
+python/ray/tune/syncer.py — checkpoints and experiment state mirror to
+`storage_path` so a head-node loss doesn't lose the run).
+
+`RunConfig(storage_path="file:///bucket/exp")` (any URI with a scheme)
+makes the runner stage locally and mirror incrementally through a
+Syncer after every checkpoint/state save. `file://` ships built in —
+the scheme-to-implementation seam is what a real object-store syncer
+(gcsfuse path, rsync, boto) plugs into via SyncConfig(syncer=...);
+plain local paths never sync (the storage IS the experiment dir)."""
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass
+
+
+class Syncer:
+    """Mirror a local directory tree to a destination URI."""
+
+    def sync_up(self, local_dir: str, remote_uri: str):
+        raise NotImplementedError
+
+    def sync_down(self, remote_uri: str, local_dir: str):
+        raise NotImplementedError
+
+
+class _FileSyncer(Syncer):
+    """file:// destination: incremental copy by (size, mtime) — the
+    local-filesystem stand-in for an object-store syncer."""
+
+    @staticmethod
+    def _resolve(uri: str) -> str:
+        assert uri.startswith("file://"), uri
+        return uri[len("file://"):]
+
+    def sync_up(self, local_dir: str, remote_uri: str):
+        self._mirror(local_dir, self._resolve(remote_uri))
+
+    def sync_down(self, remote_uri: str, local_dir: str):
+        self._mirror(self._resolve(remote_uri), local_dir)
+
+    @staticmethod
+    def _mirror(src: str, dst: str):
+        for root, _dirs, files in os.walk(src):
+            rel = os.path.relpath(root, src)
+            out_dir = os.path.join(dst, rel) if rel != "." else dst
+            os.makedirs(out_dir, exist_ok=True)
+            for name in files:
+                s = os.path.join(root, name)
+                d = os.path.join(out_dir, name)
+                try:
+                    st_s = os.stat(s)
+                    if (os.path.exists(d)
+                            and os.path.getsize(d) == st_s.st_size
+                            and os.path.getmtime(d) >= st_s.st_mtime):
+                        continue
+                    shutil.copy2(s, d)
+                except OSError:
+                    continue   # file vanished mid-sync (tmp renames)
+
+
+@dataclass
+class SyncConfig:
+    """RunConfig.sync_config (reference: tune/syncer.py SyncConfig)."""
+
+    syncer: Syncer | None = None       # None = pick by URI scheme
+    sync_period_s: float = 300.0       # periodic safety net
+
+
+def get_syncer(storage_path: str | None,
+               config: SyncConfig | None) -> tuple[Syncer | None, str | None]:
+    """(syncer, remote_uri) for a storage path — (None, None) when the
+    path is local (no sync needed)."""
+    if not storage_path or "://" not in storage_path:
+        return None, None
+    if config is not None and config.syncer is not None:
+        return config.syncer, storage_path
+    if storage_path.startswith("file://"):
+        return _FileSyncer(), storage_path
+    raise ValueError(
+        f"no syncer for {storage_path!r}: pass "
+        f"RunConfig(sync_config=SyncConfig(syncer=...)) for this scheme")

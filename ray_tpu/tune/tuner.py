@@ -117,15 +117,43 @@ class TrialRunner:
         # tune/execution/experiment_state.py): enabled when the run is named
         # or given a storage path
         self.experiment_dir = None
+        self._syncer = None
+        self._sync_uri = None
         if run_config.name or run_config.storage_path:
             import os
 
-            root = run_config.storage_path or os.path.expanduser(
-                "~/.ray_tpu/results")
-            self.experiment_dir = os.path.join(
-                root, run_config.name or "experiment")
+            from ray_tpu.tune.syncer import get_syncer
+
+            storage = run_config.storage_path
+            self._syncer, remote_root = get_syncer(
+                storage, run_config.sync_config)
+            if self._syncer is not None:
+                # remote storage: stage locally, mirror after every
+                # checkpoint/state save (reference: tune/syncer.py)
+                root = os.path.expanduser("~/.ray_tpu/results")
+            else:
+                root = storage or os.path.expanduser("~/.ray_tpu/results")
+            name = run_config.name or "experiment"
+            self.experiment_dir = os.path.join(root, name)
             os.makedirs(self.experiment_dir, exist_ok=True)
+            if self._syncer is not None:
+                self._sync_uri = remote_root.rstrip("/") + "/" + name
         self._ckpt_managers: dict = {}
+        from ray_tpu.tune.callback import _CallbackList
+
+        self.callbacks = _CallbackList(run_config.callbacks)
+        self.callbacks.fire("setup", self.experiment_dir)
+
+    def _sync_up(self):
+        if self._syncer is not None:
+            try:
+                self._syncer.sync_up(self.experiment_dir, self._sync_uri)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "experiment sync to %s failed", self._sync_uri,
+                    exc_info=True)
 
     def _should_stop(self, metrics: dict) -> bool:
         for key, bound in (self.run_config.stop or {}).items():
@@ -152,6 +180,8 @@ class TrialRunner:
             self._ckpt_managers[trial.trial_id] = cm
         path = cm.on_checkpoint(checkpoint, metrics, trial.iteration)
         trial.latest_checkpoint = Checkpoint.from_directory(path)
+        self.callbacks.fire("on_checkpoint", trial.iteration, trial, path)
+        self._sync_up()
 
     def save_experiment_state(self):
         if self.experiment_dir is None:
@@ -175,6 +205,7 @@ class TrialRunner:
             json.dump(state, f)
         os.replace(tmp, os.path.join(self.experiment_dir,
                                      "experiment_state.json"))
+        self._sync_up()
 
     def _notify_searcher(self, trial: Trial):
         searcher = self.tune_config.search_alg
@@ -248,12 +279,17 @@ class TrialRunner:
                     self._stop_actor(trial)
                     active.remove(trial)
                     self._notify_searcher(trial)
+                    self.callbacks.fire(
+                        "on_trial_error" if row.get("error")
+                        else "on_trial_complete", trial.iteration, trial)
                     self.save_experiment_state()
                     continue
                 trial.iteration = row.get("iteration", trial.iteration + 1)
                 metrics = dict(row["metrics"])
                 metrics.setdefault("training_iteration", trial.iteration)
                 trial.results.append(metrics)
+                self.callbacks.fire("on_trial_result", trial.iteration,
+                                    trial, metrics)
                 if searcher is not None:
                     try:
                         searcher.on_trial_result(trial.trial_id, metrics)
@@ -271,6 +307,8 @@ class TrialRunner:
                     self._stop_actor(trial)
                     active.remove(trial)
                     self._notify_searcher(trial)
+                    self.callbacks.fire("on_trial_complete",
+                                        trial.iteration, trial)
                     self.save_experiment_state()
                     continue
                 decision = self.scheduler.on_result(trial, metrics, self)
@@ -279,6 +317,8 @@ class TrialRunner:
                     self._stop_actor(trial)
                     active.remove(trial)
                     self._notify_searcher(trial)
+                    self.callbacks.fire("on_trial_complete",
+                                        trial.iteration, trial)
                 self.save_experiment_state()
             for trial, source, new_config in self._pending_exploits:
                 if trial in active:
@@ -290,6 +330,8 @@ class TrialRunner:
             self._pending_exploits.clear()
             if not progressed:
                 time.sleep(0.05)
+        self.callbacks.fire("on_experiment_end", self.trials)
+        self._sync_up()
         return self.trials
 
     def _start_trial(self, trial: Trial, resume=None):
@@ -324,6 +366,7 @@ class TrialRunner:
             resume if resume is not None else trial.latest_checkpoint)
         trial.status = "RUNNING"
         trial._pending = trial.actor.next_result.remote()
+        self.callbacks.fire("on_trial_start", trial.iteration, trial)
 
     def _poll(self, trial: Trial):
         ready, _ = ray_tpu.wait([trial._pending], num_returns=1, timeout=0.01)

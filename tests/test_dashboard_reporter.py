@@ -1,0 +1,80 @@
+"""Dashboard reporter-agent + Grafana factory tests (reference:
+dashboard/modules/reporter/reporter_agent.py,
+dashboard/modules/metrics/grafana_dashboard_factory.py)."""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+
+def test_collect_stats_shape():
+    from ray_tpu.dashboard.reporter import collect_stats, cpu_percent
+
+    cpu_percent()            # prime the interval
+    time.sleep(0.2)
+    s = collect_stats([os.getpid()])
+    assert s["cpus"] >= 1
+    assert 0 < s["memory"]["used_bytes"] <= s["memory"]["total_bytes"]
+    assert s["disk"]["total_bytes"] > 0
+    assert s["workers"] and s["workers"][0]["rss_bytes"] > 0
+    assert s["workers"][0]["cpu_seconds"] is not None
+    # dead pid rows are dropped, not fabricated
+    assert collect_stats([99999999])["workers"] == []
+
+
+def test_grafana_dashboard_importable_json(tmp_path):
+    from ray_tpu.dashboard.grafana import (
+        generate_default_dashboard,
+        save_default_dashboard,
+    )
+
+    d = generate_default_dashboard(datasource="prom-ds")
+    assert d["uid"] and len(d["panels"]) >= 8
+    for p in d["panels"]:
+        assert p["targets"][0]["expr"]
+        assert p["datasource"] == "prom-ds"
+    path = save_default_dashboard(str(tmp_path / "dash.json"))
+    reloaded = json.load(open(path))
+    assert reloaded["title"] == "ray_tpu"
+
+
+def test_reporter_route_aggregates_nodes(ray_start_regular):
+    """/api/reporter returns one physical-stats row per alive node,
+    including per-worker RSS (the head + per-node agent view)."""
+    import ray_tpu
+    from ray_tpu._private.worker_runtime import current_worker
+    from ray_tpu.dashboard.server import DashboardServer
+
+    # a worker must exist so the per-worker table is non-trivial
+    @ray_tpu.remote
+    def touch():
+        return os.getpid()
+
+    wpid = ray_tpu.get(touch.remote(), timeout=60)
+    gcs = current_worker().gcs.addr
+    dash = DashboardServer(f"{gcs[0]}:{gcs[1]}", port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/api/reporter",
+                timeout=30) as resp:
+            rows = json.loads(resp.read())
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["memory"]["total_bytes"] > 0
+        pids = [w["pid"] for w in row["workers"]]
+        assert wpid in pids, (wpid, pids)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/api/grafana_dashboard",
+                timeout=30) as resp:
+            dash_json = json.loads(resp.read())
+        assert dash_json["uid"] == "ray-tpu-default"
+    finally:
+        dash.stop()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v", "-x"]))

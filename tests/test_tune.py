@@ -61,9 +61,18 @@ def test_asha_stops_bad_trials(ray_start_regular):
     from ray_tpu import tune
 
     def objective(config):
+        import time as _time
+
         from ray_tpu.air import session
 
         for step in range(20):
+            # pace the steps: ASHA can only stop a trial it observes
+            # RUNNING alongside its bracket peers — an instant 20-step
+            # burst finishes before late-starting peers report (trial
+            # starts serialize behind the worker-startup gate, ~0.5 s
+            # per trial on this 1-core host, so each trial must span
+            # several seconds to guarantee overlap)
+            _time.sleep(0.2)
             session.report({"acc": config["quality"] * (step + 1)})
 
     sched = tune.AsyncHyperBandScheduler(metric="acc", mode="max",

@@ -1,0 +1,135 @@
+"""Tune callback / logger / syncer tests (reference: tune/callback.py,
+tune/logger/, tune/syncer.py)."""
+import csv
+import json
+import os
+
+import pytest
+
+
+def _trainable(config):
+    from ray_tpu import tune as _  # noqa: F401  (session import parity)
+    from ray_tpu.air import session
+    from ray_tpu.air.checkpoint import Checkpoint
+
+    for i in range(3):
+        session.report(
+            {"score": config["x"] * (i + 1)},
+            checkpoint=Checkpoint.from_dict({"iter": i}) if i == 2
+            else None)
+
+
+def test_callbacks_fire_in_order(ray_start_regular):
+    from ray_tpu import tune
+    from ray_tpu.air import RunConfig
+
+    events = []
+
+    class Recorder(tune.Callback):
+        def setup(self, experiment_dir):
+            events.append(("setup", experiment_dir))
+
+        def on_trial_start(self, iteration, trial):
+            events.append(("start", trial.trial_id))
+
+        def on_trial_result(self, iteration, trial, result):
+            events.append(("result", result["score"]))
+
+        def on_checkpoint(self, iteration, trial, checkpoint_path):
+            events.append(("checkpoint", os.path.basename(checkpoint_path)))
+
+        def on_trial_complete(self, iteration, trial):
+            events.append(("complete", trial.trial_id))
+
+        def on_experiment_end(self, trials):
+            events.append(("end", len(trials)))
+
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"x": 2},
+        run_config=RunConfig(name="cb_exp", callbacks=[Recorder()]),
+    )
+    tuner.fit()
+    kinds = [e[0] for e in events]
+    assert kinds[0] == "setup"
+    assert kinds.index("start") < kinds.index("result")
+    assert [e[1] for e in events if e[0] == "result"] == [2, 4, 6]
+    assert "checkpoint" in kinds
+    assert kinds.index("complete") < kinds.index("end")
+    assert events[-1] == ("end", 1)
+
+
+def test_json_csv_tbx_loggers_write_artifacts(ray_start_regular, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.air import RunConfig
+
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1, 3])},
+        run_config=RunConfig(
+            name="log_exp", storage_path=str(tmp_path),
+            callbacks=[tune.JsonLoggerCallback(),
+                       tune.CSVLoggerCallback(),
+                       tune.TBXLoggerCallback()]),
+    )
+    results = tuner.fit()
+    exp = tmp_path / "log_exp"
+    trial_dirs = [d for d in exp.iterdir()
+                  if d.is_dir() and (d / "result.json").exists()]
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        lines = [json.loads(ln) for ln in
+                 (d / "result.json").read_text().splitlines()]
+        assert len(lines) == 3 and "score" in lines[0]
+        params = json.loads((d / "params.json").read_text())
+        assert params["x"] in (1, 3)
+        with open(d / "progress.csv") as f:
+            rows = list(csv.DictReader(f))
+        assert len(rows) == 3 and "score" in rows[0]
+        assert any(p.name.startswith("events.out.tfevents")
+                   for p in d.iterdir()), "no tensorboard events file"
+    assert len(results) == 2
+
+
+def test_storage_uri_syncs_experiment(ray_start_regular, tmp_path):
+    """storage_path with a scheme stages locally and mirrors everything
+    (state, checkpoints, logger artifacts) to the destination."""
+    from ray_tpu import tune
+    from ray_tpu.air import RunConfig
+
+    bucket = tmp_path / "bucket"
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"x": 5},
+        run_config=RunConfig(
+            name="sync_exp", storage_path=f"file://{bucket}",
+            callbacks=[tune.JsonLoggerCallback()]),
+    )
+    tuner.fit()
+    exp = bucket / "sync_exp"
+    assert (exp / "experiment_state.json").exists()
+    trial_dirs = [d for d in exp.iterdir() if d.is_dir()]
+    assert trial_dirs, "no trial artifacts synced"
+    assert any((d / "result.json").exists() for d in trial_dirs)
+    # a checkpoint directory made it across too
+    found_ckpt = any(
+        p.name.startswith("checkpoint") for d in trial_dirs
+        for p in d.iterdir() if d.is_dir())
+    assert found_ckpt, [list(d.iterdir()) for d in trial_dirs]
+
+
+def test_unknown_scheme_fails_loudly(ray_start_regular, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.air import RunConfig
+
+    with pytest.raises(ValueError, match="no syncer"):
+        tune.Tuner(
+            _trainable, param_space={"x": 1},
+            run_config=RunConfig(name="bad", storage_path="s3://nope"),
+        ).fit()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v", "-x"]))
