@@ -438,6 +438,7 @@ class _ActorQueue:
         deadline = time.time() + timeout
         poll = 0.05
         while True:
+            synthetic = False
             try:
                 info = self.worker.gcs.call("get_actor",
                                             actor_id=self.actor_id)
@@ -445,8 +446,11 @@ class _ActorQueue:
                 # GCS overloaded (e.g. hundreds of actors creating at
                 # once): a transient RPC timeout is not a verdict on the
                 # actor — back off and re-poll instead of killing this
-                # submit thread (which would strand its queued call)
+                # submit thread (which would strand its queued call).
+                # SYNTHETIC pending: must not extend the deadline, or a
+                # permanently-dead GCS would spin this thread forever.
                 info = {"state": "PENDING_CREATION", "addr": None}
+                synthetic = True
             if info is None:
                 raise exc.ActorDiedError(self.actor_id.hex(),
                                          "actor not found")
@@ -467,7 +471,7 @@ class _ActorQueue:
                         self.client = c
                         self.addr = tuple(info["addr"])
                         return c
-            if info["state"] == "PENDING_CREATION":
+            if info["state"] == "PENDING_CREATION" and not synthetic:
                 deadline = time.time() + timeout   # not a failure: queued
             elif time.time() > deadline:
                 raise exc.GetTimeoutError(

@@ -118,25 +118,35 @@ class DashboardServer:
 
     def _reporter(self):
         """One physical-stats row per alive node (head + per-node agent
-        view; the raylet is the agent — reporter_agent.py:296)."""
+        view; the raylet is the agent — reporter_agent.py:296). Nodes
+        are polled CONCURRENTLY: response latency is the slowest node
+        (≤5 s), not the sum — a few flapping nodes must not stall the
+        dashboard for their combined timeouts."""
+        from concurrent.futures import ThreadPoolExecutor
+
         from ray_tpu._private.protocol import RpcClient
         from ray_tpu.experimental.state.api import _gcs
 
-        rows = []
         with _gcs(self.address) as call:
-            for n in call("get_nodes"):
-                if not n["Alive"]:
-                    continue
+            nodes = [n for n in call("get_nodes") if n["Alive"]]
+
+        def _poll(n):
+            try:
+                c = RpcClient((n["NodeManagerAddress"],
+                               n["NodeManagerPort"]), timeout=5.0,
+                              retry=1)
                 try:
-                    c = RpcClient((n["NodeManagerAddress"],
-                                   n["NodeManagerPort"]), timeout=5.0)
-                    try:
-                        rows.append(c.call("physical_stats"))
-                    finally:
-                        c.close()
-                except Exception:
-                    continue
-        return rows
+                    return c.call("physical_stats", timeout=5.0)
+                finally:
+                    c.close()
+            except Exception:
+                return None
+
+        if not nodes:
+            return []
+        with ThreadPoolExecutor(max_workers=min(16, len(nodes))) as pool:
+            rows = list(pool.map(_poll, nodes))
+        return [r for r in rows if r is not None]
 
     def _jobs(self):
         from ray_tpu.experimental.state.api import _gcs

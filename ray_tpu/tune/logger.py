@@ -116,6 +116,9 @@ class TBXLoggerCallback(LoggerCallback):
     def log_trial_start(self, trial, logdir):
         from torch.utils.tensorboard import SummaryWriter
 
+        old = self._writers.pop(trial.trial_id, None)
+        if old is not None:   # trial restart (PBT exploit): close cleanly
+            old.close()
         self._writers[trial.trial_id] = SummaryWriter(log_dir=logdir)
 
     def log_trial_result(self, trial, logdir, result):
@@ -156,6 +159,9 @@ class WandbLoggerCallback(LoggerCallback):
     def log_trial_start(self, trial, logdir):
         import wandb
 
+        old = self._runs.pop(trial.trial_id, None)
+        if old is not None:   # trial restart: finish the previous run
+            old.finish()
         self._runs[trial.trial_id] = wandb.init(
             project=self._project, name=trial.trial_id,
             config=trial.config, dir=logdir, reinit=True,

@@ -144,16 +144,28 @@ class TrialRunner:
         self.callbacks = _CallbackList(run_config.callbacks)
         self.callbacks.fire("setup", self.experiment_dir)
 
-    def _sync_up(self):
-        if self._syncer is not None:
-            try:
-                self._syncer.sync_up(self.experiment_dir, self._sync_uri)
-            except Exception:
-                import logging
+    def _sync_up(self, force: bool = False):
+        """Mirror the experiment tree. force=True (checkpoints, end of
+        run — durability moments) syncs immediately; routine state saves
+        are throttled by SyncConfig.sync_period_s so a busy poll loop
+        doesn't walk the whole tree per reported result."""
+        if self._syncer is None:
+            return
+        if not force:
+            period = getattr(self.run_config.sync_config, "sync_period_s",
+                             300.0) if self.run_config.sync_config else 300.0
+            last = getattr(self, "_last_sync", 0.0)
+            if time.monotonic() - last < period:
+                return
+        try:
+            self._syncer.sync_up(self.experiment_dir, self._sync_uri)
+            self._last_sync = time.monotonic()
+        except Exception:
+            import logging
 
-                logging.getLogger(__name__).warning(
-                    "experiment sync to %s failed", self._sync_uri,
-                    exc_info=True)
+            logging.getLogger(__name__).warning(
+                "experiment sync to %s failed", self._sync_uri,
+                exc_info=True)
 
     def _should_stop(self, metrics: dict) -> bool:
         for key, bound in (self.run_config.stop or {}).items():
@@ -181,7 +193,7 @@ class TrialRunner:
         path = cm.on_checkpoint(checkpoint, metrics, trial.iteration)
         trial.latest_checkpoint = Checkpoint.from_directory(path)
         self.callbacks.fire("on_checkpoint", trial.iteration, trial, path)
-        self._sync_up()
+        self._sync_up(force=True)
 
     def save_experiment_state(self):
         if self.experiment_dir is None:
@@ -331,7 +343,7 @@ class TrialRunner:
             if not progressed:
                 time.sleep(0.05)
         self.callbacks.fire("on_experiment_end", self.trials)
-        self._sync_up()
+        self._sync_up(force=True)
         return self.trials
 
     def _start_trial(self, trial: Trial, resume=None):
