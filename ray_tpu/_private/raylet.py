@@ -1065,6 +1065,9 @@ class Raylet:
     def rpc_profile_events(self, conn):
         return self._fanout_workers("profile_events")
 
+    def rpc_trace_spans(self, conn):
+        return self._fanout_workers("trace_spans")
+
     def rpc_metrics_snapshot(self, conn):
         return self._fanout_workers("metrics_snapshot")
 

@@ -1438,6 +1438,11 @@ class CoreWorker:
 
         return profiling.snapshot()
 
+    def rpc_trace_spans(self, conn):
+        from ray_tpu.util import tracing
+
+        return tracing.local_spans()
+
     def rpc_metrics_snapshot(self, conn):
         from ray_tpu.util import metrics
 
@@ -1568,24 +1573,27 @@ class CoreWorker:
         }
         if runtime_env:
             spec["runtime_env"] = runtime_env
-        self._pin_args(spec, args, kwargs)
-        self._owned.update(return_ids)
-        refs = [ObjectRef(rid, self.addr, self) for rid in return_ids]
-        for rid in return_ids:
-            self.memory_store.entry(rid)  # pre-create pending futures
-        # runtime_env joins the scheduling key: workers apply an env once
-        # and keep it (reference: envs bind to dedicated workers), so
-        # different envs must not share leases
-        key = (func_hash, tuple(sorted(resources.items())),
-               _freeze(strategy), _freeze(runtime_env))
-        with self._lock:
-            q = self._sched_queues.get(key)
-            if q is None:
-                q = _SchedulingKeyQueue(self, key, resources, strategy)
-                self._sched_queues[key] = q
+        from ray_tpu.util import tracing
+
+        with tracing.submit_span(spec, task_desc):
+            self._pin_args(spec, args, kwargs)
+            self._owned.update(return_ids)
+            refs = [ObjectRef(rid, self.addr, self) for rid in return_ids]
             for rid in return_ids:
-                self._ref_to_task[rid] = (spec, q)
-        q.submit(spec)
+                self.memory_store.entry(rid)  # pre-create pending futures
+            # runtime_env joins the scheduling key: workers apply an env
+            # once and keep it (reference: envs bind to dedicated
+            # workers), so different envs must not share leases
+            key = (func_hash, tuple(sorted(resources.items())),
+                   _freeze(strategy), _freeze(runtime_env))
+            with self._lock:
+                q = self._sched_queues.get(key)
+                if q is None:
+                    q = _SchedulingKeyQueue(self, key, resources, strategy)
+                    self._sched_queues[key] = q
+                for rid in return_ids:
+                    self._ref_to_task[rid] = (spec, q)
+            q.submit(spec)
         return refs
 
     def _inline_small_args(self, args, kwargs):
@@ -1821,18 +1829,22 @@ class CoreWorker:
             "task_desc": task_desc or f"actor method {method_name}",
             "job_id": self.job_id,
         }
-        self._pin_args(spec, args, kwargs)
-        self._owned.update(return_ids)
-        refs = [ObjectRef(rid, self.addr, self) for rid in return_ids]
-        for rid in return_ids:
-            self.memory_store.entry(rid)
-        with self._lock:
-            q = self._actor_queues.get(actor_id)
-            if q is None:
-                q = _ActorQueue(self, actor_id, {})
-                self._actor_queues[actor_id] = q
-        q.assign_seq(spec)   # in submission order, before going async
-        threading.Thread(target=q.submit, args=(spec,), daemon=True).start()
+        from ray_tpu.util import tracing
+
+        with tracing.submit_span(spec, spec["task_desc"]):
+            self._pin_args(spec, args, kwargs)
+            self._owned.update(return_ids)
+            refs = [ObjectRef(rid, self.addr, self) for rid in return_ids]
+            for rid in return_ids:
+                self.memory_store.entry(rid)
+            with self._lock:
+                q = self._actor_queues.get(actor_id)
+                if q is None:
+                    q = _ActorQueue(self, actor_id, {})
+                    self._actor_queues[actor_id] = q
+            q.assign_seq(spec)   # in submission order, before going async
+            threading.Thread(target=q.submit, args=(spec,),
+                             daemon=True).start()
         return refs
 
     # ----------------------------------------------------- execution (worker)
@@ -1956,8 +1968,16 @@ class CoreWorker:
             from ray_tpu._private.profiling import record_span
 
             try:
+                from ray_tpu.util import tracing
+
+                # tracing.span no-ops when no ctx arrived and tracing is
+                # off in this process — no guard needed
                 with record_span("task", spec.get("task_desc", "task"),
-                                 {"task_id": task_id.hex()}):
+                                 {"task_id": task_id.hex()}), \
+                     tracing.span(
+                         f"execute {spec.get('task_desc', 'task')}",
+                         "CONSUMER", spec.get("trace_ctx"),
+                         {"task_id": task_id.hex()}):
                     self._apply_runtime_env(spec.get("runtime_env"))
                     fn = self._load_function(spec["func_hash"])
                     args, kwargs = self._resolve_args(spec)
@@ -2053,12 +2073,18 @@ class CoreWorker:
             acquired = True
             from ray_tpu._private.profiling import record_span
 
+            from ray_tpu.util import tracing
+
             try:
                 with record_span(
                         "actor_task",
                         spec.get("task_desc", f"actor.{method_name}"),
                         {"actor_id": (self.actor_id.hex()
-                                      if self.actor_id else "")}):
+                                      if self.actor_id else "")}), \
+                     tracing.span(
+                         f"execute {spec.get('task_desc', method_name)}",
+                         "CONSUMER", spec.get("trace_ctx"),
+                         {"task_id": spec["task_id"].hex()}):
                     if inspect.iscoroutinefunction(method):
                         fut = asyncio.run_coroutine_threadsafe(
                             method(*args, **kwargs),

@@ -1,0 +1,221 @@
+"""Distributed tracing: spans propagated through task/actor calls.
+
+Reference: python/ray/util/tracing/tracing_helper.py:290 — Ray injects
+OpenTelemetry spans through the TaskSpec so a driver's trace continues
+inside remote execution (submit span on the caller, execute span on the
+worker, linked by parent ids). The OpenTelemetry SDK is not bundled
+here, so this module implements the same propagation natively with
+W3C-trace-context-shaped ids (128-bit trace id, 64-bit span ids) and
+exports OTLP-shaped JSON any collector/Jaeger can ingest — plugging the
+real SDK in later is a TracerProvider swap, not a redesign.
+
+Usage::
+
+    from ray_tpu.util import tracing
+    tracing.enable()
+    ray_tpu.get(f.remote())          # spans recorded on every hop
+    spans = tracing.get_spans()      # cluster-wide fan-out
+    tracing.export_otlp_json(spans, "trace.json")
+
+Propagation is implicit once a context exists: a worker executing a
+traced task records spans (and propagates to nested submissions) even
+if it never called enable() itself — exactly the reference's behavior
+where the TaskSpec carries the context.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+
+_MAX_SPANS = 10_000
+
+_lock = threading.Lock()
+_spans: collections.deque = collections.deque(maxlen=_MAX_SPANS)
+_enabled = False
+
+# the active span for THIS logical execution context (task body, driver
+# code path); contextvars keep concurrent actor calls separate
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None)
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled or _current.get() is not None
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def current_context() -> dict | None:
+    """{"trace_id", "span_id"} of the active span, or None."""
+    return _current.get()
+
+
+def inject_context() -> dict | None:
+    """Context to attach to an outgoing task/actor spec. Starts a new
+    trace at the root when tracing is enabled and no span is active."""
+    ctx = _current.get()
+    if ctx is not None:
+        return {"trace_id": ctx["trace_id"],
+                "parent_span_id": ctx["span_id"]}
+    if _enabled:
+        return {"trace_id": _new_id(16), "parent_span_id": None}
+    return None
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str, ctx: dict | None = None,
+         attributes: dict | None = None):
+    """Record one span. `ctx` (an injected context) links the span into
+    an existing trace; otherwise it continues the current one."""
+    if ctx is None:
+        inherited = _current.get()
+        if inherited is None:
+            if not _enabled:
+                yield None
+                return
+            trace_id, parent = _new_id(16), None
+        else:
+            trace_id, parent = inherited["trace_id"], inherited["span_id"]
+    else:
+        trace_id = ctx["trace_id"]
+        parent = ctx.get("parent_span_id")
+    span_id = _new_id(8)
+    token = _current.set({"trace_id": trace_id, "span_id": span_id})
+    start = time.time_ns()
+    try:
+        yield {"trace_id": trace_id, "span_id": span_id}
+    finally:
+        end = time.time_ns()
+        _current.reset(token)
+        with _lock:
+            _spans.append({
+                "traceId": trace_id,
+                "spanId": span_id,
+                "parentSpanId": parent,
+                "name": name,
+                "kind": kind,                # "PRODUCER"/"CONSUMER"/...
+                "startTimeUnixNano": start,
+                "endTimeUnixNano": end,
+                "pid": os.getpid(),
+                # pids collide across hosts; (node, pid) identifies the
+                # producing process cluster-wide
+                "node": os.uname().nodename,
+                "attributes": attributes or {},
+            })
+
+
+def submit_span(spec: dict, name: str):
+    """Context manager for an outgoing task/actor submission: opens the
+    PRODUCER span (enclosing the submission work — arg pinning, queue
+    handoff — so its duration is meaningful), and injects the context
+    into ``spec["trace_ctx"]`` so the remote execute span becomes its
+    child. No-op (null context) when tracing is inactive. One helper so
+    task and actor submission can't drift apart."""
+    ctx = inject_context()
+    if ctx is None:
+        return contextlib.nullcontext()
+
+    @contextlib.contextmanager
+    def _cm():
+        with span(f"submit {name}", "PRODUCER", ctx,
+                  {"task_id": spec["task_id"].hex()}) as sp:
+            spec["trace_ctx"] = {"trace_id": sp["trace_id"],
+                                 "parent_span_id": sp["span_id"]}
+            yield sp
+
+    return _cm()
+
+
+def local_spans() -> list[dict]:
+    with _lock:
+        return list(_spans)
+
+
+def clear():
+    with _lock:
+        _spans.clear()
+
+
+def get_spans(address: str | None = None) -> list[dict]:
+    """Cluster-wide span collection: driver-local spans plus a fan-out
+    over every raylet's workers (the same plumbing as `timeline()`)."""
+    out = local_spans()
+    try:
+        from ray_tpu.experimental.state.api import _each_raylet, _gcs
+
+        with _gcs(address) as call:
+            out.extend(_each_raylet(call, "trace_spans"))
+    except Exception:
+        # a partial trace must not masquerade as a complete one
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "cluster span fan-out failed; returning driver-local spans "
+            "only", exc_info=True)
+    # the driver's own worker also answers the fan-out — dedup by span id
+    seen, deduped = set(), []
+    for s in out:
+        if s["spanId"] in seen:
+            continue
+        seen.add(s["spanId"])
+        deduped.append(s)
+    return deduped
+
+
+def export_otlp_json(spans: list[dict], path: str) -> str:
+    """OTLP/JSON export (the shape `otelcol`'s file receiver and Jaeger's
+    OTLP ingestion accept): one resourceSpans entry per producing
+    (node, pid) — pid alone collides across hosts."""
+    by_proc: dict[tuple, list] = {}
+    for s in spans:
+        by_proc.setdefault((s.get("node", ""), s.get("pid", 0)),
+                           []).append(s)
+    doc = {"resourceSpans": [
+        {
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": "ray_tpu"}},
+                {"key": "host.name",
+                 "value": {"stringValue": node}},
+                {"key": "process.pid",
+                 "value": {"intValue": pid}},
+            ]},
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu.util.tracing"},
+                "spans": [{
+                    "traceId": s["traceId"],
+                    "spanId": s["spanId"],
+                    **({"parentSpanId": s["parentSpanId"]}
+                       if s.get("parentSpanId") else {}),
+                    "name": s["name"],
+                    "kind": {"PRODUCER": 4, "CONSUMER": 5}.get(
+                        s.get("kind", ""), 1),
+                    "startTimeUnixNano": str(s["startTimeUnixNano"]),
+                    "endTimeUnixNano": str(s["endTimeUnixNano"]),
+                    "attributes": [
+                        {"key": str(k), "value": {"stringValue": str(v)}}
+                        for k, v in (s.get("attributes") or {}).items()],
+                } for s in group],
+            }],
+        } for (node, pid), group in sorted(by_proc.items())
+    ]}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
