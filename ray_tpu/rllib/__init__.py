@@ -8,7 +8,7 @@ store — the async sample/learn split of
 rllib/execution/multi_gpu_learner_thread.py:20 with the object store as
 the ring buffer and the compiled jax update as the device step.
 """
-from ray_tpu.rllib.algorithm import DQN, Algorithm, AlgorithmConfig, PPO
+from ray_tpu.rllib.algorithm import A2C, BC, DQN, Algorithm, AlgorithmConfig, PPO
 from ray_tpu.rllib.env import CartPole, make_env
 from ray_tpu.rllib.models import init_policy, policy_apply
 from ray_tpu.rllib.replay_buffer import (
@@ -21,7 +21,7 @@ from ray_tpu.rllib.rollout_worker import (
     concat_batches,
 )
 
-__all__ = ["Algorithm", "AlgorithmConfig", "CartPole", "DQN", "PPO",
-           "PrioritizedReplayBuffer", "ReplayBuffer", "RolloutWorker",
-           "TransitionWorker", "concat_batches", "init_policy", "make_env",
-           "policy_apply"]
+__all__ = ["A2C", "Algorithm", "AlgorithmConfig", "BC", "CartPole", "DQN",
+           "PPO", "PrioritizedReplayBuffer", "ReplayBuffer",
+           "RolloutWorker", "TransitionWorker", "concat_batches",
+           "init_policy", "make_env", "policy_apply"]

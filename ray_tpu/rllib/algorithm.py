@@ -9,6 +9,7 @@ ring buffer is the object store itself).
 """
 from __future__ import annotations
 
+import copy
 import time
 
 import jax
@@ -56,6 +57,8 @@ class AlgorithmConfig:
         # IMPALA (async learner) knobs
         self.learner_queue_size = 8
         self.learner_min_step_s = 0.0   # test hook: artificial step floor
+        # BC / offline RL: {"obs", "actions"} arrays or a Dataset
+        self.offline_data = None
 
     def environment(self, env):
         self.env_spec = env
@@ -178,16 +181,7 @@ class PPO(Algorithm):
             return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
                            "entropy": entropy}
 
-        def update(params, opt_state, mb):
-            (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, mb)
-            updates, opt_state = self.optimizer.update(grads, opt_state,
-                                                       params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        self._update = jax.jit(update)
+        self._update = _jit_sgd_update(loss_fn, self.optimizer)
 
     def training_step(self, batch) -> dict:
         n = len(batch["obs"])
@@ -202,6 +196,140 @@ class PPO(Algorithm):
                 self.params, self.opt_state, aux = self._update(
                     self.params, self.opt_state, mb)
         return {k: float(v) for k, v in aux.items()}
+
+
+def _jit_sgd_update(loss_fn, optimizer):
+    """The shared value_and_grad → optimizer.update → apply_updates step
+    (one definition so PPO/A2C/BC can't drift on e.g. grad clipping)."""
+    def update(params, opt_state, mb):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, mb)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        aux["total_loss"] = loss
+        return params, opt_state, aux
+
+    return jax.jit(update)
+
+
+class A2C(Algorithm):
+    """Synchronous advantage actor-critic (reference:
+    rllib/algorithms/a2c/a2c.py — PPO minus the clipped surrogate and
+    the epoch loop: one on-policy gradient step per sampled batch, so
+    the whole update jits into a single XLA program per iteration)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        cfg = config
+
+        def loss_fn(params, mb):
+            logits, values = policy_apply(params, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb["actions"][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            adv = mb["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pi_loss = -(logp * adv).mean()
+            vf_loss = jnp.mean((values - mb["value_targets"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
+            total = (pi_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        self._update = _jit_sgd_update(loss_fn, self.optimizer)
+
+    def training_step(self, batch) -> dict:
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in aux.items()}
+
+
+class BC(Algorithm):
+    """Behavior cloning — offline RL (reference: rllib/algorithms/bc —
+    supervised imitation of a dataset of (obs, action) pairs; no
+    environment interaction during training). `config.offline_data` is
+    either {"obs": (N, obs_size) array, "actions": (N,) array} or a
+    ray_tpu Dataset of such rows. One rollout worker exists solely for
+    evaluation (`evaluate()`)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        data = config.offline_data
+        if data is None:
+            raise ValueError("BC needs config.training(offline_data=...)")
+        if hasattr(data, "take_all"):   # ray_tpu Dataset of row dicts
+            rows = data.take_all()
+            data = {"obs": np.stack([r["obs"] for r in rows]),
+                    "actions": np.asarray([r["actions"] for r in rows])}
+        self._data = {"obs": np.asarray(data["obs"], np.float32),
+                      "actions": np.asarray(data["actions"], np.int32)}
+        # evaluation needs exactly one sampler; don't mutate the CALLER's
+        # config (it may build other algorithms later)
+        config = copy.copy(config)
+        config.num_rollout_workers = 1
+        super().__init__(config)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, mb):
+            logits, _ = policy_apply(params, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb["actions"][:, None], axis=-1)[:, 0]
+            loss = -logp.mean()
+            acc = jnp.mean(
+                (jnp.argmax(logits, axis=-1) == mb["actions"]))
+            return loss, {"bc_loss": loss, "action_accuracy": acc}
+
+        self._update = _jit_sgd_update(loss_fn, self.optimizer)
+
+    def train(self) -> dict:
+        """Offline: iterate minibatches over the dataset (no sampling)."""
+        t0 = time.time()
+        self.iteration += 1
+        n = len(self._data["obs"])
+        mbs = max(1, min(self.config.minibatch_size, n))
+        rng = np.random.default_rng(self.config.seed + self.iteration)
+        perm = rng.permutation(n)
+        aux = {}
+        trained = 0
+        for start in range(0, n - mbs + 1, mbs):
+            idx = perm[start:start + mbs]
+            mb = {k: v[idx] for k, v in self._data.items()}
+            self.params, self.opt_state, aux = self._update(
+                self.params, self.opt_state, mb)
+            trained += len(idx)
+        return {**{k: float(v) for k, v in aux.items()},
+                "training_iteration": self.iteration,
+                # the n % minibatch tail is dropped this epoch (the next
+                # epoch's fresh permutation covers it)
+                "num_samples_trained": trained,
+                "time_this_iter_s": time.time() - t0}
+
+    def evaluate(self, min_episodes: int = 2,
+                 max_rounds: int = 20) -> dict:
+        """Roll the cloned policy in the real env (reference:
+        Algorithm.evaluate with evaluation workers). A good policy can
+        outlive one fragment (CartPole caps at 500 steps), so sampling
+        continues until enough EPISODES complete to score."""
+        returns: list = []
+        for _ in range(max_rounds):
+            batch = ray_tpu.get(self.workers[0].sample.remote(
+                self.params, self.config.rollout_fragment_length),
+                timeout=300)
+            returns.extend(batch["episode_returns"].tolist())
+            if len(returns) >= min_episodes:
+                break
+        return {"episode_reward_mean": (float(np.mean(returns))
+                                        if returns else 0.0),
+                "episodes": int(len(returns))}
+
+    def training_step(self, batch) -> dict:  # pragma: no cover — offline
+        raise NotImplementedError("BC trains from offline data")
 
 
 class DQN(Algorithm):

@@ -119,3 +119,109 @@ def test_dqn_cartpole_learns(ray_start_regular):
         algo.restore(state)
     finally:
         algo.stop()
+
+
+def test_a2c_cartpole_converges(ray_start_regular):
+    """A2C (PPO minus the surrogate/epochs) must also learn CartPole —
+    its single-step on-policy update is the simplest learner shape."""
+    from ray_tpu.rllib import A2C, AlgorithmConfig
+
+    algo = (AlgorithmConfig(A2C)
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_fragment_length=128)
+            .training(lr=1e-3, entropy_coeff=0.01)
+            .build())
+    try:
+        best = 0.0
+        for _ in range(60):
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+            if best >= 80.0:
+                break
+        assert best >= 60.0, f"A2C failed to learn: best reward {best}"
+    finally:
+        algo.stop()
+
+
+def _expert_cartpole_data(n: int = 4000, seed: int = 0):
+    """Roll a hand-coded balancing controller (act on pole angle +
+    angular velocity) to produce imitation data; it scores far above
+    random, so cloning it is measurable."""
+    import numpy as np
+
+    from ray_tpu.rllib.env import CartPole
+
+    env = CartPole(seed=seed)
+    obs_list, act_list = [], []
+    obs, _ = env.reset()
+    while len(obs_list) < n:
+        action = int(obs[2] + 0.5 * obs[3] > 0)
+        obs_list.append(obs.copy())
+        act_list.append(action)
+        obs, _r, terminated, truncated, _ = env.step(action)
+        if terminated or truncated:
+            obs, _ = env.reset()
+    return {"obs": np.asarray(obs_list, np.float32),
+            "actions": np.asarray(act_list, np.int64)}
+
+
+def test_bc_offline_imitates_expert(ray_start_regular):
+    """Offline RL: BC trains purely from a dataset (no env interaction)
+    and the cloned policy scores like the expert when evaluated."""
+    from ray_tpu.rllib import BC, AlgorithmConfig
+
+    data = _expert_cartpole_data()
+    algo = (AlgorithmConfig(BC)
+            .environment("CartPole-v1")
+            .rollouts(num_envs_per_worker=2, rollout_fragment_length=256)
+            .training(lr=1e-3, minibatch_size=256, offline_data=data)
+            .build())
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.rllib import policy_apply
+
+        def full_accuracy():
+            logits, _ = policy_apply(algo.params, jnp.asarray(data["obs"]))
+            pred = np.asarray(jnp.argmax(logits, axis=-1))
+            return float((pred == data["actions"]).mean())
+
+        acc = 0.0
+        for _ in range(40):
+            algo.train()
+            acc = full_accuracy()      # whole-dataset, not one minibatch
+            if acc >= 0.97:
+                break
+        assert acc >= 0.9, f"BC failed to fit the expert: acc={acc}"
+        ev = algo.evaluate()
+        # hand-coded expert scores ~180+; random ~22. Cloning must land
+        # decisively on the expert side.
+        assert ev["episode_reward_mean"] >= 100.0, ev
+    finally:
+        algo.stop()
+
+
+def test_bc_accepts_dataset_offline_data(ray_start_regular):
+    """The documented Dataset form of offline_data (rows with
+    'obs'/'actions') builds and trains."""
+    import numpy as np
+
+    import ray_tpu.data as rdata
+    from ray_tpu.rllib import BC, AlgorithmConfig
+
+    raw = _expert_cartpole_data(n=512)
+    ds = rdata.from_items([
+        {"obs": raw["obs"][i], "actions": int(raw["actions"][i])}
+        for i in range(len(raw["actions"]))])
+    algo = (AlgorithmConfig(BC)
+            .environment("CartPole-v1")
+            .training(lr=1e-3, minibatch_size=128, offline_data=ds)
+            .build())
+    try:
+        result = algo.train()
+        assert result["num_samples_trained"] == 512
+        assert "bc_loss" in result
+    finally:
+        algo.stop()
