@@ -18,9 +18,17 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "worker_pool_min_size": 0,
     "worker_register_timeout_s": 60.0,  # worker process spawn+import budget
     "worker_pool_idle_timeout_s": 120.0,
-    "max_tasks_in_flight_per_worker": 10,  # lease pipelining depth
+    "max_tasks_in_flight_per_worker": 2,  # lease pipelining depth
     "scheduler_spread_threshold": 0.5,  # hybrid policy pack→spread knob
     "scheduler_top_k_fraction": 0.2,
+    "lease_soft_cap": 0,               # 0 = auto: 2x cluster CPUs
+    "actor_resolution_poll_max_s": 1.0,  # backoff cap for pending actors
+    # --- worker pool ---
+    "prestart_workers": 4,             # warm-pool watermark per node
+    "idle_worker_cap": 8,              # max idle processes kept per node
+    "max_startup_concurrency": 0,      # 0 = auto: one per core
+    # --- TPU probing ---
+    "chip_probe_timeout_s": 60.0,      # subprocess jax.devices() budget
     # --- object store ---
     "object_store_memory_default": 256 * 1024 * 1024,
     "object_store_full_delay_ms": 10,
@@ -43,6 +51,14 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # --- memory monitor ---
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,
+    "memory_monitor_kill_cooldown_s": 5.0,  # re-kill while still over
+    # --- runtime envs ---
+    "runtime_env_dir": "/tmp/ray_tpu/runtime_envs",
+    "runtime_env_cache_max": 8,        # unreferenced envs kept (LRU)
+    # --- logs ---
+    "log_monitor_interval_ms": 250,    # worker-log tail cadence
+    # --- serve ---
+    "serve_stream_chunk_timeout_s": 300.0,  # first chunk may be a compile
     # --- collective / mesh ---
     "collective_default_backend": "xla",
     "collective_op_timeout_s": 300.0,  # dead-member failure detector
@@ -82,6 +98,22 @@ class _Config:
                 raise ValueError(f"Unknown system config key: {k}")
             self._values[k] = v
             self._system_overrides.add(k)
+
+    def system_override_env(self) -> Dict[str, str]:
+        """init(system_config=...) overrides as RAY_TPU_<NAME> env vars.
+        The raylet injects these into spawned worker processes so keys
+        consumed worker-side (runtime_env_dir, serve stream timeout, ...)
+        honor the driver's overrides — without this, system_config would
+        silently apply only in the driver process."""
+        out = {}
+        for k in self._system_overrides:
+            v = self._values[k]
+            if isinstance(v, bool):
+                v = "1" if v else "0"
+            elif isinstance(v, (dict, list)):
+                v = json.dumps(v)
+            out["RAY_TPU_" + k.upper()] = str(v)
+        return out
 
     def reset_system_config(self):
         """Drop init(system_config=...) overrides (called at shutdown so

@@ -95,7 +95,7 @@ class MemoryMonitor:
     def __init__(self, on_pressure, threshold: float | None = None,
                  interval_s: float | None = None,
                  hysteresis: float = 0.05,
-                 cooldown_s: float = 5.0,
+                 cooldown_s: float | None = None,
                  usage_fn=node_memory_usage):
         from ray_tpu._private.config import get_config
 
@@ -105,7 +105,9 @@ class MemoryMonitor:
                            else get_config("memory_monitor_refresh_ms")
                            / 1000.0)
         self.hysteresis = hysteresis
-        self.cooldown_s = cooldown_s
+        self.cooldown_s = (cooldown_s if cooldown_s is not None
+                           else get_config(
+                               "memory_monitor_kill_cooldown_s"))
         self._on_pressure = on_pressure
         self._usage_fn = usage_fn
         self._armed = True

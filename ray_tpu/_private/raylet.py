@@ -29,7 +29,6 @@ import uuid
 from ray_tpu._private.protocol import ConnectionLost, RpcClient, RpcServer
 from ray_tpu._private.store_client import StoreClient
 
-_IDLE_WORKER_CAP = 8          # max idle workers kept warm per node
 _LEASE_QUEUE_POLL = 0.02
 
 
@@ -69,10 +68,10 @@ def detect_tpu_topology() -> dict | None:
         # hangs FOREVER on a wedged axon tunnel, which would wedge
         # raylet startup (and with it ray_tpu.init) on any box where the
         # tunnel is down — learned the hard way in rounds 3-4.
+        from ray_tpu._private.config import get_config
         from ray_tpu._private.tpu_probe import probe_chips
 
-        chips = probe_chips(timeout_s=float(
-            os.environ.get("RAY_TPU_CHIP_PROBE_TIMEOUT_S", "60")))
+        chips = probe_chips(timeout_s=float(get_config("chip_probe_timeout_s")))
         if chips:
             for k, v in chips.items():
                 info.setdefault(k, v)   # env-derived identity wins
@@ -93,10 +92,11 @@ def detect_resources(num_cpus=None, num_tpus=None, memory=None,
             # SUBPROCESS probe (shared with detect_tpu_topology): an
             # in-process jax.devices() hangs forever on a wedged axon
             # tunnel, which would hang ray_tpu.init itself.
+            from ray_tpu._private.config import get_config
             from ray_tpu._private.tpu_probe import probe_chips
 
-            chips = probe_chips(timeout_s=float(
-                os.environ.get("RAY_TPU_CHIP_PROBE_TIMEOUT_S", "60")))
+            chips = probe_chips(
+                timeout_s=float(get_config("chip_probe_timeout_s")))
             num_tpus = (chips or {}).get("chips", 0)
     if num_tpus:
         out["TPU"] = float(num_tpus)
@@ -187,10 +187,13 @@ class Raylet:
         os.makedirs(self.logs_dir, exist_ok=True)
         # Worker log capture → GCS pubsub → driver console (reference:
         # _private/log_monitor.py as a thread instead of a process).
+        from ray_tpu._private.config import get_config
+
         self._log_monitor = LogMonitor(
             lambda ch, msg: self._gcs.push("publish", channel=ch,
                                            message=msg),
-            node_id=self.node_id)
+            node_id=self.node_id,
+            interval_s=get_config("log_monitor_interval_ms") / 1000.0)
         # OOM protection: poll node memory; above the threshold kill the
         # newest-task worker with a retriable OutOfMemoryError instead of
         # letting the kernel OOM-killer take the node (reference:
@@ -199,13 +202,15 @@ class Raylet:
         self._mem_monitor = MemoryMonitor(self._on_memory_pressure)
         # worker-pool spawn state — must exist before the server starts
         # accepting lease requests (they reach _spawn_worker)
+        self._idle_cap = int(get_config("idle_worker_cap"))
         self._prestart_target = min(
-            int(self.resources_total.get("CPU", 1)), _IDLE_WORKER_CAP,
-            int(os.environ.get("RAY_TPU_PRESTART_WORKERS", "4")))
+            int(self.resources_total.get("CPU", 1)), self._idle_cap,
+            int(get_config("prestart_workers")))
         self._spawning = 0
-        self._spawn_gate = threading.BoundedSemaphore(
-            max(2, int(os.environ.get("RAY_TPU_MAX_STARTUP_CONCURRENCY",
-                                      str(os.cpu_count() or 2)))))
+        startup_conc = int(get_config("max_startup_concurrency"))
+        if startup_conc <= 0:
+            startup_conc = os.cpu_count() or 2
+        self._spawn_gate = threading.BoundedSemaphore(max(2, startup_conc))
 
         self._server = RpcServer(self, host, port).start()
         self.addr = self._server.addr
@@ -255,7 +260,7 @@ class Raylet:
                     with self._lock:
                         if (h.assigned_lease is None
                                 and h not in self._idle
-                                and len(self._idle) < _IDLE_WORKER_CAP):
+                                and len(self._idle) < self._idle_cap):
                             self._idle.append(h)
                         elif h.assigned_lease is None:
                             # pool refilled concurrently (returned leases
@@ -347,6 +352,12 @@ class Raylet:
         env["RAY_TPU_STORE_NAME"] = self.store_name
         env["RAY_TPU_SPILL_DIR"] = self.spill_dir
         env["RAY_TPU_NODE_ID"] = self.node_id
+        # driver's init(system_config=...) overrides reach workers as env
+        # (config keys consumed worker-side would otherwise silently keep
+        # their defaults there)
+        from ray_tpu._private.config import GlobalConfig
+
+        env.update(GlobalConfig.system_override_env())
         env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", ""))
         # Make ray_tpu importable from anywhere, and on CPU-only runs drop
         # TPU-plugin site dirs from PYTHONPATH: their sitecustomize adds ~10s
@@ -798,7 +809,7 @@ class Raylet:
             self._give_back(lease.resources)
             worker = lease.worker
             worker.assigned_lease = None
-            if dispose or len(self._idle) >= _IDLE_WORKER_CAP:
+            if dispose or len(self._idle) >= self._idle_cap:
                 self._kill_worker(worker)
             elif worker.proc is not None and worker.proc.poll() is None:
                 worker.idle_since = time.time()
@@ -1073,6 +1084,60 @@ class Raylet:
 
     def rpc_ping(self, conn):
         return "pong"
+
+    def rpc_dump_stacks(self, conn, wait_s: float = 0.6):
+        """`ray stack` analog (reference: scripts.py `ray stack` shells
+        out to py-spy on every worker): workers register faulthandler on
+        SIGUSR1 (worker_main), so signaling them makes each dump every
+        thread's Python stack into its own stderr log; this collects the
+        fresh tails. No py-spy dependency — the dumps come from the
+        interpreter itself."""
+        with self._lock:
+            targets = [(h.worker_id, h.proc.pid)
+                       for h in self._workers.values()
+                       if h.proc is not None and h.proc.poll() is None]
+        marks = {}
+        for worker_id, _pid in targets:
+            err = os.path.join(self.logs_dir, f"worker-{worker_id}.err")
+            try:
+                marks[worker_id] = os.path.getsize(err)
+            except OSError:
+                # no file yet — mark its CURRENT end once it appears, so
+                # historical stderr is never mistaken for the dump
+                marks[worker_id] = None
+        for _worker_id, pid in targets:
+            try:
+                os.kill(pid, signal.SIGUSR1)
+            except OSError:
+                pass
+        out = {}
+        deadline = time.monotonic() + max(wait_s, 0.1)
+        pending = dict(targets)
+        while pending and time.monotonic() < deadline:
+            time.sleep(0.1)
+            for worker_id, pid in list(pending.items()):
+                err = os.path.join(self.logs_dir,
+                                   f"worker-{worker_id}.err")
+                mark = marks[worker_id]
+                try:
+                    size = os.path.getsize(err)
+                except OSError:
+                    continue
+                if mark is None:
+                    marks[worker_id] = mark = size
+                    continue
+                if size <= mark:
+                    continue
+                with open(err, "rb") as f:
+                    f.seek(mark)
+                    dump = f.read().decode(errors="replace")
+                out[worker_id] = {"pid": pid, "node_id": self.node_id,
+                                  "stack": dump[-100_000:]}
+                del pending[worker_id]
+        for worker_id, pid in pending.items():   # no dump in time
+            out[worker_id] = {"pid": pid, "node_id": self.node_id,
+                              "stack": ""}
+        return out
 
     def rpc_physical_stats(self, conn):
         """Reporter-agent sample for this node (reference:

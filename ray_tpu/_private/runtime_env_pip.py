@@ -186,10 +186,12 @@ _node_cache_lock = threading.Lock()
 
 def node_env_cache() -> PipEnvCache:
     """Process-wide cache instance (one per worker/raylet process)."""
+    from ray_tpu._private.config import get_config
+
     global _node_cache
     with _node_cache_lock:
         if _node_cache is None:
             _node_cache = PipEnvCache(
-                os.environ.get("RAY_TPU_RUNTIME_ENV_DIR",
-                               DEFAULT_CACHE_ROOT))
+                str(get_config("runtime_env_dir")),
+                max_cached=int(get_config("runtime_env_cache_max")))
         return _node_cache

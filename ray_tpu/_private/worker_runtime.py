@@ -36,7 +36,10 @@ INLINE_RESULT_LIMIT = 100 * 1024
 # Max tasks pipelined onto one leased worker before requesting another lease
 # (reference pipelines to leased workers in OnWorkerIdle,
 # direct_task_transport.cc:174).
-PIPELINE_DEPTH = 2
+def _pipeline_depth() -> int:
+    from ray_tpu._private.config import get_config
+
+    return int(get_config("max_tasks_in_flight_per_worker"))
 
 
 def _lease_soft_cap(worker=None) -> int:
@@ -44,10 +47,13 @@ def _lease_soft_cap(worker=None) -> int:
     capacity (reference: per-node worker_pool soft limits sum to cluster
     capacity), not this process's core count — a laptop driver submitting
     to a 100-core cluster must not throttle it. Cached with a TTL on the
-    worker; env RAY_TPU_LEASE_SOFT_CAP (read live) overrides."""
-    env = os.environ.get("RAY_TPU_LEASE_SOFT_CAP")
-    if env:
-        return int(env)
+    worker; config `lease_soft_cap` / env RAY_TPU_LEASE_SOFT_CAP
+    overrides (0 = auto)."""
+    from ray_tpu._private.config import get_config
+
+    configured = int(get_config("lease_soft_cap"))
+    if configured > 0:
+        return configured
     cluster = worker._cluster_cpu_total() if worker is not None else 0
     return max(4, 2 * (os.cpu_count() or 1), int(2 * cluster))
 
@@ -242,7 +248,7 @@ class _SchedulingKeyQueue:
         # serial worker deadlocks rendezvous patterns (4 tasks gating on
         # each other inside an actor, test_runtime_fixes). The fleet
         # ratchet this used to cause is bounded by _may_grow instead.
-        depth = PIPELINE_DEPTH if self.tasks.qsize() > 2 else 1
+        depth = _pipeline_depth() if self.tasks.qsize() > 2 else 1
         with self._lock:
             alive = [lw for lw in self.leased if not lw.dead]
             self.leased = alive
@@ -479,7 +485,10 @@ class _ActorQueue:
             time.sleep(poll)
             # with N pending handles this loop is N pollers against one
             # GCS; constant 50 ms polling melted it at N=400 — back off
-            poll = min(poll * 1.5, 1.0)
+            from ray_tpu._private.config import get_config
+
+            poll = min(poll * 1.5,
+                       float(get_config("actor_resolution_poll_max_s")))
 
     def assign_seq(self, spec: dict):
         """Must be called in program submission order (caller thread)."""

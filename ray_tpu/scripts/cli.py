@@ -149,6 +149,34 @@ def cmd_memory(args):
     return 0
 
 
+def cmd_stack(args):
+    """`ray stack` analog: dump every worker's Python thread stacks
+    (faulthandler over SIGUSR1 — no py-spy needed)."""
+    from ray_tpu._private.protocol import RpcClient
+    from ray_tpu.experimental.state.api import _gcs
+
+    address = args.address or _current_cluster()["gcs_address"]
+    with _gcs(address) as call:
+        nodes = [n for n in call("get_nodes") if n["Alive"]]
+    for n in nodes:
+        try:
+            c = RpcClient((n["NodeManagerAddress"], n["NodeManagerPort"]),
+                          timeout=10.0)
+            try:
+                dumps = c.call("dump_stacks", timeout=15.0)
+            finally:
+                c.close()
+        except Exception as e:
+            print(f"=== node {n['NodeID'][:8]}: unreachable ({e})")
+            continue
+        for worker_id, info in sorted(dumps.items()):
+            print(f"=== worker {worker_id} "
+                  f"(pid={info['pid']}, node={info['node_id'][:8]}) ===")
+            print(info["stack"].strip() or "(no dump captured)")
+            print()
+    return 0
+
+
 def cmd_dashboard(args):
     import time as _time
 
@@ -246,6 +274,11 @@ def main(argv=None):
     sp = sub.add_parser("microbenchmark",
                         help="core task/actor/object throughput numbers")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("stack",
+                        help="dump all workers' Python thread stacks")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     sp.add_argument("--address", default=None)

@@ -14,10 +14,9 @@ def stream_chunk_timeout_s() -> float:
     default: the FIRST next() of a TPU serving generator may trigger XLA
     compilation (tens of seconds); killing the stream for that would
     truncate a healthy response."""
-    import os
+    from ray_tpu._private.config import get_config
 
-    return float(os.environ.get("RAY_TPU_SERVE_STREAM_CHUNK_TIMEOUT_S",
-                                "300"))
+    return float(get_config("serve_stream_chunk_timeout_s"))
 
 
 def replicas_key(deployment_id: str) -> str:

@@ -76,3 +76,25 @@ def test_metrics_counter_gauge_histogram(ray_start_regular):
     text = metrics_summary(prometheus=True)
     assert "# TYPE svc_requests_total counter" in text
     assert 'svc_requests_total{route="/a"} 2.0' in text
+
+
+def test_dump_stacks_collects_worker_threads(ray_start_regular):
+    """`ray-tpu stack` analog: the raylet signals workers (faulthandler
+    SIGUSR1) and collects per-thread Python stacks from their logs."""
+    import ray_tpu
+    from ray_tpu._private.worker_runtime import current_worker
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    assert ray_tpu.get(warm.remote(), timeout=60) == 1
+    w = current_worker()
+    dumps = w.raylet.call("dump_stacks", timeout=30.0)
+    assert dumps, "no workers reported"
+    joined = "\n".join(d["stack"] for d in dumps.values())
+    # faulthandler's dump format: one 'Thread 0x...' header per thread,
+    # with the worker main loop visible somewhere
+    assert "Thread 0x" in joined or "Current thread" in joined, joined[:500]
+    assert "serve_task_loop" in joined or "worker_main" in joined, \
+        joined[:500]
