@@ -42,7 +42,8 @@ def main():
 
     import ray_tpu
 
-    ray_tpu.init(num_cpus=8, object_store_memory=512 * 1024 * 1024)
+    store_bytes = 512 * 1024 * 1024
+    ray_tpu.init(num_cpus=8, object_store_memory=store_bytes)
     rows = []
 
     # --- many queued tasks on one node (ref: 1M+ queued) -----------------
@@ -149,13 +150,28 @@ def main():
     nbytes = int(128 * 1024 * 1024 * s)
 
     def big_get():
+        # sized to FIT the shm store: this measures the data plane
+        # (serialize → shm → pinned zero-copy-ish get), not the disk
+        shm_bytes = min(nbytes, store_bytes // 2)
+        arr = np.zeros(shm_bytes, dtype=np.uint8)
+        ref = ray_tpu.put(arr)
+        out = ray_tpu.get(ref, timeout=600)
+        assert out.nbytes == shm_bytes
+        return {"gigabytes": round(shm_bytes / 2**30, 3)}
+
+    rows.append(bench("large_object_get", big_get))
+
+    def big_get_spilled():
+        # deliberately larger than the store: measures the spill path,
+        # whose floor is the DISK write rate, not the framework
         arr = np.zeros(nbytes, dtype=np.uint8)
         ref = ray_tpu.put(arr)
         out = ray_tpu.get(ref, timeout=600)
         assert out.nbytes == nbytes
-        return {"gigabytes": round(nbytes / 2**30, 3)}
+        return {"gigabytes": round(nbytes / 2**30, 3), "path": "spill"}
 
-    rows.append(bench("large_object_get", big_get))
+    if nbytes > store_bytes:
+        rows.append(bench("large_object_get_spilled", big_get_spilled))
 
     print(json.dumps({"benchmark": "scalability_envelope", "scale": s,
                       "results": rows}))

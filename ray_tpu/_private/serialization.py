@@ -46,8 +46,10 @@ class _RefPlaceholder:
         self.index = index
 
 
-def serialize(value, raised: bool = False) -> bytes:
-    """Serialize a Python value; returns the framed bytes. raised=True marks
+def serialize(value, raised: bool = False) -> bytearray:
+    """Serialize a Python value; returns the framed payload as a
+    BYTEARRAY (bytes-like but unhashable/mutable — a bytes() of it would
+    be a second full copy of every out-of-band buffer). raised=True marks
     the payload as a shipped task failure (set by serialize_error only)."""
     buffers: list = []
     refs: list = []
@@ -97,7 +99,9 @@ def serialize(value, raised: bool = False) -> bytes:
     out += meta
     for b in buffers:
         out += b
-    return bytes(out)
+    # bytearray IS the bytes-like result — bytes(out) would be a second
+    # full copy of every out-of-band buffer (gigabytes for big arrays)
+    return out
 
 
 def contained_refs(value) -> list[ObjectRef]:
@@ -165,7 +169,7 @@ def deserialize(data, worker=None, with_meta: bool = False):
     return value
 
 
-def serialize_error(exc: BaseException, task_desc: str = "") -> bytes:
+def serialize_error(exc: BaseException, task_desc: str = "") -> bytearray:
     """Ship an exception; always picklable (falls back to a stringly copy)."""
     wrapped = exc if isinstance(exc, RayError) else RayTaskError(
         type(exc).__name__, _format_tb(exc), cause=exc, task_desc=task_desc)
