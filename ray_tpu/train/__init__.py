@@ -20,3 +20,7 @@ from ray_tpu.train.torch import (  # noqa: F401
     TorchTrainer,
     prepare_model,
 )
+from ray_tpu.train.huggingface import (  # noqa: F401
+    TransformersTrainer,
+    prepare_trainer,
+)

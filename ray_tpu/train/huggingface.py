@@ -1,0 +1,139 @@
+"""HuggingFace Transformers integration (reference:
+python/ray/train/huggingface/transformers/ — `prepare_trainer` +
+`RayTrainReportCallback` adapt an off-the-shelf `transformers.Trainer`
+to run data-parallel inside the actor gang, with HF's own train loop
+reporting through the session).
+
+Usage::
+
+    from ray_tpu.train.huggingface import (
+        TransformersTrainer, prepare_trainer, RayTrainReportCallback)
+
+    def trainer_init(config):
+        args = TrainingArguments(..., use_cpu=True, report_to=[])
+        return Trainer(model=model_fn(), args=args, train_dataset=ds)
+
+    result = TransformersTrainer(
+        trainer_init,
+        scaling_config=ScalingConfig(num_workers=2)).fit()
+
+The gang's torch.distributed (gloo) process group is initialized before
+`trainer_init` runs, and the distributed env vars (RANK/WORLD_SIZE/...)
+are exported first so `TrainingArguments` → accelerate detect the
+pre-initialized group and wrap the model in DDP themselves.
+"""
+from __future__ import annotations
+
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
+
+
+_cb_cls = None
+
+
+def _report_callback_cls():
+    """The TrainerCallback subclass, created lazily ONCE (transformers
+    import is heavy and optional for everything else in ray_tpu.train)
+    and cached so isinstance checks work."""
+    global _cb_cls
+    if _cb_cls is None:
+        from transformers import TrainerCallback
+
+        class _RayTrainReportCallback(TrainerCallback):
+            def on_log(self, args, state, control, logs=None, **kwargs):
+                from ray_tpu.air import session
+
+                if logs and state.is_world_process_zero:
+                    metrics = {k: v for k, v in logs.items()
+                               if isinstance(v, (int, float))}
+                    metrics["step"] = state.global_step
+                    session.report(metrics)
+
+            def on_save(self, args, state, control, **kwargs):
+                # stream the just-written HF checkpoint dir through the
+                # session so Result.checkpoint / RunConfig.storage_path
+                # fault tolerance work for HF runs (reference:
+                # RayTrainReportCallback.on_save)
+                from ray_tpu.air import session
+                from ray_tpu.air.checkpoint import Checkpoint
+
+                if not state.is_world_process_zero:
+                    return
+                import os
+
+                path = os.path.join(
+                    args.output_dir, f"checkpoint-{state.global_step}")
+                if os.path.isdir(path):
+                    session.report(
+                        {"step": state.global_step, "saved": True},
+                        checkpoint=Checkpoint.from_directory(path))
+
+        _cb_cls = _RayTrainReportCallback
+    return _cb_cls
+
+
+def RayTrainReportCallback():
+    """Factory for the report callback (reference:
+    transformers.RayTrainReportCallback). A factory rather than a class:
+    the TrainerCallback base can only be imported lazily. To customize
+    reporting, add your own TrainerCallback alongside it."""
+    return _report_callback_cls()()
+
+
+def prepare_trainer(trainer):
+    """Final fit-up of a user-constructed `transformers.Trainer` for the
+    gang: attaches the report callback if absent (reference:
+    transformers.prepare_trainer)."""
+    cls = _report_callback_cls()
+    if not any(isinstance(cb, cls)
+               for cb in trainer.callback_handler.callbacks):
+        trainer.add_callback(RayTrainReportCallback())
+    return trainer
+
+
+def _export_dist_env(local_rank: int):
+    """accelerate/TrainingArguments read the torchrun-style env vars at
+    TrainingArguments CONSTRUCTION; the gang initializes the process
+    group directly, so mirror its coordinates into the env before user
+    code builds the arguments. `local_rank` comes from the session (NOT
+    dist.get_rank(): on multi-host gangs the global rank is wrong for
+    per-host local-main gating like main_process_first caches)."""
+    import os
+
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        os.environ.setdefault("RANK", str(dist.get_rank()))
+        os.environ.setdefault("WORLD_SIZE", str(dist.get_world_size()))
+        os.environ.setdefault("LOCAL_RANK", str(local_rank))
+        os.environ.setdefault("MASTER_ADDR", "127.0.0.1")
+        os.environ.setdefault("MASTER_PORT", "29500")
+        os.environ.setdefault("ACCELERATE_USE_CPU", "true")
+
+
+class TransformersTrainer(TorchTrainer):
+    """Run a `transformers.Trainer` per gang worker (reference:
+    train/huggingface/transformers/transformers_trainer.py).
+
+    ``trainer_init_per_worker(config) -> transformers.Trainer`` runs on
+    every worker AFTER the torch.distributed group is up; HF/accelerate
+    pick the group up and data-parallelize. The returned metrics come
+    from the last session report (HF logs via RayTrainReportCallback).
+    """
+
+    def __init__(self, trainer_init_per_worker, *,
+                 torch_config: TorchConfig | None = None, **kwargs):
+        def train_loop(config):
+            from ray_tpu.air import session
+
+            _export_dist_env(session.get_local_rank())
+            trainer = trainer_init_per_worker(config)
+            trainer = prepare_trainer(trainer)
+            out = trainer.train()
+            final = {"training_loss":
+                     float(getattr(out, "training_loss", 0.0)),
+                     "global_step":
+                     int(trainer.state.global_step),
+                     "done": True}
+            session.report(final)
+
+        super().__init__(train_loop, torch_config=torch_config, **kwargs)

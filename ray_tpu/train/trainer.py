@@ -129,31 +129,46 @@ class DataParallelTrainer(BaseTrainer):
             os.makedirs(ckpt_dir, exist_ok=True)
         kept: list[str] = []
         num_keep = self.run_config.checkpoint_config.num_to_keep
+        # Drive until RANK 0's stream ends. Workers report at different
+        # cadences (e.g. HF callbacks report only on the world-zero
+        # process), so a faster worker's completion sentinel must not
+        # truncate rank 0's remaining reports — a finished worker's
+        # next_result just keeps answering "done", making extra rounds
+        # harmless.
+        errors: list = []
         while True:
             rows = executor.next_results()
-            done = [r for r in rows if r.get("done")]
-            if done:
-                errors = [r["error"] for r in done if r.get("error")]
-                if errors:
-                    return Result(
-                        metrics=history[-1] if history else {},
-                        checkpoint=final_checkpoint,
-                        error=errors[0], metrics_history=history,
-                        path=ckpt_dir)
-                break
-            rank0 = next(r for r in rows if r["world_rank"] == 0)
-            history.append(rank0["metrics"])
-            if rank0.get("checkpoint") is not None:
-                final_checkpoint = rank0["checkpoint"]
-                if ckpt_dir:
-                    path = os.path.join(
-                        ckpt_dir, f"checkpoint_{rank0['iteration']:06d}")
-                    final_checkpoint.to_directory(path)
-                    kept.append(path)
-                    if num_keep and len(kept) > num_keep:
-                        import shutil
+            rank0_done = False
+            for rank, r in enumerate(rows):   # rows arrive in gang order
+                if r.get("done"):
+                    if r.get("error"):
+                        errors.append(r["error"])
+                    if rank == 0:
+                        rank0_done = True
+                    continue
+                if rank != 0:
+                    continue
+                history.append(r["metrics"])
+                if r.get("checkpoint") is not None:
+                    final_checkpoint = r["checkpoint"]
+                    if ckpt_dir:
+                        path = os.path.join(
+                            ckpt_dir, f"checkpoint_{r['iteration']:06d}")
+                        final_checkpoint.to_directory(path)
+                        kept.append(path)
+                        if num_keep and len(kept) > num_keep:
+                            import shutil
 
-                        shutil.rmtree(kept.pop(0), ignore_errors=True)
+                            shutil.rmtree(kept.pop(0),
+                                          ignore_errors=True)
+            if errors:
+                return Result(
+                    metrics=history[-1] if history else {},
+                    checkpoint=final_checkpoint,
+                    error=errors[0], metrics_history=history,
+                    path=ckpt_dir)
+            if rank0_done:
+                break
         return Result(metrics=history[-1] if history else {},
                       checkpoint=final_checkpoint,
                       metrics_history=history, path=ckpt_dir)
