@@ -9,6 +9,12 @@ rllib/execution/multi_gpu_learner_thread.py:20 with the object store as
 the ring buffer and the compiled jax update as the device step.
 """
 from ray_tpu.rllib.algorithm import A2C, BC, DQN, Algorithm, AlgorithmConfig, PPO
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentCartPole,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentRolloutWorker,
+)
 from ray_tpu.rllib.env import CartPole, make_env
 from ray_tpu.rllib.models import init_policy, policy_apply
 from ray_tpu.rllib.replay_buffer import (
@@ -22,6 +28,8 @@ from ray_tpu.rllib.rollout_worker import (
 )
 
 __all__ = ["A2C", "Algorithm", "AlgorithmConfig", "BC", "CartPole", "DQN",
+           "MultiAgentCartPole", "MultiAgentEnv", "MultiAgentPPO",
+           "MultiAgentRolloutWorker",
            "PPO", "PrioritizedReplayBuffer", "ReplayBuffer",
            "RolloutWorker", "TransitionWorker", "concat_batches",
            "init_policy", "make_env", "policy_apply"]

@@ -158,30 +158,10 @@ class PPO(Algorithm):
         super().__init__(config)
         self.optimizer = optax.adam(config.lr)
         self.opt_state = self.optimizer.init(self.params)
-        cfg = config
-
-        def loss_fn(params, mb):
-            logits, values = policy_apply(params, mb["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, mb["actions"][:, None].astype(jnp.int32),
-                axis=-1)[:, 0]
-            ratio = jnp.exp(logp - mb["logp"])
-            adv = mb["advantages"]
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-            surr = jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv)
-            pi_loss = -surr.mean()
-            vf_loss = jnp.mean((values - mb["value_targets"]) ** 2)
-            entropy = -jnp.mean(
-                jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
-            total = (pi_loss + cfg.vf_coeff * vf_loss
-                     - cfg.entropy_coeff * entropy)
-            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
-                           "entropy": entropy}
-
-        self._update = _jit_sgd_update(loss_fn, self.optimizer)
+        self._update = _jit_sgd_update(
+            ppo_surrogate_loss(config.clip_param, config.vf_coeff,
+                               config.entropy_coeff),
+            self.optimizer)
 
     def training_step(self, batch) -> dict:
         n = len(batch["obs"])
@@ -196,6 +176,35 @@ class PPO(Algorithm):
                 self.params, self.opt_state, aux = self._update(
                     self.params, self.opt_state, mb)
         return {k: float(v) for k, v in aux.items()}
+
+
+def ppo_surrogate_loss(clip_param: float, vf_coeff: float,
+                       entropy_coeff: float):
+    """The clipped-surrogate PPO loss as a closure factory — ONE
+    definition shared by single-agent PPO and MultiAgentPPO so the loss
+    (and its aux metrics) cannot drift between them."""
+    def loss_fn(params, mb):
+        logits, values = policy_apply(params, mb["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, mb["actions"][:, None].astype(jnp.int32),
+            axis=-1)[:, 0]
+        ratio = jnp.exp(logp - mb["logp"])
+        adv = mb["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv)
+        pi_loss = -surr.mean()
+        vf_loss = jnp.mean((values - mb["value_targets"]) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
+        total = (pi_loss + vf_coeff * vf_loss
+                 - entropy_coeff * entropy)
+        return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    return loss_fn
 
 
 def _jit_sgd_update(loss_fn, optimizer):

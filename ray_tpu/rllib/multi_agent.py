@@ -1,0 +1,333 @@
+"""Multi-agent RL (reference: rllib/env/multi_agent_env.py + the
+multi-policy training path of rllib/algorithms/ppo with
+config.multi_agent(policies=..., policy_mapping_fn=...)).
+
+Contract (reference MultiAgentEnv): ``reset() -> (obs_dict, infos)``,
+``step(action_dict) -> (obs, rewards, terminateds, truncateds, infos)``
+— all keyed by agent id, with terminateds["__all__"] ending the
+episode. Agents may finish early; finished agents stop producing
+transitions until the episode resets.
+
+Training: every agent id maps to a POLICY id via policy_mapping_fn;
+rollouts group per-policy sample batches (GAE per agent stream), and
+MultiAgentPPO keeps independent params/optimizer per policy — shared
+policies (all agents → one id) give parameter sharing for free.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.models import init_policy, policy_apply
+
+
+class MultiAgentEnv:
+    """Base contract; subclasses define agent_ids/spaces and dynamics."""
+
+    agent_ids: list[str]
+
+    def reset(self, seed: int | None = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: dict):
+        raise NotImplementedError
+
+    def spaces(self) -> dict:
+        """{agent_id: (obs_size, num_actions)}"""
+        raise NotImplementedError
+
+
+class MultiAgentCartPole(MultiAgentEnv):
+    """N independent cartpoles, one per agent (the reference's
+    MultiAgentCartPole example env): per-agent rewards, episode ends
+    for everyone when every pole has dropped (or max steps)."""
+
+    def __init__(self, num_agents: int = 2, seed: int | None = None,
+                 max_steps: int = 200):
+        from ray_tpu.rllib.env import CartPole
+
+        self.agent_ids = [f"agent_{i}" for i in range(num_agents)]
+        self._envs = {aid: CartPole(seed=(seed or 0) * 100 + i,
+                                    max_steps=max_steps)
+                      for i, aid in enumerate(self.agent_ids)}
+        self._alive: set[str] = set()
+
+    def spaces(self):
+        return {aid: (env.observation_size, env.num_actions)
+                for aid, env in self._envs.items()}
+
+    def reset(self, seed: int | None = None):
+        self._alive = set(self.agent_ids)
+        obs = {aid: env.reset()[0] for aid, env in self._envs.items()}
+        return obs, {}
+
+    def step(self, action_dict: dict):
+        obs, rewards, terms, truncs = {}, {}, {}, {}
+        for aid in list(self._alive):
+            o, r, term, trunc, _ = self._envs[aid].step(
+                int(action_dict[aid]))
+            obs[aid] = o
+            rewards[aid] = r
+            terms[aid] = term
+            truncs[aid] = trunc
+            if term or trunc:
+                self._alive.discard(aid)
+        terms["__all__"] = not self._alive
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {}
+
+
+class MultiAgentRolloutWorker:
+    """Sample per-policy batches from multi-agent episodes. One stream
+    per (env, agent); GAE runs per stream, then streams concatenate by
+    the POLICY their agent maps to."""
+
+    def __init__(self, env_fn, *, policy_mapping_fn, num_envs: int = 1,
+                 seed: int = 0, gamma: float = 0.99,
+                 gae_lambda: float = 0.95):
+        self.envs = [env_fn(seed=seed * 1000 + i)
+                     for i in range(num_envs)]
+        self.policy_mapping_fn = policy_mapping_fn
+        self.gamma = gamma
+        self.gae_lambda = gae_lambda
+        self._rng = np.random.default_rng(seed)
+        self._fwd = jax.jit(policy_apply)
+        self._obs = []
+        self._returns = []
+        for env in self.envs:
+            obs, _ = env.reset()
+            self._obs.append(obs)
+            self._returns.append({aid: 0.0 for aid in env.agent_ids})
+        self._completed: list[float] = []
+
+    def spaces(self):
+        """{policy_id: (obs_size, num_actions)} over the mapped agents."""
+        out = {}
+        for aid, sp in self.envs[0].spaces().items():
+            out[self.policy_mapping_fn(aid)] = sp
+        return out
+
+    def _forward_by_policy(self, params_by_policy: dict,
+                           keyed_obs: list) -> dict:
+        """One BATCHED jitted forward per policy across every live
+        (env, agent) pair — per-agent singleton dispatches would pay
+        num_agents x num_envs jit round trips per step. Returns
+        {key: (logits, value)} for key = (env_idx, agent_id)."""
+        by_pid: dict[str, list] = {}
+        for key, aid, obs in keyed_obs:
+            by_pid.setdefault(self.policy_mapping_fn(aid),
+                              []).append((key, obs))
+        out = {}
+        for pid, entries in by_pid.items():
+            stacked = np.stack([obs for _, obs in entries])
+            logits, values = self._fwd(params_by_policy[pid], stacked)
+            logits = np.asarray(logits)
+            values = np.asarray(values)
+            for i, (key, _) in enumerate(entries):
+                out[key] = (logits[i], float(values[i]))
+        return out
+
+    def sample(self, params_by_policy: dict, steps_per_env: int) -> dict:
+        from ray_tpu.rllib.rollout_worker import _logsumexp
+
+        streams = {}   # (env_idx, aid) -> per-step lists
+
+        def stream(e, aid):
+            key = (e, aid)
+            if key not in streams:
+                streams[key] = {"obs": [], "actions": [], "logp": [],
+                                "values": [], "rewards": [], "dones": []}
+            return streams[key]
+
+        for _ in range(steps_per_env):
+            keyed_obs = [((e, aid), aid,
+                          np.asarray(self._obs[e][aid], np.float32))
+                         for e, env in enumerate(self.envs)
+                         for aid in env.agent_ids if aid in self._obs[e]]
+            if not keyed_obs:
+                continue
+            fwd = self._forward_by_policy(params_by_policy, keyed_obs)
+            acts_by_env: dict[int, dict] = {}
+            for (e, aid), _aid, obs in keyed_obs:
+                logits, v = fwd[(e, aid)]
+                z = self._rng.gumbel(size=logits.shape)
+                act = int(np.argmax(logits + z))
+                logp = float((logits - _logsumexp(logits))[act])
+                st = stream(e, aid)
+                st["obs"].append(obs)
+                st["actions"].append(act)
+                st["logp"].append(logp)
+                st["values"].append(v)
+                acts_by_env.setdefault(e, {})[aid] = act
+            for e, actions in acts_by_env.items():
+                env = self.envs[e]
+                nobs, rewards, terms, truncs, _ = env.step(actions)
+                for aid in actions:
+                    st = stream(e, aid)
+                    st["rewards"].append(rewards.get(aid, 0.0))
+                    done = terms.get(aid) or truncs.get(aid)
+                    st["dones"].append(1.0 if done else 0.0)
+                    self._returns[e][aid] += rewards.get(aid, 0.0)
+                    if done:
+                        self._completed.append(self._returns[e][aid])
+                        self._returns[e][aid] = 0.0
+                if terms.get("__all__") or truncs.get("__all__"):
+                    obs, _ = env.reset()
+                    self._obs[e] = obs
+                else:
+                    self._obs[e] = {aid: nobs[aid] for aid in nobs
+                                    if not (terms.get(aid)
+                                            or truncs.get(aid))}
+
+        # V(s_T) bootstrap for still-alive streams, batched per policy
+        alive_keys = [((e, aid), aid,
+                       np.asarray(self._obs[e][aid], np.float32))
+                      for e, env in enumerate(self.envs)
+                      for aid in env.agent_ids if aid in self._obs[e]]
+        boot = {}
+        if alive_keys:
+            fwd = self._forward_by_policy(params_by_policy, alive_keys)
+            boot = {key: v for key, (_logits, v) in fwd.items()}
+
+        by_policy: dict[str, dict] = {}
+        for (e, aid), st in streams.items():
+            if not st["obs"]:
+                continue
+            pid = self.policy_mapping_fn(aid)
+            batch = self._gae(st, boot.get((e, aid), 0.0))
+            agg = by_policy.setdefault(pid, {k: [] for k in batch})
+            for k, v in batch.items():
+                agg[k].append(v)
+        out = {pid: {k: np.concatenate(v) for k, v in agg.items()}
+               for pid, agg in by_policy.items()}
+        completed, self._completed = self._completed, []
+        return {"policies": out,
+                "episode_returns": np.asarray(completed, np.float32)}
+
+    def _gae(self, st: dict, bootstrap_v: float) -> dict:
+        T = len(st["obs"])
+        rewards = np.asarray(st["rewards"], np.float32)
+        values = np.asarray(st["values"], np.float32)
+        dones = np.asarray(st["dones"], np.float32)
+        # bootstrap_v = V(s_T) under the CURRENT policy for a still-alive
+        # stream (0.0 when the final transition terminated)
+        last_v = bootstrap_v if dones[-1] == 0.0 else 0.0
+        adv = np.zeros(T, np.float32)
+        last_gae = 0.0
+        for t in reversed(range(T)):
+            next_v = last_v if t == T - 1 else values[t + 1]
+            nonterminal = 1.0 - dones[t]
+            delta = (rewards[t] + self.gamma * next_v * nonterminal
+                     - values[t])
+            last_gae = delta + (self.gamma * self.gae_lambda
+                                * nonterminal * last_gae)
+            adv[t] = last_gae
+        return {"obs": np.stack(st["obs"]),
+                "actions": np.asarray(st["actions"], np.int32),
+                "logp": np.asarray(st["logp"], np.float32),
+                "advantages": adv,
+                "value_targets": adv + values}
+
+
+class MultiAgentPPO:
+    """Clipped-surrogate PPO over N policies (reference: the multi-agent
+    configuration of rllib PPO — one learner pass per policy per
+    iteration, sampling shared across rollout actors)."""
+
+    def __init__(self, env_fn, *, policy_mapping_fn=lambda aid: "shared",
+                 num_rollout_workers: int = 2, num_envs_per_worker: int = 1,
+                 rollout_fragment_length: int = 64, lr: float = 3e-4,
+                 clip_param: float = 0.2, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, train_batch_epochs: int = 4,
+                 minibatch_size: int = 128, gamma: float = 0.99,
+                 gae_lambda: float = 0.95, seed: int = 0):
+        self.cfg = dict(clip=clip_param, vf=vf_coeff, ent=entropy_coeff,
+                        epochs=train_batch_epochs, mbs=minibatch_size)
+        self.rollout_fragment_length = rollout_fragment_length
+        worker_cls = ray_tpu.remote(MultiAgentRolloutWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=0).remote(
+                env_fn, policy_mapping_fn=policy_mapping_fn,
+                num_envs=num_envs_per_worker, seed=seed + i,
+                gamma=gamma, gae_lambda=gae_lambda)
+            for i in range(num_rollout_workers)
+        ]
+        spaces = ray_tpu.get(self.workers[0].spaces.remote())
+        self.params = {}
+        self.opt_states = {}
+        self.optimizer = optax.adam(lr)
+        for i, (pid, (obs_size, num_actions)) in enumerate(
+                sorted(spaces.items())):
+            self.params[pid] = init_policy(
+                jax.random.PRNGKey(seed + i), obs_size, num_actions)
+            self.opt_states[pid] = self.optimizer.init(self.params[pid])
+        from ray_tpu.rllib.algorithm import (
+            _jit_sgd_update,
+            ppo_surrogate_loss,
+        )
+
+        self._update = _jit_sgd_update(
+            ppo_surrogate_loss(clip_param, vf_coeff, entropy_coeff),
+            self.optimizer)
+        self.iteration = 0
+        self._recent_returns: list = []
+        self._seed = seed
+
+    def train(self) -> dict:
+        t0 = time.time()
+        self.iteration += 1
+        refs = [w.sample.remote(self.params, self.rollout_fragment_length)
+                for w in self.workers]
+        results = ray_tpu.get(refs, timeout=300)
+        merged: dict[str, dict] = {}
+        for r in results:
+            self._recent_returns.extend(r["episode_returns"].tolist())
+            for pid, batch in r["policies"].items():
+                agg = merged.setdefault(pid, {k: [] for k in batch})
+                for k, v in batch.items():
+                    agg[k].append(v)
+        self._recent_returns = self._recent_returns[-200:]
+        # metrics labeled PER POLICY: an unlabeled last-minibatch aux
+        # would describe one arbitrary policy while looking global
+        metrics: dict = {}
+        rng = np.random.default_rng(self._seed + self.iteration)
+        for pid, agg in merged.items():
+            batch = {k: np.concatenate(v) for k, v in agg.items()}
+            n = len(batch["obs"])
+            mbs = min(self.cfg["mbs"], n)
+            aux = {}
+            for _ in range(self.cfg["epochs"]):
+                perm = rng.permutation(n)
+                for start in range(0, n - mbs + 1, mbs):
+                    idx = perm[start:start + mbs]
+                    mb = {k: v[idx] for k, v in batch.items()}
+                    (self.params[pid], self.opt_states[pid],
+                     aux) = self._update(self.params[pid],
+                                         self.opt_states[pid], mb)
+            for k, v in aux.items():
+                metrics[f"{pid}/{k}"] = float(v)
+        return {"training_iteration": self.iteration,
+                "episode_reward_mean": (float(np.mean(
+                    self._recent_returns))
+                    if self._recent_returns else 0.0),
+                "policies_trained": sorted(merged),
+                **metrics,
+                "time_this_iter_s": time.time() - t0}
+
+    def save(self) -> dict:
+        return {"params": self.params, "iteration": self.iteration}
+
+    def restore(self, state: dict):
+        self.params = state["params"]
+        self.iteration = state["iteration"]
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
