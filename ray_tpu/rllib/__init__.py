@@ -15,6 +15,13 @@ from ray_tpu.rllib.multi_agent import (
     MultiAgentPPO,
     MultiAgentRolloutWorker,
 )
+from ray_tpu.rllib.connectors import (
+    ClipReward,
+    Connector,
+    ConnectorPipeline,
+    FrameStack,
+    MeanStdObsNormalizer,
+)
 from ray_tpu.rllib.env import CartPole, make_env
 from ray_tpu.rllib.models import init_policy, policy_apply
 from ray_tpu.rllib.replay_buffer import (
@@ -27,7 +34,9 @@ from ray_tpu.rllib.rollout_worker import (
     concat_batches,
 )
 
-__all__ = ["A2C", "Algorithm", "AlgorithmConfig", "BC", "CartPole", "DQN",
+__all__ = ["A2C", "Algorithm", "AlgorithmConfig", "BC", "CartPole",
+           "ClipReward", "Connector", "ConnectorPipeline", "DQN",
+           "FrameStack", "MeanStdObsNormalizer",
            "MultiAgentCartPole", "MultiAgentEnv", "MultiAgentPPO",
            "MultiAgentRolloutWorker",
            "PPO", "PrioritizedReplayBuffer", "ReplayBuffer",

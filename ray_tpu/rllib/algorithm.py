@@ -35,6 +35,7 @@ class AlgorithmConfig:
         self.num_rollout_workers = 2
         self.num_envs_per_worker = 2
         self.rollout_fragment_length = 128
+        self.connectors = None   # list of Connector factories (per worker)
         self.gamma = 0.99
         self.gae_lambda = 0.95
         self.lr = 3e-4
@@ -65,13 +66,18 @@ class AlgorithmConfig:
         return self
 
     def rollouts(self, *, num_rollout_workers=None, num_envs_per_worker=None,
-                 rollout_fragment_length=None):
+                 rollout_fragment_length=None, connectors=None):
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if num_envs_per_worker is not None:
             self.num_envs_per_worker = num_envs_per_worker
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if connectors is not None:
+            # factories, one call per worker: stateful connectors
+            # (FrameStack, running filters) must not share state across
+            # worker processes
+            self.connectors = connectors
         return self
 
     def training(self, **kwargs):
@@ -98,7 +104,9 @@ class Algorithm:
             worker_cls.options(num_cpus=0).remote(
                 config.env_spec, num_envs=config.num_envs_per_worker,
                 seed=config.seed + i, gamma=config.gamma,
-                gae_lambda=config.gae_lambda)
+                gae_lambda=config.gae_lambda,
+                connectors=([f() for f in config.connectors]
+                            if config.connectors else None))
             for i in range(config.num_rollout_workers)
         ]
         obs_size, num_actions = ray_tpu.get(self.workers[0].spaces.remote())
@@ -270,6 +278,13 @@ class BC(Algorithm):
         data = config.offline_data
         if data is None:
             raise ValueError("BC needs config.training(offline_data=...)")
+        if config.connectors:
+            # connectors would resize/renormalize the EVALUATION worker's
+            # observations while training sees the raw dataset — a
+            # silently distribution-shifted policy. Preprocess the
+            # dataset itself instead.
+            raise ValueError("BC does not support rollout connectors; "
+                             "apply transforms to offline_data directly")
         if hasattr(data, "take_all"):   # ray_tpu Dataset of row dicts
             rows = data.take_all()
             data = {"obs": np.stack([r["obs"] for r in rows]),

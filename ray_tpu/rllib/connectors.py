@@ -1,0 +1,120 @@
+"""Connectors — observation/reward transform pipelines between env and
+policy (reference: rllib/connectors/ — agent/env connector pipelines
+that preprocess observations and postprocess experiences so the policy
+sees a stable, normalized view).
+
+A ConnectorPipeline sits inside the rollout worker: every raw
+observation passes through `transform_obs` before the policy forward
+(and before being recorded in the sample batch), rewards pass through
+`transform_reward` before GAE. Connectors may be stateful per agent
+stream (FrameStack) or globally adaptive (MeanStdObsNormalizer's
+running statistics — per-worker, like the reference's per-worker
+filters, synced only through learned behavior)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Connector:
+    def transform_obs(self, obs: np.ndarray, stream_key=None) -> np.ndarray:
+        return obs
+
+    def transform_reward(self, reward: float, stream_key=None) -> float:
+        return reward
+
+    def obs_size(self, raw_size: int) -> int:
+        """Output obs width given the raw width (FrameStack widens)."""
+        return raw_size
+
+    def reset(self, stream_key=None):
+        """Episode boundary for one stream (clears per-stream state)."""
+
+
+class MeanStdObsNormalizer(Connector):
+    """Running mean/std observation filter (reference:
+    rllib/utils/filter.py MeanStdFilter via connectors)."""
+
+    def __init__(self, eps: float = 1e-8, clip: float = 10.0):
+        self._count = 0
+        self._mean = None
+        self._m2 = None
+        self.eps = eps
+        self.clip = clip
+
+    def transform_obs(self, obs, stream_key=None):
+        obs = np.asarray(obs, np.float64)
+        if self._mean is None:
+            self._mean = np.zeros_like(obs)
+            self._m2 = np.zeros_like(obs)
+        self._count += 1
+        delta = obs - self._mean
+        self._mean = self._mean + delta / self._count
+        self._m2 = self._m2 + delta * (obs - self._mean)
+        var = (self._m2 / max(1, self._count - 1)
+               if self._count > 1 else np.ones_like(obs))
+        out = (obs - self._mean) / np.sqrt(var + self.eps)
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+
+class ClipReward(Connector):
+    """Reward clipping (reference: connectors ClipReward / the Atari
+    sign-clip convention)."""
+
+    def __init__(self, limit: float = 1.0):
+        self.limit = limit
+
+    def transform_reward(self, reward, stream_key=None):
+        return float(np.clip(reward, -self.limit, self.limit))
+
+
+class FrameStack(Connector):
+    """Stack the last k observations per stream (reference: connectors
+    FrameStackingConnector) — gives a feedforward policy short-term
+    memory."""
+
+    def __init__(self, k: int = 4):
+        self.k = k
+        self._stacks: dict = {}
+
+    def obs_size(self, raw_size: int) -> int:
+        return raw_size * self.k
+
+    def transform_obs(self, obs, stream_key=None):
+        obs = np.asarray(obs, np.float32)
+        stack = self._stacks.get(stream_key)
+        if stack is None:
+            stack = [obs] * self.k
+        else:
+            stack = stack[1:] + [obs]
+        self._stacks[stream_key] = stack
+        return np.concatenate(stack)
+
+    def reset(self, stream_key=None):
+        if stream_key is None:
+            self._stacks.clear()
+        else:
+            self._stacks.pop(stream_key, None)
+
+
+class ConnectorPipeline(Connector):
+    def __init__(self, connectors: list):
+        self.connectors = list(connectors)
+
+    def transform_obs(self, obs, stream_key=None):
+        for c in self.connectors:
+            obs = c.transform_obs(obs, stream_key)
+        return obs
+
+    def transform_reward(self, reward, stream_key=None):
+        for c in self.connectors:
+            reward = c.transform_reward(reward, stream_key)
+        return reward
+
+    def obs_size(self, raw_size: int) -> int:
+        for c in self.connectors:
+            raw_size = c.obs_size(raw_size)
+        return raw_size
+
+    def reset(self, stream_key=None):
+        for c in self.connectors:
+            c.reset(stream_key)

@@ -11,20 +11,29 @@ from __future__ import annotations
 import numpy as np
 
 from ray_tpu.rllib.env import env_spaces, make_env
+from ray_tpu.rllib.connectors import ConnectorPipeline
 from ray_tpu.rllib.models import policy_apply
 
 
 class RolloutWorker:
     def __init__(self, env_spec, *, num_envs: int = 2, seed: int = 0,
-                 gamma: float = 0.99, gae_lambda: float = 0.95):
+                 gamma: float = 0.99, gae_lambda: float = 0.95,
+                 connectors=None):
         self.envs = [make_env(env_spec, seed=seed * 1000 + i)
                      for i in range(num_envs)]
-        self.obs_size, self.num_actions = env_spaces(self.envs[0])
+        raw_obs_size, self.num_actions = env_spaces(self.envs[0])
+        # connectors transform every observation/reward between env and
+        # policy (reference: rllib/connectors/); the policy's obs width
+        # follows the pipeline (FrameStack widens it)
+        self.connectors = ConnectorPipeline(connectors or [])
+        self.obs_size = self.connectors.obs_size(raw_obs_size)
         self.gamma = gamma
         self.gae_lambda = gae_lambda
         self._rng = np.random.default_rng(seed)
-        self._obs = [env.reset(seed=seed * 1000 + i)[0]
-                     for i, env in enumerate(self.envs)]
+        self._obs = [
+            self.connectors.transform_obs(
+                env.reset(seed=seed * 1000 + i)[0], stream_key=i)
+            for i, env in enumerate(self.envs)]
         self._episode_returns = [0.0] * num_envs
         self._completed: list[float] = []
         import jax
@@ -36,16 +45,21 @@ class RolloutWorker:
 
     def _env_step(self, e: int, action: int):
         """Step env e, handle episode bookkeeping + auto-reset. Returns
-        (next_obs_before_reset, reward, terminated, truncated); self._obs[e]
-        ends up at the obs the NEXT action should see."""
+        (next_obs_before_reset, TRANSFORMED reward, terminated,
+        truncated); self._obs[e] ends up at the (transformed) obs the
+        NEXT action should see."""
         nobs, r, terminated, truncated, _ = self.envs[e].step(int(action))
-        self._episode_returns[e] += r
+        self._episode_returns[e] += r   # true return, pre-transform
+        r = self.connectors.transform_reward(r, stream_key=e)
         if terminated or truncated:
             self._completed.append(self._episode_returns[e])
             self._episode_returns[e] = 0.0
-            self._obs[e] = self.envs[e].reset()[0]
+            self.connectors.reset(stream_key=e)
+            self._obs[e] = self.connectors.transform_obs(
+                self.envs[e].reset()[0], stream_key=e)
         else:
-            self._obs[e] = nobs
+            self._obs[e] = self.connectors.transform_obs(
+                nobs, stream_key=e)
         return nobs, r, terminated, truncated
 
     def sample(self, params, steps_per_env: int) -> dict:
@@ -142,12 +156,16 @@ class TransitionWorker(RolloutWorker):
             obs[t] = stacked
             actions[t] = act
             for e in range(E):
-                nobs, r, terminated, _ = self._env_step(e, act[e])
+                _nobs, r, terminated, _ = self._env_step(e, act[e])
                 rewards[t, e] = r
                 # truncation is not a true terminal: bootstrapping through
                 # it is correct, so done=terminated only
                 dones[t, e] = 1.0 if terminated else 0.0
-                next_obs[t, e] = nobs
+                # the TRANSFORMED next obs the target network will see
+                # (raw _nobs has the wrong width/statistics under
+                # connectors). On episode end self._obs[e] is the reset
+                # obs — fine: the TD target masks next_obs by done.
+                next_obs[t, e] = self._obs[e]
 
         flat = lambda a: a.reshape((T * E,) + a.shape[2:])
         completed, self._completed = self._completed, []
