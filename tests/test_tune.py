@@ -102,6 +102,12 @@ def test_pbt_exploit(ray_start_regular):
             start = ckpt.to_dict()["score"]
         score = start
         for step in range(8):
+            import time as _time
+
+            # pace the steps (same rationale as the ASHA test): PBT can
+            # only exploit trials it observes RUNNING together, and trial
+            # starts serialize behind the worker-startup gate
+            _time.sleep(0.3)
             score += config["lr"]
             session.report({"score": score},
                            checkpoint=Checkpoint.from_dict(
