@@ -266,6 +266,12 @@ class StoreClient:
             raise StoreError(rc, "seal")
 
     @_guarded
+    def abort(self, object_id: bytes):
+        """Discard an unsealed create() reservation (e.g. a network pull
+        that died mid-write into the segment)."""
+        self._libref.store_abort(self._h, object_id)
+
+    @_guarded
     def get(self, object_id: bytes) -> PinnedBuffer | None:
         """Pin + return a sealed object, restoring from spill if needed.
 
