@@ -318,6 +318,30 @@ def recv(src_rank: int, group_name: str = "default"):
     return _p2p(g).recv(src_rank, seq)
 
 
+def send_device(tensor, dst_rank: int, group_name: str = "default"):
+    """Device-resident point-to-point send (xla groups only): the
+    endpoints enter a compiled 2-device ppermute program, so on TPU the
+    payload rides ICI/DCN instead of the host mailbox plane (the
+    NCCL-send analog the host-path `send` is not). Matched-call
+    contract: the peer must call `recv_device` with the same shape/dtype
+    in the same order."""
+    g = _manager.get(group_name)
+    if getattr(g, "backend", None) != "xla":
+        raise ValueError("send_device requires an xla collective group")
+    # _coerce keeps jax arrays ON DEVICE for xla groups and converts
+    # foreign inputs (torch tensors incl. requires_grad, lists)
+    g.impl.send_device(_coerce(g, tensor), dst_rank)
+
+
+def recv_device(shape, dtype, src_rank: int, group_name: str = "default"):
+    """Device-resident point-to-point receive (pairs with send_device);
+    returns a device-resident jax array."""
+    g = _manager.get(group_name)
+    if getattr(g, "backend", None) != "xla":
+        raise ValueError("recv_device requires an xla collective group")
+    return g.impl.recv_device(shape, dtype, src_rank)
+
+
 def barrier(group_name: str = "default"):
     g = _manager.get(group_name)
     g.impl.barrier(g.next_seq())

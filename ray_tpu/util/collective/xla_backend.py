@@ -68,17 +68,24 @@ class XlaGroup:
             self._mesh = jax.sharding.Mesh(np.array(devs), ("ranks",))
         return self._mesh
 
-    def _global_array(self, arr: np.ndarray):
-        """Stack this rank's array as its shard of a leading `ranks` axis."""
+    def _global_array(self, arr, mesh=None, axis: str = "ranks",
+                      world: int | None = None):
+        """Stack this rank's array as its shard of a leading group axis.
+        Works for numpy AND device-resident jax arrays (device_put moves
+        device-to-device, no host staging); the pair-mesh p2p path reuses
+        it with axis="pair", world=2."""
         jax = self._jax
-        mesh = self._ensure_mesh()
-        spec = jax.sharding.PartitionSpec("ranks", *([None] * arr.ndim))
+        if mesh is None:
+            mesh = self._ensure_mesh()
+        if world is None:
+            world = self.world_size
+        spec = jax.sharding.PartitionSpec(axis, *([None] * arr.ndim))
         sharding = jax.sharding.NamedSharding(mesh, spec)
         local_dev = [d for d in mesh.devices.flat
                      if d.process_index == jax.process_index()][0]
         shard = jax.device_put(arr[None, ...], local_dev)
         return jax.make_array_from_single_device_arrays(
-            (self.world_size,) + arr.shape, sharding, [shard]), sharding
+            (world,) + tuple(arr.shape), sharding, [shard]), sharding
 
     def _compiled(self, kind: str, op: str, shape, dtype):
         key = (kind, op, shape, dtype)
@@ -165,6 +172,66 @@ class XlaGroup:
                                    out_specs=in_spec))
         self._fns[key] = fn
         return fn
+
+    # -- device-resident p2p ------------------------------------------------
+
+    def _rank_device(self, rank: int):
+        for d in self._jax.devices():
+            if d.process_index == rank:
+                return d
+        raise RuntimeError(f"no device for rank {rank}")
+
+    def _pair_fn(self, src: int, dst: int, shape, dtype):
+        """Compiled 2-device ppermute over a SUB-mesh of the world: only
+        the endpoints enter the program, so send/recv stays a
+        point-to-point exchange (NCCL-send/recv analog) — on TPU the
+        transfer rides ICI/DCN links, never the host mailbox plane."""
+        key = ("p2p", src, dst, shape, dtype)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        mesh = Mesh(
+            np.array([self._rank_device(src), self._rank_device(dst)]),
+            ("pair",))
+        in_spec = P("pair", *([None] * len(shape)))
+
+        def body(x):
+            return lax.ppermute(x, "pair", perm=[(0, 1)])
+
+        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_spec,
+                                   out_specs=in_spec))
+        self._fns[key] = (fn, mesh)
+        return self._fns[key]
+
+    def send_device(self, arr, dst: int):
+        """Called on the SOURCE rank; pairs with recv_device(dst side).
+        Device-resident inputs never stage through the host (device_put
+        is device-to-device). Blocks until the transfer program ran
+        (matched-call contract, same as NCCL send/recv)."""
+        jax = self._jax
+        if not self._is_device_array(arr):
+            arr = np.asarray(arr)
+        dtype = str(jax.numpy.dtype(arr.dtype))   # canonical (bfloat16!)
+        fn, mesh = self._pair_fn(self.rank, dst, tuple(arr.shape), dtype)
+        garr, _ = self._global_array(arr, mesh=mesh, axis="pair", world=2)
+        jax.block_until_ready(fn(garr))
+
+    def recv_device(self, shape, dtype, src: int):
+        """Called on the DESTINATION rank; returns the payload as a
+        device-resident jax array."""
+        jax = self._jax
+        dt = jax.numpy.dtype(dtype)   # resolves "bfloat16" via ml_dtypes
+        fn, mesh = self._pair_fn(src, self.rank, tuple(shape), str(dt))
+        zeros = np.zeros(tuple(shape), dt)
+        garr, _ = self._global_array(zeros, mesh=mesh, axis="pair",
+                                     world=2)
+        out = fn(garr)
+        return out.addressable_shards[0].data[0]
 
     # -- ops ----------------------------------------------------------------
     # Device residency: jax-array inputs stay on device end-to-end — the

@@ -363,3 +363,74 @@ def test_object_collectives(ray_start_regular):
     out = ray_tpu.get([w.bcast.remote(payloads[i] if i == 0 else None)
                        for i, w in enumerate(workers)])
     assert all(o == payloads[0] for o in out)
+
+
+def test_xla_device_p2p_send_recv(ray_start_regular):
+    """Device-resident p2p: endpoints exchange through a compiled
+    2-device ppermute (NCCL-send/recv analog; on TPU this rides
+    ICI/DCN, not the host mailbox plane)."""
+    ray = ray_start_regular
+    from ray_tpu.util import collective as col
+    from ray_tpu.util.collective import CollectiveActorMixin
+
+    @ray.remote
+    class Rank(CollectiveActorMixin):
+        def exchange(self, rank):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.util import collective as c
+
+            if rank == 0:
+                c.send_device(jnp.arange(6, dtype=jnp.float32) + 100.0,
+                              dst_rank=1, group_name="p2pdev")
+                return "sent"
+            out = c.recv_device((6,), "float32", src_rank=0,
+                                group_name="p2pdev")
+            return bool(isinstance(out, jax.Array)), \
+                [float(x) for x in out]
+
+    actors = [Rank.options(num_cpus=0).remote() for _ in range(2)]
+    col.create_collective_group(actors, 2, [0, 1], backend="xla",
+                                group_name="p2pdev")
+    sent, (is_jax, values) = ray.get(
+        [a.exchange.remote(i) for i, a in enumerate(actors)], timeout=180)
+    assert sent == "sent"
+    assert is_jax, "recv_device returned a host array"
+    assert values == [100.0, 101.0, 102.0, 103.0, 104.0, 105.0]
+
+
+def test_xla_device_p2p_subset_of_larger_world(ray_start_regular):
+    """Only the two endpoints enter the pair program — ranks 1 and 2 of
+    a 4-rank world exchange while ranks 0 and 3 do unrelated work (the
+    point-to-point property; a collective would hang them)."""
+    ray = ray_start_regular
+    from ray_tpu.util import collective as col
+    from ray_tpu.util.collective import CollectiveActorMixin
+
+    @ray.remote
+    class Rank(CollectiveActorMixin):
+        def run(self, rank):
+            import jax.numpy as jnp
+            import numpy as np
+
+            from ray_tpu.util import collective as c
+
+            if rank == 1:
+                c.send_device(jnp.full((3,), 7.0), dst_rank=2,
+                              group_name="p2pworld")
+                return "sent"
+            if rank == 2:
+                out = c.recv_device((3,), "float32", src_rank=1,
+                                    group_name="p2pworld")
+                return [float(x) for x in np.asarray(out)]
+            return "idle"
+
+    actors = [Rank.options(num_cpus=0).remote() for _ in range(4)]
+    col.create_collective_group(actors, 4, [0, 1, 2, 3], backend="xla",
+                                group_name="p2pworld")
+    out = ray.get([a.run.remote(i) for i, a in enumerate(actors)],
+                  timeout=180)
+    assert out[0] == "idle" and out[3] == "idle"
+    assert out[1] == "sent"
+    assert out[2] == [7.0, 7.0, 7.0]
