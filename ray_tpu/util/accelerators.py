@@ -1,0 +1,58 @@
+"""Accelerator-type constants + helpers (reference:
+python/ray/util/accelerators/ — NVIDIA_TESLA_* constants used in
+`@ray.remote(accelerator_type=...)`; here the first-class citizens are
+TPU generations, and the helpers read the TPU VM runtime env the way
+the reference's TPU pod detection does)."""
+from __future__ import annotations
+
+import os
+
+# accelerator_type constants (GKE/GCE TPU accelerator type strings)
+TPU_V2 = "TPU-V2"
+TPU_V3 = "TPU-V3"
+TPU_V4 = "TPU-V4"
+TPU_V5E = "TPU-V5LITEPOD"
+TPU_V5P = "TPU-V5P"
+TPU_V6E = "TPU-V6E"
+
+_GENERATION_PREFIXES = {
+    "v2": TPU_V2, "v3": TPU_V3, "v4": TPU_V4,
+    "v5litepod": TPU_V5E, "v5e": TPU_V5E, "v5p": TPU_V5P,
+    "v6e": TPU_V6E,
+}
+
+
+def get_current_pod_name() -> str | None:
+    """The TPU pod/slice this host belongs to (TPU_NAME on TPU VMs)."""
+    return os.environ.get("TPU_NAME") or os.environ.get("TPU_SLICE_ID")
+
+
+def get_current_pod_worker_count() -> int | None:
+    """Number of hosts in this pod (TPU_WORKER_HOSTNAMES on TPU VMs)."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if hosts:
+        return len(hosts.split(","))
+    return None
+
+
+def get_current_accelerator_type() -> str | None:
+    """Normalized accelerator type of this host (e.g. 'TPU-V5LITEPOD'
+    for a v5litepod-16 slice), or None off-TPU."""
+    raw = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    if not raw:
+        return None
+    gen = raw.split("-")[0].lower()
+    return _GENERATION_PREFIXES.get(gen, f"TPU-{gen.upper()}")
+
+
+def get_current_topology() -> str | None:
+    """Chip topology string of this slice (e.g. '2x4'), or None."""
+    topo = os.environ.get("TPU_TOPOLOGY")
+    if topo:
+        return topo
+    raw = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    # v5litepod-16 → 16 chips; topology proper only comes from
+    # TPU_TOPOLOGY, so expose the chip count form when that's all we have
+    if "-" in raw:
+        return raw.split("-", 1)[1]
+    return None

@@ -1,0 +1,147 @@
+"""Remote pdb — break inside a task/actor and attach from anywhere
+(reference: python/ray/util/rpdb.py — `ray debug` connects to a
+socket-backed pdb the breakpoint opened; here `ray_tpu.util.rpdb
+.set_trace()` listens on a TCP port, announces itself through GCS KV,
+and `connect()` (or plain `nc host port`) attaches)."""
+from __future__ import annotations
+
+import pdb
+import socket
+import sys
+
+
+class _SocketIO:
+    """File-like adapter over one accepted connection."""
+
+    def __init__(self, conn: socket.socket):
+        self._file = conn.makefile("rw", buffering=1)
+
+    def readline(self):
+        return self._file.readline()
+
+    def read(self, *a):
+        return self._file.read(*a)
+
+    def write(self, data):
+        try:
+            self._file.write(data)
+        except OSError:
+            pass
+        return len(data)
+
+    def flush(self):
+        try:
+            self._file.flush()
+        except OSError:
+            pass
+
+
+class RemotePdb(pdb.Pdb):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(1)
+        self.addr = self._listener.getsockname()
+        self._announce()
+        print(f"[rpdb] waiting for debugger on "
+              f"{self.addr[0]}:{self.addr[1]} "
+              f"(nc {self.addr[0]} {self.addr[1]})",
+              file=sys.stderr, flush=True)
+        self._conn, _ = self._listener.accept()
+        self._withdraw()   # a session list shows WAITING breakpoints
+        io = _SocketIO(self._conn)
+        super().__init__(stdin=io, stdout=io)
+        self.prompt = "(rpdb) "
+
+    def _announce(self):
+        """Register in GCS KV so `active_sessions()` finds us."""
+        try:
+            import json
+            import os
+
+            from ray_tpu._private.worker_runtime import current_worker
+
+            w = current_worker()
+            if w is not None:
+                w.gcs.call(
+                    "kv_put", ns="rpdb",
+                    key=f"{os.getpid()}".encode(),
+                    value=json.dumps({
+                        "host": self.addr[0], "port": self.addr[1],
+                        "pid": os.getpid(),
+                        "worker_id": w.worker_id}).encode(),
+                    timeout=5.0)
+        except Exception:
+            pass   # debugging must work even when the runtime is down
+
+    def _withdraw(self):
+        try:
+            import os
+
+            from ray_tpu._private.worker_runtime import current_worker
+
+            w = current_worker()
+            if w is not None:
+                w.gcs.call("kv_del", ns="rpdb",
+                           key=f"{os.getpid()}".encode(), timeout=5.0)
+        except Exception:
+            pass
+
+    def close(self):
+        try:
+            self._conn.close()
+        finally:
+            self._listener.close()
+
+
+def set_trace(host: str = "127.0.0.1", port: int = 0):
+    """Open a remote breakpoint at the caller's frame and BLOCK until a
+    debugger attaches (parity: ray.util.rpdb.set_trace)."""
+    rdb = RemotePdb(host, port)
+    rdb.set_trace(sys._getframe().f_back)
+
+
+def active_sessions(address: str | None = None) -> list[dict]:
+    """Breakpoints currently waiting across the cluster (from GCS KV)."""
+    import json
+
+    from ray_tpu.experimental.state.api import _gcs
+
+    out = []
+    with _gcs(address) as call:
+        for key in call("kv_keys", ns="rpdb"):
+            blob = call("kv_get", ns="rpdb", key=key)
+            if blob:
+                out.append(json.loads(blob))
+    return out
+
+
+def connect(host: str, port: int):
+    """Interactive attach: bridge this terminal to a waiting breakpoint
+    (the `ray debug` role; `nc host port` works equally)."""
+    sock = socket.create_connection((host, int(port)), timeout=10)
+    f = sock.makefile("rw", buffering=1)
+    import threading
+
+    def pump_out():
+        try:
+            while True:
+                data = f.readline()
+                if not data:
+                    break
+                sys.stdout.write(data)
+                sys.stdout.flush()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=pump_out, daemon=True)
+    t.start()
+    try:
+        for line in sys.stdin:
+            f.write(line)
+            f.flush()
+    except (KeyboardInterrupt, OSError):
+        pass
+    finally:
+        sock.close()

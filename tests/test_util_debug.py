@@ -1,0 +1,81 @@
+"""util.accelerators + util.rpdb tests (reference: ray/util/accelerators,
+ray/util/rpdb — `ray debug`)."""
+import socket
+import threading
+import time
+
+import pytest
+
+
+def test_accelerator_helpers(monkeypatch):
+    from ray_tpu.util import accelerators as acc
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    monkeypatch.setenv("TPU_NAME", "my-slice")
+    monkeypatch.setenv("TPU_TOPOLOGY", "2x4")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3")
+    assert acc.get_current_accelerator_type() == acc.TPU_V5E
+    assert acc.get_current_pod_name() == "my-slice"
+    assert acc.get_current_topology() == "2x4"
+    assert acc.get_current_pod_worker_count() == 4
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE")
+    assert acc.get_current_accelerator_type() is None
+
+
+def test_rpdb_breakpoint_drives_over_socket(ray_start_regular):
+    """A task hits set_trace; the test attaches over TCP, inspects a
+    local variable, and continues the task (the `ray debug` flow)."""
+    import re
+
+    import ray_tpu
+    from ray_tpu.util import rpdb
+
+    @ray_tpu.remote
+    def buggy():
+        secret = 41 + 1
+        rpdb.set_trace()
+        return secret
+
+    ref = buggy.remote()
+    # find the announced breakpoint
+    session = None
+    deadline = time.monotonic() + 60
+    while session is None and time.monotonic() < deadline:
+        sessions = rpdb.active_sessions()
+        if sessions:
+            session = sessions[-1]
+        else:
+            time.sleep(0.2)
+    assert session, "breakpoint never announced"
+
+    sock = socket.create_connection(
+        (session["host"], session["port"]), timeout=30)
+    f = sock.makefile("rw", buffering=1)
+
+    def read_until(pattern, timeout=30):
+        buf = ""
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            ch = f.read(1)
+            if not ch:
+                break
+            buf += ch
+            if re.search(pattern, buf):
+                return buf
+        raise AssertionError(f"pattern {pattern!r} not seen in {buf!r}")
+
+    read_until(r"\(rpdb\) ")
+    f.write("p secret\n")
+    f.flush()
+    out = read_until(r"42")
+    assert "42" in out
+    f.write("c\n")
+    f.flush()
+    sock.close()
+    assert ray_tpu.get(ref, timeout=60) == 42
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v", "-x"]))
