@@ -43,12 +43,27 @@ class RemotePdb(pdb.Pdb):
         self._listener.bind((host, port))
         self._listener.listen(1)
         self.addr = self._listener.getsockname()
+        self._attached = False
         self._announce()
+        # heartbeat while waiting: active_sessions prunes entries whose
+        # ts goes stale (a killed task can't clean up after itself)
+        import threading
+
+        def _beat():
+            import time as _t
+
+            while not self._attached:
+                _t.sleep(5.0)
+                if not self._attached:
+                    self._announce()
+
+        threading.Thread(target=_beat, daemon=True).start()
         print(f"[rpdb] waiting for debugger on "
               f"{self.addr[0]}:{self.addr[1]} "
               f"(nc {self.addr[0]} {self.addr[1]})",
               file=sys.stderr, flush=True)
         self._conn, _ = self._listener.accept()
+        self._attached = True
         self._withdraw()   # a session list shows WAITING breakpoints
         io = _SocketIO(self._conn)
         super().__init__(stdin=io, stdout=io)
@@ -64,12 +79,21 @@ class RemotePdb(pdb.Pdb):
 
             w = current_worker()
             if w is not None:
+                # announce a ROUTABLE host: the bind address (loopback /
+                # 0.0.0.0) is meaningless from other nodes — the
+                # worker's registered RPC address is how peers reach
+                # this host
+                host = self.addr[0]
+                if host in ("0.0.0.0", "127.0.0.1") and w.addr:
+                    host = w.addr[0]
+                import time
+
                 w.gcs.call(
                     "kv_put", ns="rpdb",
                     key=f"{os.getpid()}".encode(),
                     value=json.dumps({
-                        "host": self.addr[0], "port": self.addr[1],
-                        "pid": os.getpid(),
+                        "host": host, "port": self.addr[1],
+                        "pid": os.getpid(), "ts": time.time(),
                         "worker_id": w.worker_id}).encode(),
                     timeout=5.0)
         except Exception:
@@ -94,26 +118,56 @@ class RemotePdb(pdb.Pdb):
         finally:
             self._listener.close()
 
+    # session-over hooks: 'c' (with no breakpoints) or 'q' ends the
+    # remote session — close the sockets so the client sees EOF and a
+    # looping breakpoint can't leak fds
+    def set_continue(self):
+        super().set_continue()
+        if not self.breaks:
+            self.close()
+
+    def set_quit(self):
+        super().set_quit()
+        self.close()
+
 
 def set_trace(host: str = "127.0.0.1", port: int = 0):
     """Open a remote breakpoint at the caller's frame and BLOCK until a
-    debugger attaches (parity: ray.util.rpdb.set_trace)."""
+    debugger attaches (parity: ray.util.rpdb.set_trace). The session's
+    sockets close when the debugger continues/quits (set_continue/
+    set_quit hooks) — the client gets EOF and repeated breakpoints don't
+    leak fds. NOTE: pdb.set_trace installs tracing and returns; closing
+    here would kill the session before the first prompt."""
     rdb = RemotePdb(host, port)
     rdb.set_trace(sys._getframe().f_back)
 
 
 def active_sessions(address: str | None = None) -> list[dict]:
-    """Breakpoints currently waiting across the cluster (from GCS KV)."""
+    """Breakpoints currently WAITING across the cluster. Entries whose
+    listener no longer answers (task cancelled / worker killed before
+    any attach) are pruned from the KV as they are discovered — a crash
+    can't clean up after itself, so the listing does."""
     import json
 
     from ray_tpu.experimental.state.api import _gcs
+
+    import time
 
     out = []
     with _gcs(address) as call:
         for key in call("kv_keys", ns="rpdb"):
             blob = call("kv_get", ns="rpdb", key=key)
-            if blob:
-                out.append(json.loads(blob))
+            if not blob:
+                continue
+            info = json.loads(blob)
+            # liveness via the entry's heartbeat (the waiting breakpoint
+            # refreshes `ts` every few seconds; a TCP probe would be
+            # DESTRUCTIVE — it would consume the single accept slot and
+            # bind the pdb session to the probe)
+            if time.time() - info.get("ts", 0) > 20.0:
+                call("kv_del", ns="rpdb", key=key)   # stale entry
+                continue
+            out.append(info)
     return out
 
 
@@ -125,12 +179,14 @@ def connect(host: str, port: int):
     import threading
 
     def pump_out():
+        # byte-wise: the '(rpdb) ' prompt carries no newline, so a
+        # line-buffered pump would never show it
         try:
             while True:
-                data = f.readline()
+                data = sock.recv(4096)
                 if not data:
                     break
-                sys.stdout.write(data)
+                sys.stdout.write(data.decode(errors="replace"))
                 sys.stdout.flush()
         except OSError:
             pass
