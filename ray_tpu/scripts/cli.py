@@ -149,6 +149,48 @@ def cmd_memory(args):
     return 0
 
 
+def cmd_serve(args):
+    """`ray-tpu serve run/status/shutdown` (reference: serve CLI,
+    python/ray/serve/scripts.py). `run module:attr` imports the bound
+    Application and deploys it; --non-blocking returns after deploy
+    (deployments are detached actors — they outlive this process)."""
+    import importlib
+
+    import ray_tpu
+
+    if args.action == "run" and not args.target:
+        raise SystemExit("serve run needs a target (module:attr of a "
+                         "bound Application)")
+    address = args.address or _current_cluster()["gcs_address"]
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    from ray_tpu import serve
+
+    if args.action == "run":
+        mod_name, _, attr = args.target.partition(":")
+        app = getattr(importlib.import_module(mod_name), attr or "app")
+        serve.run(app, route_prefix=args.route_prefix or "/")
+        print(json.dumps({"status": "deployed",
+                          "target": args.target,
+                          "http_port": serve.http_port()}), flush=True)
+        if args.non_blocking:
+            return 0
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            serve.shutdown()
+        return 0
+    if args.action == "status":
+        try:
+            print(json.dumps(serve.status(), default=str, indent=2))
+        except ValueError:
+            raise SystemExit("Serve is not running on this cluster")
+        return 0
+    serve.shutdown()
+    print('{"status": "shutdown"}')
+    return 0
+
+
 def cmd_stack(args):
     """`ray stack` analog: dump every worker's Python thread stacks
     (faulthandler over SIGUSR1 — no py-spy needed)."""
@@ -274,6 +316,15 @@ def main(argv=None):
     sp = sub.add_parser("microbenchmark",
                         help="core task/actor/object throughput numbers")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("serve", help="deploy / inspect Serve apps")
+    sp.add_argument("action", choices=["run", "status", "shutdown"])
+    sp.add_argument("target", nargs="?", default=None,
+                    help="module:attr of a bound Application (run)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--route-prefix", default=None)
+    sp.add_argument("--non-blocking", action="store_true")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("stack",
                         help="dump all workers' Python thread stacks")

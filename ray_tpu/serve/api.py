@@ -277,14 +277,36 @@ def shutdown():
 
     with _lock:
         _shutdown_routers()
-        if _proxy_handle is not None:
+        # the proxy is a DETACHED named actor: resolve it by name, not
+        # only through this process's handle — `ray-tpu serve shutdown`
+        # runs in a fresh process where _proxy_handle is None, and
+        # leaking the proxy would leave its port bound serving stale
+        # routes
+        proxies = [_proxy_handle] if _proxy_handle is not None else []
+        if not proxies:
             try:
-                ray_tpu.get(_proxy_handle.shutdown.remote(), timeout=5.0)
-                ray_tpu.kill(_proxy_handle)
+                import ray_tpu.util as _util
+
+                for row in _util.list_named_actors(all_namespaces=True):
+                    if (row.get("namespace") == SERVE_NAMESPACE
+                            and str(row.get("name", "")).startswith(
+                                PROXY_NAME_PREFIX)):
+                        try:
+                            proxies.append(ray_tpu.get_actor(
+                                row["name"],
+                                namespace=SERVE_NAMESPACE))
+                        except ValueError:
+                            pass
             except Exception:
                 pass
-            _proxy_handle = None
-            _proxy_port = None
+        for proxy in proxies:
+            try:
+                ray_tpu.get(proxy.shutdown.remote(), timeout=5.0)
+                ray_tpu.kill(proxy)
+            except Exception:
+                pass
+        _proxy_handle = None
+        _proxy_port = None
         try:
             controller = _get_controller()
         except ValueError:

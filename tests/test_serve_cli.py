@@ -1,0 +1,80 @@
+"""`ray-tpu serve` CLI (reference: `serve run/status/shutdown` CLI,
+python/ray/serve/scripts.py)."""
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+APP_MODULE = '''
+from ray_tpu import serve
+
+
+@serve.deployment
+def hello(request):
+    return {"msg": "hi from cli"}
+
+
+app = hello.bind()
+'''
+
+
+def test_serve_run_status_shutdown(ray_start_regular, tmp_path,
+                                   monkeypatch):
+    from ray_tpu._private.worker_runtime import current_worker
+
+    (tmp_path / "cli_app.py").write_text(APP_MODULE)
+    gcs = current_worker().gcs.addr
+    address = f"{gcs[0]}:{gcs[1]}"
+    env = dict(__import__("os").environ)
+    env["PYTHONPATH"] = f"{tmp_path}:{env.get('PYTHONPATH', '')}"
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "serve", "run",
+         "cli_app:app", "--address", address, "--route-prefix", "/cli",
+         "--non-blocking"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    out, _ = proc.communicate(timeout=180)
+    assert proc.returncode == 0, out
+    port = json.loads(out.strip().splitlines()[-1])["http_port"]
+
+    # the app answers over HTTP
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/cli", timeout=30) as resp:
+        assert json.loads(resp.read())["msg"] == "hi from cli"
+
+    status = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "serve", "status",
+         "--address", address],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert status.returncode == 0, status.stderr
+    payload = json.loads(status.stdout)
+    assert payload["default"]["status"] == "RUNNING", payload
+    assert "hello" in payload["default"]["deployments"], payload
+
+    down = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "serve",
+         "shutdown", "--address", address],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert down.returncode == 0, down.stderr
+
+    # the detached proxy must die with the instance even though shutdown
+    # ran in a DIFFERENT process than the deploy (no local handle)
+    deadline = time.monotonic() + 30
+    dead = False
+    while time.monotonic() < deadline and not dead:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/cli", timeout=3)
+            time.sleep(0.5)
+        except Exception:
+            dead = True
+    assert dead, "HTTP proxy still answering after serve shutdown"
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v", "-x"]))
