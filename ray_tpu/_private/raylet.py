@@ -440,6 +440,7 @@ class Raylet:
                 self._on_worker_exit(wid)
             if ticks % 25 == 0:   # every ~5s: GC leases of remote lessees
                 self._gc_remote_lessee_leases()
+                self._reap_idle_workers()
             if ticks % 3 == 0:    # ~600ms: resource view → GCS (the
                 # RaySyncer-gossip analog; the PG scheduler packs against
                 # this instead of node totals)
@@ -528,6 +529,31 @@ class Raylet:
                 # worker; it is not safely reusable — kill it (reference
                 # kills leased workers when the lease client disconnects).
                 self._kill_worker(worker)
+
+    def _reap_idle_workers(self):
+        """Reap idle workers past `worker_pool_idle_timeout_s`, keeping
+        the prestart watermark warm (reference: worker_pool.h
+        TryKillingIdleWorkers — idle processes beyond the pool target
+        are returned to the OS instead of lingering forever)."""
+        from ray_tpu._private.config import get_config
+
+        timeout_s = float(get_config("worker_pool_idle_timeout_s"))
+        if timeout_s <= 0:
+            return
+        now = time.time()
+        doomed = []
+        with self._lock:
+            keep = []
+            for h in self._idle:
+                if (len(self._idle) - len(doomed) > self._prestart_target
+                        and now - h.idle_since > timeout_s):
+                    doomed.append(h)
+                else:
+                    keep.append(h)
+            if doomed:
+                self._idle = keep
+                for h in doomed:
+                    self._kill_worker(h)
 
     def _gc_remote_lessee_leases(self):
         """Leases whose lessee lives on another node (spillback grants) are

@@ -325,3 +325,40 @@ def test_ref_del_never_takes_locks(ray_start_regular):
         time.sleep(0.05)
     assert worker.memory_store.get_nowait(oid) is None
     del ref
+
+
+def test_idle_workers_reaped_after_timeout():
+    """worker_pool_idle_timeout_s: idle workers beyond the prestart
+    watermark are returned to the OS (reference: worker_pool.h
+    TryKillingIdleWorkers), instead of lingering forever."""
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+    from ray_tpu._private import api
+
+    ray_tpu.init(num_cpus=8,
+                 system_config={"worker_pool_idle_timeout_s": 1.0})
+    try:
+        @ray_tpu.remote(num_cpus=0, max_retries=0)
+        def noop(i):
+            return i
+
+        # a burst leases several workers; afterwards they go idle
+        assert ray_tpu.get([noop.remote(i) for i in range(200)],
+                           timeout=120) == list(range(200))
+        raylet = api._global_node.raylet
+        target = raylet._prestart_target
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with raylet._lock:
+                idle = len(raylet._idle)
+            if idle <= target:
+                break
+            time.sleep(0.5)
+        assert idle <= target, \
+            f"{idle} idle workers linger past the {target} watermark"
+    finally:
+        ray_tpu.shutdown()
