@@ -1,0 +1,86 @@
+"""Task/actor spec schema — the typed contract for the dicts that cross
+the control plane.
+
+Reference: src/ray/common/task/task_spec.h (+ common.proto TaskSpec) —
+the reference compiles its spec into protobuf; here the wire form stays
+a plain dict (pickled by the RPC layer), and THIS module is the single
+place that says which keys exist, who writes them, and what they mean.
+`validate_task_spec` runs at submission in debug/test mode
+(RAY_TPU_VALIDATE_SPECS or RAY_TPU_TESTING) so schema drift fails loudly
+at the producer, not as a KeyError deep inside a worker.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, TypedDict
+
+
+class TaskSpec(TypedDict, total=False):
+    """A normal-task submission (producer: CoreWorker.submit_task)."""
+
+    task_id: bytes               # 16-byte unique id
+    func_hash: bytes             # function-table key (GCS ns=functions)
+    args: bytes                  # ser.serialize((args, kwargs))
+    return_ids: list             # [16-byte object id, ...]
+    owner_addr: tuple            # (host, port) of the owning worker
+    retries_left: int            # worker-death retry budget
+    reconstructions_left: int    # lineage re-execution budget
+    task_desc: str               # human-readable ("task f()")
+    job_id: int
+    runtime_env: dict            # normalized (content keys, not paths)
+    trace_ctx: dict              # {"trace_id", "parent_span_id"}
+    # actor-call extension (producer: submit_actor_task)
+    actor_id: bytes
+    method_name: str
+    caller_id: str               # submitting worker id (seq scoping)
+    caller_epoch: int            # bumped per reconnect
+    seq: int                     # per-caller submission order
+
+
+# Keys every normal-task spec MUST carry (actor calls add their own).
+REQUIRED_TASK_KEYS = frozenset({
+    "task_id", "func_hash", "args", "return_ids", "owner_addr",
+    "retries_left", "task_desc", "job_id",
+})
+
+REQUIRED_ACTOR_KEYS = frozenset({
+    "task_id", "actor_id", "method_name", "args", "return_ids",
+    "owner_addr", "caller_id",
+})
+
+# Prefix for driver-local bookkeeping that must NEVER cross the wire
+# (CoreWorker._strip_spec removes these before pushing).
+LOCAL_KEY_PREFIX = "_"
+
+
+def _validation_enabled() -> bool:
+    return bool(os.environ.get("RAY_TPU_VALIDATE_SPECS")
+                or os.environ.get("RAY_TPU_TESTING"))
+
+
+def validate_task_spec(spec: dict[str, Any], *, actor: bool = False):
+    """Schema check at the PRODUCER (no-op unless validation is on).
+    Raises ValueError naming exactly what drifted."""
+    if not _validation_enabled():
+        return
+    required = REQUIRED_ACTOR_KEYS if actor else REQUIRED_TASK_KEYS
+    missing = required - spec.keys()
+    if missing:
+        raise ValueError(
+            f"task spec missing required keys {sorted(missing)} "
+            f"(schema: _private/task_spec.py)")
+    unknown = {
+        k for k in spec
+        if not k.startswith(LOCAL_KEY_PREFIX)
+        and k not in TaskSpec.__annotations__
+    }
+    if unknown:
+        raise ValueError(
+            f"task spec carries undeclared keys {sorted(unknown)} — "
+            f"declare them in _private/task_spec.py (the schema is the "
+            f"contract both ends compile against)")
+    if len(spec.get("task_id", b"")) != 16:
+        raise ValueError("task_id must be 16 bytes")
+    for rid in spec.get("return_ids", ()):
+        if len(rid) != 16:
+            raise ValueError("return ids must be 16 bytes")

@@ -1631,6 +1631,9 @@ class CoreWorker:
             spec["runtime_env"] = runtime_env
         from ray_tpu.util import tracing
 
+        from ray_tpu._private.task_spec import validate_task_spec
+
+        validate_task_spec(spec)
         with tracing.submit_span(spec, task_desc):
             self._pin_args(spec, args, kwargs)
             self._owned.update(return_ids)
@@ -1887,6 +1890,9 @@ class CoreWorker:
         }
         from ray_tpu.util import tracing
 
+        from ray_tpu._private.task_spec import validate_task_spec
+
+        validate_task_spec(spec, actor=True)
         with tracing.submit_span(spec, spec["task_desc"]):
             self._pin_args(spec, args, kwargs)
             self._owned.update(return_ids)

@@ -362,3 +362,29 @@ def test_idle_workers_reaped_after_timeout():
             f"{idle} idle workers linger past the {target} watermark"
     finally:
         ray_tpu.shutdown()
+
+
+def test_task_spec_schema_validation():
+    """The spec schema is the contract: missing/undeclared keys fail at
+    the producer when validation is on (RAY_TPU_TESTING does that for
+    the whole suite — this exercises the failure modes directly)."""
+    import os
+
+    import pytest as _pytest
+
+    from ray_tpu._private.task_spec import validate_task_spec
+
+    good = {
+        "task_id": os.urandom(16), "func_hash": b"h", "args": b"",
+        "return_ids": [os.urandom(16)], "owner_addr": ("h", 1),
+        "retries_left": 0, "task_desc": "t", "job_id": 0,
+    }
+    validate_task_spec(good)                      # passes
+    validate_task_spec({**good, "_local": 1})     # local keys exempt
+    with _pytest.raises(ValueError, match="missing required"):
+        validate_task_spec({k: v for k, v in good.items()
+                            if k != "func_hash"})
+    with _pytest.raises(ValueError, match="undeclared keys"):
+        validate_task_spec({**good, "surprise_field": 1})
+    with _pytest.raises(ValueError, match="16 bytes"):
+        validate_task_spec({**good, "task_id": b"short"})
