@@ -60,6 +60,8 @@ def load_lib():
         lib.rpc_cl_poll_async.restype = ctypes.c_int
         lib.rpc_cl_closed.argtypes = [ctypes.c_void_p]
         lib.rpc_cl_closed.restype = ctypes.c_int
+        lib.rpc_cl_ver_mismatch.argtypes = [ctypes.c_void_p]
+        lib.rpc_cl_ver_mismatch.restype = ctypes.c_int
         lib.rpc_cl_close.argtypes = [ctypes.c_void_p]
         lib.rpc_cl_close.restype = None
 
@@ -135,12 +137,23 @@ class NativeRpcClient:
             self._seq += 1
             return self._seq
 
+    def _lost_error(self):
+        """ConnectionLost — or the NAMED ProtocolMismatch when the C reader
+        dropped the connection over a wire-revision disagreement."""
+        from ray_tpu._private.protocol import ConnectionLost, ProtocolMismatch
+
+        if self._lib.rpc_cl_ver_mismatch(self._h):
+            return ProtocolMismatch(
+                f"rpc protocol version mismatch with {self.addr} — both "
+                f"ends of a cluster must run the same ray-tpu wire revision")
+        return ConnectionLost(f"connection to {self.addr} lost")
+
     # ------------------------------------------------------------- sync path
     def call(self, method: str, timeout: float | None = None, **kwargs):
-        from ray_tpu._private.protocol import ConnectionLost, _RemoteError
+        from ray_tpu._private.protocol import _RemoteError
 
         if self._closed:
-            raise ConnectionLost(f"connection to {self.addr} closed")
+            raise self._lost_error()
         seq = self._next_seq()
         payload = pickle.dumps((method, kwargs),
                                protocol=pickle.HIGHEST_PROTOCOL)
@@ -148,7 +161,7 @@ class NativeRpcClient:
                                    len(payload), 1)
         if rc != 0:
             self._closed = True
-            raise ConnectionLost(f"connection to {self.addr} lost")
+            raise self._lost_error()
         t = timeout if timeout is not None else self._timeout
         out = ctypes.c_void_p()
         out_len = ctypes.c_size_t()
@@ -160,7 +173,7 @@ class NativeRpcClient:
             raise TimeoutError("rpc call timed out")
         if rc != 0:
             self._closed = True
-            raise ConnectionLost(f"connection to {self.addr} lost")
+            raise self._lost_error()
         result = pickle.loads(_take_buf(self._lib, out, out_len.value))
         if isinstance(result, _RemoteError):
             raise result.exc
@@ -168,11 +181,10 @@ class NativeRpcClient:
 
     # ------------------------------------------------------------ async path
     def call_async(self, method: str, **kwargs):
-        from ray_tpu._private.protocol import (ConnectionLost, _Future,
-                                               _RemoteError)
+        from ray_tpu._private.protocol import _Future, _RemoteError
 
         if self._closed:
-            raise ConnectionLost(f"connection to {self.addr} closed")
+            raise self._lost_error()
         self._ensure_pump()
         seq = self._next_seq()
         fut = _Future()
@@ -186,28 +198,25 @@ class NativeRpcClient:
             with self._pending_lock:
                 self._pending.pop(seq, None)
             self._closed = True
-            raise ConnectionLost(f"connection to {self.addr} lost")
+            raise self._lost_error()
         # the pump may already have resolved+removed it; re-check closed to
         # avoid an unresolvable future registered after pump exit
         if self._closed:
             with self._pending_lock:
                 if self._pending.pop(seq, None) is not None:
-                    fut.set(_RemoteError(
-                        ConnectionLost(f"connection to {self.addr} lost")))
+                    fut.set(_RemoteError(self._lost_error()))
         return fut
 
     def push(self, method: str, **kwargs):
-        from ray_tpu._private.protocol import ConnectionLost
-
         if self._closed:
-            raise ConnectionLost(f"connection to {self.addr} closed")
+            raise self._lost_error()
         payload = pickle.dumps((method, kwargs),
                                protocol=pickle.HIGHEST_PROTOCOL)
         rc = self._lib.rpc_cl_send(self._h, _PUSH, 0, payload,
                                    len(payload), 0)
         if rc != 0:
             self._closed = True
-            raise ConnectionLost(f"connection to {self.addr} lost")
+            raise self._lost_error()
 
     # ----------------------------------------------------------------- pump
     def _ensure_pump(self):
@@ -220,7 +229,7 @@ class NativeRpcClient:
                     self._pump.start()
 
     def _pump_loop(self):
-        from ray_tpu._private.protocol import ConnectionLost, _RemoteError
+        from ray_tpu._private.protocol import _RemoteError
 
         kind = ctypes.c_int()
         seq = ctypes.c_longlong()
@@ -250,7 +259,7 @@ class NativeRpcClient:
                 except Exception:
                     pass
         self._closed = True
-        err = _RemoteError(ConnectionLost(f"connection to {self.addr} lost"))
+        err = _RemoteError(self._lost_error())
         with self._pending_lock:
             pending, self._pending = self._pending, {}
         for fut in pending.values():

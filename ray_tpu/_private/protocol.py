@@ -8,8 +8,11 @@ trust domain (one cluster, one user), exactly like the reference's
 cloudpickled task specs.
 
 Wire format (shared with the native C++ core, src/rpc/rpc_core.cc):
-``[len: u64 BE] [kind: u8] [seq: i64 BE] [payload: len-9 bytes]`` where
-payload is an opaque pickle. kind is REQUEST/REPLY/PUSH.
+``[len: u64 BE] [ver<<4 | kind: u8] [seq: i64 BE] [payload: len-9 bytes]``
+where payload is an opaque pickle. kind (low nibble) is
+REQUEST/REPLY/PUSH; the high nibble carries PROTOCOL_VERSION so a peer
+speaking a different frame layout is rejected with a named error instead
+of a misparse (the reference versions its protobuf schema the same way).
 
 Two interoperable implementations: the native C++ core (framing,
 correlation and queueing off-GIL — the default; see native_rpc.py) and
@@ -22,6 +25,7 @@ import os
 import pickle
 import socket
 import struct
+import sys
 import threading
 import time
 import traceback
@@ -29,7 +33,16 @@ import uuid
 
 REQUEST, REPLY, PUSH = 0, 1, 2
 
-_HDR = struct.Struct(">QBq")   # total-after-len, kind, seq
+# Bump on any incompatible frame-layout/semantics change. Must match
+# kProtocolVersion in src/rpc/rpc_core.cc.
+# Detection is receive-side: a v(N) receiver names a v(M!=N) sender's rev
+# in the error. The inverse direction against a PRE-versioning build (which
+# reads the whole byte as `kind`) surfaces as silently dropped frames →
+# call timeout, not a named error; v1 is the first versioned rev, so that
+# legacy pairing disappears once every node runs any versioned build.
+PROTOCOL_VERSION = 1
+
+_HDR = struct.Struct(">QBq")   # total-after-len, ver<<4|kind, seq
 
 # Sentinel a handler returns to suppress the automatic reply; it must
 # then answer later via conn.reply(seq, result) (deferred replies let
@@ -46,10 +59,15 @@ class ConnectionLost(RpcError):
     pass
 
 
+class ProtocolMismatch(RpcError):
+    """Peer speaks a different frame-protocol version; the connection is
+    unusable and gets dropped (both ends must run the same wire rev)."""
+
+
 def _send_frame(sock: socket.socket, kind: int, seq: int, payload,
                 lock: threading.Lock):
     data = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
-    hdr = _HDR.pack(len(data) + 9, kind, seq)
+    hdr = _HDR.pack(len(data) + 9, (PROTOCOL_VERSION << 4) | kind, seq)
     with lock:
         sock.sendall(hdr + data)
 
@@ -66,8 +84,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_frame(sock: socket.socket):
-    length, kind, seq = _HDR.unpack(_recv_exact(sock, 17))
-    return kind, seq, pickle.loads(_recv_exact(sock, length - 9))
+    length, kind_byte, seq = _HDR.unpack(_recv_exact(sock, 17))
+    ver = kind_byte >> 4
+    if ver != PROTOCOL_VERSION:
+        raise ProtocolMismatch(
+            f"rpc protocol version mismatch: peer sent v{ver}, this "
+            f"process speaks v{PROTOCOL_VERSION} — both ends of a cluster "
+            f"must run the same ray-tpu wire revision")
+    return kind_byte & 0x0F, seq, pickle.loads(_recv_exact(sock, length - 9))
 
 
 class _RemoteError:
@@ -104,6 +128,7 @@ class PyRpcClient:
         self._seq_lock = threading.Lock()
         self._pending: dict[int, _Future] = {}
         self._closed = False
+        self._mismatch: ProtocolMismatch | None = None
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name=f"rpc-client-{self.addr}")
         self._reader.start()
@@ -114,6 +139,7 @@ class PyRpcClient:
             return self._seq
 
     def _read_loop(self):
+        mismatch = None
         try:
             while True:
                 kind, seq, payload = _recv_frame(self._sock)
@@ -126,6 +152,10 @@ class PyRpcClient:
                         self._on_push(payload)
                     except Exception:
                         pass
+        except ProtocolMismatch as e:
+            mismatch = self._mismatch = e
+            print(f"ray-tpu rpc: {e} (peer {self.addr})",
+                  file=sys.stderr, flush=True)
         except (ConnectionLost, OSError, EOFError, pickle.UnpicklingError):
             if os.environ.get("RAY_TPU_RPC_DEBUG"):
                 import traceback
@@ -134,7 +164,15 @@ class PyRpcClient:
                 traceback.print_exc()
         finally:
             self._closed = True
-            err = _RemoteError(ConnectionLost(f"connection to {self.addr} lost"))
+            # On a version mismatch the TCP connection is still healthy —
+            # close it here or the fd (and the peer's sends) leak.
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            err = _RemoteError(
+                mismatch
+                or ConnectionLost(f"connection to {self.addr} lost"))
             for fut in list(self._pending.values()):
                 fut.set(err)
             self._pending.clear()
@@ -146,7 +184,8 @@ class PyRpcClient:
 
     def call_async(self, method: str, **kwargs) -> "_Future":
         if self._closed:
-            raise ConnectionLost(f"connection to {self.addr} closed")
+            raise self._mismatch or ConnectionLost(
+                f"connection to {self.addr} closed")
         seq = self._next_seq()
         fut = _Future()
         self._pending[seq] = fut
@@ -155,7 +194,8 @@ class PyRpcClient:
         # leave this future unresolvable.
         if self._closed:
             self._pending.pop(seq, None)
-            raise ConnectionLost(f"connection to {self.addr} closed")
+            raise self._mismatch or ConnectionLost(
+                f"connection to {self.addr} closed")
         try:
             _send_frame(self._sock, REQUEST, seq, (method, kwargs), self._wlock)
         except OSError as e:
@@ -167,7 +207,8 @@ class PyRpcClient:
     def push(self, method: str, **kwargs):
         """One-way message; no reply expected."""
         if self._closed:
-            raise ConnectionLost(f"connection to {self.addr} closed")
+            raise self._mismatch or ConnectionLost(
+                f"connection to {self.addr} closed")
         try:
             _send_frame(self._sock, PUSH, 0, (method, kwargs), self._wlock)
         except OSError as e:
@@ -343,6 +384,11 @@ class PyRpcServer:
                         self._lookup(method)(conn, **kwargs)
                     except Exception:
                         pass
+        except ProtocolMismatch as e:
+            # Drop the connection loudly: we cannot even parse the peer's
+            # frames, so an in-band error reply is impossible.
+            print(f"ray-tpu rpc: {e} (client {conn.peer}); dropping "
+                  f"connection", file=sys.stderr, flush=True)
         except (ConnectionLost, OSError, EOFError, pickle.UnpicklingError) as e:
             if os.environ.get("RAY_TPU_RPC_DEBUG"):
                 print(f"[rpc-debug pid={os.getpid()}] "

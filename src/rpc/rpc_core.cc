@@ -9,11 +9,13 @@
 // fallback implementation remains authoritative for semantics; wire
 // format is shared:
 //
-//   [len: u64 BE] [kind: u8] [seq: i64 BE] [payload: len-9 bytes]
+//   [len: u64 BE] [ver<<4 | kind: u8] [seq: i64 BE] [payload: len-9 bytes]
 //
-// kind: 0 REQUEST, 1 REPLY, 2 PUSH. The payload is an opaque pickle —
-// this layer never inspects it, exactly like gRPC treating message
-// bodies as bytes.
+// kind (low nibble): 0 REQUEST, 1 REPLY, 2 PUSH. The high nibble is the
+// protocol version (kProtocolVersion); a receiver that sees any other
+// version prints a loud diagnostic and drops the connection instead of
+// misparsing the stream. The payload is an opaque pickle — this layer
+// never inspects it, exactly like gRPC treating message bodies as bytes.
 //
 // Threading model:
 //   client: one reader thread per connection. Sync callers register
@@ -38,6 +40,7 @@
 
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <deque>
 #include <memory>
@@ -48,6 +51,10 @@
 #include <vector>
 
 namespace {
+
+// Bump when the frame layout or frame semantics change incompatibly.
+// Must match PROTOCOL_VERSION in ray_tpu/_private/protocol.py.
+constexpr int kProtocolVersion = 1;
 
 constexpr int kReq = 0;
 constexpr int kReply = 1;
@@ -108,7 +115,7 @@ bool send_frame(int fd, std::mutex& wlock, int kind, int64_t seq,
                 const char* buf, size_t len) {
   unsigned char hdr[17];
   put_be64(hdr, len + 9);
-  hdr[8] = static_cast<unsigned char>(kind);
+  hdr[8] = static_cast<unsigned char>((kProtocolVersion << 4) | (kind & 0x0F));
   put_be64(hdr + 9, static_cast<uint64_t>(seq));
   std::lock_guard<std::mutex> g(wlock);
   if (len <= 64 * 1024) {
@@ -122,13 +129,25 @@ bool send_frame(int fd, std::mutex& wlock, int kind, int64_t seq,
 }
 
 // Reads one frame; on success fills kind/seq/buf/len (malloc'd buf).
-bool recv_frame(int fd, int* kind, int64_t* seq, char** buf, size_t* len) {
+// On a protocol-version mismatch sets *ver_mismatch (when given) so the
+// caller can surface the NAMED error instead of a generic disconnect.
+bool recv_frame(int fd, int* kind, int64_t* seq, char** buf, size_t* len,
+                bool* ver_mismatch = nullptr) {
   unsigned char hdr[17];
   if (!recv_exact(fd, hdr, 8)) return false;
   uint64_t total = be64(hdr);
   if (total < 9 || total > (1ull << 40)) return false;
   if (!recv_exact(fd, hdr + 8, 9)) return false;
-  *kind = static_cast<int>(hdr[8]);
+  int ver = hdr[8] >> 4;
+  if (ver != kProtocolVersion) {
+    fprintf(stderr,
+            "ray-tpu rpc: protocol version mismatch (peer sent v%d, this "
+            "build speaks v%d); closing connection\n",
+            ver, kProtocolVersion);
+    if (ver_mismatch) *ver_mismatch = true;
+    return false;
+  }
+  *kind = static_cast<int>(hdr[8] & 0x0F);
   *seq = static_cast<int64_t>(be64(hdr + 9));
   *len = total - 9;
   *buf = static_cast<char*>(malloc(*len ? *len : 1));
@@ -155,11 +174,13 @@ struct Client {
   std::unordered_map<int64_t, Frame> sync_done;
   std::deque<Frame> async_q;           // pushes + non-sync replies
   bool closed = false;
+  bool ver_mismatch = false;   // closed because the peer speaks another rev
 
   void reader_loop() {
+    bool vm = false;   // published under mu below (TSAN-clean)
     for (;;) {
       Frame f;
-      if (!recv_frame(fd, &f.kind, &f.seq, &f.buf, &f.len)) break;
+      if (!recv_frame(fd, &f.kind, &f.seq, &f.buf, &f.len, &vm)) break;
       std::lock_guard<std::mutex> g(mu);
       if (f.kind == kReply && sync_waiting.count(f.seq)) {
         sync_done[f.seq] = f;
@@ -169,8 +190,14 @@ struct Client {
         async_cv.notify_one();
       }
     }
+    // The mismatch path leaves a HEALTHY TCP connection behind; shut it
+    // down so the peer sees the drop and no fd/conn leaks if the caller
+    // never gets around to rpc_cl_close (shutdown — unlike close — is
+    // safe against a concurrent rpc_cl_send on the same fd).
+    ::shutdown(fd, SHUT_RDWR);
     std::lock_guard<std::mutex> g(mu);
     closed = true;
+    ver_mismatch = vm;
     cv.notify_all();
     async_cv.notify_all();
   }
@@ -378,6 +405,14 @@ int rpc_cl_closed(void* h) {
   auto* c = static_cast<Client*>(h);
   std::lock_guard<std::mutex> g(c->mu);
   return c->closed ? 1 : 0;
+}
+
+// 1 iff the connection died because the peer speaks a different protocol
+// revision (lets Python raise ProtocolMismatch, not ConnectionLost).
+int rpc_cl_ver_mismatch(void* h) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  return c->ver_mismatch ? 1 : 0;
 }
 
 // Shut the connection down and reclaim its buffers. The Client struct
