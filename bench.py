@@ -18,6 +18,10 @@ import time
 
 from ray_tpu._private.tpu_probe import tpu_reachable_once as _tpu_reachable_once
 
+# Timestamped probe-attempt audit trail; surfaces in the JSON "extra" so a
+# CPU-fallback artifact documents WHEN the tunnel was tried and found dead.
+_PROBE_LOG: list = []
+
 
 def _tpu_reachable(window_s: float = None) -> bool:
     """Retry the reachability probe with backoff across a run window.
@@ -34,16 +38,22 @@ def _tpu_reachable(window_s: float = None) -> bool:
     attempt = 0
     while True:
         attempt += 1
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         if _tpu_reachable_once():
+            print(f"# bench: [{stamp}] TPU probe {attempt} SUCCEEDED",
+                  file=sys.stderr)
+            _PROBE_LOG.append(f"{stamp} probe {attempt}: ok")
             return True
+        _PROBE_LOG.append(f"{stamp} probe {attempt}: unreachable")
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            print(f"# bench: TPU unreachable after {attempt} probe(s); "
-                  "falling back to CPU smoke", file=sys.stderr)
+            print(f"# bench: [{stamp}] TPU unreachable after {attempt} "
+                  "probe(s); falling back to CPU smoke", file=sys.stderr)
             return False
         wait = min(delay, remaining)
-        print(f"# bench: TPU probe {attempt} failed; retrying in {wait:.0f}s "
-              f"({remaining:.0f}s left in window)", file=sys.stderr)
+        print(f"# bench: [{stamp}] TPU probe {attempt} failed; retrying in "
+              f"{wait:.0f}s ({remaining:.0f}s left in window)",
+              file=sys.stderr)
         time.sleep(wait)
         delay = min(delay * 2, 300.0)
 
@@ -146,6 +156,7 @@ def main():
                     "n_params": cfg.n_params,
                     "backend": jax.default_backend(),
                     "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+                    "probe_log": _PROBE_LOG,
                 },
             }
         )

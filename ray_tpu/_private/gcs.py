@@ -10,9 +10,11 @@ holding the cluster-global state machines —
 - actor table (gcs_actor_manager.h:270): registration, name→actor resolution,
   death notification, restart bookkeeping (ReconstructActor:495),
 - internal KV (gcs_kv_manager.h): function table, cluster metadata,
-- object directory: object id → node locations (the reference resolves via
-  owner workers, ownership_based_object_directory.h; centralizing in GCS is
-  the v1 simplification),
+- object directory (residual): locations live with OWNING WORKERS
+  (worker_runtime.py owner-based directory, matching the reference's
+  ownership_based_object_directory.h) — the GCS keeps only the free-path
+  fan-out (owners hand it holder lists; it maps node ids to raylet
+  connections) and legacy tables for observability stats,
 - placement groups (gcs_placement_group_manager.h): bundle reservation with
   PACK/SPREAD/STRICT_PACK/STRICT_SPREAD over the node table,
 - pubsub (pubsub_handler.h): actor state and node membership channels pushed
@@ -370,12 +372,19 @@ class GcsServer:
                 "lost": object_id in self.lost_objects,
             }
 
-    def rpc_free_objects(self, conn, object_ids: list[bytes]):
-        """Broadcast deletion to every node holding a copy."""
+    def rpc_free_objects(self, conn, object_ids: list[bytes],
+                         locations: dict | None = None):
+        """Broadcast deletion to every node holding a copy. `locations`
+        (oid → [node_id]) comes from the OWNER's directory — the GCS's
+        residual table only supplements it (owner-based directory: the GCS
+        no longer tracks per-object locations itself)."""
         with self._lock:
             targets: dict[str, list[bytes]] = {}
             for oid in object_ids:
-                for node_id in self.object_locations.pop(oid, ()):  # noqa: B909
+                holders = set(self.object_locations.pop(oid, ()))
+                if locations:
+                    holders |= set(locations.get(oid, ()))
+                for node_id in holders:
                     targets.setdefault(node_id, []).append(oid)
                 self.object_sizes.pop(oid, None)
             conns = {c.meta.get("node_id"): c

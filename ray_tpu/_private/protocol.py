@@ -40,7 +40,9 @@ REQUEST, REPLY, PUSH = 0, 1, 2
 # reads the whole byte as `kind`) surfaces as silently dropped frames →
 # call timeout, not a named error; v1 is the first versioned rev, so that
 # legacy pairing disappears once every node runs any versioned build.
-PROTOCOL_VERSION = 1
+# v2: owner-based object directory (free_objects locations kwarg,
+# register_worker node snapshot, task-reply stored_sizes/node keys).
+PROTOCOL_VERSION = 2
 
 _HDR = struct.Struct(">QBq")   # total-after-len, ver<<4|kind, seq
 
@@ -165,9 +167,12 @@ class PyRpcClient:
         finally:
             self._closed = True
             # On a version mismatch the TCP connection is still healthy —
-            # close it here or the fd (and the peer's sends) leak.
+            # drop it or the fd (and the peer's sends) leak. shutdown, NOT
+            # close: a writer thread may be inside sendall on this socket,
+            # and close() would free the fd number for reuse mid-write
+            # (same reasoning as rpc_core.cc reader_loop).
             try:
-                self._sock.close()
+                self._sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
             err = _RemoteError(

@@ -418,8 +418,14 @@ class Raylet:
             handle.addr = tuple(addr)
             conn.meta["worker_id"] = worker_id
         handle.registered.set()
+        # `node` is the snapshot shape _pull_remote consumes — workers hand
+        # it to object OWNERS when announcing copies (owner-based directory)
         return {"node_id": self.node_id, "store_name": self.store_name,
-                "spill_dir": self.spill_dir}
+                "spill_dir": self.spill_dir,
+                "node": {"NodeID": self.node_id,
+                         "NodeManagerAddress": self.addr[0],
+                         "NodeManagerPort": self.addr[1],
+                         "object_data_port": self.data_port}}
 
     def on_disconnect(self, conn):
         worker_id = conn.meta.get("worker_id")
@@ -1043,6 +1049,14 @@ class Raylet:
 
     def rpc_store_stats(self, conn):
         return self.store.stats()
+
+    def rpc_list_store_objects(self, conn):
+        """Per-node object inventory (`ray-tpu memory` source). Under the
+        owner-based directory there is no central location table — the
+        state API unions these per-node rows instead."""
+        return [{"ObjectID": oid.hex(), "Size": size,
+                 "Locations": [self.node_id], "Lost": False}
+                for oid, size in self.store.list_objects()]
 
     def rpc_node_info(self, conn):
         with self._lock:

@@ -60,6 +60,9 @@ def _load():
                "store_delete"):
         getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.store_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64 * 4)]
+    lib.store_list.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
     lib.store_data_server_start.restype = ctypes.c_void_p
     lib.store_data_server_start.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
@@ -340,6 +343,35 @@ class StoreClient:
             "heap_size": out[2],
             "evictions": out[3],
         }
+
+    def list_objects(self, max_objects: int = 65536) -> list[tuple[bytes, int]]:
+        """(object_id, size) of every sealed object in the segment, plus
+        spilled ones. Feeds `ray-tpu memory` now that locations live with
+        owners instead of a central GCS table."""
+        ids = ctypes.create_string_buffer(16 * max_objects)
+        sizes = (ctypes.c_uint64 * max_objects)()
+        n = self._libref.store_list(
+            self._h, ids,
+            ctypes.cast(sizes, ctypes.POINTER(ctypes.c_uint64)),
+            max_objects)
+        if n < 0:
+            raise StoreError(n, "list")
+        out = [(ids.raw[i * 16:(i + 1) * 16], int(sizes[i]))
+               for i in range(n)]
+        if self.spill_dir and os.path.isdir(self.spill_dir):
+            seen = {oid for oid, _ in out}
+            for fname in os.listdir(self.spill_dir):
+                try:
+                    oid = bytes.fromhex(fname)
+                except ValueError:
+                    continue
+                if len(oid) == 16 and oid not in seen:
+                    try:
+                        out.append((oid, os.path.getsize(
+                            os.path.join(self.spill_dir, fname))))
+                    except OSError:
+                        pass   # freed between listdir and stat — skip
+        return out
 
     def _release(self, object_id: bytes):
         with self._guard:

@@ -649,6 +649,16 @@ class CoreWorker:
         reg = self.raylet.call("register_worker", worker_id=self.worker_id,
                                addr=self.addr, pid=os.getpid())
         self.node_id = reg["node_id"]
+        # Owner-based object directory (reference:
+        # src/ray/object_manager/ownership_based_object_directory.h:1 — the
+        # OWNER of an object tracks which nodes hold copies; borrowers and
+        # the owner itself resolve locations here, with ZERO GCS round
+        # trips on the pull path). _my_node is the snapshot shape handed to
+        # owners when this node announces a copy.
+        self._my_node = reg.get("node") or {"NodeID": self.node_id}
+        self._dir_lock = threading.Lock()
+        self._obj_locations: dict[bytes, dict[str, dict]] = {}
+        self._obj_sizes: dict[bytes, int] = {}
         self.store = StoreClient(store_name or reg["store_name"],
                                  spill_dir=spill_dir or reg["spill_dir"])
         self.job_id = job_id if job_id is not None else (
@@ -895,8 +905,8 @@ class CoreWorker:
         data = ser.serialize(value)
         object_id = os.urandom(16)
         self.store.put(object_id, data)
-        self.gcs.push("add_object_location", object_id=object_id,
-                      node_id=self.node_id, size=len(data))
+        # we own it: record the location in OUR directory — no RPC at all
+        self._loc_add(object_id, self._my_node, len(data))
         self._owned.add(object_id)
         ref = ObjectRef(object_id, self.addr, self)
         return ref
@@ -948,8 +958,15 @@ class CoreWorker:
         if to_unpin is not None:
             self._unpin_args(to_unpin)
         if owned:
+            # we are the directory: hand the GCS the holder list so it can
+            # fan the delete out to those raylets (node connections live
+            # there), then drop our entries
+            with self._dir_lock:
+                holders = list(self._obj_locations.pop(object_id, {}))
+                self._obj_sizes.pop(object_id, None)
             try:
-                self.gcs.push("free_objects", object_ids=[object_id])
+                self.gcs.push("free_objects", object_ids=[object_id],
+                              locations={object_id: holders})
             except Exception:
                 pass
 
@@ -1091,20 +1108,35 @@ class CoreWorker:
                     return buf.to_bytes()
                 finally:
                     buf.release()
-            # 3. remote copy via object directory
-            try:
-                locs = self.gcs.call("get_object_locations",
-                                     object_id=ref.id)
-            except ConnectionLost:
-                locs = {"nodes": []}
-            for node in locs["nodes"]:
-                if node["NodeID"] == self.node_id:
-                    continue
-                data = self._pull_remote(ref.id, node)
-                if data is not None:
-                    return data
-            # 4. ask the owner directly (value may still be pending)
-            if ref.owner_addr and tuple(ref.owner_addr) != self.addr:
+            # 3. resolve through the OWNER-BASED directory — zero GCS calls
+            # (reference: ownership_based_object_directory.h).
+            we_own = not ref.owner_addr or tuple(ref.owner_addr) == self.addr
+            if we_own:
+                # we are the owner: our table is the directory
+                nodes, created_size = self._loc_snapshot(ref.id)
+                for node in nodes:
+                    if node["NodeID"] == self.node_id:
+                        continue
+                    data = self._pull_remote(ref.id, node)
+                    if data is not None:
+                        return data
+                    # the copy is gone with its node — drop the location
+                    self._loc_remove(ref.id, node["NodeID"])
+                # Sealed once, zero copies left, no producing task in
+                # flight → recovery is OUR job (reference:
+                # ObjectRecoveryManager runs in the owner's core worker):
+                # re-execute the creating task if we hold lineage, else
+                # the loss is permanent.
+                remote = [n for n in nodes
+                          if n["NodeID"] != self.node_id]
+                if created_size and not remote \
+                        and ref.id not in self._ref_to_task:
+                    if not self._maybe_reconstruct(ref.id):
+                        raise exc.ObjectLostError(ref.hex())
+            else:
+                # borrower: ONE owner round trip resolves value (inline),
+                # holder nodes ("at" → data-plane pull inside _ask_owner),
+                # pending, or lost.
                 data = self._ask_owner(ref, deadline)
                 if data is not None:
                     # borrower-side cache: repeat gets of this ref skip the
@@ -1122,20 +1154,11 @@ class CoreWorker:
                         # reaper's free already ran — undo our insert
                         if self.reference_counter.count(ref.id) == 0:
                             self.memory_store.free(ref.id)
-                    else:
-                        self._cache_local(ref.id, data)
+                    elif not self.store.contains(ref.id):
+                        # (an "at" pull already cached+announced; don't
+                        # double-insert)
+                        self._cache_local(ref.id, data, ref.owner_addr)
                     return data
-            # The GCS knows it was created and that every copy died with its
-            # node. Recovery is the OWNER's job (reference:
-            # ObjectRecoveryManager runs in the owner's core worker): the
-            # owner re-executes the creating task if it holds lineage, else
-            # fails fast. Borrowers keep polling — the owner's verdict
-            # reaches them through _ask_owner ("lost" reply) instead.
-            we_own = not ref.owner_addr or tuple(ref.owner_addr) == self.addr
-            if locs.get("lost") and ref.id not in self._ref_to_task \
-                    and we_own:
-                if not self._maybe_reconstruct(ref.id):
-                    raise exc.ObjectLostError(ref.hex())
             if deadline is not None and time.time() > deadline:
                 raise exc.GetTimeoutError(
                     f"get() timed out waiting for {ref.hex()}")
@@ -1147,14 +1170,17 @@ class CoreWorker:
             entry.event.wait(wait_t)
             poll = min(poll * 2, 0.1)
 
-    def _pull_remote(self, object_id: bytes, node_snapshot: dict):
+    def _pull_remote(self, object_id: bytes, node_snapshot: dict,
+                     owner_addr=None):
         """Chunked node-to-node pull with admission control.
 
         Reference: PullManager (pull_manager.h:48) bounds in-flight pull
         bytes; PushManager (push_manager.h:29) moves objects as chunks. A
         large object crosses the network in `object_transfer_chunk_bytes`
         frames instead of one pickle frame, and the total bytes being
-        pulled concurrently by this worker is capped."""
+        pulled concurrently by this worker is capped. owner_addr names the
+        object's owner so the cached copy gets announced to its directory
+        (None/self → we are the owner)."""
         from ray_tpu._private.config import get_config
 
         host = node_snapshot["NodeManagerAddress"]
@@ -1166,7 +1192,7 @@ class CoreWorker:
         cached = False
         if data_port:
             data, cached = self._pull_native(object_id, (host, data_port),
-                                             chunk)
+                                             chunk, owner_addr)
         if data is None:
             data = self._pull_rpc(
                 object_id, (host, node_snapshot["NodeManagerPort"]), chunk)
@@ -1176,16 +1202,16 @@ class CoreWorker:
         # local plasma) — unless the native path already received the
         # bytes straight into the store and announced the location.
         if not cached:
-            self._cache_local(object_id, data)
+            self._cache_local(object_id, data, owner_addr)
         return data
 
-    def _cache_local(self, object_id: bytes, data: bytes):
+    def _cache_local(self, object_id: bytes, data: bytes, owner_addr=None):
         """Cache fetched bytes in the local shm store and register the new
-        location (best-effort; a full store just skips the cache)."""
+        location with the owner (best-effort; a full store skips the
+        cache)."""
         try:
             self.store.put(object_id, data)
-            self.gcs.push("add_object_location", object_id=object_id,
-                          node_id=self.node_id, size=len(data))
+            self._announce_copy(object_id, len(data), owner_addr)
         except Exception:
             pass
 
@@ -1229,22 +1255,23 @@ class CoreWorker:
         except OSError:
             pass
 
-    def _pull_native(self, object_id: bytes, addr, chunk: int):
+    def _pull_native(self, object_id: bytes, addr, chunk: int,
+                     owner_addr=None):
         """Fetch via the remote store's C++ data server
         (src/store/data_server.cc). Protocol: 32-byte request (id, offset,
         max_len) -> 16-byte header (total_size, payload_len) + payload.
         A pooled (possibly stale) connection gets one retry on a fresh
         socket before giving up."""
-        result = self._pull_native_once(object_id, addr, chunk)
+        result = self._pull_native_once(object_id, addr, chunk, owner_addr)
         if result is _RETRY_FRESH:
             result = self._pull_native_once(object_id, addr, chunk,
-                                            fresh=True)
+                                            owner_addr, fresh=True)
         if result is _RETRY_FRESH or result is None:
             return None, False
         return result   # (data, cached_in_local_store)
 
     def _pull_native_once(self, object_id: bytes, addr, chunk: int,
-                          fresh: bool = False):
+                          owner_addr=None, fresh: bool = False):
         import struct as _struct
 
         missing = (1 << 64) - 1
@@ -1317,12 +1344,7 @@ class CoreWorker:
                 # re-download over the slow RPC plane
                 payload = bytes(shm_view)
                 self.store.seal(object_id)
-                try:
-                    self.gcs.push("add_object_location",
-                                  object_id=object_id,
-                                  node_id=self.node_id, size=size)
-                except Exception:
-                    pass
+                self._announce_copy(object_id, size, owner_addr)
                 return payload, True
             return (bytes(data), False) if data is not None else None
         except Exception:
@@ -1465,6 +1487,34 @@ class CoreWorker:
                 if isinstance(reply, dict) and "status" in reply:
                     if reply["status"] == "lost":
                         raise exc.ObjectLostError(ref.hex())
+                    if reply["status"] == "at":
+                        # big value: pull over the data plane from a holder
+                        # node instead of this pickle channel
+                        for node in reply.get("nodes", ()):
+                            if node["NodeID"] == self.node_id:
+                                # our own cached copy is gone (local store
+                                # already missed before we got here) —
+                                # retract it or the owner's directory never
+                                # drains and lost-detection never fires
+                                try:
+                                    client.push("object_location_removed",
+                                                object_id=ref.id,
+                                                node_id=node["NodeID"])
+                                except Exception:
+                                    pass
+                                continue
+                            data = self._pull_remote(ref.id, node,
+                                                     owner_addr=addr)
+                            if data is not None:
+                                return data
+                            # stale location (holder died): tell the owner
+                            try:
+                                client.push("object_location_removed",
+                                            object_id=ref.id,
+                                            node_id=node["NodeID"])
+                            except Exception:
+                                pass
+                        return None   # caller keeps polling; owner recovers
                     return reply.get("data")
                 return reply
             except TimeoutError:
@@ -1504,32 +1554,101 @@ class CoreWorker:
 
         return metrics.registry_snapshot()
 
+    # ------------------------------------------- owner-based object directory
+    # Reference: ownership_based_object_directory.h:1 — the owning worker is
+    # the source of truth for which nodes hold copies of its objects. Nodes
+    # that create a copy (task return, pull-cache) announce to the OWNER;
+    # readers resolve through the owner. The GCS keeps no per-get role.
+
+    def _loc_add(self, object_id: bytes, node: dict, size: int = 0):
+        with self._dir_lock:
+            self._obj_locations.setdefault(
+                object_id, {})[node["NodeID"]] = dict(node)
+            if size:
+                self._obj_sizes[object_id] = size
+
+    def _loc_remove(self, object_id: bytes, node_id: str):
+        with self._dir_lock:
+            locs = self._obj_locations.get(object_id)
+            if locs:
+                locs.pop(node_id, None)
+
+    def _loc_snapshot(self, object_id: bytes):
+        """(nodes, size) for an owned object — size>0 means a copy was
+        sealed somewhere at some point (the was-created signal that arms
+        lost-object detection once nodes drains to empty)."""
+        with self._dir_lock:
+            nodes = [dict(n)
+                     for n in self._obj_locations.get(object_id, {}).values()]
+            return nodes, self._obj_sizes.get(object_id, 0)
+
+    def _announce_copy(self, object_id: bytes, size: int, owner_addr):
+        """This node now holds a sealed copy: register it with the object's
+        owner (ourselves → table write; remote → one-way push)."""
+        if not owner_addr or tuple(owner_addr) == self.addr:
+            self._loc_add(object_id, self._my_node, size)
+            return
+        try:
+            self._owner_client(tuple(owner_addr)).push(
+                "object_location_added", object_id=object_id,
+                node=self._my_node, size=size)
+        except Exception:
+            pass   # owner gone: the copy is orphaned; raylet LRU reclaims
+
+    def rpc_object_location_added(self, conn, object_id: bytes, node: dict,
+                                  size: int = 0):
+        self._loc_add(object_id, node, size)
+
+    def rpc_object_location_removed(self, conn, object_id: bytes,
+                                    node_id: str):
+        self._loc_remove(object_id, node_id)
+
+    def rpc_locate_object(self, conn, object_id: bytes):
+        """Non-blocking readiness+location probe (wait()/_is_ready path).
+        INLINE: dict lookups and a shm-index probe only."""
+        ready = (self.memory_store.contains_resolved(object_id)
+                 or self.store.contains(object_id))
+        nodes, size = self._loc_snapshot(object_id)
+        return {"ready": ready or bool(nodes), "nodes": nodes, "size": size}
+
     def rpc_get_owned_value(self, conn, object_id: bytes):
         """Serve a value we own to a borrower. Blocks briefly if the task
-        producing it hasn't finished. If every copy of a sealed value died,
-        the owner is the one holding lineage — kick reconstruction here so
-        borrowers recover too (reference: recovery runs in the owner's core
-        worker, object_recovery_manager.h)."""
+        producing it hasn't finished. Small values ride the reply inline;
+        big ones return the holder nodes ("at") so the borrower pulls over
+        the zero-copy data plane instead of this pickle channel. If every
+        copy of a sealed value died, the owner is the one holding lineage —
+        kick reconstruction here so borrowers recover too (reference:
+        recovery runs in the owner's core worker,
+        object_recovery_manager.h)."""
+        from ray_tpu._private.config import get_config
+
+        inline_max = int(get_config("inline_object_max_size_bytes"))
         entry = self.memory_store.entry(object_id)
         if entry.event.wait(0.5):
             return {"status": "ok", "data": entry.data}
         buf = self.store.get(object_id)
         if buf is not None:
             try:
-                return {"status": "ok", "data": buf.to_bytes()}
+                size = len(buf)
+                if size <= inline_max:
+                    return {"status": "ok", "data": buf.to_bytes()}
             finally:
                 buf.release()
-        try:
-            locs = self.gcs.call("get_object_locations", object_id=object_id)
-        except ConnectionLost:
-            locs = {}
-        if locs.get("lost") and object_id not in self._ref_to_task:
+            nodes, _ = self._loc_snapshot(object_id)
+            nodes = ([dict(self._my_node)]
+                     + [n for n in nodes if n["NodeID"] != self.node_id])
+            return {"status": "at", "nodes": nodes, "size": size}
+        nodes, size = self._loc_snapshot(object_id)
+        nodes = [n for n in nodes if n["NodeID"] != self.node_id]
+        if nodes:
+            return {"status": "at", "nodes": nodes, "size": size}
+        if size and object_id not in self._ref_to_task:
+            # sealed once, zero live copies → lost unless lineage recovers it
             if not self._maybe_reconstruct(object_id):
                 return {"status": "lost"}
         if entry.event.wait(3.0):
             return {"status": "ok", "data": entry.data}
-        # pending: task still running / reconstruction in flight / result
-        # lives in some shm store (borrower finds it via the directory)
+        # pending: task still running / reconstruction in flight
         return {"status": "pending"}
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
@@ -1564,11 +1683,15 @@ class CoreWorker:
             return True
         if self.store.contains(ref.id):
             return True
+        if not ref.owner_addr or tuple(ref.owner_addr) == self.addr:
+            with self._dir_lock:
+                return bool(self._obj_locations.get(ref.id))
         try:
-            locs = self.gcs.call("get_object_locations", object_id=ref.id)
-            return bool(locs["nodes"])
-        except ConnectionLost:
-            return False
+            reply = self._owner_client(tuple(ref.owner_addr)).call(
+                "locate_object", object_id=ref.id, timeout=5.0)
+            return bool(reply.get("ready"))
+        except Exception:
+            return False   # owner unreachable → not fetchable either
 
     def as_future(self, ref: ObjectRef) -> PyFuture:
         fut = PyFuture()
@@ -1804,8 +1927,14 @@ class CoreWorker:
             # flight, storing the result would resurrect an unfreeable object
             if self.reference_counter.count(rid) > 0 or rid in self._owned:
                 self.memory_store.put(rid, data)
-        # returns listed in reply["stored"] live in a shm store and resolve
-        # through the object directory in _fetch_bytes
+        # returns listed in reply["stored"] live in the executor node's shm
+        # store — record them in OUR directory (we own them); _fetch_bytes
+        # and borrower queries resolve through it
+        exec_node = reply.get("node")
+        if exec_node:
+            sizes = reply.get("stored_sizes", {})
+            for rid in reply.get("stored", ()):
+                self._loc_add(rid, exec_node, sizes.get(rid, 0))
 
     # --------------------------------------------------------------- actors
 
@@ -1932,7 +2061,8 @@ class CoreWorker:
     # the raylet then "reclaims" a live driver's leases, killing its
     # workers mid-task (observed as WorkerCrashedError storms in the
     # chaos suite).
-    INLINE_RPC = frozenset({"push_task", "ping", "task_state"})
+    INLINE_RPC = frozenset({"push_task", "ping", "task_state",
+                            "locate_object"})
     DEFERRED_RPC = frozenset({"push_task"})
 
     def rpc_push_task(self, conn, seq, spec: dict):
@@ -2187,16 +2317,20 @@ class CoreWorker:
                     f"{len(values)} values"))
         inline: dict[bytes, bytes] = {}
         stored: list[bytes] = []
+        sizes: dict[bytes, int] = {}
         for rid, value in zip(spec["return_ids"], values):
             data = ser.serialize(value)
             if len(data) <= INLINE_RESULT_LIMIT:
                 inline[rid] = data
             else:
                 self.store.put(rid, data)
-                self.gcs.push("add_object_location", object_id=rid,
-                              node_id=self.node_id, size=len(data))
                 stored.append(rid)
-        return {"results": inline, "stored": stored}
+                sizes[rid] = len(data)
+        # The task REPLY doubles as the location announcement: the owner
+        # records (rid → this node) in its directory on receipt — no
+        # directory RPC at all on the return path.
+        return {"results": inline, "stored": stored, "stored_sizes": sizes,
+                "node": self._my_node}
 
     def _package_error(self, spec: dict, error: BaseException) -> dict:
         if isinstance(error, KeyboardInterrupt):

@@ -77,8 +77,21 @@ def list_placement_groups(*, address: str | None = None) -> list[dict]:
 
 
 def list_objects(*, address: str | None = None) -> list[dict]:
+    """Union of per-node store inventories, merged by object id. Locations
+    live with owning workers (owner-based directory), so the cluster-wide
+    view is assembled from the raylets' stores rather than a GCS table."""
     with _gcs(address) as call:
-        return call("list_objects")
+        rows = _each_raylet(call, "list_store_objects")
+    merged: dict[str, dict] = {}
+    for r in rows:
+        cur = merged.get(r["ObjectID"])
+        if cur is None:
+            merged[r["ObjectID"]] = dict(r)
+        else:
+            cur["Locations"] = sorted(set(cur["Locations"])
+                                      | set(r["Locations"]))
+            cur["Size"] = max(cur["Size"], r["Size"])
+    return list(merged.values())
 
 
 def list_tasks(*, address: str | None = None) -> list[dict]:

@@ -54,7 +54,7 @@ namespace {
 
 // Bump when the frame layout or frame semantics change incompatibly.
 // Must match PROTOCOL_VERSION in ray_tpu/_private/protocol.py.
-constexpr int kProtocolVersion = 1;
+constexpr int kProtocolVersion = 2;
 
 constexpr int kReq = 0;
 constexpr int kReply = 1;

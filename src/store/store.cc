@@ -511,6 +511,26 @@ int store_delete(Store* s, const uint8_t* id) {
   return OK;
 }
 
+// List sealed objects: writes up to `max` (id, size) rows into out_ids
+// (max*16 bytes) / out_sizes (max entries); returns the number written,
+// or ERR_SYS. Powers `ray-tpu memory` under the owner-based directory —
+// per-node store contents replace the retired central location table.
+int store_list(Store* s, uint8_t* out_ids, uint64_t* out_sizes,
+               uint64_t max) {
+  if (lock(s) != 0) return ERR_SYS;
+  Header* h = header(s);
+  ObjectEntry* t = table(s);
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < h->n_slots && n < max; i++) {
+    if (t[i].state != 2) continue;
+    memcpy(out_ids + n * kIdSize, t[i].id, kIdSize);
+    out_sizes[n] = t[i].data_size;
+    n++;
+  }
+  unlock(s);
+  return static_cast<int>(n);
+}
+
 // Stats: fills [n_objects, bytes_used, heap_size, evictions].
 int store_stats(Store* s, uint64_t* out4) {
   if (lock(s) != 0) return ERR_SYS;

@@ -1,0 +1,135 @@
+"""Owner-based object directory.
+
+Reference: src/ray/object_manager/ownership_based_object_directory.h —
+object locations live with the OWNING worker; borrowers and the owner
+resolve through it, and the GCS plays no per-object role on the pull
+path. These tests pin the three load-bearing properties: zero GCS
+directory traffic on the put/get hot path, owner-side location records
+for remote task returns, and borrower resolution through the owner.
+"""
+import numpy as np
+
+
+class _GcsSpy:
+    """Wraps a CoreWorker's GCS client, recording call/push method names."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.methods: list[str] = []
+
+    def call(self, method, *a, **kw):
+        self.methods.append(method)
+        return self._inner.call(method, *a, **kw)
+
+    def call_async(self, method, **kw):
+        self.methods.append(method)
+        return self._inner.call_async(method, **kw)
+
+    def push(self, method, **kw):
+        self.methods.append(method)
+        return self._inner.push(method, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+DIRECTORY_METHODS = {"add_object_location", "remove_object_location",
+                     "get_object_locations"}
+
+
+def test_zero_gcs_calls_on_object_hot_path(ray_start_regular):
+    """put/get of many objects — including task returns big enough to ride
+    the shm store — produces NO GCS object-directory RPCs, and the GCS
+    call count stays flat in the object count (the round-5 done
+    criterion)."""
+    import ray_tpu
+    from ray_tpu._private.worker_runtime import current_worker
+
+    @ray_tpu.remote
+    def produce(i):
+        return np.full(50_000, float(i))   # 400 KB → stored, not inlined
+
+    # warm up the submission path (function registration etc.)
+    ray_tpu.get(produce.remote(0))
+
+    w = current_worker()
+    spy = _GcsSpy(w.gcs)
+    w.gcs = spy
+    try:
+        refs = [ray_tpu.put(i) for i in range(50)]
+        assert ray_tpu.get(refs) == list(range(50))
+        big = [produce.remote(i) for i in range(8)]
+        for i, arr in enumerate(ray_tpu.get(big)):
+            assert arr[0] == float(i)
+        hits = [m for m in spy.methods if m in DIRECTORY_METHODS]
+        assert hits == [], f"GCS directory RPCs on the hot path: {hits}"
+        # flatness: GCS traffic must not scale with the 58 objects moved
+        assert len(spy.methods) < 30, (
+            f"GCS call count scales with object count: {spy.methods}")
+    finally:
+        w.gcs = spy._inner
+
+
+def test_owner_records_remote_task_return_locations(ray_start_cluster):
+    """A big return stored on another node lands in the OWNER's directory
+    via the task reply (no directory RPC), and the owner pulls it through
+    that record."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=2, resources={"side": 1})
+    cluster.connect()
+    import ray_tpu
+    from ray_tpu._private.worker_runtime import current_worker
+
+    @ray_tpu.remote(num_cpus=0, resources={"side": 0.5})
+    def produce():
+        return np.arange(100_000, dtype=np.float64)   # 800 KB
+
+    ref = produce.remote()
+    done, _ = ray_tpu.wait([ref], timeout=60, fetch_local=False)
+    assert done
+    w = current_worker()
+    nodes, size = w._loc_snapshot(ref.id)
+    assert nodes, "owner directory has no record of the stored return"
+    assert nodes[0]["NodeID"] != w.node_id, "return should be remote"
+    assert size == 0 or size > 100_000
+    out = ray_tpu.get(ref, timeout=30)
+    assert out.sum() == np.arange(100_000, dtype=np.float64).sum()
+
+
+def test_borrower_resolves_big_value_through_owner(ray_start_cluster):
+    """A borrower task on node B gets a driver-owned big object: the owner
+    answers with holder locations ("at") and the bytes cross the data
+    plane, not the owner's pickle channel."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=2, resources={"side": 1})
+    cluster.connect()
+    import ray_tpu
+
+    payload = np.random.default_rng(7).standard_normal(200_000)  # 1.6 MB
+    ref = ray_tpu.put(payload)
+
+    @ray_tpu.remote(num_cpus=0, resources={"side": 0.5})
+    def consume(arr):
+        return float(arr.sum())
+
+    assert abs(ray_tpu.get(consume.remote(ref), timeout=60)
+               - float(payload.sum())) < 1e-6
+
+
+def test_locate_object_rpc_shapes(ray_start_regular):
+    """locate_object: ready+nodes for a stored object, not-ready for an
+    unknown id."""
+    import os
+
+    import ray_tpu
+    from ray_tpu._private.worker_runtime import current_worker
+
+    w = current_worker()
+    ref = ray_tpu.put(np.zeros(64_000))
+    reply = w.rpc_locate_object(None, ref.id)
+    assert reply["ready"] and reply["nodes"]
+    assert reply["nodes"][0]["NodeID"] == w.node_id
+    missing = w.rpc_locate_object(None, os.urandom(16))
+    assert not missing["ready"] and not missing["nodes"]
