@@ -375,7 +375,15 @@ def get_runtime_context() -> RuntimeContext:
 
 _TASK_DEFAULTS = dict(num_cpus=1.0, num_tpus=0.0, memory=None, resources=None,
                       num_returns=1, max_retries=3, retry_exceptions=False,
-                      scheduling_strategy=None, runtime_env=None)
+                      scheduling_strategy=None, runtime_env=None,
+                      # Opt-in: execute on the worker's transport pump
+                      # instead of the main-thread loop — skips a queue
+                      # handoff + thread wake per task. ONLY for tasks that
+                      # never block (no nested get()/wait(), no runtime
+                      # envs) and import no thread-hostile native libs
+                      # (pyarrow). Reference analog: direct-call execution
+                      # without an executor hop.
+                      inline_exec=False)
 _ACTOR_DEFAULTS = dict(num_cpus=1.0, num_tpus=0.0, memory=None, resources=None,
                        max_restarts=0, max_task_retries=0, max_concurrency=1,
                        concurrency_groups=None, name=None, namespace=None,
@@ -456,6 +464,7 @@ class RemoteFunction:
             max_retries=opts["max_retries"],
             runtime_env=opts.get("runtime_env"),
             task_desc=f"task {self._fn.__name__}()",
+            inline_exec=bool(opts.get("inline_exec")),
         )
         if opts["num_returns"] == 1:
             return refs[0]

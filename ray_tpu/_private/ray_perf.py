@@ -80,6 +80,20 @@ def main(min_time: float = 1.0):
     timeit("single client tasks async",
            lambda: ray_tpu.get([noop.remote() for _ in range(100)]),
            multiplier=100, min_time=min_time, results=results)
+
+    # inline_exec: the task runs on the worker's transport pump (no
+    # main-thread handoff) — the opt-in hot path for pump-safe tasks
+    @ray_tpu.remote(num_cpus=0, max_retries=0, inline_exec=True)
+    def noop_inline():
+        return None
+
+    ray_tpu.get(noop_inline.remote())
+    timeit("single client tasks sync (inline exec)",
+           lambda: ray_tpu.get(noop_inline.remote()),
+           min_time=min_time, results=results)
+    timeit("single client tasks async (inline exec)",
+           lambda: ray_tpu.get([noop_inline.remote() for _ in range(100)]),
+           multiplier=100, min_time=min_time, results=results)
     obj = ray_tpu.put(small)
     timeit("single client tasks with object ref arg",
            lambda: ray_tpu.get([noop_arg.remote(obj) for _ in range(20)]),

@@ -29,6 +29,7 @@ class TaskSpec(TypedDict, total=False):
     task_desc: str               # human-readable ("task f()")
     job_id: int
     runtime_env: dict            # normalized (content keys, not paths)
+    inline_exec: bool            # pump-safe: execute on the transport pump
     trace_ctx: dict              # {"trace_id", "parent_span_id"}
     # actor-call extension (producer: submit_actor_task)
     actor_id: bytes
@@ -53,6 +54,10 @@ REQUIRED_ACTOR_KEYS = frozenset({
 # (CoreWorker._strip_spec removes these before pushing).
 LOCAL_KEY_PREFIX = "_"
 
+# Precomputed so the per-submission validator doesn't rebuild the allowed
+# set from TypedDict.__annotations__ on every task (hot path).
+_DECLARED_KEYS = frozenset(TaskSpec.__annotations__)
+
 
 def _validation_enabled() -> bool:
     return os.environ.get("RAY_TPU_VALIDATE_SPECS", "1") != "0"
@@ -72,7 +77,7 @@ def validate_task_spec(spec: dict[str, Any], *, actor: bool = False):
     unknown = {
         k for k in spec
         if not k.startswith(LOCAL_KEY_PREFIX)
-        and k not in TaskSpec.__annotations__
+        and k not in _DECLARED_KEYS
     }
     if unknown:
         raise ValueError(

@@ -40,8 +40,25 @@ def main():
         return 0
     set_current_worker(worker)
 
+    profile_dir = os.environ.get("RAY_TPU_WORKER_PROFILE")
+    prof = None
+    if profile_dir:
+        import cProfile
+
+        prof = cProfile.Profile()
+
+    def _dump_profile():
+        if prof is not None:
+            try:
+                os.makedirs(profile_dir, exist_ok=True)
+                prof.dump_stats(os.path.join(
+                    profile_dir, f"worker-{os.getpid()}.prof"))
+            except Exception:
+                pass
+
     def _term(signum, frame):
         worker.stopped = True
+        _dump_profile()
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _term)
@@ -67,6 +84,15 @@ def main():
     # (pyarrow submodule init) are unreliable on short-lived dispatch
     # threads; the main thread is always safe. Returns when the raylet
     # connection drops — the node is gone.
+    if prof is not None:
+        # Perf diagnosis aid (RAY_TPU_WORKER_PROFILE=dir): cProfile the
+        # main task loop — where normal-task execution happens — and dump
+        # per-pid stats at exit (including SIGTERM, see _term).
+        try:
+            prof.runcall(worker.serve_task_loop)
+        finally:
+            _dump_profile()
+        os._exit(1)
     worker.serve_task_loop()
     os._exit(1)
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import itertools
 import hashlib
 import os
 import queue
@@ -182,12 +183,28 @@ class _SchedulingKeyQueue:
         self._lock = threading.Lock()
         self._wakeup = threading.Event()
         self._lease_pending = False       # one in-flight lease request max
+        self._dispatching = False         # dispatch thread holds a popped spec
         self._lease_error: BaseException | None = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"submit-{key[0][:8].hex() if isinstance(key[0], bytes) else key[0]}")
         self._thread.start()
 
     def submit(self, spec: dict):
+        # Fast path: a leased worker with a free pipeline slot takes the
+        # push straight from the submitting thread — no dispatch-thread
+        # handoff (queue put + wake + get costs ~50µs of the sync-task
+        # budget on the 1-core box). Fairness: the shortcut only fires
+        # when nothing is waiting in the queue AND the dispatch thread is
+        # not holding a popped spec it is still trying to place (that
+        # spec is invisible to qsize(); without the flag a stream of
+        # fast-path submits could starve it of freed slots).
+        if self.tasks.qsize() == 0 and not self._dispatching \
+                and not spec.get("_cancelled"):
+            lw = self._pick_worker()
+            if lw is not None:
+                self._last_dispatch = time.monotonic()
+                if self._push(lw, spec):
+                    return
         self.tasks.put(spec)
         self._wakeup.set()
 
@@ -203,6 +220,7 @@ class _SchedulingKeyQueue:
             except queue.Empty:
                 self._maybe_return_leases()
                 continue
+            self._dispatching = True
             dispatched = False
             while not dispatched and not self.worker.stopped:
                 if spec.get("_cancelled"):
@@ -235,6 +253,7 @@ class _SchedulingKeyQueue:
                     continue
                 self._wakeup.wait(timeout=0.05)
                 self._wakeup.clear()
+            self._dispatching = False
 
     def _pick_worker(self):
         # Depth-1 unless there's real QUEUE pressure: with a short queue,
@@ -577,6 +596,11 @@ class CoreWorker:
         self.mode = mode                      # "driver" | "worker"
         self.worker_id = worker_id or uuid.uuid4().hex[:16]
         self.stopped = False
+        # id mint: random 8-byte process prefix + counter. Ids need
+        # uniqueness, not unpredictability, and os.urandom is a syscall
+        # (~16µs) paid twice per task on the submit hot path.
+        self._id_prefix = os.urandom(8)
+        self._id_counter = itertools.count(1)
         self.memory_store = MemoryStore()
         self.reference_counter = ReferenceCounter(
             on_zero=self._on_local_refs_zero)
@@ -666,6 +690,11 @@ class CoreWorker:
         self._ready.set()
 
     # ------------------------------------------------------------------ utils
+
+    def _new_id(self) -> bytes:
+        """16-byte unique id (process-random prefix + counter) — the id
+        mint for tasks/objects/actors; see __init__ for why not urandom."""
+        return self._id_prefix + next(self._id_counter).to_bytes(8, "big")
 
     def _on_gcs_push(self, payload):
         pass  # subscriptions are registered lazily where needed
@@ -903,7 +932,7 @@ class CoreWorker:
 
     def put(self, value) -> ObjectRef:
         data = ser.serialize(value)
-        object_id = os.urandom(16)
+        object_id = self._new_id()
         self.store.put(object_id, data)
         # we own it: record the location in OUR directory — no RPC at all
         self._loc_add(object_id, self._my_node, len(data))
@@ -963,7 +992,12 @@ class CoreWorker:
             # there), then drop our entries
             with self._dir_lock:
                 holders = list(self._obj_locations.pop(object_id, {}))
-                self._obj_sizes.pop(object_id, None)
+                size = self._obj_sizes.pop(object_id, None)   # always pop
+                had_copy = bool(holders) or size is not None
+            if not had_copy:
+                return   # inline-only result: nothing anywhere to delete,
+                         # and the per-task free push + GCS handler round
+                         # is pure hot-path overhead (profiled round 5)
             try:
                 self.gcs.push("free_objects", object_ids=[object_id],
                               locations={object_id: holders})
@@ -1101,17 +1135,28 @@ class CoreWorker:
             data = self.memory_store.get_nowait(ref.id)
             if data is not None:
                 return data
-            # 2. local shm store
-            buf = self.store.get(ref.id)
-            if buf is not None:
-                try:
-                    return buf.to_bytes()
-                finally:
-                    buf.release()
+            # While OUR producing task is still in flight, nothing below
+            # can hit: the result announces through the task reply (inline
+            # → memory store; stored → directory record), so probing the
+            # shm store (a C-lock + spill-stat round, ~100µs on the dev
+            # box) or the directory every poll is pure hot-path waste.
+            # Skip straight to the wait; the reply or a poll tick re-runs
+            # the full path once the task is done.
+            in_flight = ref.id in self._ref_to_task
+            if not in_flight:
+                # 2. local shm store
+                buf = self.store.get(ref.id)
+                if buf is not None:
+                    try:
+                        return buf.to_bytes()
+                    finally:
+                        buf.release()
             # 3. resolve through the OWNER-BASED directory — zero GCS calls
             # (reference: ownership_based_object_directory.h).
             we_own = not ref.owner_addr or tuple(ref.owner_addr) == self.addr
-            if we_own:
+            if in_flight:
+                pass          # wait below; the reply resolves everything
+            elif we_own:
                 # we are the owner: our table is the directory
                 nodes, created_size = self._loc_snapshot(ref.id)
                 for node in nodes:
@@ -1728,15 +1773,16 @@ class CoreWorker:
 
     def submit_task(self, func_hash: bytes, args, kwargs, *, num_returns=1,
                     resources=None, strategy=None, max_retries=0,
-                    runtime_env=None, task_desc="task") -> list[ObjectRef]:
+                    runtime_env=None, task_desc="task",
+                    inline_exec=False) -> list[ObjectRef]:
         # {} is a legitimate request (num_cpus=0: schedule anywhere, consume
         # nothing); only None means "default 1 CPU".
         resources = {"CPU": 1.0} if resources is None else dict(resources)
         runtime_env = self._normalize_runtime_env(runtime_env)
-        return_ids = [os.urandom(16) for _ in range(num_returns)]
+        return_ids = [self._new_id() for _ in range(num_returns)]
         args, kwargs = self._inline_small_args(args, kwargs)
         spec = {
-            "task_id": os.urandom(16),
+            "task_id": self._new_id(),
             "func_hash": func_hash,
             "args": ser.serialize((args, kwargs)),
             "return_ids": return_ids,
@@ -1752,6 +1798,15 @@ class CoreWorker:
         }
         if runtime_env:
             spec["runtime_env"] = runtime_env
+        if inline_exec and not runtime_env and \
+                not ser.contained_refs((args, kwargs)):
+            # Only pump-safe if no arg resolution can block: a ref that
+            # survived small-arg inlining would make the pump fetch it
+            # (possibly a cross-node transfer) mid-dispatch. Such tasks
+            # silently take the main-loop path instead. (Refs nested deep
+            # inside opaque objects can still slip through — the option's
+            # contract says don't do that.)
+            spec["inline_exec"] = True
         from ray_tpu.util import tracing
 
         from ray_tpu._private.task_spec import validate_task_spec
@@ -1799,6 +1854,12 @@ class CoreWorker:
                     try:
                         if len(buf) <= limit:
                             data = buf.to_bytes()
+                            # heap-cache the inlined bytes: passing the
+                            # same small ref to many tasks otherwise pays
+                            # a shm probe (C lock + spill stat) per
+                            # SUBMIT. Freed by the normal ref-zero path.
+                            if self.reference_counter.count(v.id) > 0:
+                                self.memory_store.put(v.id, data)
                     finally:
                         buf.release()
             if data is None or len(data) > limit:
@@ -1939,7 +2000,7 @@ class CoreWorker:
     # --------------------------------------------------------------- actors
 
     def create_actor(self, class_hash: bytes, args, kwargs, *, options):
-        actor_id = os.urandom(16)
+        actor_id = self._new_id()
         spec = {
             "class_hash": class_hash,
             "class_name": options.get("class_name", "Actor"),
@@ -2004,9 +2065,9 @@ class CoreWorker:
     def submit_actor_task(self, actor_id: bytes, method_name: str, args,
                           kwargs, *, num_returns=1, max_task_retries=0,
                           task_desc=""):
-        return_ids = [os.urandom(16) for _ in range(num_returns)]
+        return_ids = [self._new_id() for _ in range(num_returns)]
         spec = {
-            "task_id": os.urandom(16),
+            "task_id": self._new_id(),
             "actor_id": actor_id,
             "method_name": method_name,
             "args": ser.serialize((args, kwargs)),
@@ -2078,6 +2139,28 @@ class CoreWorker:
 
         if (spec.get("actor_id") is None and self._ready.is_set()
                 and self._main_loop_running):
+            if spec.get("inline_exec") and \
+                    self._normal_exec_lock.acquire(blocking=False):
+                # Caller declared the task pump-safe (never blocks, no
+                # thread-hostile native imports): run it RIGHT HERE and
+                # skip the main-thread queue handoff + wake entirely.
+                # Non-blocking acquire: if the main loop is mid-task we
+                # fall through to the queue rather than stall the pump.
+                # interruptible=False: a force-cancel KeyboardInterrupt
+                # aimed at this THREAD could detonate in the transport
+                # reader loop after the task returns; inline tasks are
+                # cancel-by-flag only (they are short by contract).
+                from ray_tpu._private.protocol import _RemoteError
+
+                try:
+                    result = self._exec_task_body(spec,
+                                                  interruptible=False)
+                except BaseException as e:  # noqa: BLE001
+                    result = _RemoteError(e)
+                finally:
+                    self._normal_exec_lock.release()
+                conn.reply(seq, result)
+                return NO_REPLY
             self._main_jobs.put(
                 (spec, lambda result: conn.reply(seq, result)))
             return NO_REPLY
@@ -2151,36 +2234,45 @@ class CoreWorker:
             self._cancelled.discard(task_id)
             return {"cancelled": True}
         with self._normal_exec_lock:
-            if task_id in self._cancelled:   # cancelled while queued here
-                self._cancelled.discard(task_id)
-                return {"cancelled": True}
-            self._current_task_id = task_id
-            self._current_task_thread = threading.get_ident()
-            self._current_task_started = time.time()   # OOM victim ranking
-            from ray_tpu._private.profiling import record_span
+            return self._exec_task_body(spec)
 
-            try:
-                from ray_tpu.util import tracing
+    def _exec_task_body(self, spec: dict, interruptible: bool = True) -> dict:
+        """Execution core; caller holds _normal_exec_lock (main loop via
+        _execute_normal_task, or the pump's non-blocking inline_exec
+        acquire). interruptible=False leaves _current_task_thread unset so
+        force-cancel never aims an async exception at the transport pump."""
+        task_id = spec["task_id"]
+        if task_id in self._cancelled:       # cancelled while queued here
+            self._cancelled.discard(task_id)
+            return {"cancelled": True}
+        self._current_task_id = task_id
+        self._current_task_thread = \
+            threading.get_ident() if interruptible else None
+        self._current_task_started = time.time()   # OOM victim ranking
+        from ray_tpu._private.profiling import record_span
 
-                # tracing.span no-ops when no ctx arrived and tracing is
-                # off in this process — no guard needed
-                with record_span("task", spec.get("task_desc", "task"),
-                                 {"task_id": task_id.hex()}), \
-                     tracing.span(
-                         f"execute {spec.get('task_desc', 'task')}",
-                         "CONSUMER", spec.get("trace_ctx"),
-                         {"task_id": task_id.hex()}):
-                    self._apply_runtime_env(spec.get("runtime_env"))
-                    fn = self._load_function(spec["func_hash"])
-                    args, kwargs = self._resolve_args(spec)
-                    result = fn(*args, **kwargs)
-                return self._package_results(spec, result)
-            except BaseException as e:  # noqa: BLE001
-                return self._package_error(spec, e)
-            finally:
-                self._current_task_id = None
-                self._current_task_thread = None
-                self._current_task_started = None
+        try:
+            from ray_tpu.util import tracing
+
+            # tracing.span no-ops when no ctx arrived and tracing is
+            # off in this process — no guard needed
+            with record_span("task", spec.get("task_desc", "task"),
+                             {"task_id": task_id.hex()}), \
+                 tracing.span(
+                     f"execute {spec.get('task_desc', 'task')}",
+                     "CONSUMER", spec.get("trace_ctx"),
+                     {"task_id": task_id.hex()}):
+                self._apply_runtime_env(spec.get("runtime_env"))
+                fn = self._load_function(spec["func_hash"])
+                args, kwargs = self._resolve_args(spec)
+                result = fn(*args, **kwargs)
+            return self._package_results(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            return self._package_error(spec, e)
+        finally:
+            self._current_task_id = None
+            self._current_task_thread = None
+            self._current_task_started = None
 
     def rpc_task_state(self, conn):
         """Non-blocking probe of what this worker is running (inline —
@@ -2328,7 +2420,10 @@ class CoreWorker:
                 sizes[rid] = len(data)
         # The task REPLY doubles as the location announcement: the owner
         # records (rid → this node) in its directory on receipt — no
-        # directory RPC at all on the return path.
+        # directory RPC at all on the return path. (node omitted when
+        # nothing was stored: it's reply-size dead weight per task.)
+        if not stored:
+            return {"results": inline, "stored": stored}
         return {"results": inline, "stored": stored, "stored_sizes": sizes,
                 "node": self._my_node}
 

@@ -516,3 +516,26 @@ def test_dispatch_time_group_failure_does_not_wedge(ray_start_regular):
     assert ray_tpu.get(t.good.remote(), timeout=30) == 2
     with pytest.raises(Exception, match="concurrency group"):
         ray_tpu.get(bad_ref, timeout=30)
+
+
+def test_inline_exec_tasks(ray_start_regular):
+    """inline_exec=True runs tasks on the worker's transport pump (no
+    main-thread handoff). Semantics must match the default path: values,
+    errors, and ref args all behave identically."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0, inline_exec=True)
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote(num_cpus=0, inline_exec=True)
+    def boom():
+        raise ValueError("inline boom")
+
+    assert ray_tpu.get([double.remote(i) for i in range(20)]) == \
+        [i * 2 for i in range(20)]
+    ref = ray_tpu.put(21)
+    assert ray_tpu.get(double.remote(ref)) == 42
+    import pytest as _pytest
+    with _pytest.raises(ray_tpu.exceptions.TaskError, match="inline boom"):
+        ray_tpu.get(boom.remote())
