@@ -14,6 +14,7 @@ from ray_tpu._version import __version__  # noqa: F401
 # dragging in the runtime (and vice versa).
 _API_NAMES = (
     "ObjectRef",
+    "ObjectRefGenerator",
     "available_resources",
     "cancel",
     "cluster_resources",

@@ -17,7 +17,7 @@ import threading
 import time
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu._private.worker_runtime import (
     CoreWorker,
     current_worker,
@@ -466,7 +466,10 @@ class RemoteFunction:
             task_desc=f"task {self._fn.__name__}()",
             inline_exec=bool(opts.get("inline_exec")),
         )
-        if opts["num_returns"] == 1:
+        if opts["num_returns"] == "streaming":
+            return ObjectRefGenerator(refs[0].id, refs[0].owner_addr,
+                                      None, worker)
+        if opts["num_returns"] in (1, "dynamic"):
             return refs[0]
         return refs
 
@@ -497,7 +500,10 @@ class ActorMethod:
             max_task_retries=self._handle._max_task_retries,
             task_desc=f"actor method {self._name}()",
         )
-        if self._num_returns == 1:
+        if self._num_returns == "streaming":
+            return ObjectRefGenerator(refs[0].id, refs[0].owner_addr,
+                                      None, worker)
+        if self._num_returns in (1, "dynamic"):
             return refs[0]
         return refs
 

@@ -80,6 +80,108 @@ def _rebuild_ref(object_id: bytes, owner_addr):
     return ObjectRef(object_id, owner_addr, worker)
 
 
+class ObjectRefGenerator:
+    """Iterator over the refs of a dynamic-returns task.
+
+    Analog of the reference's ObjectRefGenerator
+    (python/ray/_raylet.pyx:168): a task declared
+    ``num_returns="dynamic"`` yields values, each stored as its own
+    object; the task's single return ref resolves to this generator.
+    With ``num_returns="streaming"`` the generator comes back from
+    ``.remote()`` directly and can be consumed WHILE the task is still
+    producing — ``__next__`` blocks until the next item is announced.
+
+    Two modes share this class:
+    - *static* (``_refs`` known): rebuilt from a completed task's
+      return value; iteration never blocks.
+    - *live* (``_stream`` bound): created at submission in streaming
+      mode; iteration waits on the owner-side stream that the
+      executor's per-item announcements feed.
+    """
+
+    def __init__(self, gen_id: bytes, owner_addr=None, item_ids=None,
+                 worker=None):
+        self._gen_id = gen_id
+        self._owner_addr = tuple(owner_addr) if owner_addr else None
+        self._worker = worker
+        self._item_ids = list(item_ids) if item_ids is not None else None
+        self._cursor = 0
+        self._closed = False
+        # hold a local ref on the generator object itself so the task's
+        # lineage/result stays alive while the generator is
+        self._gen_ref = ObjectRef(gen_id, owner_addr, worker)
+
+    # -- iteration -----------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        if self._closed:
+            raise StopIteration
+        if self._item_ids is not None:
+            if self._cursor >= len(self._item_ids):
+                raise StopIteration
+            rid = self._item_ids[self._cursor]
+        else:
+            rid = self._worker._gen_next(self._gen_id, self._cursor)
+            if rid is None:
+                raise StopIteration
+        self._cursor += 1
+        return ObjectRef(rid, self._owner_addr, self._worker)
+
+    def __len__(self):
+        if self._item_ids is not None:
+            return len(self._item_ids)
+        n = self._worker._gen_total(self._gen_id)
+        if n is None:
+            raise TypeError(
+                "len() on a streaming ObjectRefGenerator whose task is "
+                "still producing; iterate it or wait on completed()")
+        return n
+
+    # -- control -------------------------------------------------------------
+    def completed(self) -> ObjectRef:
+        """Ref that resolves when the producing task finishes (its value
+        is this generator in static form)."""
+        return self._gen_ref
+
+    def close(self):
+        """Stop consuming: cancels the producing task if it is still
+        running (reference: deleting/closing a streaming generator
+        cancels the task)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._item_ids is None and self._worker is not None:
+            self._worker._close_gen(self._gen_ref)
+
+    def __del__(self):
+        try:
+            if self._item_ids is None and not self._closed:
+                self.close()
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        if self._item_ids is None:
+            raise TypeError(
+                "a streaming ObjectRefGenerator cannot be serialized; "
+                "pass the individual ObjectRefs instead")
+        return (_rebuild_gen, (self._gen_id, self._owner_addr,
+                               list(self._item_ids)))
+
+    def __repr__(self):
+        mode = ("static" if self._item_ids is not None else "streaming")
+        return f"ObjectRefGenerator({self._gen_id.hex()}, {mode})"
+
+
+def _rebuild_gen(gen_id: bytes, owner_addr, item_ids):
+    from ray_tpu._private.worker_runtime import current_worker
+
+    return ObjectRefGenerator(gen_id, owner_addr, item_ids,
+                              current_worker())
+
+
 class ReferenceCounter:
     """Process-local ref counting feeding the distributed release protocol.
 

@@ -30,6 +30,8 @@ class TaskSpec(TypedDict, total=False):
     job_id: int
     runtime_env: dict            # normalized (content keys, not paths)
     inline_exec: bool            # pump-safe: execute on the transport pump
+    dynamic_returns: bool        # num_returns="dynamic"/"streaming": the
+                                 # task yields items, each its own object
     trace_ctx: dict              # {"trace_id", "parent_span_id"}
     # actor-call extension (producer: submit_actor_task)
     actor_id: bytes

@@ -157,6 +157,52 @@ class MemoryStore:
         return len(self._values)
 
 
+def _derive_item_id(gen_id: bytes, index: int) -> bytes:
+    """Deterministic id for item `index` of a dynamic-returns stream:
+    re-executing the producer (lineage reconstruction) regenerates the
+    same ids, so existing borrowed refs resolve against the new run."""
+    return hashlib.blake2b(gen_id + index.to_bytes(8, "big"),
+                           digest_size=16).digest()
+
+
+class _GenStream:
+    """Owner-side record of one dynamic-returns task's item stream.
+
+    The executor announces each yielded item as it is produced
+    (rpc_generator_item); the final task reply carries the item count
+    (success) or the error payload. Iterators (_gen_next) wait here.
+    Reference: the streaming-generator return path in
+    python/ray/_raylet.pyx:168 + core_worker task_manager's
+    dynamic_return_ids.
+    """
+
+    __slots__ = ("items", "total", "error", "cond", "closed")
+
+    def __init__(self):
+        self.items: dict[int, bytes] = {}   # index -> object id
+        self.total: int | None = None       # known once the task finishes
+        self.error: bytes | None = None     # serialize_error payload
+        self.closed = False                 # consumer closed early
+        self.cond = threading.Condition()
+
+    def add(self, index: int, rid: bytes):
+        with self.cond:
+            self.items[index] = rid
+            self.cond.notify_all()
+
+    def finish(self, total: int):
+        with self.cond:
+            if self.total is None:
+                self.total = total
+            self.cond.notify_all()
+
+    def fail(self, error_data: bytes):
+        with self.cond:
+            if self.error is None:
+                self.error = error_data
+            self.cond.notify_all()
+
+
 class _LeasedWorker:
     def __init__(self, grant: dict, client: RpcClient):
         self.lease_id = grant["lease_id"]
@@ -613,6 +659,7 @@ class CoreWorker:
         self._actor_queues: dict[bytes, _ActorQueue] = {}
         self._task_futures: dict[bytes, PyFuture] = {}
         self._ref_to_task: dict[bytes, tuple] = {}  # rid -> (spec, queue)
+        self._gen_streams: dict[bytes, _GenStream] = {}  # gen_id -> stream
         # Lineage for object reconstruction (reference:
         # core_worker/object_recovery_manager.h:30 + task_manager.h:93-110
         # lineage pinning): completed normal-task specs are retained, keyed
@@ -977,6 +1024,7 @@ class CoreWorker:
         to_unpin = None
         with self._lock:
             self._ref_to_task.pop(object_id, None)
+            gen_stream = self._gen_streams.pop(object_id, None)
             owned = object_id in self._owned
             self._owned.discard(object_id)
             tid = self._lineage_index.pop(object_id, None)
@@ -986,6 +1034,19 @@ class CoreWorker:
                     to_unpin = self._drop_lineage_locked(tid)
         if to_unpin is not None:
             self._unpin_args(to_unpin)
+        if gen_stream is not None:
+            # The generator itself is gone: release stream items nobody
+            # ever took a Python ref on (closed early / dropped
+            # uniterated) — their refcount is 0 so on_zero can never fire
+            # for them. Items the consumer DID take refs on free through
+            # the normal refcount path when those refs die.
+            with gen_stream.cond:
+                gen_stream.closed = True
+                item_ids = list(gen_stream.items.values())
+                gen_stream.cond.notify_all()
+            for rid in item_ids:
+                if self.reference_counter.count(rid) == 0:
+                    self._free_object(rid)
         if owned:
             # we are the directory: hand the GCS the holder list so it can
             # fan the delete out to those raylets (node connections live
@@ -1779,7 +1840,9 @@ class CoreWorker:
         # nothing); only None means "default 1 CPU".
         resources = {"CPU": 1.0} if resources is None else dict(resources)
         runtime_env = self._normalize_runtime_env(runtime_env)
-        return_ids = [self._new_id() for _ in range(num_returns)]
+        dynamic = num_returns in ("dynamic", "streaming")
+        return_ids = [self._new_id()
+                      for _ in range(1 if dynamic else num_returns)]
         args, kwargs = self._inline_small_args(args, kwargs)
         spec = {
             "task_id": self._new_id(),
@@ -1798,7 +1861,11 @@ class CoreWorker:
         }
         if runtime_env:
             spec["runtime_env"] = runtime_env
-        if inline_exec and not runtime_env and \
+        if dynamic:
+            spec["dynamic_returns"] = True
+            with self._lock:
+                self._gen_streams[return_ids[0]] = _GenStream()
+        if inline_exec and not runtime_env and not dynamic and \
                 not ser.contained_refs((args, kwargs)):
             # Only pump-safe if no arg resolution can block: a ref that
             # survived small-arg inlining would make the pump fetch it
@@ -1890,6 +1957,20 @@ class CoreWorker:
             return False
         spec, q = entry
         spec["_cancelled"] = True
+        if q is None:
+            # dynamic-returns actor task: route the cancel through the
+            # actor connection (flag-only; the drain loop between yields
+            # honors it)
+            with self._lock:
+                aq = self._actor_queues.get(spec.get("actor_id"))
+            client = aq.client if aq is not None else None
+            if client is not None:
+                try:
+                    client.push("cancel_task", task_id=spec["task_id"],
+                                force=force)
+                except Exception:
+                    pass
+            return True
         for lw in list(q.leased):
             try:
                 lw.client.push("cancel_task", task_id=spec["task_id"],
@@ -1955,6 +2036,8 @@ class CoreWorker:
 
     def _fail_task(self, spec: dict, error: BaseException):
         data = ser.serialize_error(error, spec.get("task_desc", "task"))
+        if spec.get("dynamic_returns"):
+            self._finalize_gen(spec, None, error=data)
         for rid in spec["return_ids"]:
             self.memory_store.put(rid, data)
             with self._lock:
@@ -1981,6 +2064,10 @@ class CoreWorker:
             return
         # Successful completion: keep the spec as lineage (arg pins held)
         # so a lost result can be recomputed; unpin happens at lineage drop.
+        if spec.get("dynamic_returns"):
+            # BEFORE lineage retention: extends return_ids with the item
+            # ids so reconstruction covers every streamed object
+            self._finalize_gen(spec, reply)
         self._retain_lineage(spec)
         results = reply.get("results", {})
         for rid, data in results.items():
@@ -2065,7 +2152,9 @@ class CoreWorker:
     def submit_actor_task(self, actor_id: bytes, method_name: str, args,
                           kwargs, *, num_returns=1, max_task_retries=0,
                           task_desc=""):
-        return_ids = [self._new_id() for _ in range(num_returns)]
+        dynamic = num_returns in ("dynamic", "streaming")
+        return_ids = [self._new_id()
+                      for _ in range(1 if dynamic else num_returns)]
         spec = {
             "task_id": self._new_id(),
             "actor_id": actor_id,
@@ -2078,6 +2167,13 @@ class CoreWorker:
             "task_desc": task_desc or f"actor method {method_name}",
             "job_id": self.job_id,
         }
+        if dynamic:
+            spec["dynamic_returns"] = True
+            with self._lock:
+                self._gen_streams[return_ids[0]] = _GenStream()
+                # registered so _close_gen → cancel_task can find the
+                # spec; q is None (actor path has no scheduling queue)
+                self._ref_to_task[return_ids[0]] = (spec, None)
         from ray_tpu.util import tracing
 
         from ray_tpu._private.task_spec import validate_task_spec
@@ -2123,7 +2219,7 @@ class CoreWorker:
     # workers mid-task (observed as WorkerCrashedError storms in the
     # chaos suite).
     INLINE_RPC = frozenset({"push_task", "ping", "task_state",
-                            "locate_object"})
+                            "locate_object", "generator_item"})
     DEFERRED_RPC = frozenset({"push_task"})
 
     def rpc_push_task(self, conn, seq, spec: dict):
@@ -2376,8 +2472,15 @@ class CoreWorker:
                         result = fut.result()
                     else:
                         result = method(*args, **kwargs)
+                    if spec.get("dynamic_returns"):
+                        # drain INSIDE the concurrency slot: the generator
+                        # body is actor code and must not overlap the next
+                        # call at max_concurrency=1
+                        result = self._package_results(spec, result)
             finally:
                 sem.release()
+            if spec.get("dynamic_returns"):
+                return result
             return self._package_results(spec, result)
         except BaseException as e:  # noqa: BLE001
             return self._package_error(spec, e)
@@ -2396,6 +2499,8 @@ class CoreWorker:
         return self._async_loop
 
     def _package_results(self, spec: dict, result) -> dict:
+        if spec.get("dynamic_returns"):
+            return self._package_generator(spec, result)
         num_returns = len(spec["return_ids"])
         if num_returns == 1:
             values = [result]
@@ -2426,6 +2531,213 @@ class CoreWorker:
             return {"results": inline, "stored": stored}
         return {"results": inline, "stored": stored, "stored_sizes": sizes,
                 "node": self._my_node}
+
+    def _package_generator(self, spec: dict, result) -> dict:
+        """Drain a dynamic-returns task's iterator, announcing each item
+        to the owner AS IT IS PRODUCED so a streaming consumer can start
+        before the task finishes (reference: _raylet.pyx:168
+        ObjectRefGenerator; streaming-generator item pushes in
+        task_manager's HandleReportGeneratorItemReturns).
+
+        Item ids derive deterministically from (gen_id, index) so a
+        lineage re-execution regenerates the SAME ids and announcements
+        land idempotently. Announcements are pipelined call_asyncs; the
+        final reply waits for their acks, so by the time the owner sees
+        the task reply every item it carries is already registered."""
+        from ray_tpu._private.object_ref import ObjectRefGenerator
+
+        gen_id = spec["return_ids"][0]
+        owner = spec.get("owner_addr")
+        local = not owner or tuple(owner) == self.addr
+        rids: list[bytes] = []
+        stored: list[bytes] = []
+        sizes: dict[bytes, int] = {}
+        acks = []
+        error = None
+        try:
+            iterator = iter(result)
+        except TypeError:
+            return self._package_error(spec, TypeError(
+                f"num_returns='dynamic' task returned non-iterable "
+                f"{type(result).__name__}"))
+        while True:
+            if spec["task_id"] in self._cancelled:
+                self._cancelled.discard(spec["task_id"])
+                self._await_gen_acks(acks)
+                return {"cancelled": True}
+            try:
+                value = next(iterator)
+            except StopIteration:
+                break
+            except BaseException as e:  # noqa: BLE001 — partial stream
+                error = e
+                break
+            index = len(rids)
+            rid = _derive_item_id(gen_id, index)
+            data = ser.serialize(value)
+            item = {"gen_id": gen_id, "index": index, "object_id": rid}
+            if len(data) <= INLINE_RESULT_LIMIT:
+                item["data"] = data
+            else:
+                self.store.put(rid, data)
+                stored.append(rid)
+                sizes[rid] = len(data)
+                item["node"] = self._my_node
+                item["size"] = len(data)
+            if local:
+                self._gen_item_local(**item)
+            else:
+                try:
+                    acks.append(self._owner_client(tuple(owner))
+                                .call_async("generator_item", **item))
+                except Exception:
+                    pass   # owner gone: the reply path will fail too
+            rids.append(rid)
+        self._await_gen_acks(acks)
+        if error is not None:
+            # partial stream: the owner already holds items 0..n-1; the
+            # reply's error payload finalizes the stream so iteration
+            # yields the produced prefix, then raises
+            return self._package_error(spec, error)
+        gen = ObjectRefGenerator(gen_id, owner, rids)
+        reply = {"results": {gen_id: ser.serialize(gen)},
+                 "stored": stored, "gen_count": len(rids)}
+        if stored:
+            reply["stored_sizes"] = sizes
+            reply["node"] = self._my_node
+        return reply
+
+    @staticmethod
+    def _await_gen_acks(acks):
+        for fut in acks:
+            try:
+                fut.result(timeout=30.0)
+            except Exception:
+                pass   # owner died mid-stream; reply delivery fails too
+
+    def _gen_item_local(self, gen_id: bytes, index: int, object_id: bytes,
+                        data: bytes | None = None, node: dict | None = None,
+                        size: int = 0):
+        """Owner-side registration of one generator item (also the
+        executor fast path when the owner is this process)."""
+        with self._lock:
+            stream = self._gen_streams.get(gen_id)
+        if stream is None:
+            return   # generator already freed: drop late items, don't
+                     # register objects nothing can ever release
+        self._owned.add(object_id)
+        if data is not None:
+            self.memory_store.put(object_id, data)
+        elif node is not None:
+            self._loc_add(object_id, node, size)
+        stream.add(index, object_id)
+
+    def rpc_generator_item(self, conn, gen_id: bytes, index: int,
+                           object_id: bytes, data: bytes | None = None,
+                           node: dict | None = None, size: int = 0):
+        """INLINE: dict inserts + a condition notify only."""
+        self._gen_item_local(gen_id, index, object_id, data, node, size)
+        return True
+
+    # ---- owner-side stream consumption (ObjectRefGenerator backing) -------
+
+    def _gen_next(self, gen_id: bytes, index: int,
+                  timeout: float | None = None):
+        """Block until item `index` of the stream exists; returns its
+        object id, None past the end, or raises the task's error once
+        the produced prefix is consumed."""
+        with self._lock:
+            stream = self._gen_streams.get(gen_id)
+        if stream is None:
+            raise exc.RayError(f"unknown generator {gen_id.hex()}")
+        deadline = None if timeout is None else time.time() + timeout
+        with stream.cond:
+            while True:
+                rid = stream.items.get(index)
+                if rid is not None:
+                    return rid
+                if stream.total is not None and index >= stream.total:
+                    return None
+                if stream.error is not None:
+                    value, _meta = ser.deserialize(stream.error, self,
+                                                   with_meta=True)
+                    raise value
+                if stream.closed:
+                    return None
+                wait_t = 0.5 if deadline is None else min(
+                    0.5, max(0.0, deadline - time.time()))
+                if deadline is not None and time.time() > deadline:
+                    raise exc.GetTimeoutError(
+                        f"generator item {index} not produced in time")
+                stream.cond.wait(wait_t)
+
+    def _gen_total(self, gen_id: bytes):
+        with self._lock:
+            stream = self._gen_streams.get(gen_id)
+        return None if stream is None else stream.total
+
+    def _close_gen(self, gen_ref):
+        """Consumer closed a streaming generator early: cancel the
+        producer and wake any blocked iterators."""
+        with self._lock:
+            stream = self._gen_streams.get(gen_ref.id)
+        if stream is None:
+            return
+        with stream.cond:
+            already_done = stream.total is not None or \
+                stream.error is not None
+            stream.closed = True
+            stream.cond.notify_all()
+        if not already_done:
+            try:
+                self.cancel_task(gen_ref, force=False)
+            except Exception:
+                pass
+
+    def _finalize_gen(self, spec: dict, reply: dict | None,
+                      error: BaseException | bytes | None = None):
+        """Resolve a dynamic task's stream from its final reply (count on
+        success, error payload on failure/cancel). On success the item
+        ids join the spec's return_ids so lineage reconstruction covers
+        them (re-execution re-derives the same ids)."""
+        gen_id = spec["return_ids"][0]
+        with self._lock:
+            stream = self._gen_streams.get(gen_id)
+        if stream is None:
+            return
+        if error is not None:
+            data = error if isinstance(
+                error, (bytes, bytearray, memoryview)) else \
+                ser.serialize_error(error, spec.get("task_desc", "task"))
+            stream.fail(data)
+            return
+        count = reply.get("gen_count")
+        if count is None:    # task failed: results[gen_id] is the error
+            stream.fail(reply.get("results", {}).get(gen_id))
+            return
+        item_ids = [_derive_item_id(gen_id, i) for i in range(count)]
+        self._owned.update(item_ids)
+        if spec.get("_gen_finalized") is None:
+            spec["_gen_finalized"] = True
+            spec["return_ids"] = list(spec["return_ids"]) + item_ids
+        # Backfill any index whose announcement got lost with a dropped
+        # owner connection: the ids re-derive, so the consumer still gets
+        # its ref; if the item was inline its data died with the push, so
+        # resolve it to ObjectLostError — a loud get() failure instead of
+        # _gen_next blocking forever on a hole in the stream.
+        with stream.cond:
+            missing = [(i, rid) for i, rid in enumerate(item_ids)
+                       if i not in stream.items]
+            for i, rid in missing:
+                stream.items[i] = rid
+        for _i, rid in missing:
+            if not self.memory_store.contains_resolved(rid):
+                nodes, _size = self._loc_snapshot(rid)
+                if not nodes:
+                    self.memory_store.put(rid, ser.serialize_error(
+                        exc.ObjectLostError(rid.hex()),
+                        spec.get("task_desc", "task")))
+        stream.finish(count)
 
     def _package_error(self, spec: dict, error: BaseException) -> dict:
         if isinstance(error, KeyboardInterrupt):

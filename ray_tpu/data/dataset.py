@@ -790,12 +790,27 @@ def from_pandas(df, *, parallelism: int = 4) -> Dataset:
     return Dataset(refs)
 
 
-def read_csv(paths, *, parallelism: int = 4) -> Dataset:
-    import pandas as pd
-
+def read_csv(paths, *, parallelism: int = 4,
+             chunk_rows: int = 200_000) -> Dataset:
+    """Distributed read: one task per file, one block per `chunk_rows`
+    rows. The block count per file is unknown until the file is read, so
+    each task streams blocks out through ``num_returns="dynamic"``
+    (reference: data/read_api.py read tasks produce a dynamic block
+    count per file via ObjectRefGenerator, _raylet.pyx:168)."""
     if isinstance(paths, str):
         paths = [paths]
-    refs = [ray_tpu.put(pd.read_csv(p)) for p in paths]
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def _read_csv_file(path, rows):
+        import pandas as pd
+
+        for chunk in pd.read_csv(path, chunksize=rows):
+            yield chunk
+
+    gens = [_read_csv_file.remote(p, chunk_rows) for p in paths]
+    refs = []
+    for g in gens:
+        refs.extend(ray_tpu.get(g))
     return Dataset(refs)
 
 
@@ -815,11 +830,27 @@ def read_json(paths) -> Dataset:
 
 
 def read_parquet(paths, *, parallelism: int = 4) -> Dataset:
-    import pandas as pd
-
+    """Distributed read: one task per file, one block per row group —
+    the block count only exists after the footer is open, which is
+    exactly the ``num_returns="dynamic"`` shape (reference:
+    data/read_api.py + _raylet.pyx:168)."""
     if isinstance(paths, str):
         paths = [paths]
-    refs = [ray_tpu.put(pd.read_parquet(p)) for p in paths]
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def _read_parquet_file(path):
+        import pyarrow.parquet as pq
+
+        f = pq.ParquetFile(path)
+        for rg in builtins.range(f.num_row_groups):
+            t = f.read_row_group(rg)
+            yield {name: t.column(name).to_numpy(zero_copy_only=False)
+                   for name in t.column_names}
+
+    gens = [_read_parquet_file.remote(p) for p in paths]
+    refs = []
+    for g in gens:
+        refs.extend(ray_tpu.get(g))
     return Dataset(refs)
 
 
