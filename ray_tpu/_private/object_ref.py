@@ -156,11 +156,11 @@ class ObjectRefGenerator:
             self._worker._close_gen(self._gen_ref)
 
     def __del__(self):
-        try:
-            if self._item_ids is None and not self._closed:
-                self.close()
-        except Exception:
-            pass
+        # NO locks, NO network here: GC can run this at any bytecode
+        # boundary (see CoreWorker._on_local_refs_zero). Dropping
+        # self._gen_ref enqueues the free; the reaper thread cancels a
+        # still-running producer inside _free_object.
+        self._closed = True
 
     def __reduce__(self):
         if self._item_ids is None:

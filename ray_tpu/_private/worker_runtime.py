@@ -1023,7 +1023,7 @@ class CoreWorker:
         self.memory_store.free(object_id)
         to_unpin = None
         with self._lock:
-            self._ref_to_task.pop(object_id, None)
+            task_entry = self._ref_to_task.pop(object_id, None)
             gen_stream = self._gen_streams.pop(object_id, None)
             owned = object_id in self._owned
             self._owned.discard(object_id)
@@ -1039,11 +1039,19 @@ class CoreWorker:
             # ever took a Python ref on (closed early / dropped
             # uniterated) — their refcount is 0 so on_zero can never fire
             # for them. Items the consumer DID take refs on free through
-            # the normal refcount path when those refs die.
+            # the normal refcount path when those refs die. If the
+            # producer is still running, cancel it here (we are on the
+            # reaper thread, where blocking pushes are allowed —
+            # ObjectRefGenerator.__del__ itself must never touch locks
+            # or the network, matching _on_local_refs_zero's contract).
             with gen_stream.cond:
+                unfinished = (gen_stream.total is None
+                              and gen_stream.error is None)
                 gen_stream.closed = True
                 item_ids = list(gen_stream.items.values())
                 gen_stream.cond.notify_all()
+            if unfinished and task_entry is not None:
+                self._cancel_spec(*task_entry, force=False)
             for rid in item_ids:
                 if self.reference_counter.count(rid) == 0:
                     self._free_object(rid)
@@ -1955,7 +1963,9 @@ class CoreWorker:
             entry = self._ref_to_task.get(ref.id)
         if entry is None:
             return False
-        spec, q = entry
+        return self._cancel_spec(*entry, force=force)
+
+    def _cancel_spec(self, spec: dict, q, force: bool = False) -> bool:
         spec["_cancelled"] = True
         if q is None:
             # dynamic-returns actor task: route the cancel through the
@@ -2620,17 +2630,20 @@ class CoreWorker:
                         size: int = 0):
         """Owner-side registration of one generator item (also the
         executor fast path when the owner is this process)."""
+        # Atomic with _free_object's stream pop (one lock): a late item
+        # racing the generator's release must either land before the
+        # cleanup snapshot or not register at all — registering after it
+        # would leak the object for the life of the worker.
         with self._lock:
             stream = self._gen_streams.get(gen_id)
-        if stream is None:
-            return   # generator already freed: drop late items, don't
-                     # register objects nothing can ever release
-        self._owned.add(object_id)
-        if data is not None:
-            self.memory_store.put(object_id, data)
-        elif node is not None:
-            self._loc_add(object_id, node, size)
-        stream.add(index, object_id)
+            if stream is None:
+                return   # generator already freed: drop late items
+            self._owned.add(object_id)
+            if data is not None:
+                self.memory_store.put(object_id, data)
+            elif node is not None:
+                self._loc_add(object_id, node, size)
+            stream.add(index, object_id)
 
     def rpc_generator_item(self, conn, gen_id: bytes, index: int,
                            object_id: bytes, data: bytes | None = None,

@@ -12,5 +12,8 @@ analog), a TPU pod provider slots in the same API for GCE/QR.
 """
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
 from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+from ray_tpu.autoscaler.tpu_provider import (MockTpuApi, TpuApi,
+                                             TPUPodNodeProvider)
 
-__all__ = ["LocalNodeProvider", "NodeProvider", "StandardAutoscaler"]
+__all__ = ["LocalNodeProvider", "MockTpuApi", "NodeProvider",
+           "StandardAutoscaler", "TpuApi", "TPUPodNodeProvider"]

@@ -1,0 +1,214 @@
+"""Cluster launcher — `ray-tpu up/down <cluster.yaml>`.
+
+Reference: python/ray/scripts/scripts.py:1164 (`ray up`) / :1240
+(`ray down`) + autoscaler/_private/commands.py (create_or_update_cluster,
+teardown_cluster). The YAML schema keeps the reference's field names
+(cluster_name, max_workers, provider, available_node_types,
+head_node_type — see autoscaler/gcp/tpu.yaml:29) with a TPU-first
+provider set:
+
+    cluster_name: demo
+    max_workers: 4
+    idle_timeout_s: 60
+    provider:
+      type: mock            # local | mock | gce_tpu
+      # gce_tpu: project, zone, runtime_version
+    head_node_type: head
+    available_node_types:
+      head:
+        resources: {CPU: 2}
+      v5e_pod:
+        min_workers: 0
+        max_workers: 4
+        resources: {CPU: 4, TPU: 4}
+        tpu_slice: {accelerator_type: v5litepod-16, topology: 4x4,
+                    hosts: 4}
+
+`up` starts a head node process, records cluster state under
+/tmp/ray_tpu/clusters/<name>.json, and spawns a detached monitor
+process (`python -m ray_tpu.autoscaler.monitor`) that owns the provider
+and runs the StandardAutoscaler reconcile loop — the reference's
+monitor.py shape. `down` signals the monitor (which releases every
+provider node/slice on SIGTERM), then stops the head.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+STATE_DIR = "/tmp/ray_tpu/clusters"
+
+
+def load_cluster_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    for key in ("cluster_name", "provider", "available_node_types"):
+        if key not in cfg:
+            raise ValueError(f"cluster config missing required key {key!r}")
+    head_type = cfg.get("head_node_type")
+    if head_type and head_type not in cfg["available_node_types"]:
+        raise ValueError(f"head_node_type {head_type!r} not in "
+                         f"available_node_types")
+    return cfg
+
+
+def make_provider(cfg: dict, gcs_address: str):
+    """Provider registry (reference: autoscaler/_private/providers.py
+    _get_node_provider). Worker-node providers attach to the running
+    cluster's GCS so scaled nodes join it."""
+    ptype = cfg["provider"].get("type", "local")
+    cluster = cfg.get("cluster_name", "ray-tpu")
+    if ptype == "local":
+        from ray_tpu.autoscaler.node_provider import LocalNodeProvider
+
+        return LocalNodeProvider(gcs_address)
+    if ptype == "mock":
+        from ray_tpu.autoscaler.tpu_provider import (MockTpuApi,
+                                                     TPUPodNodeProvider)
+
+        p = cfg["provider"]
+        api = MockTpuApi(gcs_address,
+                         provision_delay_s=p.get("provision_delay_s", 0.0),
+                         capacity_hosts=p.get("capacity_hosts"))
+        return TPUPodNodeProvider(api, cluster)
+    if ptype == "gce_tpu":
+        from ray_tpu.autoscaler.tpu_provider import (GceTpuApi,
+                                                     TPUPodNodeProvider)
+
+        p = cfg["provider"]
+        api = GceTpuApi(p["project"], p["zone"],
+                        p.get("runtime_version", "v2-alpha-tpuv5-lite"))
+        return TPUPodNodeProvider(api, cluster)
+    raise ValueError(f"unknown provider type {ptype!r}")
+
+
+def _state_path(cluster_name: str) -> str:
+    return os.path.join(STATE_DIR, f"{cluster_name}.json")
+
+
+def up(config_path: str, *, no_monitor: bool = False) -> dict:
+    """Create (or reconnect to) the cluster described by the YAML.
+    Returns the cluster state dict {gcs_address, head_pid, monitor_pid}."""
+    cfg = load_cluster_config(config_path)
+    name = cfg["cluster_name"]
+    os.makedirs(STATE_DIR, exist_ok=True)
+    state_file = _state_path(name)
+    if os.path.exists(state_file):
+        with open(state_file) as f:
+            state = json.load(f)
+        if _alive(state.get("head_pid")):
+            return state    # idempotent re-up: cluster already running
+        os.unlink(state_file)
+
+    head_type = cfg.get("head_node_type")
+    head_spec = (cfg["available_node_types"].get(head_type, {})
+                 if head_type else {})
+    head_res = dict(head_spec.get("resources") or {"CPU": 1})
+    node_args = [sys.executable, "-m", "ray_tpu.scripts.node", "--head",
+                 "--num-cpus", str(int(head_res.get("CPU", 1))),
+                 "--object-store-memory",
+                 str(head_spec.get("object_store_memory",
+                                   128 * 1024 * 1024))]
+    extra = {k: v for k, v in head_res.items()
+             if k not in ("CPU", "memory")}
+    if extra:
+        node_args += ["--resources", json.dumps(extra)]
+    ready = os.path.join(STATE_DIR, f"ready_{name}_{time.time_ns()}")
+    node_args += ["--ready-file", ready]
+    head = subprocess.Popen(node_args, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL,
+                            start_new_session=True)
+    deadline = time.time() + 90
+    info = None
+    while time.time() < deadline:
+        if os.path.exists(ready):
+            with open(ready) as f:
+                info = json.load(f)
+            os.unlink(ready)
+            break
+        if head.poll() is not None:
+            raise RuntimeError("head node died during ray-tpu up")
+        time.sleep(0.1)
+    if info is None:
+        head.kill()
+        raise TimeoutError("head node not ready in 90s")
+
+    monitor_pid = None
+    if not no_monitor:
+        mon = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.autoscaler.monitor",
+             "--config", os.path.abspath(config_path),
+             "--gcs-address", info["gcs_address"]],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        monitor_pid = mon.pid
+
+    state = {"cluster_name": name, "config_path": os.path.abspath(
+        config_path), "gcs_address": info["gcs_address"],
+        "head_pid": head.pid, "monitor_pid": monitor_pid,
+        "started_at": time.time()}
+    with open(state_file, "w") as f:
+        json.dump(state, f)
+    return state
+
+
+def down(config_path_or_name: str, *, timeout: float = 30.0) -> bool:
+    """Tear the cluster down: the monitor releases every provider
+    node/slice on SIGTERM, then the head is stopped. Returns True if a
+    running cluster was found."""
+    name = config_path_or_name
+    if os.path.exists(config_path_or_name):
+        name = load_cluster_config(config_path_or_name)["cluster_name"]
+    state_file = _state_path(name)
+    if not os.path.exists(state_file):
+        return False
+    with open(state_file) as f:
+        state = json.load(f)
+
+    mon_pid = state.get("monitor_pid")
+    if mon_pid and _alive(mon_pid):
+        os.kill(mon_pid, signal.SIGTERM)
+        deadline = time.time() + timeout
+        while _alive(mon_pid) and time.time() < deadline:
+            time.sleep(0.1)
+        if _alive(mon_pid):
+            os.kill(mon_pid, signal.SIGKILL)
+
+    head_pid = state.get("head_pid")
+    if head_pid and _alive(head_pid):
+        os.kill(head_pid, signal.SIGTERM)
+        deadline = time.time() + timeout
+        while _alive(head_pid) and time.time() < deadline:
+            time.sleep(0.1)
+        if _alive(head_pid):
+            os.kill(head_pid, signal.SIGKILL)
+    os.unlink(state_file)
+    return True
+
+
+def _alive(pid) -> bool:
+    if not pid:
+        return False
+    try:
+        # reap if it's our child (up() in-process): a zombie passes the
+        # kill-0 probe forever otherwise
+        os.waitpid(pid, os.WNOHANG)
+    except OSError:
+        pass
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            if f.read().rsplit(")", 1)[1].split()[0] == "Z":
+                return False
+    except OSError:
+        return False
+    return True

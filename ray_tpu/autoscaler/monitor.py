@@ -1,0 +1,78 @@
+"""Autoscaler monitor process: `python -m ray_tpu.autoscaler.monitor`.
+
+Reference: python/ray/autoscaler/_private/monitor.py — a standalone
+process on the head node owning the NodeProvider and driving
+StandardAutoscaler.update() on an interval. SIGTERM releases every
+provider node/slice before exit (`ray down` relies on this: worker VMs
+belong to the provider in THIS process).
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu.autoscaler.monitor")
+    p.add_argument("--config", required=True, help="cluster YAML path")
+    p.add_argument("--gcs-address", required=True)
+    p.add_argument("--interval-s", type=float, default=5.0)
+    args = p.parse_args(argv)
+
+    from ray_tpu.autoscaler import StandardAutoscaler
+    from ray_tpu.autoscaler.launcher import (load_cluster_config,
+                                             make_provider)
+
+    cfg = load_cluster_config(args.config)
+    provider = make_provider(cfg, args.gcs_address)
+    head_type = cfg.get("head_node_type")
+    worker_types = {
+        name: spec for name, spec in cfg["available_node_types"].items()
+        if name != head_type
+    }
+    autoscaler = StandardAutoscaler(
+        args.gcs_address,
+        {"max_workers": cfg.get("max_workers", 8),
+         "min_workers": cfg.get("min_workers", 0),
+         "idle_timeout_s": cfg.get("idle_timeout_s", 60.0),
+         "available_node_types": worker_types},
+        provider)
+
+    stop = threading.Event()
+
+    def _term(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+
+    # min_workers launch immediately (reference: the monitor's first
+    # update brings the cluster to min size before any demand exists)
+    for name, spec in worker_types.items():
+        for _ in range(int(spec.get("min_workers", 0))):
+            try:
+                if spec.get("tpu_slice"):
+                    provider.create_slice(
+                        name, spec, spec["tpu_slice"].get("topology", ""))
+                else:
+                    provider.create_node(name, spec, 1)
+            except Exception:
+                pass
+
+    while not stop.is_set():
+        try:
+            autoscaler.update()
+        except Exception:
+            pass
+        stop.wait(args.interval_s)
+
+    autoscaler.stop()
+    try:
+        provider.shutdown()
+    except Exception:
+        pass
+
+
+if __name__ == "__main__":
+    main()

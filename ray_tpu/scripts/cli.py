@@ -281,6 +281,29 @@ def cmd_microbenchmark(_args):
     return 0
 
 
+def cmd_up(args):
+    """Reference: `ray up cluster.yaml` (scripts/scripts.py:1164)."""
+    from ray_tpu.autoscaler.launcher import up
+
+    state = up(args.config, no_monitor=args.no_monitor)
+    print(f"cluster {state['cluster_name']!r} is up")
+    print(f"GCS address: {state['gcs_address']}")
+    print(f"connect with: ray_tpu.init(address={state['gcs_address']!r})")
+    print(f"tear down with: ray-tpu down {args.config}")
+    return 0
+
+
+def cmd_down(args):
+    """Reference: `ray down cluster.yaml` (scripts/scripts.py:1240)."""
+    from ray_tpu.autoscaler.launcher import down
+
+    if down(args.config):
+        print("cluster stopped")
+        return 0
+    print("no running cluster for that config")
+    return 1
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -347,6 +370,16 @@ def main(argv=None):
     sp.add_argument("--env", action="append", default=[],
                     help="KEY=VALUE runtime env var (repeatable)")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("up", help="launch a cluster from a YAML spec")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.add_argument("--no-monitor", action="store_true",
+                    help="skip the autoscaler monitor process")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a launched cluster")
+    sp.add_argument("config", help="cluster YAML path (or cluster name)")
+    sp.set_defaults(fn=cmd_down)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,261 @@
+"""TPU-pod node provider + cluster launcher tests.
+
+Reference tier: autoscaler fake-multinode E2E tests
+(python/ray/tests/test_autoscaler_fake_multinode.py) + the GCP provider
+unit tests (test_gcp_node_provider.py), re-shaped around slice-atomic
+queued-resources semantics.
+"""
+import json
+import os
+import subprocess
+import time
+
+import pytest
+
+
+# ------------------------------------------------------------- unit tier
+
+def test_slice_atomic_create_and_terminate():
+    """Creating a pod creates every host in ONE request; terminating any
+    host releases the whole slice."""
+    from ray_tpu.autoscaler import MockTpuApi, TPUPodNodeProvider
+
+    api = MockTpuApi()
+    prov = TPUPodNodeProvider(api, "t")
+    ids = prov.create_slice(
+        "v5e_pod", {"tpu_slice": {"hosts": 4, "topology": "4x4",
+                                  "accelerator_type": "v5litepod-16"}},
+        "4x4")
+    assert len(ids) == 4
+    assert len(api.requests) == 1 and api.requests[0]["hosts"] == 4
+    assert api.requests[0]["topology"] == "4x4"
+    nodes = prov.non_terminated_nodes()
+    assert len(nodes) == 4
+    assert len({n["slice_id"] for n in nodes}) == 1
+
+    prov.terminate_node(ids[2])          # any host → whole slice
+    assert prov.non_terminated_nodes() == []
+    deletes = [r for r in api.requests if r["op"] == "delete"]
+    assert len(deletes) == 1
+    prov.terminate_node(ids[0])          # second ask: no-op
+    assert len([r for r in api.requests if r["op"] == "delete"]) == 1
+
+
+def test_provisioning_slice_is_not_capacity():
+    """A slice still WAITING/PROVISIONING is invisible to binpacking —
+    QR grants are all-or-nothing."""
+    from ray_tpu.autoscaler import MockTpuApi, TPUPodNodeProvider
+
+    api = MockTpuApi(provision_delay_s=0.5)
+    prov = TPUPodNodeProvider(api, "t")
+    prov.create_slice("pod", {"tpu_slice": {"hosts": 2}}, "")
+    assert prov.non_terminated_nodes() == []
+    assert len(prov.pending_slices()) == 1
+    deadline = time.time() + 5
+    while not prov.non_terminated_nodes() and time.time() < deadline:
+        time.sleep(0.05)
+    assert len(prov.non_terminated_nodes()) == 2
+    prov.shutdown()
+
+
+def test_quota_exhaustion_raises():
+    from ray_tpu.autoscaler import MockTpuApi, TPUPodNodeProvider
+
+    api = MockTpuApi(capacity_hosts=4)
+    prov = TPUPodNodeProvider(api, "t")
+    prov.create_slice("pod", {"tpu_slice": {"hosts": 4}}, "")
+    with pytest.raises(RuntimeError, match="QUOTA_EXHAUSTED"):
+        prov.create_slice("pod", {"tpu_slice": {"hosts": 4}}, "")
+    prov.shutdown()
+
+
+def test_gce_api_request_shapes():
+    """GceTpuApi builds the queued-resources REST calls; _execute is the
+    recorded seam."""
+    from ray_tpu.autoscaler.tpu_provider import ACTIVE, GceTpuApi
+
+    calls = []
+
+    class Recorder(GceTpuApi):
+        def _execute(self, method, path, body):
+            calls.append((method, path, body))
+            if method == "GET":
+                return {"queuedResources": [{
+                    "name": f"{self._parent}/queuedResources/qr1",
+                    "state": {"state": "ACTIVE"},
+                    "tpu": {"nodeSpec": [{
+                        "nodeId": "qr1",
+                        "node": {"accelerator_type": "v5litepod-16",
+                                 "accelerator_config": {
+                                     "type": "V5LITE_POD",
+                                     "topology": "4x4"}}}]},
+                }]}
+            return {}
+
+    api = Recorder("proj", "us-central2-b")
+    sid = api.create_slice("qr1", "v5litepod-16", "4x4", 4,
+                           {"schedulingConfig": {"preemptible": True}})
+    assert sid == "qr1"
+    method, path, body = calls[0]
+    assert method == "POST"
+    assert "projects/proj/locations/us-central2-b/queuedResources" in path
+    assert "queued_resource_id=qr1" in path
+    spec = body["tpu"]["node_spec"][0]
+    assert spec["node"]["accelerator_type"] == "v5litepod-16"
+    assert spec["node"]["accelerator_config"]["topology"] == "4x4"
+    assert "best_effort" in body            # preemptible → best-effort QR
+
+    slices = api.list_slices()
+    assert slices[0]["state"] == ACTIVE
+    # 4x4 topology = 16 chips = 4 hosts
+    assert len(slices[0]["hosts"]) == 4
+
+    api.delete_slice("qr1")
+    method, path, _ = calls[-1]
+    assert method == "DELETE" and "queuedResources/qr1" in path
+
+
+# -------------------------------------------------------- autoscaler E2E
+
+def test_autoscaler_pod_demand_to_scale_down():
+    """VERDICT r4 #5 E2E: pending PG demand → ONE slice-atomic launch
+    (all hosts join as real nodes) → PG schedules → idle → the slice
+    scales down as a unit."""
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.raylet import Raylet, detect_resources
+    from ray_tpu._private.worker_runtime import (CoreWorker,
+                                                 set_current_worker)
+    from ray_tpu.autoscaler import (MockTpuApi, StandardAutoscaler,
+                                    TPUPodNodeProvider)
+
+    gcs = GcsServer().start()
+    head = Raylet(gcs.addr, resources=detect_resources(1, 0),
+                  store_size=64 * 1024 * 1024)
+    address = f"{gcs.addr[0]}:{gcs.addr[1]}"
+    api = MockTpuApi(address)
+    provider = TPUPodNodeProvider(api, "e2e")
+    autoscaler = StandardAutoscaler(
+        address,
+        {"max_workers": 4, "min_workers": 0, "idle_timeout_s": 1.0,
+         "available_node_types": {
+             "v5e_pod": {"resources": {"CPU": 2, "TPU": 4},
+                         "max_workers": 4,
+                         "object_store_memory": 64 * 1024 * 1024,
+                         "tpu_slice": {"hosts": 2, "topology": "2x4",
+                                       "accelerator_type":
+                                           "v5litepod-8"}}}},
+        provider)
+    worker = CoreWorker(gcs.addr, head.addr, mode="driver")
+    set_current_worker(worker)
+    try:
+        import ray_tpu
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+
+        pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="SPREAD")
+        assert not pg.wait(1)
+
+        report = autoscaler.update()
+        assert report["launched"], "no slice launched for TPU PG demand"
+        creates = [r for r in api.requests if r["op"] == "create"]
+        assert len(creates) == 1 and creates[0]["hosts"] == 2
+        assert pg.wait(60), "PG never scheduled on the slice"
+        nodes = provider.non_terminated_nodes()
+        assert len(nodes) == 2
+        assert all(n["node_id"] for n in nodes), "hosts didn't join GCS"
+
+        remove_placement_group(pg)
+        deadline = time.time() + 30
+        terminated = []
+        while time.time() < deadline:
+            terminated = autoscaler.update()["terminated"]
+            if terminated:
+                break
+            time.sleep(0.5)
+        assert terminated, "idle slice never scaled down"
+        assert provider.non_terminated_nodes() == []
+        assert [r for r in api.requests if r["op"] == "delete"]
+    finally:
+        autoscaler.stop()
+        provider.shutdown()
+        worker.shutdown()
+        set_current_worker(None)
+        head.stop(kill_workers=True)
+        gcs.stop()
+
+
+# ---------------------------------------------------------- launcher E2E
+
+def test_up_down_cli(tmp_path):
+    """`ray-tpu up` brings up head + monitor + min_workers on the mock
+    provider; a driver connects and runs work on a scaled node;
+    `ray-tpu down` releases everything."""
+    cfg = {
+        "cluster_name": f"lnch{os.getpid()}",
+        "max_workers": 2,
+        "min_workers": 1,
+        "idle_timeout_s": 300,
+        "provider": {"type": "mock"},
+        "head_node_type": "head",
+        "available_node_types": {
+            "head": {"resources": {"CPU": 1}},
+            "worker": {"resources": {"CPU": 2, "lava": 2},
+                       "max_workers": 2,
+                       "object_store_memory": 64 * 1024 * 1024,
+                       "tpu_slice": {"hosts": 1}},
+        },
+    }
+    import yaml
+
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+
+    from ray_tpu.scripts import cli
+
+    assert cli.main(["up", str(path)]) == 0
+    state_file = f"/tmp/ray_tpu/clusters/{cfg['cluster_name']}.json"
+    assert os.path.exists(state_file)
+    with open(state_file) as f:
+        state = json.load(f)
+    try:
+        import ray_tpu
+
+        ray_tpu.init(address=state["gcs_address"])
+        try:
+            # min_workers=1 slice carries the "lava" resource; wait for
+            # the monitor to bring it up, then run on it
+            @ray_tpu.remote(num_cpus=0, resources={"lava": 1},
+                            max_retries=0)
+            def on_worker():
+                return os.getpid()
+
+            pid = ray_tpu.get(on_worker.remote(), timeout=90)
+            assert pid != os.getpid()
+        finally:
+            ray_tpu.shutdown()
+
+        from ray_tpu.autoscaler.launcher import _alive
+
+        head_pid, mon_pid = state["head_pid"], state["monitor_pid"]
+        assert cli.main(["down", str(path)]) == 0
+        assert not os.path.exists(state_file)
+        for pid in (head_pid, mon_pid):
+            deadline = time.time() + 15
+            while time.time() < deadline and _alive(pid):
+                time.sleep(0.2)
+            assert not _alive(pid), f"pid {pid} still alive after down"
+        # idempotent: down again reports nothing to do
+        assert cli.main(["down", str(path)]) == 1
+    finally:
+        subprocess.run([__import__("sys").executable, "-c", f"""
+import json, os, signal
+try:
+    with open({state_file!r}) as f:
+        st = json.load(f)
+    for k in ("monitor_pid", "head_pid"):
+        try: os.kill(st[k], signal.SIGKILL)
+        except Exception: pass
+    os.unlink({state_file!r})
+except FileNotFoundError:
+    pass
+"""], check=False)
