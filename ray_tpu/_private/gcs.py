@@ -116,7 +116,13 @@ class GcsServer:
     """RPC handler + state. One instance per cluster head."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 snapshot_path: str | None = None):
+                 snapshot_path: str | None = None, store=None,
+                 recovery_grace_s: float = 8.0):
+        """store: a GcsStoreClient (or "sqlite:<path>"/"log:<path>" spec)
+        making the actor/PG/KV/job tables durable with zero snapshot
+        window — every mutation is written through before the RPC
+        returns (reference: redis_store_client.h fault-tolerant mode).
+        snapshot_path remains the legacy periodic-snapshot fallback."""
         self._lock = threading.RLock()
         self.nodes: dict[str, NodeInfo] = {}
         self.actors: dict[bytes, ActorInfo] = {}
@@ -141,12 +147,36 @@ class GcsServer:
         self.rpc_psub_poll = self._long_poll.rpc_psub_poll
         self._node_conns: dict[str, str] = {}     # conn.id -> node_id
         self._snapshot_path = snapshot_path
+        if isinstance(store, str):
+            from ray_tpu._private.gcs_store import make_store
+
+            store = make_store(store)
+        self._store = store
+        self._recovery_grace_s = recovery_grace_s
+        self._restored = False
+        # actor_started announcements seen by THIS process — after a
+        # restore, an ALIVE actor whose raylet came back but never
+        # re-announced it is dead (its worker died during the outage)
+        self._reannounced: set[bytes] = set()
+        if store is not None:
+            self._restore_from_store()
         self._server = RpcServer(self, host, port)
-        if snapshot_path and os.path.exists(snapshot_path):
+        if not self._restored and snapshot_path and \
+                os.path.exists(snapshot_path):
             self._load_snapshot()
+        if store is not None and not self._restored:
+            self._persist_meta()   # cluster_id survives the first restart
 
     def start(self):
         self._server.start()
+        if self._restored:
+            # raylets reconnect + re-register within their gossip tick;
+            # after the grace window, reconcile restored state against
+            # who actually came back (reference: node_manager.cc:1179
+            # HandleNotifyGCSRestart + gcs_actor_manager restart-on-
+            # -node-death)
+            threading.Thread(target=self._reconcile_after_restart,
+                             daemon=True, name="gcs-recovery").start()
         if self._snapshot_path:
             # periodic durability (the reference's Redis-backed tables
             # analog): metadata survives a GCS restart
@@ -169,6 +199,8 @@ class GcsServer:
 
     def stop(self):
         self._server.stop()
+        if self._store is not None:
+            self._store.close()
 
     # ---- connection liveness → node failure detection ----------------------
 
@@ -212,6 +244,7 @@ class GcsServer:
             for pg in self.placement_groups.values():
                 if node_id in pg.bundle_nodes:
                     pg.state = "RESCHEDULING"
+                    self._persist_pg(pg)
         self._publish("nodes", {"event": "dead", "node_id": node_id,
                                 "reason": reason})
         # The dead node's raylet can't re-create its actors — pick a
@@ -315,6 +348,7 @@ class GcsServer:
     def rpc_next_job_id(self, conn):
         with self._lock:
             self.job_counter += 1
+            self._persist_meta()
             return self.job_counter
 
     # ---- KV (function table, metadata) -------------------------------------
@@ -326,6 +360,7 @@ class GcsServer:
             if not overwrite and key in table:
                 return False
             table[key] = value
+            self._persist_kv(ns, key, value)
             return True
 
     def rpc_kv_get(self, conn, ns: str, key: bytes):
@@ -334,7 +369,10 @@ class GcsServer:
 
     def rpc_kv_del(self, conn, ns: str, key: bytes):
         with self._lock:
-            return self.kv.get(ns, {}).pop(key, None) is not None
+            existed = self.kv.get(ns, {}).pop(key, None) is not None
+            if existed:
+                self._persist_kv(ns, key, None)
+            return existed
 
     def rpc_kv_exists(self, conn, ns: str, key: bytes):
         with self._lock:
@@ -399,6 +437,10 @@ class GcsServer:
 
     def rpc_register_actor(self, conn, actor_id: bytes, spec: dict):
         with self._lock:
+            if actor_id in self.actors:
+                # replay of our own registration (client retried across a
+                # GCS restart that had already applied it) — idempotent
+                return {"existing": None}
             name = spec.get("name")
             ns = spec.get("namespace", "default")
             if name:
@@ -415,6 +457,7 @@ class GcsServer:
             self.actors[actor_id] = info
             if name:
                 self.named_actors[(ns, name)] = actor_id
+            self._persist_actor(info)
         return {"existing": None}
 
     def rpc_actor_started(self, conn, actor_id: bytes, addr, node_id: str):
@@ -425,6 +468,8 @@ class GcsServer:
             actor.state = "ALIVE"
             actor.addr = tuple(addr)
             actor.node_id = node_id
+            self._reannounced.add(actor_id)
+            self._persist_actor(actor)
         self._publish("actors", {"event": "alive",
                                  "actor_id": actor_id,
                                  "addr": tuple(addr)})
@@ -446,6 +491,7 @@ class GcsServer:
             actor.state = "DEAD"
             actor.death_cause = "exited"
             self._drop_name(actor)
+            self._persist_actor(actor)
         self._publish("actors", {"event": "dead", "actor_id": actor_id,
                                  "reason": "exited"})
         return True
@@ -467,6 +513,7 @@ class GcsServer:
             actor.addr = None
             self._publish("actors", {"event": "restarting",
                                      "actor_id": actor.actor_id})
+            self._persist_actor(actor)
             return {"restart": True, "num_restarts": actor.num_restarts}
         actor.state = "DEAD"
         actor.death_cause = reason
@@ -474,6 +521,7 @@ class GcsServer:
         self._publish("actors", {"event": "dead",
                                  "actor_id": actor.actor_id,
                                  "reason": reason})
+        self._persist_actor(actor)
         return {"restart": False}
 
     def rpc_get_actor(self, conn, actor_id: bytes = None, name: str = None,
@@ -521,6 +569,7 @@ class GcsServer:
             pg = PlacementGroupInfo(pg_id, bundles, strategy, name)
             self.placement_groups[pg_id] = pg
             self._try_schedule_pg(pg)
+            self._persist_pg(pg)
             return pg.snapshot()
 
     def _try_schedule_pg(self, pg: PlacementGroupInfo):
@@ -725,6 +774,7 @@ class GcsServer:
             if pg is None:
                 return False
             pg.state = "REMOVED"
+            self._persist_pg(pg)
         self._publish("placement_groups", {"event": "removed",
                                            "pg_id": pg_id})
         return True
@@ -771,6 +821,118 @@ class GcsServer:
         self._publish(channel, message)
         return True
 
+    # ---- durable store (write-through fault tolerance) ----------------------
+    # Reference: src/ray/gcs/store_client/redis_store_client.h — in
+    # fault-tolerant mode every actor/PG/KV/job mutation lands in the
+    # external store before the RPC returns; a restarted GCS reloads the
+    # tables and raylets re-register (HandleNotifyGCSRestart,
+    # node_manager.cc:1179).
+
+    def _persist_actor(self, actor: "ActorInfo"):
+        if self._store is None:
+            return
+        self._store.put("actors", actor.actor_id.hex(), pickle.dumps({
+            "actor_id": actor.actor_id, "spec": actor.spec,
+            "state": actor.state, "addr": actor.addr,
+            "node_id": actor.node_id, "num_restarts": actor.num_restarts,
+            "death_cause": actor.death_cause}))
+
+    def _persist_pg(self, pg: "PlacementGroupInfo"):
+        if self._store is None:
+            return
+        if pg.state == "REMOVED":
+            self._store.delete("pgs", pg.pg_id.hex())
+            return
+        self._store.put("pgs", pg.pg_id.hex(), pickle.dumps({
+            "pg_id": pg.pg_id, "bundles": pg.bundles,
+            "strategy": pg.strategy, "name": pg.name, "state": pg.state,
+            "bundle_nodes": pg.bundle_nodes}))
+
+    def _persist_meta(self):
+        if self._store is None:
+            return
+        self._store.put("meta", "meta", pickle.dumps({
+            "job_counter": self.job_counter,
+            "cluster_id": self.cluster_id}))
+
+    def _persist_kv(self, ns: str, key: bytes, value: bytes | None):
+        if self._store is None:
+            return
+        skey = f"{ns}\x00{key.hex()}"
+        if value is None:
+            self._store.delete("kv", skey)
+        else:
+            self._store.put("kv", skey, value)
+
+    def _restore_from_store(self):
+        meta = self._store.get("meta", "meta")
+        actors = self._store.get_all("actors")
+        pgs = self._store.get_all("pgs")
+        kv = self._store.get_all("kv")
+        if meta is None and not actors and not pgs and not kv:
+            return   # fresh store: nothing to restore
+        if meta is not None:
+            m = pickle.loads(meta)
+            self.job_counter = m["job_counter"]
+            self.cluster_id = m["cluster_id"]
+        for blob in actors.values():
+            d = pickle.loads(blob)
+            info = ActorInfo(d["actor_id"], d["spec"])
+            info.state = d["state"]
+            info.addr = tuple(d["addr"]) if d["addr"] else None
+            info.node_id = d["node_id"]
+            info.num_restarts = d["num_restarts"]
+            info.death_cause = d["death_cause"]
+            self.actors[d["actor_id"]] = info
+            if info.name and info.state != "DEAD":
+                self.named_actors[(info.namespace, info.name)] = \
+                    info.actor_id
+        for blob in pgs.values():
+            d = pickle.loads(blob)
+            pg = PlacementGroupInfo(d["pg_id"], d["bundles"],
+                                    d["strategy"], d["name"])
+            pg.state = d["state"]
+            pg.bundle_nodes = d["bundle_nodes"]
+            self.placement_groups[d["pg_id"]] = pg
+        for skey, value in kv.items():
+            ns, _, keyhex = skey.partition("\x00")
+            self.kv.setdefault(ns, {})[bytes.fromhex(keyhex)] = value
+        self._restored = True
+
+    def _reconcile_after_restart(self):
+        time.sleep(self._recovery_grace_s)
+        if self._server._stopped:
+            return
+        to_recreate: list[bytes] = []
+        with self._lock:
+            alive = {nid for nid, n in self.nodes.items() if n.alive}
+            for actor in self.actors.values():
+                if actor.state == "DEAD":
+                    continue
+                if actor.state == "ALIVE" and actor.node_id in alive \
+                        and actor.actor_id in self._reannounced:
+                    continue   # its raylet came back AND re-announced it
+                # host never returned, or the worker died during the
+                # outage (node back but no re-announce), or creation was
+                # in flight: normal failure path → restart budget decides
+                # (_on_actor_failure persists on both branches)
+                if actor.state in ("ALIVE", "PENDING_CREATION"):
+                    decision = self._on_actor_failure(
+                        actor, "lost across GCS restart")
+                    if decision.get("restart"):
+                        to_recreate.append(actor.actor_id)
+                elif actor.state == "RESTARTING":
+                    to_recreate.append(actor.actor_id)
+            for pg in self.placement_groups.values():
+                if pg.state == "CREATED" and \
+                        not all(n in alive for n in pg.bundle_nodes):
+                    pg.state = "RESCHEDULING"
+                    self._persist_pg(pg)
+                # PENDING/RESCHEDULING PGs reschedule on the next
+                # report_resources gossip tick
+        for actor_id in to_recreate:
+            self._push_recreate(actor_id)
+
     # ---- snapshot (GCS fault tolerance analog) ------------------------------
 
     def rpc_save_snapshot(self, conn=None):
@@ -811,12 +973,27 @@ class GcsServer:
 
 
 def main():  # pragma: no cover - exercised as a subprocess
-    """Entry point: `python -m ray_tpu._private.gcs <port> [snapshot]`."""
+    """Entry point: `python -m ray_tpu._private.gcs <port> [snapshot]
+    [--store sqlite:<path>|log:<path>] [--grace <s>]`."""
     import sys
 
-    port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
-    snap = sys.argv[2] if len(sys.argv) > 2 else None
-    server = GcsServer(port=port, snapshot_path=snap).start()
+    argv = [a for a in sys.argv[1:]]
+    store = grace = None
+    if "--store" in argv:
+        i = argv.index("--store")
+        store = argv[i + 1]
+        del argv[i:i + 2]
+    if "--grace" in argv:
+        i = argv.index("--grace")
+        grace = float(argv[i + 1])
+        del argv[i:i + 2]
+    port = int(argv[0]) if argv else 0
+    snap = argv[1] if len(argv) > 1 else None
+    kwargs = {}
+    if grace is not None:
+        kwargs["recovery_grace_s"] = grace
+    server = GcsServer(port=port, snapshot_path=snap, store=store,
+                       **kwargs).start()
     # Report the bound port on stdout for the parent supervisor.
     print(f"GCS_READY {server.addr[0]}:{server.addr[1]}", flush=True)
     try:

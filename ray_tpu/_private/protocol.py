@@ -513,3 +513,74 @@ def RpcServer(handler, host: str = "127.0.0.1", port: int = 0):
 
         return NativeRpcServer(handler, host=host, port=port)
     return PyRpcServer(handler, host=host, port=port)
+
+
+class ReconnectingRpcClient:
+    """Self-healing client for control-plane endpoints that may RESTART
+    (the GCS in fault-tolerant mode). On ConnectionLost the call
+    reconnects once and retries; an `on_reconnect(raw_client)` hook lets
+    the owner replay its registration state (reference:
+    gcs_rpc_client.h reconnection + node_manager.cc:1179
+    HandleNotifyGCSRestart re-registration).
+
+    Only safe for idempotent protocols — GCS table ops are (register_*
+    overwrite by id, kv_put overwrites, actor_started re-announces);
+    task submission is NOT and stays on plain RpcClient.
+    """
+
+    def __init__(self, addr, timeout: float = 30.0, on_push=None,
+                 on_reconnect=None):
+        self.addr = tuple(addr)
+        self._timeout = timeout
+        self._on_push = on_push
+        self._on_reconnect = on_reconnect
+        self._lock = threading.Lock()
+        self._client = RpcClient(self.addr, timeout=timeout,
+                                 on_push=on_push)
+        self._shutdown = False
+
+    def _reconnect(self):
+        with self._lock:
+            if self._shutdown:
+                raise ConnectionLost("client shut down")
+            if not self._client.closed:
+                return self._client   # another thread already healed it
+            fresh = RpcClient(self.addr, timeout=self._timeout,
+                              on_push=self._on_push)
+            if self._on_reconnect is not None:
+                # replay registration through the RAW client (the wrapper
+                # lock is held; recursing through call() would deadlock)
+                try:
+                    self._on_reconnect(fresh)
+                except Exception:
+                    fresh.close()
+                    raise
+            self._client = fresh
+            return fresh
+
+    def call(self, method: str, timeout: float | None = None, **kwargs):
+        try:
+            return self._client.call(method, timeout=timeout, **kwargs)
+        except ConnectionLost:
+            return self._reconnect().call(method, timeout=timeout, **kwargs)
+
+    def call_once(self, method: str, timeout: float | None = None,
+                  **kwargs):
+        """Single attempt, NO retry — for ops that are not idempotent
+        (e.g. actor_failed consumes restart budget: a retry after the
+        server applied-then-died would double-charge it)."""
+        return self._client.call(method, timeout=timeout, **kwargs)
+
+    def push(self, method: str, **kwargs):
+        try:
+            self._client.push(method, **kwargs)
+        except ConnectionLost:
+            self._reconnect().push(method, **kwargs)
+
+    @property
+    def closed(self) -> bool:
+        return self._shutdown
+
+    def close(self):
+        self._shutdown = True
+        self._client.close()

@@ -214,17 +214,15 @@ class Raylet:
 
         self._server = RpcServer(self, host, port).start()
         self.addr = self._server.addr
-        self._gcs = RpcClient(self.gcs_addr, on_push=self._on_gcs_push)
-        self._gcs.call("register_node", node_id=self.node_id, addr=self.addr,
-                       resources=self.resources_total,
-                       meta={"store_name": self.store_name,
-                             "spill_dir": self.spill_dir,
-                             "session_dir": self.session_dir,
-                             "hostname": os.uname().nodename,
-                             "pid": os.getpid(),
-                             "object_data_port": self.data_port,
-                             "tpu": self.tpu_topology})
-        self._gcs.call("subscribe", channels=["placement_groups"])
+        # Self-healing GCS channel: survives a GCS restart by
+        # re-registering this node and re-announcing its live actors
+        # (reference: node_manager.cc:1179 HandleNotifyGCSRestart)
+        from ray_tpu._private.protocol import ReconnectingRpcClient
+
+        self._gcs = ReconnectingRpcClient(
+            self.gcs_addr, on_push=self._on_gcs_push,
+            on_reconnect=self._replay_gcs_registration)
+        self._replay_gcs_registration(self._gcs)
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
                                         name=f"raylet-reap-{self.node_id[:6]}")
         self._reaper.start()
@@ -237,6 +235,36 @@ class Raylet:
         # ~300ms each of lease-grant latency (profiled round 4).
         if self._prestart_target > 0:
             self._maybe_refill()
+
+    def _replay_gcs_registration(self, gcs):
+        """Initial registration AND the reconnect replay: (re-)register
+        this node, re-subscribe, and re-announce actors still running
+        here so a restarted GCS repopulates its actor table with live
+        addresses instead of restarting healthy actors."""
+        gcs.call("register_node", node_id=self.node_id, addr=self.addr,
+                 resources=self.resources_total,
+                 meta={"store_name": self.store_name,
+                       "spill_dir": self.spill_dir,
+                       "session_dir": self.session_dir,
+                       "hostname": os.uname().nodename,
+                       "pid": os.getpid(),
+                       "object_data_port": self.data_port,
+                       "tpu": self.tpu_topology})
+        gcs.call("subscribe", channels=["placement_groups"])
+        with self._lock:
+            live = [(h.actor_id, h.addr)
+                    for h in self._workers.values()
+                    if h.is_actor and h.actor_id and h.addr
+                    and h.proc is not None and h.proc.poll() is None]
+        # Failures here MUST propagate: the replay only runs on
+        # reconnect, and a swallowed actor_started would leave the actor
+        # out of the GCS's re-announce set — the recovery reconcile
+        # would then restart a healthy actor (split-brain). Raising
+        # aborts this reconnect; the next 600ms report tick retries the
+        # whole replay.
+        for actor_id, addr in live:
+            gcs.call("actor_started", actor_id=actor_id, addr=addr,
+                     node_id=self.node_id)
 
     def _maybe_refill(self):
         """Top the idle pool back up to the prestart watermark in the
@@ -620,7 +648,7 @@ class Raylet:
         reason = (self._oom_reasons.pop(handle.worker_id, None)
                   or "worker process died")
         try:
-            decision = self._gcs.call("actor_failed",
+            decision = self._gcs.call_once("actor_failed",
                                       actor_id=handle.actor_id,
                                       reason=reason)
         except ConnectionLost:
@@ -644,7 +672,7 @@ class Raylet:
             self._create_actor_locally(actor_id, spec)
         except Exception:
             try:
-                self._gcs.call("actor_failed", actor_id=actor_id,
+                self._gcs.call_once("actor_failed", actor_id=actor_id,
                                reason="restart failed")
             except ConnectionLost:
                 pass
@@ -1079,6 +1107,7 @@ class Raylet:
                 "resources": dict(lease.resources),
                 "worker_id": lease.worker.worker_id,
                 "worker_pid": lease.worker.proc.pid,
+                "worker_addr": lease.worker.addr,
                 "is_actor": lease.worker.is_actor,
             } for lease in self._leases.values()]
 

@@ -713,7 +713,13 @@ class CoreWorker:
         # Connect out only after all execution state exists: registering with
         # the raylet makes us leasable, and a task can be pushed the moment
         # that happens.
-        self.gcs = RpcClient(tuple(gcs_addr), on_push=self._on_gcs_push)
+        # Self-healing: GCS table ops are idempotent, so calls retry
+        # across a GCS restart instead of surfacing ConnectionLost to
+        # the driver (reference: gcs_rpc_client.h reconnection)
+        from ray_tpu._private.protocol import ReconnectingRpcClient
+
+        self.gcs = ReconnectingRpcClient(tuple(gcs_addr),
+                                         on_push=self._on_gcs_push)
         self._server = RpcServer(self).start()
         self.addr = self._server.addr
         self.raylet = RpcClient(tuple(raylet_addr), timeout=None)
@@ -2154,7 +2160,7 @@ class CoreWorker:
             raise RuntimeError("actor creation spillback loop")
         except Exception as e:  # noqa: BLE001
             try:
-                self.gcs.call("actor_failed", actor_id=actor_id,
+                self.gcs.call_once("actor_failed", actor_id=actor_id,
                               reason=f"creation failed: {e}")
             except ConnectionLost:
                 pass
@@ -2352,6 +2358,7 @@ class CoreWorker:
             self._cancelled.discard(task_id)
             return {"cancelled": True}
         self._current_task_id = task_id
+        self._current_task_desc = spec.get("task_desc")
         self._current_task_thread = \
             threading.get_ident() if interruptible else None
         self._current_task_started = time.time()   # OOM victim ranking
@@ -2377,6 +2384,7 @@ class CoreWorker:
             return self._package_error(spec, e)
         finally:
             self._current_task_id = None
+            self._current_task_desc = None
             self._current_task_thread = None
             self._current_task_started = None
 
@@ -2385,8 +2393,11 @@ class CoreWorker:
         the raylet's OOM victim ranking queries it under memory
         pressure; the lease grant time it would otherwise use is the age
         of the LEASE, not of the current task)."""
+        tid = getattr(self, "_current_task_id", None)
         return {"task_started_at": getattr(self, "_current_task_started",
-                                           None)}
+                                           None),
+                "task_id": tid.hex() if tid else None,
+                "task_desc": getattr(self, "_current_task_desc", None)}
 
     def _execute_actor_task(self, spec: dict, conn=None) -> dict:
         # Per-caller ordering: DISPATCH tasks in seq order for each caller
@@ -2782,7 +2793,7 @@ class CoreWorker:
         try:
             self._apply_runtime_env(spec.get("runtime_env"))
         except BaseException as e:  # noqa: BLE001 — env setup is fatal
-            self.gcs.call("actor_failed", actor_id=actor_id,
+            self.gcs.call_once("actor_failed", actor_id=actor_id,
                           reason=f"runtime_env setup failed: {e}")
             raise
         cls = self._load_function(spec["class_hash"])
@@ -2793,7 +2804,7 @@ class CoreWorker:
         try:
             self._actor_instance = cls(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
-            self.gcs.call("actor_failed", actor_id=actor_id,
+            self.gcs.call_once("actor_failed", actor_id=actor_id,
                           reason=f"__init__ raised: "
                                  f"{type(e).__name__}: {e}")
             raise

@@ -32,6 +32,9 @@ def main(argv=None):
     p.add_argument("--object-store-memory", type=int,
                    default=256 * 1024 * 1024)
     p.add_argument("--ready-file", default=None)
+    p.add_argument("--gcs-store", default=None,
+                   help="durable GCS store: sqlite:<path> | log:<path> "
+                        "(head only; zero-window fault tolerance)")
     args = p.parse_args(argv)
 
     from ray_tpu._private.gcs import GcsServer
@@ -42,7 +45,8 @@ def main(argv=None):
 
     gcs = None
     if args.head:
-        gcs = GcsServer(host=args.host, port=args.port).start()
+        gcs = GcsServer(host=args.host, port=args.port,
+                        store=args.gcs_store).start()
         gcs_addr = gcs.addr
     else:
         if not args.address:

@@ -1,0 +1,286 @@
+"""Durable GCS storage + fault tolerance.
+
+Reference tier: GCS FT tests over the Redis store client
+(python/ray/tests/test_gcs_fault_tolerance.py): kill the GCS
+mid-workload, restart it against the same store, and the control plane
+comes back — raylets re-register (node_manager.cc:1179
+HandleNotifyGCSRestart), live actors re-announce, lost ones restart.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+# ------------------------------------------------------ store client tier
+
+@pytest.mark.parametrize("kind", ["sqlite", "log"])
+def test_store_client_roundtrip(tmp_path, kind):
+    from ray_tpu._private.gcs_store import make_store
+
+    path = str(tmp_path / f"store_{kind}")
+    s = make_store(f"{kind}:{path}")
+    s.put("actors", "a1", b"spec1")
+    s.put("actors", "a2", b"spec2")
+    s.put("kv", "k", b"v")
+    s.delete("actors", "a1")
+    assert s.get("actors", "a2") == b"spec2"
+    assert s.get("actors", "a1") is None
+    assert s.get_all("actors") == {"a2": b"spec2"}
+    s.close()
+
+    # durability: reopen sees the same state
+    s2 = make_store(f"{kind}:{path}")
+    assert s2.get_all("actors") == {"a2": b"spec2"}
+    assert s2.get("kv", "k") == b"v"
+    s2.close()
+
+
+def test_filelog_torn_record_and_compaction(tmp_path):
+    from ray_tpu._private.gcs_store import FileLogStoreClient
+
+    path = str(tmp_path / "log")
+    s = FileLogStoreClient(path, compact_bytes=4096)
+    for i in range(200):                      # overwrites force compaction
+        s.put("t", "key", b"x" * 64 + str(i).encode())
+    s.close()
+    assert os.path.getsize(path) < 4096 + 256, "log never compacted"
+
+    # torn final record (crash mid-append) is dropped on replay AND
+    # truncated away, so appending after it stays well-framed
+    with open(path, "ab") as f:
+        f.write(b"\x01\x02\x00\x00")          # garbage partial frame
+    s2 = FileLogStoreClient(path)
+    assert s2.get("t", "key") == b"x" * 64 + b"199"
+    s2.put("t", "post_tear", b"alive")
+    s2.close()
+    s3 = FileLogStoreClient(path)
+    assert s3.get("t", "key") == b"x" * 64 + b"199"
+    assert s3.get("t", "post_tear") == b"alive"
+    s3.close()
+
+
+# --------------------------------------------------- write-through restore
+
+def test_gcs_restart_restores_tables(tmp_path):
+    """Actors, named actors, PGs, KV, and the job counter survive a stop
+    + fresh-process-style restart with ZERO snapshot window (no
+    save_snapshot call anywhere)."""
+    from ray_tpu._private.gcs import GcsServer
+
+    store = f"sqlite:{tmp_path}/gcs.db"
+    gcs = GcsServer(store=store).start()
+    try:
+        gcs.rpc_register_actor(
+            None, b"A" * 16,
+            {"name": "keeper", "namespace": "ns1", "class_name": "K",
+             "max_restarts": -1, "lifetime": "detached"})
+        gcs.rpc_actor_started(None, b"A" * 16, ("127.0.0.1", 5), "node9")
+        gcs.rpc_kv_put(None, ns="funcs", key=b"f1", value=b"blob")
+        gcs.rpc_create_placement_group(
+            None, b"P" * 16, [{"CPU": 1}], "PACK", name="gang")
+        assert gcs.rpc_next_job_id(None) == 1
+    finally:
+        gcs.stop()
+
+    gcs2 = GcsServer(store=store, recovery_grace_s=3600).start()
+    try:
+        info = gcs2.rpc_get_actor(None, name="keeper", namespace="ns1")
+        assert info is not None and info["state"] == "ALIVE"
+        assert gcs2.rpc_kv_get(None, ns="funcs", key=b"f1") == b"blob"
+        pgs = gcs2.rpc_list_placement_groups(None)
+        assert len(pgs) == 1 and pgs[0]["Name"] == "gang"
+        assert gcs2.rpc_next_job_id(None) == 2   # counter continues
+    finally:
+        gcs2.stop()
+
+
+# ----------------------------------------------------------- chaos tier
+
+def _spawn_gcs(port: int, store: str, grace: float = 2.0):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.gcs", str(port),
+         "--store", store, "--grace", str(grace)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("GCS_READY"), line
+    addr = line.split()[1]
+    host, p = addr.rsplit(":", 1)
+    return proc, (host, int(p))
+
+
+def test_sigkill_gcs_detached_actor_and_pg_survive(tmp_path):
+    """VERDICT r4 #6 chaos: SIGKILL the GCS mid-workload, restart it on
+    the same durable store, and (a) in-flight actor handles keep
+    working THROUGH the outage, (b) named lookup works after restart
+    without client errors, (c) the PG survives as CREATED on the
+    re-registered node."""
+    from ray_tpu._private.raylet import Raylet, detect_resources
+    from ray_tpu._private.worker_runtime import (CoreWorker,
+                                                 set_current_worker)
+
+    store = f"sqlite:{tmp_path}/gcs.db"
+    gcs_proc, gcs_addr = _spawn_gcs(0, store)
+    raylet = None
+    worker = None
+    try:
+        raylet = Raylet(gcs_addr, resources=detect_resources(4, 0),
+                        store_size=64 * 1024 * 1024)
+        worker = CoreWorker(gcs_addr, raylet.addr, mode="driver")
+        set_current_worker(worker)
+        import ray_tpu
+        from ray_tpu.util.placement_group import placement_group
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(name="survivor", lifetime="detached",
+                            max_restarts=-1).remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(30)
+
+        # ---- SIGKILL the GCS mid-workload
+        os.kill(gcs_proc.pid, signal.SIGKILL)
+        gcs_proc.wait()
+
+        # (a) the established actor channel needs no GCS: calls keep
+        # flowing during the outage
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 2
+
+        # ---- restart on the SAME port + store
+        gcs_proc, _ = _spawn_gcs(gcs_addr[1], store)
+
+        # (b) named resolution after restart — the driver's GCS channel
+        # self-heals; the actor table was restored from the store and
+        # the raylet re-announced the live actor
+        deadline = time.time() + 30
+        info = None
+        while time.time() < deadline:
+            try:
+                h = ray_tpu.get_actor("survivor")
+                info = h
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert info is not None, "named actor not resolvable after restart"
+        assert ray_tpu.get(info.incr.remote(), timeout=60) == 3
+
+        # (c) the PG survived and its bundle node re-registered
+        deadline = time.time() + 30
+        state = None
+        while time.time() < deadline:
+            pgs = worker.gcs.call("list_placement_groups")
+            if pgs and pgs[0]["State"] == "CREATED" and \
+                    all(pgs[0]["BundleNodes"]):
+                state = pgs[0]
+                break
+            time.sleep(0.5)
+        assert state is not None, f"PG not CREATED after restart: {pgs}"
+
+        # and new work schedules inside it
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+        )
+
+        @ray_tpu.remote(num_cpus=1, max_retries=0)
+        def inside():
+            return "ok"
+
+        assert ray_tpu.get(inside.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                pg)).remote(), timeout=60) == "ok"
+    finally:
+        try:
+            gcs_proc.kill()
+        except Exception:
+            pass
+        if worker is not None:
+            worker.shutdown()
+            set_current_worker(None)
+        if raylet is not None:
+            raylet.stop(kill_workers=True)
+
+
+def test_gcs_restart_restarts_lost_detached_actor(tmp_path):
+    """An actor whose HOST died during the GCS outage: after restart +
+    grace, reconciliation restarts it on a surviving node (restored
+    spec + durable KV actor_spec drive _push_recreate)."""
+    from ray_tpu._private.raylet import Raylet, detect_resources
+    from ray_tpu._private.worker_runtime import (CoreWorker,
+                                                 set_current_worker)
+
+    store = f"sqlite:{tmp_path}/gcs.db"
+    gcs_proc, gcs_addr = _spawn_gcs(0, store, grace=2.0)
+    raylets = []
+    worker = None
+    try:
+        # node A hosts the actor; node B survives to restart it
+        a = Raylet(gcs_addr, resources=detect_resources(2, 0),
+                   store_size=64 * 1024 * 1024)
+        raylets.append(a)
+        worker = CoreWorker(gcs_addr, a.addr, mode="driver")
+        set_current_worker(worker)
+        import ray_tpu
+
+        @ray_tpu.remote
+        class Phoenix:
+            def where(self):
+                return os.getpid()
+
+        p = Phoenix.options(name="phoenix", lifetime="detached",
+                            max_restarts=-1).remote()
+        pid1 = ray_tpu.get(p.where.remote(), timeout=60)
+
+        b = Raylet(gcs_addr, resources=detect_resources(2, 0),
+                   store_size=64 * 1024 * 1024)
+        raylets.append(b)
+        time.sleep(1.0)   # let B register + gossip
+
+        os.kill(gcs_proc.pid, signal.SIGKILL)
+        gcs_proc.wait()
+        # the actor's host dies DURING the outage (stop() won't reach
+        # the dead GCS; swallow the teardown noise)
+        try:
+            a.stop(kill_workers=True)
+        except Exception:
+            pass
+        raylets.remove(a)
+
+        gcs_proc, _ = _spawn_gcs(gcs_addr[1], store, grace=2.0)
+
+        # after grace, reconciliation restarts the actor on node B
+        deadline = time.time() + 60
+        pid2 = None
+        while time.time() < deadline:
+            try:
+                h = ray_tpu.get_actor("phoenix")
+                pid2 = ray_tpu.get(h.where.remote(), timeout=10)
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert pid2 is not None, "detached actor never restarted"
+        assert pid2 != pid1
+    finally:
+        try:
+            gcs_proc.kill()
+        except Exception:
+            pass
+        if worker is not None:
+            worker.shutdown()
+            set_current_worker(None)
+        for r in raylets:
+            try:
+                r.stop(kill_workers=True)
+            except Exception:
+                pass
