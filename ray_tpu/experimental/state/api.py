@@ -61,22 +61,63 @@ def _each_raylet(call, method: str) -> list:
     return out
 
 
-def list_nodes(*, address: str | None = None) -> list[dict]:
+_FILTER_OPS = {
+    "=": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a is not None and a < b,
+    "<=": lambda a, b: a is not None and a <= b,
+    ">": lambda a, b: a is not None and a > b,
+    ">=": lambda a, b: a is not None and a >= b,
+    "contains": lambda a, b: b in (a or ""),
+}
+
+
+def _apply_filters(rows: list[dict], filters, limit) -> list[dict]:
+    """Predicate filtering + truncation, the reference's state-API
+    filter form (python/ray/experimental/state/api.py — filters are
+    (key, op, value) tuples ANDed together; `=` compares after str()
+    coercion so CLI-sourced values match ints/bools)."""
+    for f in filters or ():
+        try:
+            key, op, value = f
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"filter must be (key, op, value), got {f!r}") from None
+        if op not in _FILTER_OPS:
+            raise ValueError(f"unknown filter op {op!r} "
+                             f"(one of {sorted(_FILTER_OPS)})")
+        pred = _FILTER_OPS[op]
+        if op in ("=", "!="):
+            rows = [r for r in rows
+                    if pred(str(r.get(key)), str(value))]
+        else:
+            rows = [r for r in rows if pred(r.get(key), value)]
+    if limit is not None:
+        rows = rows[:limit]
+    return rows
+
+
+def list_nodes(*, address: str | None = None, filters=None,
+               limit=None) -> list[dict]:
     with _gcs(address) as call:
-        return call("get_nodes")
+        return _apply_filters(call("get_nodes"), filters, limit)
 
 
-def list_actors(*, address: str | None = None) -> list[dict]:
+def list_actors(*, address: str | None = None, filters=None,
+                limit=None) -> list[dict]:
     with _gcs(address) as call:
-        return call("list_actors")
+        return _apply_filters(call("list_actors"), filters, limit)
 
 
-def list_placement_groups(*, address: str | None = None) -> list[dict]:
+def list_placement_groups(*, address: str | None = None, filters=None,
+                          limit=None) -> list[dict]:
     with _gcs(address) as call:
-        return call("list_placement_groups")
+        return _apply_filters(call("list_placement_groups"), filters,
+                              limit)
 
 
-def list_objects(*, address: str | None = None) -> list[dict]:
+def list_objects(*, address: str | None = None, filters=None,
+                 limit=None) -> list[dict]:
     """Union of per-node store inventories, merged by object id. Locations
     live with owning workers (owner-based directory), so the cluster-wide
     view is assembled from the raylets' stores rather than a GCS table."""
@@ -91,20 +132,135 @@ def list_objects(*, address: str | None = None) -> list[dict]:
             cur["Locations"] = sorted(set(cur["Locations"])
                                       | set(r["Locations"]))
             cur["Size"] = max(cur["Size"], r["Size"])
-    return list(merged.values())
+    return _apply_filters(list(merged.values()), filters, limit)
 
 
-def list_tasks(*, address: str | None = None) -> list[dict]:
+def list_tasks(*, address: str | None = None, filters=None,
+               limit=None, detail: bool = False) -> list[dict]:
     """Raylet-level view: one row per active lease (running task slot).
     The reference's task events flow through its dashboard agent; here the
-    lease table is the source of truth for what is running where."""
+    lease table is the source of truth for what is running where.
+    detail=True additionally asks each leased worker what it is running
+    (task id/desc/start time — the reference's `ray get tasks <id>`
+    tier)."""
     with _gcs(address) as call:
-        return _each_raylet(call, "list_leases")
+        rows = _each_raylet(call, "list_leases")
+    if detail:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ray_tpu._private.protocol import RpcClient
+
+        def probe(r):
+            addr = r.get("worker_addr")
+            if not addr:
+                return
+            try:
+                c = RpcClient(tuple(addr), timeout=2.0)
+                try:
+                    r.update(c.call("task_state"))
+                finally:
+                    c.close()
+            except Exception:
+                pass
+
+        # concurrent probes: dead workers each cost up to the 2s
+        # timeout, which must not stack serially across the cluster
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            list(pool.map(probe, rows))
+    return _apply_filters(rows, filters, limit)
 
 
-def list_workers(*, address: str | None = None) -> list[dict]:
+def list_workers(*, address: str | None = None, filters=None,
+                 limit=None) -> list[dict]:
     with _gcs(address) as call:
-        return _each_raylet(call, "list_workers")
+        return _apply_filters(_each_raylet(call, "list_workers"),
+                              filters, limit)
+
+
+# ---- per-entity detail lookups (reference: state api get_* tier) ----------
+
+def get_actor(actor_id: str, *, address: str | None = None) -> dict | None:
+    """One actor's full record by hex id."""
+    for row in list_actors(address=address):
+        if row["ActorID"] == actor_id:
+            return row
+    return None
+
+
+def get_node(node_id: str, *, address: str | None = None) -> dict | None:
+    for row in list_nodes(address=address):
+        if row["NodeID"] == node_id:
+            return row
+    return None
+
+
+def get_placement_group(pg_id: str, *,
+                        address: str | None = None) -> dict | None:
+    for row in list_placement_groups(address=address):
+        if row["PlacementGroupID"] == pg_id:
+            return row
+    return None
+
+
+def get_task(task_id: str, *, address: str | None = None) -> dict | None:
+    """Detail for one RUNNING task by hex id (lease + worker probe)."""
+    for row in list_tasks(address=address, detail=True):
+        if row.get("task_id") == task_id:
+            return row
+    return None
+
+
+def get_objects(object_id: str, *,
+                address: str | None = None) -> list[dict]:
+    """Every store's view of one object (locations/size/lost)."""
+    return [r for r in list_objects(address=address)
+            if r["ObjectID"] == object_id]
+
+
+# ---- summaries (reference: `ray summary` / state_aggregator rollups) ------
+
+def summarize_actors(*, address: str | None = None) -> dict:
+    """Counts grouped class -> state (reference: `ray summary actors`)."""
+    out: dict[str, dict[str, int]] = {}
+    for a in list_actors(address=address):
+        by_state = out.setdefault(a.get("ClassName") or "?", {})
+        by_state[a["State"]] = by_state.get(a["State"], 0) + 1
+    return out
+
+
+def summarize_tasks(*, address: str | None = None) -> dict:
+    """Running work grouped by description (leases + worker probes) plus
+    queued demand by shape (reference: `ray summary tasks` groups by
+    func_or_class_name and state)."""
+    running: dict[str, int] = {}
+    for t in list_tasks(address=address, detail=True):
+        key = t.get("task_desc") or (
+            "actor_task" if t.get("is_actor") else "task")
+        running[key] = running.get(key, 0) + 1
+    queued: dict[str, int] = {}
+    with _gcs(address) as call:
+        for n in call("get_cluster_load")["nodes"]:
+            for shape in n.get("PendingDemand", ()):
+                key = ",".join(f"{k}:{v:g}"
+                               for k, v in sorted(shape.items()))
+                queued[key] = queued.get(key, 0) + 1
+    return {"running": running, "queued_by_shape": queued}
+
+
+def summarize_objects(*, address: str | None = None) -> dict:
+    """Object-store rollup: counts/bytes total and per node (reference:
+    `ray summary objects`)."""
+    objs = list_objects(address=address)
+    per_node: dict[str, dict] = {}
+    for o in objs:
+        for node in o["Locations"]:
+            agg = per_node.setdefault(node, {"count": 0, "bytes": 0})
+            agg["count"] += 1
+            agg["bytes"] += o["Size"]
+    return {"total_objects": len(objs),
+            "total_bytes": sum(o["Size"] for o in objs),
+            "lost_objects": sum(1 for o in objs if o.get("Lost")),
+            "per_node": per_node}
 
 
 def cluster_status(*, address: str | None = None) -> str:
@@ -184,9 +340,3 @@ def metrics_summary(*, address: str | None = None,
     return snaps
 
 
-def summarize_tasks(*, address: str | None = None) -> dict:
-    rows = list_tasks(address=address)
-    return {"total_running": len(rows),
-            "by_node": {r["node_id"]: sum(1 for x in rows
-                                          if x["node_id"] == r["node_id"])
-                        for r in rows}}

@@ -61,6 +61,8 @@ def cmd_start(args):
     if args.resources:
         node_args += ["--resources", args.resources]
     node_args += ["--object-store-memory", str(args.object_store_memory)]
+    if getattr(args, "gcs_store", None):
+        node_args += ["--gcs-store", args.gcs_store]
     info = _spawn_node(node_args)
     print(f"started {'head' if args.head else 'worker'} node "
           f"{info['node_id']} (pid {info['pid']})")
@@ -281,6 +283,17 @@ def cmd_microbenchmark(_args):
     return 0
 
 
+def cmd_summary(args):
+    """Reference: `ray summary actors|tasks|objects` (state CLI)."""
+    from ray_tpu.experimental.state import api as state
+
+    fn = {"actors": state.summarize_actors,
+          "tasks": state.summarize_tasks,
+          "objects": state.summarize_objects}[args.resource]
+    print(json.dumps(fn(address=args.address), indent=2, default=str))
+    return 0
+
+
 def cmd_up(args):
     """Reference: `ray up cluster.yaml` (scripts/scripts.py:1164)."""
     from ray_tpu.autoscaler.launcher import up
@@ -315,6 +328,9 @@ def main(argv=None):
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.add_argument("--num-tpus", type=int, default=None)
     sp.add_argument("--resources", default=None)
+    sp.add_argument("--gcs-store", default=None,
+                    help="head only: durable GCS store "
+                         "(sqlite:<path> | log:<path>)")
     sp.add_argument("--object-store-memory", type=int,
                     default=256 * 1024 * 1024)
     sp.set_defaults(fn=cmd_start)
@@ -370,6 +386,12 @@ def main(argv=None):
     sp.add_argument("--env", action="append", default=[],
                     help="KEY=VALUE runtime env var (repeatable)")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("summary",
+                        help="aggregated cluster state rollups")
+    sp.add_argument("resource", choices=["actors", "tasks", "objects"])
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_summary)
 
     sp = sub.add_parser("up", help="launch a cluster from a YAML spec")
     sp.add_argument("config", help="cluster YAML path")

@@ -1,0 +1,106 @@
+"""State API: filters, summaries, per-entity detail.
+
+Reference tier: python/ray/experimental/state/ tests — list_* with
+(key, op, value) filters, `ray summary`-style rollups, and get_* detail
+lookups.
+"""
+import time
+
+import pytest
+
+
+def test_filters_and_limit():
+    from ray_tpu.experimental.state.api import _apply_filters
+
+    rows = [{"State": "ALIVE", "n": 1}, {"State": "DEAD", "n": 2},
+            {"State": "ALIVE", "n": 3}]
+    assert len(_apply_filters(rows, [("State", "=", "ALIVE")], None)) == 2
+    assert len(_apply_filters(rows, [("State", "!=", "ALIVE")], None)) == 1
+    assert len(_apply_filters(rows, [("n", ">", 1)], None)) == 2
+    assert len(_apply_filters(rows, [("n", ">=", 1)], 2)) == 2
+    assert _apply_filters(rows, [("State", "contains", "LIV")], None)[0][
+        "n"] == 1
+    with pytest.raises(ValueError, match="unknown filter op"):
+        _apply_filters(rows, [("State", "~", "x")], None)
+    with pytest.raises(ValueError, match="key, op, value"):
+        _apply_filters(rows, ["State"], None)
+
+
+def test_list_actors_filtered_and_get(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.experimental.state import api as state
+
+    @ray.remote
+    class Alpha:
+        def ping(self):
+            return 1
+
+    @ray.remote
+    class Beta:
+        def ping(self):
+            return 1
+
+    a = Alpha.remote()
+    b = Beta.remote()
+    ray.get([a.ping.remote(), b.ping.remote()])
+
+    alive = state.list_actors(filters=[("State", "=", "ALIVE")])
+    assert len(alive) == 2
+    alphas = state.list_actors(filters=[("ClassName", "=", "Alpha")])
+    assert len(alphas) == 1
+    detail = state.get_actor(alphas[0]["ActorID"])
+    assert detail is not None and detail["ClassName"] == "Alpha"
+    assert state.get_actor("f" * 32) is None
+    assert len(state.list_actors(limit=1)) == 1
+
+    summary = state.summarize_actors()
+    assert summary["Alpha"]["ALIVE"] == 1
+    assert summary["Beta"]["ALIVE"] == 1
+
+
+def test_task_detail_and_summary(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.experimental.state import api as state
+
+    @ray.remote
+    def camp(n):
+        time.sleep(n)
+        return 1
+
+    ref = camp.remote(8)
+    # wait for it to actually start
+    deadline = time.time() + 30
+    rows = []
+    while time.time() < deadline:
+        rows = state.list_tasks(detail=True)
+        if any(r.get("task_desc") for r in rows):
+            break
+        time.sleep(0.2)
+    running = [r for r in rows if r.get("task_desc")]
+    assert running, f"no running task detail: {rows}"
+    assert "camp" in running[0]["task_desc"]
+    assert running[0]["task_id"]
+
+    # per-task lookup round-trips through the id
+    got = state.get_task(running[0]["task_id"])
+    assert got is not None and got["task_desc"] == running[0]["task_desc"]
+
+    summary = state.summarize_tasks()
+    assert any("camp" in k for k in summary["running"]), summary
+    ray.cancel(ref, force=True)
+
+
+def test_summarize_objects(ray_start_regular):
+    ray = ray_start_regular
+    import numpy as np
+
+    from ray_tpu.experimental.state import api as state
+
+    refs = [ray.put(np.zeros(300_000, np.uint8)) for _ in range(3)]
+    summary = state.summarize_objects()
+    assert summary["total_objects"] >= 3
+    assert summary["total_bytes"] >= 3 * 300_000
+    assert summary["per_node"]
+    oid = state.list_objects()[0]["ObjectID"]
+    assert state.get_objects(oid)
+    del refs
