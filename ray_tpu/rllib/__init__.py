@@ -22,7 +22,10 @@ from ray_tpu.rllib.connectors import (
     FrameStack,
     MeanStdObsNormalizer,
 )
-from ray_tpu.rllib.env import CartPole, make_env
+from ray_tpu.rllib.apex import ApexDQN, ReplayShard
+from ray_tpu.rllib.learner_group import LearnerGroup
+from ray_tpu.rllib.env import CartPole, Pendulum, make_env
+from ray_tpu.rllib.sac import SAC, ContinuousTransitionWorker
 from ray_tpu.rllib.models import init_policy, policy_apply
 from ray_tpu.rllib.replay_buffer import (
     PrioritizedReplayBuffer,
@@ -34,11 +37,13 @@ from ray_tpu.rllib.rollout_worker import (
     concat_batches,
 )
 
-__all__ = ["A2C", "Algorithm", "AlgorithmConfig", "BC", "CartPole",
+__all__ = ["A2C", "Algorithm", "AlgorithmConfig", "ApexDQN", "BC",
+           "CartPole", "ContinuousTransitionWorker", "Pendulum",
+           "ReplayShard", "SAC",
            "ClipReward", "Connector", "ConnectorPipeline", "DQN",
            "FrameStack", "MeanStdObsNormalizer",
            "MultiAgentCartPole", "MultiAgentEnv", "MultiAgentPPO",
            "MultiAgentRolloutWorker",
-           "PPO", "PrioritizedReplayBuffer", "ReplayBuffer",
+           "LearnerGroup", "PPO", "PrioritizedReplayBuffer", "ReplayBuffer",
            "RolloutWorker", "TransitionWorker", "concat_batches",
            "init_policy", "make_env", "policy_apply"]

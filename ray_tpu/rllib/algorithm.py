@@ -55,6 +55,12 @@ class AlgorithmConfig:
         self.epsilon_anneal_iters = 15
         self.double_q = True
         self.prioritized_replay = False
+        # SAC (continuous off-policy) knobs
+        self.tau = 0.005                # polyak target coefficient
+        self.init_alpha = 0.1           # initial entropy temperature
+        self.alpha_lr = 3e-4
+        # APEX (distributed prioritized replay) knobs
+        self.num_replay_shards = 2
         # IMPALA (async learner) knobs
         self.learner_queue_size = 8
         self.learner_min_step_s = 0.0   # test hook: artificial step floor

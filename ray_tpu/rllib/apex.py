@@ -1,0 +1,161 @@
+"""APEX-DQN — distributed prioritized experience replay.
+
+Reference: rllib/algorithms/apex_dqn/apex_dqn.py: many sampling actors
+feed SHARDED replay-buffer actors; the learner pulls sample batches
+from the shards, updates, and sends new TD-error priorities back to the
+owning shard (the priority-update round trip). The decoupling means
+sampling throughput and learning throughput scale independently — the
+same reason the reference runs its replay buffers as actors.
+
+Execution here: TransitionWorkers sample continuously (in-flight refs,
+no barrier with the learner), batches round-robin into >=2 ReplayShard
+actors, the learner samples each shard in turn and routes
+update_priorities back by shard index.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.models import policy_apply
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
+from ray_tpu.rllib.rollout_worker import TransitionWorker
+
+
+class ReplayShard:
+    """One shard of the distributed prioritized replay (reference:
+    apex_dqn's ReplayActor over PrioritizedReplayBuffer)."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6,
+                 beta: float = 0.4, seed: int = 0):
+        self.buffer = PrioritizedReplayBuffer(capacity, alpha=alpha,
+                                              beta=beta, seed=seed)
+        self.adds = 0
+        self.priority_updates = 0
+
+    def add_batch(self, batch: dict):
+        batch.pop("episode_returns", None)
+        self.buffer.add_batch(batch)
+        self.adds += 1
+        return len(self.buffer)
+
+    def sample(self, batch_size: int):
+        if len(self.buffer) < batch_size:
+            return None
+        return self.buffer.sample(batch_size)
+
+    def update_priorities(self, indexes, td_errors):
+        self.buffer.update_priorities(np.asarray(indexes),
+                                      np.asarray(td_errors))
+        self.priority_updates += 1
+        return True
+
+    def stats(self):
+        return {"size": len(self.buffer), "adds": self.adds,
+                "priority_updates": self.priority_updates}
+
+
+class ApexDQN(Algorithm):
+    """Distributed prioritized DQN (reference: apex_dqn.py)."""
+
+    worker_cls = TransitionWorker
+
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        shard_cls = ray_tpu.remote(ReplayShard)
+        n = max(2, config.num_replay_shards)
+        per_shard = max(1, config.buffer_capacity // n)
+        self.shards = [
+            shard_cls.options(num_cpus=0).remote(
+                per_shard, seed=config.seed + 100 + i)
+            for i in range(n)
+        ]
+        self._next_shard = 0
+        self._sample_cursor = 0
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        cfg = config
+
+        def loss_fn(params, target_params, mb):
+            q, _ = policy_apply(params, mb["obs"])
+            q_taken = jnp.take_along_axis(
+                q, mb["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+            q_next_t, _ = policy_apply(target_params, mb["next_obs"])
+            q_next_o, _ = policy_apply(params, mb["next_obs"])
+            next_a = jnp.argmax(q_next_o, axis=-1)     # double-Q
+            next_q = jnp.take_along_axis(
+                q_next_t, next_a[:, None], axis=-1)[:, 0]
+            target = mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * next_q
+            td = q_taken - jax.lax.stop_gradient(target)
+            huber = jnp.where(jnp.abs(td) <= 1.0, 0.5 * td * td,
+                              jnp.abs(td) - 0.5)
+            return jnp.mean(huber * mb["weights"]), td
+
+        def update(params, target_params, opt_state, mb):
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, mb)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._update = jax.jit(update)
+
+    def epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_anneal_iters))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def _sample_call(self, worker):
+        return worker.sample_transitions.remote(
+            self.params, self.config.rollout_fragment_length,
+            self.epsilon())
+
+    def training_step(self, batch) -> dict:
+        # route the fresh batch to the next shard (round-robin); the
+        # base-class train() already pulled it off the workers
+        self.shards[self._next_shard].add_batch.remote(batch)
+        self._next_shard = (self._next_shard + 1) % len(self.shards)
+
+        loss = None
+        trained = 0
+        for _ in range(self.config.num_sgd_steps):
+            shard_i = self._sample_cursor % len(self.shards)
+            self._sample_cursor += 1
+            mb = ray_tpu.get(self.shards[shard_i].sample.remote(
+                self.config.minibatch_size), timeout=60)
+            if mb is None:
+                continue   # shard still warming up
+            idx = mb.pop("batch_indexes")
+            jmb = {k: jnp.asarray(v) for k, v in mb.items()}
+            self.params, self.opt_state, loss, td = self._update(
+                self.params, self.target_params, self.opt_state, jmb)
+            # priority-update round trip to the shard that OWNS the rows
+            self.shards[shard_i].update_priorities.remote(
+                idx, np.asarray(td))
+            trained += 1
+        if self.iteration % self.config.target_update_freq == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+        metrics = {"epsilon": self.epsilon(), "sgd_steps": trained}
+        if loss is not None:
+            metrics["loss"] = float(loss)
+        return metrics
+
+    def replay_stats(self) -> list[dict]:
+        return ray_tpu.get([s.stats.remote() for s in self.shards],
+                           timeout=60)
+
+    def save(self) -> dict:
+        return {"params": self.params, "iteration": self.iteration,
+                "target_params": self.target_params}
+
+    def restore(self, state: dict):
+        super().restore(state)
+        self.target_params = state.get("target_params", self.params)

@@ -56,6 +56,52 @@ class CartPole:
                 {})
 
 
+class Pendulum:
+    """Classic pendulum swing-up, pure numpy — the hermetic
+    continuous-control test env (classic control formulation; the
+    reference exercises SAC on the gym version of the same problem).
+
+    State (theta, theta_dot); observation (cos, sin, theta_dot); action:
+    torque in [-2, 2]; reward -(theta^2 + 0.1*theta_dot^2 + 0.001*a^2).
+    """
+
+    def __init__(self, seed: int | None = None, max_steps: int = 200):
+        self._rng = np.random.default_rng(seed)
+        self.max_steps = max_steps
+        self.observation_size = 3
+        self.action_size = 1
+        self.action_low = np.array([-2.0], np.float32)
+        self.action_high = np.array([2.0], np.float32)
+        self.continuous = True
+        self._th = self._thdot = 0.0
+        self._t = 0
+
+    def _obs(self):
+        return np.array([np.cos(self._th), np.sin(self._th),
+                         self._thdot], np.float32)
+
+    def reset(self, seed: int | None = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._th = self._rng.uniform(-np.pi, np.pi)
+        self._thdot = self._rng.uniform(-1.0, 1.0)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0], -2.0, 2.0))
+        g, m, length, dt = 10.0, 1.0, 1.0, 0.05
+        th = ((self._th + np.pi) % (2 * np.pi)) - np.pi   # normalize
+        cost = th ** 2 + 0.1 * self._thdot ** 2 + 0.001 * u ** 2
+        self._thdot += (3 * g / (2 * length) * np.sin(self._th)
+                        + 3.0 / (m * length ** 2) * u) * dt
+        self._thdot = float(np.clip(self._thdot, -8.0, 8.0))
+        self._th += self._thdot * dt
+        self._t += 1
+        truncated = self._t >= self.max_steps
+        return self._obs(), -float(cost), False, truncated, {}
+
+
 def make_env(env_spec, seed: int | None = None):
     """env_spec: "CartPole-v1" (built-in), a gymnasium id, or a zero-arg
     callable returning a reset/step env."""
@@ -64,6 +110,8 @@ def make_env(env_spec, seed: int | None = None):
     if env_spec in ("CartPole-v1", "CartPole-v0"):
         return CartPole(seed=seed,
                         max_steps=500 if env_spec.endswith("v1") else 200)
+    if env_spec in ("Pendulum-v1", "Pendulum-v0"):
+        return Pendulum(seed=seed)
     import gymnasium
 
     env = gymnasium.make(env_spec)
@@ -78,3 +126,20 @@ def env_spaces(env) -> tuple[int, int]:
         return env.observation_size, env.num_actions
     obs_size = int(np.prod(env.observation_space.shape))
     return obs_size, int(env.action_space.n)
+
+
+def env_action_space(env) -> dict:
+    """Structured space info covering continuous-action envs
+    {obs_size, action_size, low, high} (reference: gym Box spaces)."""
+    if getattr(env, "continuous", False):
+        return {"obs_size": env.observation_size,
+                "action_size": env.action_size,
+                "low": env.action_low, "high": env.action_high}
+    if hasattr(env, "action_space") and \
+            hasattr(env.action_space, "shape") and \
+            env.action_space.shape:
+        return {"obs_size": int(np.prod(env.observation_space.shape)),
+                "action_size": int(np.prod(env.action_space.shape)),
+                "low": np.asarray(env.action_space.low, np.float32),
+                "high": np.asarray(env.action_space.high, np.float32)}
+    raise ValueError("env has no continuous action space")

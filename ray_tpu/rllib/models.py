@@ -43,3 +43,138 @@ def policy_apply(params, obs):
     logits = _apply_mlp(params["pi"], obs)
     value = _apply_mlp(params["vf"], obs)[..., 0]
     return logits, value
+
+
+# ------------------------------------------------- SAC (continuous control)
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def init_sac_networks(key, obs_size: int, action_size: int,
+                      hidden: tuple = (64, 64)):
+    """Squashed-Gaussian actor (outputs [mean, log_std]) + twin Q nets
+    over (obs, action) (reference: rllib/algorithms/sac/sac_tf_model.py
+    — policy net and two Q nets)."""
+    kp, k1, k2 = jax.random.split(key, 3)
+    return {
+        "pi": _init_mlp(kp, (obs_size, *hidden, 2 * action_size)),
+        "q1": _init_mlp(k1, (obs_size + action_size, *hidden, 1)),
+        "q2": _init_mlp(k2, (obs_size + action_size, *hidden, 1)),
+    }
+
+
+def sac_actor_apply(params, obs):
+    """-> (mean [B, A], log_std [B, A]), log_std clamped."""
+    out = _apply_mlp(params["pi"], obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+
+def sac_q_apply(q_params, obs, action):
+    """Q(s, a) [B] for one critic's params (pass params["q1"]/["q2"])."""
+    return _apply_mlp(q_params, jnp.concatenate([obs, action],
+                                                axis=-1))[..., 0]
+
+
+def sac_sample_action(params, obs, key):
+    """Reparameterized tanh-squashed sample -> (action in [-1,1]^A,
+    log_prob [B]) with the tanh jacobian correction."""
+    mean, log_std = sac_actor_apply(params, obs)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mean.shape)
+    pre = mean + std * eps
+    action = jnp.tanh(pre)
+    # N(pre; mean, std) log-density minus log|d tanh/d pre|
+    logp = (-0.5 * (eps ** 2) - log_std
+            - 0.5 * jnp.log(2 * jnp.pi)).sum(-1)
+    logp -= jnp.log(1 - action ** 2 + 1e-6).sum(-1)
+    return action, logp
+
+
+# ----------------------------------------------------- model zoo (CNN/LSTM)
+
+def init_cnn_policy(key, obs_shape: tuple, num_actions: int,
+                    channels: tuple = (16, 32), hidden: int = 128):
+    """Conv policy for image observations [H, W, C] (reference:
+    rllib/models/ VisionNetwork). Convs are lax.conv_general_dilated
+    with 3x3 stride-2 kernels — shapes stay static so XLA tiles them
+    onto the MXU."""
+    params = {"conv": []}
+    h, w, c_in = obs_shape
+    for c_out in channels:
+        key, sub = jax.random.split(key)
+        scale = jnp.sqrt(2.0 / (3 * 3 * c_in))
+        params["conv"].append({
+            "w": jax.random.normal(sub, (3, 3, c_in, c_out)) * scale,
+            "b": jnp.zeros((c_out,)),
+        })
+        h, w, c_in = (h + 1) // 2, (w + 1) // 2, c_out
+    flat = h * w * c_in
+    kp, kv = jax.random.split(key)
+    params["pi"] = _init_mlp(kp, (flat, hidden, num_actions))
+    params["vf"] = _init_mlp(kv, (flat, hidden, 1))
+    return params
+
+
+def cnn_policy_apply(params, obs):
+    """obs [B, H, W, C] -> (logits [B, A], value [B])."""
+    x = obs
+    for layer in params["conv"]:
+        x = jax.lax.conv_general_dilated(
+            x, layer["w"], window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + layer["b"]
+        x = jax.nn.relu(x)
+    x = x.reshape(x.shape[0], -1)
+    logits = _apply_mlp(params["pi"], x)
+    value = _apply_mlp(params["vf"], x)[..., 0]
+    return logits, value
+
+
+def init_lstm_policy(key, obs_size: int, num_actions: int,
+                     hidden: int = 64):
+    """Recurrent policy (reference: rllib/models/ use_lstm=True): one
+    LSTM cell over the observation encoding, heads on the cell
+    output."""
+    ke, kl, kp, kv = jax.random.split(key, 4)
+    scale_in = jnp.sqrt(2.0 / obs_size)
+    scale_h = jnp.sqrt(2.0 / hidden)
+    return {
+        "enc": _init_mlp(ke, (obs_size, hidden)),
+        "lstm": {
+            "wi": jax.random.normal(kl, (hidden, 4 * hidden)) * scale_in,
+            "wh": jax.random.normal(kl, (hidden, 4 * hidden)) * scale_h,
+            "b": jnp.zeros((4 * hidden,)),
+        },
+        "pi": _init_mlp(kp, (hidden, num_actions)),
+        "vf": _init_mlp(kv, (hidden, 1)),
+    }
+
+
+def lstm_policy_initial_state(hidden: int = 64, batch: int = 1):
+    return (jnp.zeros((batch, hidden)), jnp.zeros((batch, hidden)))
+
+
+def lstm_policy_apply(params, obs, state):
+    """One recurrent step: obs [B, obs_size], state (h, c) ->
+    (logits, value, new_state)."""
+    h, c = state
+    x = jnp.tanh(_apply_mlp(params["enc"], obs))
+    gates = x @ params["lstm"]["wi"] + h @ params["lstm"]["wh"] \
+        + params["lstm"]["b"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    logits = _apply_mlp(params["pi"], h)
+    value = _apply_mlp(params["vf"], h)[..., 0]
+    return logits, value, (h, c)
+
+
+def lstm_policy_unroll(params, obs_seq, state):
+    """Scan the cell over a [T, B, obs] sequence (lax.scan — one
+    compiled loop, no per-step dispatch)."""
+    def step(carry, obs_t):
+        logits, value, carry = lstm_policy_apply(params, obs_t, carry)
+        return carry, (logits, value)
+
+    final, (logits, values) = jax.lax.scan(step, state, obs_seq)
+    return logits, values, final

@@ -1,0 +1,251 @@
+"""SAC — soft actor-critic for continuous control.
+
+Reference: rllib/algorithms/sac/sac.py (+ sac_tf_policy.py losses):
+off-policy maximum-entropy RL — a squashed-Gaussian actor, twin Q
+critics with a polyak-averaged target pair, and automatic entropy
+temperature tuning toward a -|A| target. The execution pattern is the
+DQN family's (transition workers -> replay buffer -> jitted learner);
+what SAC adds is the continuous-action model set and the three-way
+actor/critic/alpha update, which compiles into ONE jitted step here
+(the XLA fusion does what the reference's multi-GPU tower loop does by
+hand).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.env import env_action_space, make_env
+from ray_tpu.rllib.models import (
+    init_sac_networks,
+    sac_actor_apply,
+    sac_q_apply,
+    sac_sample_action,
+)
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+
+class ContinuousTransitionWorker:
+    """Sampling actor for continuous-action envs: steps with the current
+    squashed-Gaussian actor, returns transition batches (reference:
+    rollout_worker.py in transition mode, continuous branch)."""
+
+    def __init__(self, env_spec, *, num_envs: int = 1, seed: int = 0):
+        self.envs = [make_env(env_spec, seed=seed * 1000 + i)
+                     for i in range(num_envs)]
+        space = env_action_space(self.envs[0])
+        self.obs_size = space["obs_size"]
+        self.action_size = space["action_size"]
+        self.low = np.asarray(space["low"], np.float32)
+        self.high = np.asarray(space["high"], np.float32)
+        self._key = jax.random.PRNGKey(seed)
+        self._obs = [np.asarray(e.reset(seed=seed * 1000 + i)[0],
+                                np.float32)
+                     for i, e in enumerate(self.envs)]
+        self._episode_returns = [0.0] * num_envs
+        self._completed: list[float] = []
+        self._sample = jax.jit(sac_sample_action)
+
+    def spaces(self):
+        return {"obs_size": self.obs_size,
+                "action_size": self.action_size,
+                "low": self.low, "high": self.high}
+
+    def sample_transitions(self, params, steps_per_env: int,
+                           random_warmup: bool = False) -> dict:
+        E, T = len(self.envs), steps_per_env
+        obs = np.zeros((T, E, self.obs_size), np.float32)
+        actions = np.zeros((T, E, self.action_size), np.float32)
+        rewards = np.zeros((T, E), np.float32)
+        dones = np.zeros((T, E), np.float32)
+        next_obs = np.zeros((T, E, self.obs_size), np.float32)
+        scale = (self.high - self.low) / 2.0
+        mid = (self.high + self.low) / 2.0
+        for t in range(T):
+            stacked = np.stack(self._obs)
+            if random_warmup:
+                a_unit = np.random.uniform(-1, 1, (E, self.action_size))
+            else:
+                self._key, sub = jax.random.split(self._key)
+                a_unit = np.asarray(self._sample(params, stacked, sub)[0])
+            a_env = a_unit * scale + mid
+            for e in range(E):
+                obs[t, e] = self._obs[e]
+                actions[t, e] = a_unit[e]
+                nobs, r, term, trunc, _ = self.envs[e].step(a_env[e])
+                self._episode_returns[e] += r
+                rewards[t, e] = r
+                # time-limit truncation is NOT a true terminal: bootstrap
+                dones[t, e] = float(term)
+                next_obs[t, e] = np.asarray(nobs, np.float32)
+                if term or trunc:
+                    self._completed.append(self._episode_returns[e])
+                    self._episode_returns[e] = 0.0
+                    self._obs[e] = np.asarray(self.envs[e].reset()[0],
+                                              np.float32)
+                else:
+                    self._obs[e] = next_obs[t, e]
+        flat = {
+            "obs": obs.reshape(T * E, -1),
+            "actions": actions.reshape(T * E, -1),
+            "rewards": rewards.reshape(T * E),
+            "dones": dones.reshape(T * E),
+            "next_obs": next_obs.reshape(T * E, -1),
+        }
+        flat["episode_returns"] = np.asarray(self._completed, np.float64)
+        self._completed = []
+        return flat
+
+
+class SAC(Algorithm):
+    """Soft actor-critic (reference: rllib/algorithms/sac/sac.py)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        # bespoke worker set (continuous spaces) — skip Algorithm.__init__
+        self.config = config
+        worker_cls = ray_tpu.remote(ContinuousTransitionWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=0).remote(
+                config.env_spec, num_envs=config.num_envs_per_worker,
+                seed=config.seed + i)
+            for i in range(config.num_rollout_workers)
+        ]
+        space = ray_tpu.get(self.workers[0].spaces.remote())
+        self.action_size = space["action_size"]
+        self.params = init_sac_networks(
+            jax.random.PRNGKey(config.seed), space["obs_size"],
+            self.action_size)
+        self.target_q = jax.tree_util.tree_map(
+            jnp.copy, {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self.log_alpha = jnp.asarray(float(np.log(config.init_alpha)))
+        self.target_entropy = -float(self.action_size)
+        self.buffer = ReplayBuffer(config.buffer_capacity,
+                                   seed=config.seed)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.alpha_opt = optax.adam(config.alpha_lr)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        self.iteration = 0
+        self._recent_returns: list = []
+        self._key = jax.random.PRNGKey(config.seed + 7)
+        cfg = config
+
+        def critic_loss(params, target_q, log_alpha, mb, key):
+            next_a, next_logp = sac_sample_action(
+                params, mb["next_obs"], key)
+            tq1 = sac_q_apply(target_q["q1"], mb["next_obs"], next_a)
+            tq2 = sac_q_apply(target_q["q2"], mb["next_obs"], next_a)
+            alpha = jnp.exp(log_alpha)
+            soft_q = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target = mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * soft_q
+            target = jax.lax.stop_gradient(target)
+            q1 = sac_q_apply(params["q1"], mb["obs"], mb["actions"])
+            q2 = sac_q_apply(params["q2"], mb["obs"], mb["actions"])
+            return jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+
+        def actor_loss(params, log_alpha, mb, key):
+            a, logp = sac_sample_action(params, mb["obs"], key)
+            q = jnp.minimum(sac_q_apply(params["q1"], mb["obs"], a),
+                            sac_q_apply(params["q2"], mb["obs"], a))
+            return jnp.mean(jnp.exp(log_alpha) * logp - q), logp
+
+        def update(params, target_q, log_alpha, opt_state,
+                   alpha_opt_state, mb, key):
+            kc, ka = jax.random.split(key)
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                params, target_q, log_alpha, mb, kc)
+            (a_loss, logp), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(params, log_alpha, mb, ka)
+            # one optimizer over the whole param tree: critic grads drive
+            # q1/q2, actor grads drive pi — mask the cross terms
+            grads = {
+                "pi": a_grads["pi"],
+                "q1": c_grads["q1"],
+                "q2": c_grads["q2"],
+            }
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            # temperature: pull entropy toward -|A|
+            alpha_grad = jax.grad(
+                lambda la: -jnp.mean(
+                    la * jax.lax.stop_gradient(
+                        logp + self.target_entropy)))(log_alpha)
+            a_updates, alpha_opt_state = self.alpha_opt.update(
+                alpha_grad, alpha_opt_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, a_updates)
+            # polyak target update
+            target_q = jax.tree_util.tree_map(
+                lambda t, s: (1 - cfg.tau) * t + cfg.tau * s,
+                target_q, {"q1": params["q1"], "q2": params["q2"]})
+            aux = {"critic_loss": c_loss, "actor_loss": a_loss,
+                   "alpha": jnp.exp(log_alpha),
+                   "entropy": -jnp.mean(logp)}
+            return params, target_q, log_alpha, opt_state, \
+                alpha_opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def _sample_call(self, worker):
+        warmup = len(self.buffer) < self.config.learning_starts
+        return worker.sample_transitions.remote(
+            self.params, self.config.rollout_fragment_length,
+            random_warmup=warmup)
+
+    def training_step(self, batch) -> dict:
+        self.buffer.add_batch(batch)
+        metrics = {"replay_buffer_size": len(self.buffer)}
+        if len(self.buffer) < self.config.learning_starts:
+            return metrics
+        for _ in range(self.config.num_sgd_steps):
+            mb = {k: jnp.asarray(v)
+                  for k, v in self.buffer.sample(
+                      self.config.minibatch_size).items()}
+            self._key, sub = jax.random.split(self._key)
+            (self.params, self.target_q, self.log_alpha, self.opt_state,
+             self.alpha_opt_state, aux) = self._update(
+                self.params, self.target_q, self.log_alpha,
+                self.opt_state, self.alpha_opt_state, mb, sub)
+        metrics.update({k: float(v) for k, v in aux.items()})
+        return metrics
+
+    def evaluate(self, num_episodes: int = 3, seed: int = 123) -> dict:
+        """Deterministic-policy evaluation (tanh(mean), no sampling) on
+        fresh local envs — the reference's evaluation_config
+        explore=False rollouts."""
+        from ray_tpu.rllib.models import sac_actor_apply
+
+        env = make_env(self.config.env_spec, seed=seed)
+        space = env_action_space(env)
+        scale = (np.asarray(space["high"]) - space["low"]) / 2.0
+        mid = (np.asarray(space["high"]) + space["low"]) / 2.0
+        fwd = jax.jit(sac_actor_apply)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            total, done = 0.0, False
+            while not done:
+                mean, _ = fwd(self.params, np.asarray(obs,
+                                                      np.float32)[None])
+                a = np.tanh(np.asarray(mean))[0] * scale + mid
+                obs, r, term, trunc, _ = env.step(a)
+                total += r
+                done = term or trunc
+            returns.append(total)
+        return {"episode_reward_mean": float(np.mean(returns)),
+                "episodes": num_episodes}
+
+    def save(self) -> dict:
+        return {"params": self.params, "iteration": self.iteration,
+                "target_q": self.target_q,
+                "log_alpha": self.log_alpha}
+
+    def restore(self, state: dict):
+        self.params = state["params"]
+        self.iteration = state["iteration"]
+        self.target_q = state.get("target_q", self.target_q)
+        self.log_alpha = state.get("log_alpha", self.log_alpha)

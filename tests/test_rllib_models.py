@@ -1,0 +1,123 @@
+"""Model zoo (CNN/LSTM) + multi-learner scaling + env throughput.
+
+Reference tier: rllib/models tests (VisionNetwork/LSTM wrappers) and
+core/learner/learner_group tests (N learners, grad all-reduce parity
+with 1 learner).
+"""
+import numpy as np
+import pytest
+
+
+def test_cnn_policy_shapes():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.models import cnn_policy_apply, init_cnn_policy
+
+    params = init_cnn_policy(jax.random.PRNGKey(0), (16, 16, 3), 4)
+    obs = jnp.ones((7, 16, 16, 3))
+    logits, value = jax.jit(cnn_policy_apply)(params, obs)
+    assert logits.shape == (7, 4) and value.shape == (7,)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_lstm_policy_carries_state():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.models import (init_lstm_policy,
+                                      lstm_policy_apply,
+                                      lstm_policy_initial_state,
+                                      lstm_policy_unroll)
+
+    params = init_lstm_policy(jax.random.PRNGKey(0), 4, 2, hidden=16)
+    state = lstm_policy_initial_state(16, batch=3)
+    obs = jnp.ones((3, 4))
+    logits1, _v, state1 = lstm_policy_apply(params, obs, state)
+    logits2, _v, _state2 = lstm_policy_apply(params, obs, state1)
+    assert logits1.shape == (3, 2)
+    # state matters: same obs, different carry -> different logits
+    assert not np.allclose(np.asarray(logits1), np.asarray(logits2))
+
+    seq = jnp.ones((5, 3, 4))
+    logits_seq, values_seq, final = lstm_policy_unroll(params, seq, state)
+    assert logits_seq.shape == (5, 3, 2) and values_seq.shape == (5, 3)
+    # scan step 0 == single step from the same carry
+    assert np.allclose(np.asarray(logits_seq[0]), np.asarray(logits1),
+                       atol=1e-5)
+
+
+def test_learner_group_matches_single_learner():
+    """The 8-way data-parallel step produces the SAME update as one
+    learner on the full batch (pmean of shard grads == full-batch
+    grad): the multi-learner scaling contract."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.learner_group import LearnerGroup
+
+    def loss_fn(params, mb):
+        pred = mb["x"] @ params["w"]
+        loss = jnp.mean((pred - mb["y"]) ** 2)
+        return loss, {"mse": loss}
+
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.normal(size=(64, 8)).astype(np.float32),
+             "y": rng.normal(size=(64,)).astype(np.float32)}
+    w0 = jnp.asarray(rng.normal(size=(8,)).astype(np.float32))
+
+    group = LearnerGroup(loss_fn, {"w": w0}, lr=1e-2)
+    assert group.num_learners == 8     # conftest forces 8 CPU devices
+    out = group.update(batch)
+    assert out["num_learners"] == 8 and np.isfinite(out["loss"])
+
+    # single-learner reference update
+    opt = optax.adam(1e-2)
+    st = opt.init({"w": w0})
+    (_l, _aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        {"w": w0}, {k: jnp.asarray(v) for k, v in batch.items()})
+    upd, _ = opt.update(grads, st, {"w": w0})
+    expect = optax.apply_updates({"w": w0}, upd)
+    assert np.allclose(np.asarray(group.params["w"]),
+                       np.asarray(expect["w"]), atol=1e-5), (
+        "dp update diverged from single-learner update")
+
+
+def test_learner_group_truncates_ragged_batch():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.learner_group import LearnerGroup
+
+    def loss_fn(params, mb):
+        loss = jnp.mean((mb["x"] @ params["w"]) ** 2)
+        return loss, {}
+
+    group = LearnerGroup(loss_fn, {"w": jnp.ones((4,))}, lr=1e-3)
+    out = group.update({"x": np.ones((67, 4), np.float32)})   # 67 % 8 != 0
+    assert np.isfinite(out["loss"])
+
+
+def test_vectorized_env_throughput_number(ray_start_regular):
+    """Record a steps/s number for the sampling plane (weak #7 asked for
+    a vectorized-env throughput measurement; the assertion is a sanity
+    floor, the number itself prints for PERF.md)."""
+    import time
+
+    import jax
+
+    from ray_tpu.rllib.models import init_policy
+    from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+    w = RolloutWorker("CartPole-v1", num_envs=8, seed=0)
+    params = init_policy(jax.random.PRNGKey(0), *w.spaces())
+    w.sample(params, 16)                     # warm the jit
+    t0 = time.time()
+    batch = w.sample(params, 64)
+    dt = time.time() - t0
+    steps = len(batch["obs"])
+    rate = steps / dt
+    print(f"\nvectorized-env throughput: {rate:.0f} env-steps/s "
+          f"({steps} steps in {dt:.2f}s, 8 envs)")
+    assert steps == 8 * 64
+    assert rate > 200, f"sampling plane unreasonably slow: {rate}/s"
