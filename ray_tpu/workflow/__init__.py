@@ -108,5 +108,16 @@ def continuation(dag):
     return dag
 
 
-__all__ = ["continuation", "delete", "get_output", "get_status", "init",
-            "list_all", "resume", "resume_all", "run"]
+from ray_tpu.workflow.event_listener import (  # noqa: E402
+    EventListener,
+    FileEventListener,
+    HTTPEventListener,
+    HTTPEventProvider,
+    TimerListener,
+    wait_for_event,
+)
+
+__all__ = ["EventListener", "FileEventListener", "HTTPEventListener",
+           "HTTPEventProvider", "TimerListener", "continuation", "delete",
+           "get_output", "get_status", "init", "list_all", "resume",
+           "resume_all", "run", "wait_for_event"]

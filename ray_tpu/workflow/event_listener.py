@@ -1,0 +1,199 @@
+"""Workflow event providers — external-event wait/trigger steps.
+
+Reference: python/ray/workflow/event_listener.py (EventListener with
+poll_for_event + event_checkpointed, TimerListener) and
+http_event_provider.py (an HTTP endpoint workflows wait on). The
+durability contract matches the reference: the event payload is
+persisted as the step's result BEFORE `event_checkpointed` fires, so a
+provider may delete its copy on ack — a crash after persist but before
+ack re-acks (at-least-once ack, exactly-once delivery to downstream
+steps).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+
+class EventListener:
+    """Contract for event sources a workflow can wait on."""
+
+    def poll_for_event(self):
+        """Block until the event arrives; return its payload."""
+        raise NotImplementedError
+
+    def event_checkpointed(self, event) -> None:
+        """Called AFTER the payload is durably persisted as the step's
+        result — the provider may now delete its copy."""
+
+
+class TimerListener(EventListener):
+    """Fires after a duration (reference: event_listener.py
+    TimerListener)."""
+
+    def __init__(self, duration_s: float):
+        self.duration_s = float(duration_s)
+
+    def poll_for_event(self):
+        time.sleep(self.duration_s)
+        return {"fired_after_s": self.duration_s}
+
+
+class FileEventListener(EventListener):
+    """Fires when a file appears; payload is its JSON (or raw text)
+    contents. Ack deletes the file."""
+
+    def __init__(self, path: str, poll_interval_s: float = 0.1):
+        self.path = path
+        self.poll_interval_s = poll_interval_s
+
+    def poll_for_event(self):
+        while not os.path.exists(self.path):
+            time.sleep(self.poll_interval_s)
+        with open(self.path) as f:
+            raw = f.read()
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return raw
+
+    def event_checkpointed(self, event) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class HTTPEventProvider:
+    """In-process HTTP endpoint external systems POST events to
+    (reference: http_event_provider.py, minus the Serve dependency —
+    a plain threaded http.server is enough for the contract).
+
+    POST /event/<key>      body = JSON payload  -> 200
+    GET  /event/<key>      -> 200 payload | 404 (listener poll)
+    DELETE /event/<key>    -> 200 (listener ack after checkpoint)
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        events: dict[str, bytes] = {}
+        lock = threading.Lock()
+        self._events = events
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # noqa: N802 — stdlib name
+                pass
+
+            def _key(self):
+                return self.path.split("/event/", 1)[-1]
+
+            def do_POST(self):   # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                with lock:
+                    events[self._key()] = self.rfile.read(n)
+                self.send_response(200)
+                self.end_headers()
+
+            def do_GET(self):    # noqa: N802
+                with lock:
+                    body = events.get(self._key())
+                if body is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_DELETE(self):  # noqa: N802
+                with lock:
+                    events.pop(self._key(), None)
+                self.send_response(200)
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name="workflow-events")
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def pending_events(self) -> list[str]:
+        return list(self._events)
+
+    def shutdown(self):
+        self._server.shutdown()
+
+
+class HTTPEventListener(EventListener):
+    """Waits on one key of an HTTPEventProvider; ack deletes the
+    provider's copy (after the payload is checkpointed)."""
+
+    def __init__(self, provider_address: str, key: str,
+                 poll_interval_s: float = 0.2):
+        self.url = f"{provider_address}/event/{key}"
+        self.poll_interval_s = poll_interval_s
+
+    def poll_for_event(self):
+        while True:
+            try:
+                with urllib.request.urlopen(self.url, timeout=5) as resp:
+                    raw = resp.read()
+                try:
+                    return json.loads(raw)
+                except ValueError:
+                    return raw.decode()
+            except urllib.error.HTTPError as e:
+                if e.code != 404:
+                    raise
+            time.sleep(self.poll_interval_s)
+
+    def event_checkpointed(self, event) -> None:
+        req = urllib.request.Request(self.url, method="DELETE")
+        try:
+            urllib.request.urlopen(req, timeout=5).read()
+        except Exception:
+            pass   # provider gone: its copy dies with it anyway
+
+
+class _EventHolder:
+    """Marker a wait_for_event step returns: tells the executor to
+    persist `.event` as the result, THEN ack via event_checkpointed."""
+
+    __slots__ = ("listener_cls", "args", "kwargs", "event")
+
+    def __init__(self, listener_cls, args, kwargs, event):
+        self.listener_cls = listener_cls
+        self.args = args
+        self.kwargs = kwargs
+        self.event = event
+
+    def ack(self):
+        self.listener_cls(*self.args, **self.kwargs).event_checkpointed(
+            self.event)
+
+
+def _poll_event_step(listener_cls, args, kwargs):
+    listener = listener_cls(*args, **kwargs)
+    event = listener.poll_for_event()
+    return _EventHolder(listener_cls, args, kwargs, event)
+
+
+def wait_for_event(event_listener_cls, *args, **kwargs):
+    """A bindable DAG node that completes when the listener's event
+    arrives; its value (the payload) flows to downstream steps
+    (reference: workflow/api.py wait_for_event)."""
+    import ray_tpu
+
+    if not issubclass(event_listener_cls, EventListener):
+        raise TypeError("wait_for_event takes an EventListener subclass")
+    step = ray_tpu.remote(_poll_event_step)
+    return step.bind(event_listener_cls, args, kwargs)

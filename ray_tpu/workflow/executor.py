@@ -124,6 +124,7 @@ class WorkflowExecutor:
         for sid in list(specs):
             if self.storage.has_step_result(wid, sid):
                 done[sid] = self.storage.load_step_result(wid, sid)
+        self._retry_pending_acks()
 
         in_flight: dict = {}          # ObjectRef -> step_id
         while True:
@@ -171,8 +172,37 @@ class WorkflowExecutor:
                     self.storage.save_step_spec(wid, sid, alias)
                     specs[sid] = alias
                     continue
+                from ray_tpu.workflow.event_listener import _EventHolder
+
+                if isinstance(value, _EventHolder):
+                    # event step: persist the payload FIRST, then ack so
+                    # the provider may delete its copy (the reference's
+                    # event_checkpointed contract). The ack-pending
+                    # marker is written before the result so a failed
+                    # ack is RETRIED on resume (without it the stale
+                    # provider copy would re-fire a later wait).
+                    self.storage.save_pending_ack(wid, sid, value)
+                    self.storage.save_step_result(wid, sid, value.event)
+                    try:
+                        value.ack()
+                        self.storage.clear_pending_ack(wid, sid)
+                    except Exception:
+                        pass   # retried by _retry_pending_acks on resume
+                    done[sid] = value.event
+                    continue
                 self.storage.save_step_result(wid, sid, value)
                 done[sid] = value
+
+    def _retry_pending_acks(self):
+        """Re-run event-provider acks that failed after their payload
+        was checkpointed (crash or transient provider error)."""
+        for sid, holder in self.storage.pending_acks(
+                self.workflow_id).items():
+            try:
+                holder.ack()
+                self.storage.clear_pending_ack(self.workflow_id, sid)
+            except Exception:
+                pass   # provider still unreachable; retried next resume
 
     @staticmethod
     def _dep_ids(spec: dict) -> list[str]:

@@ -123,3 +123,26 @@ class WorkflowStorage:
         with open(self._step_path(workflow_id, step_id, "result"),
                   "rb") as f:
             return pickle.load(f)
+
+    # pending event-provider acks (executor retries them on resume)
+    def save_pending_ack(self, workflow_id: str, step_id: str, holder):
+        _atomic_write(self._step_path(workflow_id, step_id, "ack"),
+                      cloudpickle.dumps(holder))
+
+    def pending_acks(self, workflow_id: str) -> dict[str, object]:
+        steps_dir = os.path.join(self._wf_dir(workflow_id), "steps")
+        out = {}
+        if not os.path.isdir(steps_dir):
+            return out
+        for name in os.listdir(steps_dir):
+            if name.endswith(".ack.pkl") and not name.startswith(".tmp"):
+                sid = name[:-len(".ack.pkl")].replace("__", "/")
+                with open(os.path.join(steps_dir, name), "rb") as f:
+                    out[sid] = pickle.load(f)
+        return out
+
+    def clear_pending_ack(self, workflow_id: str, step_id: str):
+        try:
+            os.unlink(self._step_path(workflow_id, step_id, "ack"))
+        except OSError:
+            pass

@@ -1,0 +1,128 @@
+"""Workflow event providers — wait/trigger steps.
+
+Reference tier: workflow event tests over event_listener.py +
+http_event_provider.py: a workflow blocks on an external event, the
+payload flows downstream, the provider's copy is acked AFTER the
+payload is durably checkpointed, and resume does not re-wait.
+"""
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+
+def test_timer_listener_fires():
+    from ray_tpu.workflow import TimerListener
+
+    t0 = time.time()
+    event = TimerListener(0.2).poll_for_event()
+    assert time.time() - t0 >= 0.2
+    assert event["fired_after_s"] == 0.2
+
+
+def test_workflow_waits_for_file_event(ray_start_regular, tmp_path):
+    """The workflow blocks on the event step; once the trigger file
+    appears its payload flows into the downstream step, and the ack
+    deletes the trigger."""
+    import ray_tpu
+    from ray_tpu import workflow
+    from ray_tpu.workflow import FileEventListener, wait_for_event
+
+    trigger = str(tmp_path / "trigger.json")
+    storage = str(tmp_path / "wf")
+
+    @ray_tpu.remote
+    def combine(event, tag):
+        return (event["value"], tag)
+
+    dag = combine.bind(
+        wait_for_event(FileEventListener, trigger), "done")
+
+    result_box = {}
+
+    def run():
+        result_box["out"] = workflow.run(dag, workflow_id="evt1",
+                                         storage_dir=storage)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(1.0)
+    assert "out" not in result_box       # still waiting on the event
+    with open(trigger, "w") as f:
+        json.dump({"value": 41}, f)
+    t.join(timeout=60)
+    assert result_box.get("out") == (41, "done")
+    deadline = time.time() + 10          # ack deletes the trigger file
+    while os.path.exists(trigger) and time.time() < deadline:
+        time.sleep(0.1)
+    assert not os.path.exists(trigger)
+
+
+def test_resume_does_not_rewait_checkpointed_event(ray_start_regular,
+                                                   tmp_path):
+    """After the event step persisted its payload, resume replays from
+    storage — no second wait, same answer (the reference's
+    event-checkpoint durability contract)."""
+    import ray_tpu
+    from ray_tpu import workflow
+    from ray_tpu.workflow import FileEventListener, wait_for_event
+
+    trigger = str(tmp_path / "t.json")
+    storage = str(tmp_path / "wf")
+    with open(trigger, "w") as f:
+        json.dump({"value": 7}, f)
+
+    @ray_tpu.remote
+    def double(event):
+        return event["value"] * 2
+
+    dag = double.bind(wait_for_event(FileEventListener, trigger))
+    assert workflow.run(dag, workflow_id="evt2",
+                        storage_dir=storage) == 14
+    # the trigger is gone (acked); resume must NOT wait for it again
+    assert not os.path.exists(trigger)
+    assert workflow.resume("evt2", storage_dir=storage) == 14
+
+
+def test_http_event_provider_round_trip(ray_start_regular, tmp_path):
+    """External systems POST to the provider; the workflow's HTTP
+    listener picks the event up and acks it after checkpoint."""
+    import ray_tpu
+    from ray_tpu import workflow
+    from ray_tpu.workflow import (HTTPEventListener, HTTPEventProvider,
+                                  wait_for_event)
+
+    provider = HTTPEventProvider()
+    try:
+        @ray_tpu.remote
+        def greet(event):
+            return f"hello {event['who']}"
+
+        dag = greet.bind(wait_for_event(
+            HTTPEventListener, provider.address, "approval"))
+
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(out=workflow.run(
+                dag, workflow_id="evt3",
+                storage_dir=str(tmp_path / "wf"))),
+            daemon=True)
+        t.start()
+        time.sleep(1.0)
+        assert "out" not in box
+        req = urllib.request.Request(
+            f"{provider.address}/event/approval",
+            data=json.dumps({"who": "world"}).encode(), method="POST")
+        urllib.request.urlopen(req, timeout=5).read()
+        t.join(timeout=60)
+        assert box.get("out") == "hello world"
+        deadline = time.time() + 10      # acked → provider copy deleted
+        while provider.pending_events() and time.time() < deadline:
+            time.sleep(0.1)
+        assert provider.pending_events() == []
+    finally:
+        provider.shutdown()
