@@ -1,11 +1,16 @@
 from ray_tpu.tune.schedulers import (  # noqa: F401
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401
+    BOHBSearcher,
     ConcurrencyLimiter,
+    ExternalSearcher,
+    OptunaSearch,
     Searcher,
     TPESearcher,
     choice,

@@ -360,3 +360,135 @@ class TPESearcher(Searcher):
             return
         if self.metric and self.metric in result:
             self._observations.append((config, float(result[self.metric])))
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model half (reference: tune/search/bohb/bohb_search.py):
+    TPE fit on rung-level observations fed by HyperBandForBOHB — the
+    model always trains on the HIGHEST rung (budget) that has enough
+    data, so early low-fidelity scores guide sampling until
+    high-fidelity results exist, then stop polluting the model."""
+
+    def __init__(self, *args, min_rung_points: int | None = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.min_rung_points = (min_rung_points
+                                if min_rung_points is not None
+                                else self.n_startup_trials)
+        self._rungs: dict[int, list[tuple[dict, float]]] = {}
+
+    def observe_rung(self, config: dict, iteration: int, score: float):
+        self._rungs.setdefault(int(iteration), []).append(
+            (dict(config), float(score)))
+
+    def suggest(self, trial_id):
+        pool = None
+        for rung in sorted(self._rungs, reverse=True):
+            if len(self._rungs[rung]) >= self.min_rung_points:
+                pool = self._rungs[rung]
+                break
+        if pool is not None:
+            # COPY: aliasing the rung list would let the inherited
+            # on_trial_complete append final-fidelity results into the
+            # rung, polluting its budget-pure data
+            self._observations = list(pool)
+        return super().suggest(trial_id)
+
+
+class ExternalSearcher(Searcher):
+    """Adapter for third-party search libraries (the reference's
+    integration shape: tune/search/optuna/optuna_search.py,
+    hyperopt/hyperopt_search.py). Wraps any backend exposing the
+    ask/tell protocol:
+
+        ask()  -> (handle, config_dict)   # next configuration
+        tell(handle, value, error=False)  # report the (mode-signed)
+                                          # final metric
+
+    The adapter owns trial_id -> handle bookkeeping and metric/mode
+    normalization; the backend never sees tune types.
+    """
+
+    def __init__(self, backend, metric: str | None = None,
+                 mode: str | None = None):
+        if not hasattr(backend, "ask") or not hasattr(backend, "tell"):
+            raise TypeError("ExternalSearcher backend must expose "
+                            "ask()/tell()")
+        self.backend = backend
+        self.metric = metric
+        self.mode = mode
+        self._handles: dict[str, object] = {}
+
+    def suggest(self, trial_id):
+        out = self.backend.ask()
+        if out is None:
+            return Searcher.FINISHED
+        handle, config = out
+        self._handles[trial_id] = handle
+        return dict(config)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        handle = self._handles.pop(trial_id, None)
+        if handle is None:
+            return
+        value = None
+        if result and self.metric and self.metric in result:
+            value = float(result[self.metric])
+            if (self.mode or "max") == "min":
+                value = -value
+        try:
+            self.backend.tell(handle, value, error=error or value is None)
+        except TypeError:
+            self.backend.tell(handle, value)
+
+
+class OptunaSearch(ExternalSearcher):
+    """Optuna integration over the ask/tell adapter (reference:
+    tune/search/optuna/optuna_search.py). Translates the tune Domain
+    space into optuna distributions; requires optuna installed."""
+
+    def __init__(self, param_space: dict, metric: str | None = None,
+                 mode: str | None = None, seed: int | None = None):
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires optuna (not bundled in this "
+                "image); use the native TPESearcher for the same "
+                "algorithm, or wrap another library via "
+                "ExternalSearcher") from e
+
+        domains = flatten_domains(param_space)
+        study = optuna.create_study(
+            sampler=optuna.samplers.TPESampler(seed=seed),
+            direction="maximize")
+
+        class _Backend:
+            def ask(self):
+                trial = study.ask()
+                flat = {}
+                for path, dom in domains.items():
+                    if isinstance(dom, LogUniform):
+                        flat[path] = trial.suggest_float(
+                            path, dom.low, dom.high, log=True)
+                    elif isinstance(dom, Uniform):
+                        flat[path] = trial.suggest_float(
+                            path, dom.low, dom.high)
+                    elif isinstance(dom, Randint):
+                        flat[path] = trial.suggest_int(
+                            path, dom.low, dom.high - 1)
+                    elif isinstance(dom, (Choice, GridSearch)):
+                        cats = (dom.categories if isinstance(dom, Choice)
+                                else dom.values)
+                        flat[path] = trial.suggest_categorical(path, cats)
+                    else:
+                        flat[path] = dom
+                return trial, build_config(flat, param_space)
+
+            def tell(self, trial, value, error=False):
+                state = (optuna.trial.TrialState.FAIL if error
+                         else optuna.trial.TrialState.COMPLETE)
+                study.tell(trial, value, state=state)
+
+        super().__init__(_Backend(), metric=metric, mode=mode)
+        self.param_space = param_space

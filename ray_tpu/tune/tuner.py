@@ -112,6 +112,16 @@ class TrialRunner:
         self.run_config = run_config
         self.resources = resources_per_trial or {"CPU": 1}
         self.scheduler = tune_config.scheduler or sched_mod.FIFOScheduler()
+        # BOHB pairing: the scheduler feeds rung-level observations to
+        # the model-based searcher (reference: hb_bohb.py + bohb_search
+        # cooperate the same way)
+        if hasattr(self.scheduler, "attach_searcher") and \
+                tune_config.search_alg is not None:
+            target = tune_config.search_alg
+            # unwrap ConcurrencyLimiter-style decorators
+            target = getattr(target, "searcher", target)
+            if hasattr(target, "observe_rung"):
+                self.scheduler.attach_searcher(target)
         self._pending_exploits: list[tuple] = []
         # experiment persistence (reference: trial_runner checkpointing +
         # tune/execution/experiment_state.py): enabled when the run is named
