@@ -103,7 +103,20 @@ def up(config_path: str, *, no_monitor: bool = False) -> dict:
         with open(state_file) as f:
             state = json.load(f)
         if _alive(state.get("head_pid")):
-            return state    # idempotent re-up: cluster already running
+            # idempotent re-up — but a dead monitor means nobody owns the
+            # provider's nodes/slices (its SIGTERM handler is what
+            # releases them on `down`): respawn it
+            if not no_monitor and not _alive(state.get("monitor_pid")):
+                mon = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu.autoscaler.monitor",
+                     "--config", os.path.abspath(config_path),
+                     "--gcs-address", state["gcs_address"]],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                    start_new_session=True)
+                state["monitor_pid"] = mon.pid
+                with open(state_file, "w") as f:
+                    json.dump(state, f)
+            return state
         os.unlink(state_file)
 
     head_type = cfg.get("head_node_type")

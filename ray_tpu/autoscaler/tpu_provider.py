@@ -224,12 +224,28 @@ class MockTpuApi(TpuApi):
         if self.gcs_address:
             # back every host with a real node process, stamping the
             # slice-topology env the scheduler's contiguous-ICI packing
-            # reads (gcs.py _place_on_contiguous_slice)
+            # reads (gcs.py _place_on_contiguous_slice). Re-check
+            # liveness around each spawn: delete_slice racing this loop
+            # must not leave orphan node processes it can't see.
             for i, host in enumerate(rec["hosts"]):
+                with self._lock:
+                    if self._slices.get(slice_id) is not rec or \
+                            rec["state"] == DELETING:
+                        return
                 proc, node_id = self._spawn_host(rec, i)
                 with self._lock:
-                    host["proc"] = proc
-                    host["node_id"] = node_id
+                    gone = (self._slices.get(slice_id) is not rec
+                            or rec["state"] == DELETING)
+                    if not gone:
+                        host["proc"] = proc
+                        host["node_id"] = node_id
+                if gone:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                    return
         with self._lock:
             if rec["state"] != DELETING:
                 rec["state"] = ACTIVE
