@@ -51,6 +51,19 @@ def serialize(value, raised: bool = False) -> bytearray:
     BYTEARRAY (bytes-like but unhashable/mutable — a bytes() of it would
     be a second full copy of every out-of-band buffer). raised=True marks
     the payload as a shipped task failure (set by serialize_error only)."""
+    parts = serialize_parts(value, raised)
+    out = bytearray(parts[0])
+    for b in parts[1:]:
+        out += b
+    return out
+
+
+def serialize_parts(value, raised: bool = False) -> list:
+    """The frame as a PARTS LIST [header+meta, oob_buffer, ...], NOT
+    assembled: callers that stream (shm segment copy, spill-file write)
+    skip a full copy of every out-of-band buffer — gigabytes for big
+    arrays. Writing the parts sequentially reproduces serialize()
+    byte-for-byte."""
     buffers: list = []
     refs: list = []
     ref_index: dict[bytes, int] = {}
@@ -94,14 +107,10 @@ def serialize(value, raised: bool = False) -> bytearray:
         },
         protocol=pickle.HIGHEST_PROTOCOL,
     )
-    out = bytearray()
-    out += _U32.pack(len(meta))
-    out += meta
-    for b in buffers:
-        out += b
-    # bytearray IS the bytes-like result — bytes(out) would be a second
-    # full copy of every out-of-band buffer (gigabytes for big arrays)
-    return out
+    header = bytearray()
+    header += _U32.pack(len(meta))
+    header += meta
+    return [header, *buffers]
 
 
 def contained_refs(value) -> list[ObjectRef]:

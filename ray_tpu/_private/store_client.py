@@ -223,6 +223,14 @@ class StoreClient:
         size = len(data)
         if self._spilled_path_if_exists(object_id) is not None:
             return False  # immutable: the spilled copy is the object
+        if size > self._capacity():
+            # can never fit the segment: straight to disk, skipping the
+            # C create (its lock + LRU bookkeeping are pure overhead for
+            # the guaranteed-FULL answer)
+            if self.spill_dir is None:
+                raise StoreError(-3, "put")
+            self._spill_write(object_id, data)
+            return True
         ptr = ctypes.c_void_p()
         rc = self._libref.store_create_object(self._h, object_id, size,
                                               ctypes.byref(ptr))
@@ -247,6 +255,43 @@ class StoreClient:
             self._libref.store_abort(self._h, object_id)
             raise
         return True
+
+    def put_parts(self, object_id: bytes, parts: list) -> int:
+        """put() from a frame-parts list (serialize_parts): each part is
+        copied straight into the segment (or streamed to the spill
+        file) without assembling them first — saves one full copy of
+        every out-of-band buffer. Returns the total byte size."""
+        views = [memoryview(p).cast("B") for p in parts]
+        total = sum(len(v) for v in views)
+        if self._spilled_path_if_exists(object_id) is not None:
+            return total
+        if total <= self._capacity():
+            buf = self.create(object_id, total)
+            if buf is None:
+                return total   # already exists (idempotent put)
+            try:
+                dst = memoryview(buf).cast("B")
+                off = 0
+                for v in views:
+                    dst[off:off + len(v)] = v
+                    off += len(v)
+                self.seal(object_id)
+                return total
+            except StoreError:
+                self.abort(object_id)
+                raise
+            except Exception:
+                self.abort(object_id)
+                raise
+        if self.spill_dir is None:
+            raise StoreError(-3, "put")
+        p = self._spill_path(object_id)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            for v in views:
+                f.write(v)
+        os.replace(tmp, p)
+        return total
 
     @_guarded
     def create(self, object_id: bytes, size: int):
@@ -331,6 +376,18 @@ class StoreClient:
             except OSError:
                 pass
 
+    def _capacity(self) -> int:
+        """Heap size of the segment (cached: it never changes after
+        creation) — the oversized-object fast-path threshold."""
+        cap = getattr(self, "_capacity_cache", None)
+        if cap is None:
+            try:
+                cap = int(self.stats()["heap_size"])
+            except Exception:
+                cap = 1 << 62   # stats unavailable: never short-circuit
+            self._capacity_cache = cap
+        return cap
+
     @_guarded
     def stats(self) -> dict:
         out = (ctypes.c_uint64 * 4)()
@@ -410,6 +467,16 @@ class StoreClient:
         p = self._spilled_path_if_exists(object_id)
         if p is None:
             return None
+        size = os.path.getsize(p)
+        if size > self._capacity():
+            # can never re-enter shm: serve the file MAPPED — the only
+            # full pass over the bytes is the consumer's own read
+            # (deserialize), with OS readahead paging it in
+            import mmap
+
+            with open(p, "rb") as f:
+                mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+            return _BytesBuffer(mm)
         with open(p, "rb") as f:
             data = f.read()
         buf = None

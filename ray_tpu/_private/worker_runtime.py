@@ -984,11 +984,14 @@ class CoreWorker:
     # ---------------------------------------------------------------- put/get
 
     def put(self, value) -> ObjectRef:
-        data = ser.serialize(value)
+        # parts path: out-of-band buffers copy straight into the shm
+        # segment (or stream to the spill file) — no assembled
+        # intermediate frame (one full copy saved per big array)
+        parts = ser.serialize_parts(value)
         object_id = self._new_id()
-        self.store.put(object_id, data)
+        size = self.store.put_parts(object_id, parts)
         # we own it: record the location in OUR directory — no RPC at all
-        self._loc_add(object_id, self._my_node, len(data))
+        self._loc_add(object_id, self._my_node, size)
         self._owned.add(object_id)
         ref = ObjectRef(object_id, self.addr, self)
         return ref

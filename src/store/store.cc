@@ -365,6 +365,15 @@ int store_create_object(Store* s, const uint8_t* id, uint64_t size,
                         void** out_ptr) {
   if (lock(s) != 0) return ERR_SYS;
   Header* h = header(s);
+  // An object that can NEVER fit must not trigger the eviction loop:
+  // without this check a single oversized create evicted every unpinned
+  // object (each victim an O(n_slots) scan under the cross-process
+  // lock) and still failed — mass data eviction + quadratic latency for
+  // nothing. The caller spills oversized objects to disk instead.
+  if (size > h->heap_size) {
+    unlock(s);
+    return ERR_FULL;
+  }
   ObjectEntry* existing = find_slot(s, id, false);
   if (existing) {
     unlock(s);
