@@ -51,11 +51,7 @@ def serialize(value, raised: bool = False) -> bytearray:
     BYTEARRAY (bytes-like but unhashable/mutable — a bytes() of it would
     be a second full copy of every out-of-band buffer). raised=True marks
     the payload as a shipped task failure (set by serialize_error only)."""
-    parts = serialize_parts(value, raised)
-    out = bytearray(parts[0])
-    for b in parts[1:]:
-        out += b
-    return out
+    return assemble_parts(serialize_parts(value, raised))
 
 
 def serialize_parts(value, raised: bool = False) -> list:
@@ -111,6 +107,19 @@ def serialize_parts(value, raised: bool = False) -> list:
     header += _U32.pack(len(meta))
     header += meta
     return [header, *buffers]
+
+
+def assemble_parts(parts: list) -> bytearray:
+    """Concatenate a serialize_parts frame (for consumers that need one
+    contiguous payload, e.g. inline task-reply results)."""
+    out = bytearray(parts[0])
+    for b in parts[1:]:
+        out += b
+    return out
+
+
+def parts_size(parts: list) -> int:
+    return sum(memoryview(p).nbytes for p in parts)
 
 
 def contained_refs(value) -> list[ObjectRef]:

@@ -266,31 +266,31 @@ class StoreClient:
         if self._spilled_path_if_exists(object_id) is not None:
             return total
         if total <= self._capacity():
-            buf = self.create(object_id, total)
-            if buf is None:
-                return total   # already exists (idempotent put)
             try:
-                dst = memoryview(buf).cast("B")
-                off = 0
-                for v in views:
-                    dst[off:off + len(v)] = v
-                    off += len(v)
-                self.seal(object_id)
-                return total
-            except StoreError:
-                self.abort(object_id)
-                raise
-            except Exception:
-                self.abort(object_id)
-                raise
+                buf = self.create(object_id, total)
+            except StoreError as e:
+                # FULL / TABLE_FULL (e.g. everything pinned): fall back
+                # to the spill file like put() always has
+                if e.code not in (-3, -4) or self.spill_dir is None:
+                    raise
+                buf = None
+            if buf is None and self.contains(object_id):
+                return total   # already exists (idempotent put)
+            if buf is not None:
+                try:
+                    dst = memoryview(buf).cast("B")
+                    off = 0
+                    for v in views:
+                        dst[off:off + len(v)] = v
+                        off += len(v)
+                    self.seal(object_id)
+                    return total
+                except BaseException:
+                    self.abort(object_id)
+                    raise
         if self.spill_dir is None:
             raise StoreError(-3, "put")
-        p = self._spill_path(object_id)
-        tmp = p + ".tmp"
-        with open(tmp, "wb") as f:
-            for v in views:
-                f.write(v)
-        os.replace(tmp, p)
+        self._spill_write(object_id, views)
         return total
 
     @_guarded
@@ -377,15 +377,18 @@ class StoreClient:
                 pass
 
     def _capacity(self) -> int:
-        """Heap size of the segment (cached: it never changes after
-        creation) — the oversized-object fast-path threshold."""
+        """Usable heap bytes for ONE object (cached on success only —
+        a transient stats() failure must not disable the oversized
+        short-circuit forever). 128 bytes of allocator headroom mirror
+        heap_alloc's per-allocation overhead, so near-heap-size objects
+        short-circuit too instead of evicting everything and failing."""
         cap = getattr(self, "_capacity_cache", None)
         if cap is None:
             try:
-                cap = int(self.stats()["heap_size"])
+                cap = max(0, int(self.stats()["heap_size"]) - 128)
+                self._capacity_cache = cap
             except Exception:
-                cap = 1 << 62   # stats unavailable: never short-circuit
-            self._capacity_cache = cap
+                return 1 << 62   # unknown right now: don't short-circuit
         return cap
 
     @_guarded
@@ -455,10 +458,16 @@ class StoreClient:
         return p if os.path.exists(p) else None
 
     def _spill_write(self, object_id: bytes, data):
+        """data: one buffer or a list of buffers (parts path). Atomic:
+        tmp file + rename, so readers never see a half-written spill."""
         p = self._spill_path(object_id)
         tmp = p + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(data)
+            if isinstance(data, (list, tuple)):
+                for piece in data:
+                    f.write(piece)
+            else:
+                f.write(data)
         os.replace(tmp, p)
 
     def _spill_restore(self, object_id: bytes):
@@ -530,16 +539,28 @@ class StoreClient:
 
 
 class _BytesBuffer:
-    """PinnedBuffer-compatible wrapper over plain bytes (spill fallback)."""
+    """PinnedBuffer-compatible wrapper over host memory (spill fallback:
+    plain bytes, or a read-only mmap of the spill file)."""
 
-    def __init__(self, data: bytes):
+    def __init__(self, data):
         self._data = data
 
     def memoryview(self) -> memoryview:
         return memoryview(self._data)
 
     def to_bytes(self) -> bytes:
-        return self._data
+        # contract: ALWAYS bytes (the RPC path pickles the result; an
+        # mmap object would not survive that)
+        if isinstance(self._data, bytes):
+            return self._data
+        return bytes(self._data)
+
+    def view(self) -> memoryview:
+        """Zero-copy view, valid for the buffer's lifetime (release is
+        a no-op here, unlike PinnedBuffer whose storage unpins). The
+        local get path uses this so an mmap'd spill file is consumed
+        without a full-copy to_bytes."""
+        return memoryview(self._data)
 
     def release(self):
         pass

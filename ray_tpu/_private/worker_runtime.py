@@ -1226,6 +1226,10 @@ class CoreWorker:
                 buf = self.store.get(ref.id)
                 if buf is not None:
                     try:
+                        if hasattr(buf, "view"):
+                            # spill-backed host buffer (possibly an
+                            # mmap): zero-copy view, safe past release
+                            return buf.view()
                         return buf.to_bytes()
                     finally:
                         buf.release()
@@ -2540,13 +2544,16 @@ class CoreWorker:
         stored: list[bytes] = []
         sizes: dict[bytes, int] = {}
         for rid, value in zip(spec["return_ids"], values):
-            data = ser.serialize(value)
-            if len(data) <= INLINE_RESULT_LIMIT:
-                inline[rid] = data
+            parts = ser.serialize_parts(value)
+            size = ser.parts_size(parts)
+            if size <= INLINE_RESULT_LIMIT:
+                inline[rid] = ser.assemble_parts(parts)
             else:
-                self.store.put(rid, data)
+                # parts stream straight into the segment/spill file —
+                # no assembled intermediate copy for big returns
+                self.store.put_parts(rid, parts)
                 stored.append(rid)
-                sizes[rid] = len(data)
+                sizes[rid] = size
         # The task REPLY doubles as the location announcement: the owner
         # records (rid → this node) in its directory on receipt — no
         # directory RPC at all on the return path. (node omitted when
@@ -2598,16 +2605,17 @@ class CoreWorker:
                 break
             index = len(rids)
             rid = _derive_item_id(gen_id, index)
-            data = ser.serialize(value)
+            item_parts = ser.serialize_parts(value)
+            size = ser.parts_size(item_parts)
             item = {"gen_id": gen_id, "index": index, "object_id": rid}
-            if len(data) <= INLINE_RESULT_LIMIT:
-                item["data"] = data
+            if size <= INLINE_RESULT_LIMIT:
+                item["data"] = ser.assemble_parts(item_parts)
             else:
-                self.store.put(rid, data)
+                self.store.put_parts(rid, item_parts)
                 stored.append(rid)
-                sizes[rid] = len(data)
+                sizes[rid] = size
                 item["node"] = self._my_node
-                item["size"] = len(data)
+                item["size"] = size
             if local:
                 self._gen_item_local(**item)
             else:

@@ -370,7 +370,9 @@ int store_create_object(Store* s, const uint8_t* id, uint64_t size,
   // object (each victim an O(n_slots) scan under the cross-process
   // lock) and still failed — mass data eviction + quadratic latency for
   // nothing. The caller spills oversized objects to disk instead.
-  if (size > h->heap_size) {
+  // The 128-byte headroom mirrors heap_alloc's worst-case alignment +
+  // block-header overhead, so near-heap-size objects short-circuit too.
+  if (size + 128 > h->heap_size) {
     unlock(s);
     return ERR_FULL;
   }
