@@ -219,64 +219,41 @@ class StoreClient:
         spilled on disk). Spills to disk if the segment can't fit it even
         after eviction."""
         self._check_id(object_id)
-        data = memoryview(data).cast("B")
-        size = len(data)
-        if self._spilled_path_if_exists(object_id) is not None:
-            return False  # immutable: the spilled copy is the object
-        if size > self._capacity():
-            # can never fit the segment: straight to disk, skipping the
-            # C create (its lock + LRU bookkeeping are pure overhead for
-            # the guaranteed-FULL answer)
-            if self.spill_dir is None:
-                raise StoreError(-3, "put")
-            self._spill_write(object_id, data)
-            return True
-        ptr = ctypes.c_void_p()
-        rc = self._libref.store_create_object(self._h, object_id, size,
-                                              ctypes.byref(ptr))
-        if rc == -2:  # EXISTS
-            return False
-        if rc in (-3, -4):  # FULL / TABLE_FULL → spill
-            if self.spill_dir is None:
-                raise StoreError(rc, "put")
-            self._spill_write(object_id, data)
-            return True
-        if rc != 0:
-            raise StoreError(rc, "put")
-        try:
-            if size:
-                # single copy, straight into the mapped segment
-                dst = (ctypes.c_ubyte * size).from_address(ptr.value)
-                memoryview(dst).cast("B")[:] = data
-            rc = self._libref.store_seal(self._h, object_id)
-            if rc != 0:
-                raise StoreError(rc, "seal")
-        except Exception:
-            self._libref.store_abort(self._h, object_id)
-            raise
-        return True
+        created, _size = self._put_views(
+            object_id, [memoryview(data).cast("B")])
+        return created
 
     def put_parts(self, object_id: bytes, parts: list) -> int:
         """put() from a frame-parts list (serialize_parts): each part is
         copied straight into the segment (or streamed to the spill
         file) without assembling them first — saves one full copy of
         every out-of-band buffer. Returns the total byte size."""
-        views = [memoryview(p).cast("B") for p in parts]
+        _created, total = self._put_views(
+            object_id, [memoryview(p).cast("B") for p in parts])
+        return total
+
+    def _put_views(self, object_id: bytes, views: list) -> tuple[bool, int]:
+        """Single EXISTS/FULL/spill decision path shared by put() and
+        put_parts(). Returns (created, total_size); created=False means
+        the object already existed (sealed, mid-create, or spilled) —
+        puts are idempotent."""
         total = sum(len(v) for v in views)
         if self._spilled_path_if_exists(object_id) is not None:
-            return total
+            return False, total
         if total <= self._capacity():
             try:
                 buf = self.create(object_id, total)
             except StoreError as e:
                 # FULL / TABLE_FULL (e.g. everything pinned): fall back
-                # to the spill file like put() always has
+                # to the spill file
                 if e.code not in (-3, -4) or self.spill_dir is None:
                     raise
                 buf = None
-            if buf is None and self.contains(object_id):
-                return total   # already exists (idempotent put)
-            if buf is not None:
+            else:
+                if buf is None:
+                    # EXISTS (sealed or another producer mid-create):
+                    # immutable objects make the duplicate a no-op
+                    return False, total
                 try:
                     dst = memoryview(buf).cast("B")
                     off = 0
@@ -284,14 +261,14 @@ class StoreClient:
                         dst[off:off + len(v)] = v
                         off += len(v)
                     self.seal(object_id)
-                    return total
+                    return True, total
                 except BaseException:
                     self.abort(object_id)
                     raise
         if self.spill_dir is None:
             raise StoreError(-3, "put")
         self._spill_write(object_id, views)
-        return total
+        return True, total
 
     @_guarded
     def create(self, object_id: bytes, size: int):

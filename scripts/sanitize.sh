@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# TSAN/ASAN pass over the native runtime (store, RPC core, data server).
+#
+# Reference analog: the asan-tagged test configs of the reference
+# (python/ray/tests/BUILD asan tags). Builds the stress driver against
+# the real sources with each sanitizer and runs every mode; any
+# sanitizer report fails the run (halt_on_error=1).
+#
+# Usage: scripts/sanitize.sh [iters]   (default 2000)
+set -u
+cd "$(dirname "$0")/.."
+ITERS="${1:-2000}"
+SRC="src/stress/stress_native.cc src/store/store.cc src/store/data_server.cc src/rpc/rpc_core.cc"
+OUT=build/sanitize
+mkdir -p "$OUT"
+fail=0
+
+# The Client/Server handle structs leak BY DESIGN (documented in
+# rpc_core.cc rpc_cl_close/rpc_sv_stop: threads may still be inside
+# wait/send when close races them; the leaked struct reports "closed"
+# forever instead of dangling). Suppress exactly those two allocation
+# sites; every other allocation (frame buffers, queues) must be freed.
+cat > "$OUT/lsan.supp" <<'SUPP'
+leak:rpc_cl_connect
+leak:rpc_sv_start
+SUPP
+
+for SAN in thread address; do
+  BIN="$OUT/stress_$SAN"
+  echo "== building -fsanitize=$SAN =="
+  if ! g++ -O1 -g -std=c++17 -fsanitize=$SAN -fno-omit-frame-pointer \
+       -o "$BIN" $SRC -lpthread -lrt 2> "$OUT/build_$SAN.log"; then
+    echo "BUILD FAILED for $SAN (see $OUT/build_$SAN.log)"
+    fail=1
+    continue
+  fi
+  for MODE in store rpc dataserver; do
+    echo "-- $SAN / $MODE --"
+    if [ "$SAN" = thread ]; then
+      TSAN_OPTIONS="halt_on_error=1" "$BIN" "$MODE" "$ITERS" \
+          2> "$OUT/${SAN}_${MODE}.log"
+    else
+      ASAN_OPTIONS="halt_on_error=1:detect_leaks=1" \
+      LSAN_OPTIONS="suppressions=$OUT/lsan.supp" \
+          "$BIN" "$MODE" "$ITERS" 2> "$OUT/${SAN}_${MODE}.log"
+    fi
+    rc=$?
+    tail -3 "$OUT/${SAN}_${MODE}.log"
+    if [ $rc -ne 0 ]; then
+      echo "FAIL: $SAN/$MODE rc=$rc (full log: $OUT/${SAN}_${MODE}.log)"
+      fail=1
+    fi
+  done
+done
+
+if [ $fail -eq 0 ]; then
+  echo "SANITIZE PASS: tsan+asan clean over store/rpc/dataserver"
+fi
+exit $fail

@@ -1,0 +1,359 @@
+// Sanitizer stress driver for the native runtime components.
+//
+// Reference analog: the asan/tsan-tagged stress configs of the
+// reference's test BUILD (python/ray/tests/BUILD asan tags) — here a
+// standalone C++ binary so ThreadSanitizer/AddressSanitizer see fully
+// instrumented code without an instrumented Python.
+//
+//   stress_native store      — multi-thread + multi-process segment abuse
+//   stress_native rpc        — echo server vs N hammering client threads
+//   stress_native dataserver — concurrent range pulls during churn
+//
+// Exit 0 = workload completed; sanitizer findings fail the run via the
+// sanitizer's own exit code (halt_on_error).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---- store C API (store.cc) ------------------------------------------------
+struct Store;
+extern "C" {
+Store* store_create(const char* name, uint64_t size, uint64_t n_slots);
+Store* store_connect(const char* name);
+void store_disconnect(Store* s);
+void store_destroy(Store* s);
+int store_create_object(Store* s, const uint8_t* id, uint64_t size,
+                        void** out_ptr);
+int store_seal(Store* s, const uint8_t* id);
+int store_abort(Store* s, const uint8_t* id);
+int store_get(Store* s, const uint8_t* id, void** out_ptr,
+              uint64_t* out_size);
+int store_release(Store* s, const uint8_t* id);
+int store_contains(Store* s, const uint8_t* id);
+int store_delete(Store* s, const uint8_t* id);
+int store_stats(Store* s, uint64_t* out4);
+void* store_data_server_start(Store* s, int port, int* out_port);
+int store_data_server_stop(void* h);
+// rpc C API (rpc_core.cc)
+void rpc_buf_free(char* buf);
+void* rpc_cl_connect(const char* host, int port, int timeout_ms);
+int rpc_cl_send(void* h, int kind, long long seq, const char* buf,
+                size_t len, int expect_sync);
+int rpc_cl_wait(void* h, long long seq, int timeout_ms, char** out,
+                size_t* out_len);
+void rpc_cl_close(void* h);
+void* rpc_sv_start(const char* host, int port);
+int rpc_sv_port(void* h);
+int rpc_sv_next(void* h, int timeout_ms, unsigned long long* conn_id,
+                int* kind, long long* seq, char** out, size_t* out_len);
+int rpc_sv_send(void* h, unsigned long long conn_id, int kind,
+                long long seq, const char* buf, size_t len);
+void rpc_sv_stop(void* h);
+}
+
+namespace {
+
+uint64_t splitmix(uint64_t& x) {
+  x += 0x9E3779B97F4A7C15ull;
+  uint64_t z = x;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+void make_id(uint64_t a, uint64_t b, uint8_t* out) {
+  memcpy(out, &a, 8);
+  memcpy(out + 8, &b, 8);
+}
+
+// ---- store stress ----------------------------------------------------------
+
+void store_worker(Store* s, int tid, int iters, std::atomic<int>* errors) {
+  uint64_t rng = 0xC0FFEE + tid;
+  for (int i = 0; i < iters; i++) {
+    uint8_t id[16];
+    make_id(tid, splitmix(rng) % 64, id);
+    uint64_t size = 64 + splitmix(rng) % 8192;
+    void* ptr = nullptr;
+    int rc = store_create_object(s, id, size, &ptr);
+    if (rc == 0) {
+      memset(ptr, static_cast<int>(size & 0xFF), size);
+      if (store_seal(s, id) != 0) errors->fetch_add(1);
+    }
+    void* got = nullptr;
+    uint64_t got_size = 0;
+    if (store_get(s, id, &got, &got_size) == 0) {
+      // validate a sample byte while pinned (races with eviction would
+      // show as tsan findings or wrong bytes)
+      volatile uint8_t v = static_cast<uint8_t*>(got)[got_size / 2];
+      if (v != static_cast<uint8_t>(got_size & 0xFF)) errors->fetch_add(1);
+      store_release(s, id);
+    }
+    if (splitmix(rng) % 7 == 0) store_delete(s, id);
+    if (splitmix(rng) % 31 == 0) {
+      // eviction pressure: big object forces the LRU loop
+      uint8_t big[16];
+      make_id(0xB16, tid, big);
+      void* bp = nullptr;
+      if (store_create_object(s, big, 512 * 1024, &bp) == 0) {
+        store_seal(s, big);
+        store_delete(s, big);
+      }
+    }
+  }
+}
+
+int run_store(int iters) {
+  char name[64];
+  snprintf(name, sizeof(name), "stress-%d", getpid());
+  Store* s = store_create(name, 8 * 1024 * 1024, 4096);
+  if (!s) {
+    fprintf(stderr, "store_create failed\n");
+    return 1;
+  }
+  std::atomic<int> errors{0};
+  // in-process threads
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; t++)
+    ts.emplace_back(store_worker, s, t, iters, &errors);
+  // cross-process contention: forked children attach by name (the
+  // robust-mutex + shared free-list paths)
+  std::vector<pid_t> kids;
+  for (int p = 0; p < 2; p++) {
+    pid_t pid = fork();
+    if (pid == 0) {
+      Store* cs = store_connect(name);
+      if (!cs) _exit(2);
+      std::atomic<int> cerr{0};
+      store_worker(cs, 100 + p, iters, &cerr);
+      store_disconnect(cs);
+      _exit(cerr.load() ? 3 : 0);
+    }
+    kids.push_back(pid);
+  }
+  for (auto& t : ts) t.join();
+  int fail = 0;
+  for (pid_t pid : kids) {
+    int st = 0;
+    waitpid(pid, &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) fail++;
+  }
+  uint64_t out4[4];
+  store_stats(s, out4);
+  fprintf(stderr, "store: objects=%llu used=%llu evictions=%llu "
+          "errors=%d child_fail=%d\n",
+          (unsigned long long)out4[0], (unsigned long long)out4[1],
+          (unsigned long long)out4[3], errors.load(), fail);
+  store_destroy(s);
+  return (errors.load() || fail) ? 1 : 0;
+}
+
+// ---- rpc stress ------------------------------------------------------------
+
+int run_rpc(int iters) {
+  void* sv = rpc_sv_start("127.0.0.1", 0);
+  if (!sv) return 1;
+  int port = rpc_sv_port(sv);
+  std::atomic<bool> stop{false};
+  std::thread server([&] {
+    // echo loop: REQUEST (kind 0) -> REPLY (kind 1) with the same bytes
+    while (!stop.load()) {
+      unsigned long long conn = 0;
+      int kind = 0;
+      long long seq = 0;
+      char* buf = nullptr;
+      size_t len = 0;
+      int rc = rpc_sv_next(sv, 50, &conn, &kind, &seq, &buf, &len);
+      if (rc == 2) break;
+      if (rc != 0) continue;
+      if (kind == 0) rpc_sv_send(sv, conn, 1, seq, buf, len);
+      if (buf) rpc_buf_free(buf);
+    }
+  });
+  std::atomic<int> errors{0};
+  std::vector<std::thread> clients;
+  for (int c = 0; c < 6; c++) {
+    clients.emplace_back([&, c] {
+      void* cl = rpc_cl_connect("127.0.0.1", port, 30000);
+      if (!cl) {
+        errors.fetch_add(1);
+        return;
+      }
+      uint64_t rng = 0xABCD + c;
+      std::string payload;
+      for (int i = 1; i <= iters; i++) {
+        payload.assign(1 + splitmix(rng) % 70000,
+                       static_cast<char>('a' + (i % 26)));
+        if (rpc_cl_send(cl, 0, i, payload.data(), payload.size(), 1) != 0) {
+          fprintf(stderr, "client %d iter %d: send failed\n", c, i);
+          errors.fetch_add(1);
+          break;
+        }
+        char* out = nullptr;
+        size_t out_len = 0;
+        int wrc = rpc_cl_wait(cl, i, 120000, &out, &out_len);
+        if (wrc != 0 || out_len != payload.size() ||
+            memcmp(out, payload.data(), out_len) != 0) {
+          fprintf(stderr, "client %d iter %d: wait rc=%d len=%zu "
+                  "want=%zu\n", c, i, wrc, out_len, payload.size());
+          errors.fetch_add(1);
+          if (out) rpc_buf_free(out);
+          break;
+        }
+        rpc_buf_free(out);
+      }
+      rpc_cl_close(cl);
+    });
+  }
+  for (auto& t : clients) t.join();
+  stop.store(true);
+  rpc_sv_stop(sv);
+  server.join();
+  fprintf(stderr, "rpc: errors=%d\n", errors.load());
+  return errors.load() ? 1 : 0;
+}
+
+// ---- data-server stress ----------------------------------------------------
+
+bool pull_once(int port, const uint8_t* id, uint64_t offset,
+               uint64_t max_len, std::string* out) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return false;
+  }
+  uint8_t req[32];
+  memcpy(req, id, 16);
+  memcpy(req + 16, &offset, 8);
+  memcpy(req + 24, &max_len, 8);
+  auto wr = [&](const void* p, size_t n) {
+    const char* c = static_cast<const char*>(p);
+    while (n) {
+      ssize_t w = write(fd, c, n);
+      if (w <= 0) return false;
+      c += w;
+      n -= static_cast<size_t>(w);
+    }
+    return true;
+  };
+  auto rd = [&](void* p, size_t n) {
+    char* c = static_cast<char*>(p);
+    while (n) {
+      ssize_t r = read(fd, c, n);
+      if (r <= 0) return false;
+      c += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  };
+  bool ok = false;
+  uint64_t hdr[2];
+  if (wr(req, sizeof(req)) && rd(hdr, sizeof(hdr)) &&
+      hdr[0] != ~0ull) {
+    out->resize(hdr[1]);
+    ok = hdr[1] == 0 || rd(&(*out)[0], hdr[1]);
+  }
+  close(fd);
+  return ok;
+}
+
+int run_dataserver(int iters) {
+  char name[64];
+  snprintf(name, sizeof(name), "dstress-%d", getpid());
+  Store* s = store_create(name, 16 * 1024 * 1024, 1024);
+  if (!s) return 1;
+  int port = 0;
+  void* srv = store_data_server_start(s, 0, &port);
+  if (!srv) {
+    store_destroy(s);
+    return 1;
+  }
+  // seed objects
+  const int kObjects = 16;
+  for (int i = 0; i < kObjects; i++) {
+    uint8_t id[16];
+    make_id(0xDA7A, i, id);
+    void* ptr = nullptr;
+    uint64_t size = 4096 * (1 + i);
+    if (store_create_object(s, id, size, &ptr) == 0) {
+      memset(ptr, i, size);
+      store_seal(s, id);
+    }
+  }
+  std::atomic<int> errors{0};
+  std::vector<std::thread> pullers;
+  for (int c = 0; c < 4; c++) {
+    pullers.emplace_back([&, c] {
+      uint64_t rng = 0xD00D + c;
+      for (int i = 0; i < iters; i++) {
+        int oi = static_cast<int>(splitmix(rng) % kObjects);
+        uint8_t id[16];
+        make_id(0xDA7A, oi, id);
+        uint64_t size = 4096 * (1 + oi);
+        uint64_t off = splitmix(rng) % size;
+        std::string out;
+        if (pull_once(port, id, off, 2048, &out)) {
+          for (char ch : out)
+            if (static_cast<uint8_t>(ch) != oi) {
+              errors.fetch_add(1);
+              break;
+            }
+        }
+      }
+    });
+  }
+  // churn: rewrite objects while pulls stream (delete + recreate)
+  std::thread churn([&] {
+    uint64_t rng = 0xC4C4;
+    for (int i = 0; i < iters; i++) {
+      int oi = static_cast<int>(splitmix(rng) % kObjects);
+      uint8_t id[16];
+      make_id(0xDA7A, oi, id);
+      store_delete(s, id);
+      void* ptr = nullptr;
+      uint64_t size = 4096 * (1 + oi);
+      if (store_create_object(s, id, size, &ptr) == 0) {
+        memset(ptr, oi, size);
+        store_seal(s, id);
+      }
+    }
+  });
+  for (auto& t : pullers) t.join();
+  churn.join();
+  store_data_server_stop(srv);
+  fprintf(stderr, "dataserver: errors=%d\n", errors.load());
+  store_destroy(s);
+  return errors.load() ? 1 : 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s store|rpc|dataserver [iters]\n", argv[0]);
+    return 64;
+  }
+  int iters = argc > 2 ? atoi(argv[2]) : 2000;
+  std::string mode = argv[1];
+  if (mode == "store") return run_store(iters);
+  if (mode == "rpc") return run_rpc(iters);
+  if (mode == "dataserver") return run_dataserver(iters);
+  fprintf(stderr, "unknown mode %s\n", mode.c_str());
+  return 64;
+}
