@@ -69,6 +69,12 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "pubsub_max_mailbox": 1000,           # long-poll mailbox bound (drop-oldest)
     "pubsub_subscriber_timeout_s": 60.0,  # GC long-pollers gone this long
     "client_poll_slice_s": 60.0,          # ray:// get/wait re-poll granularity
+    "client_session_ttl_s": 60.0,         # ray:// reconnect grace: session
+                                          # state survives a dropped socket
+                                          # this long
+    "client_chunk_bytes": 4 * 1024 * 1024,  # ray:// get/put chunk size —
+                                          # bounds per-frame size on the
+                                          # shared client socket
     "event_log_max_bytes": 16 * 1024 * 1024,
     "metrics_report_interval_ms": 2_000,
     "log_to_driver": True,

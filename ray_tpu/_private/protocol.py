@@ -524,8 +524,11 @@ class ReconnectingRpcClient:
     HandleNotifyGCSRestart re-registration).
 
     Only safe for idempotent protocols — GCS table ops are (register_*
-    overwrite by id, kv_put overwrites, actor_started re-announces);
-    task submission is NOT and stays on plain RpcClient.
+    overwrite by id, kv_put overwrites, actor_started re-announces).
+    Non-idempotent ops may ride it ONLY when the server dedups them
+    (the ray:// client pairs every submit/put with a session req_id
+    the proxy caches); adding a new non-idempotent op without that
+    pairing reintroduces double-apply on retry.
     """
 
     def __init__(self, addr, timeout: float = 30.0, on_push=None,
@@ -570,6 +573,15 @@ class ReconnectingRpcClient:
         (e.g. actor_failed consumes restart budget: a retry after the
         server applied-then-died would double-charge it)."""
         return self._client.call(method, timeout=timeout, **kwargs)
+
+    def call_async(self, method: str, **kwargs):
+        """Async submit; the retry covers only a dead connection at
+        SUBMIT time — a future that later fails with ConnectionLost is
+        the caller's to handle (retrying it here could double-apply)."""
+        try:
+            return self._client.call_async(method, **kwargs)
+        except ConnectionLost:
+            return self._reconnect().call_async(method, **kwargs)
 
     def push(self, method: str, **kwargs):
         try:

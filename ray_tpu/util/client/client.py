@@ -16,7 +16,7 @@ import threading
 
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.object_ref import ObjectRef, ReferenceCounter
-from ray_tpu._private.protocol import RpcClient
+from ray_tpu._private.protocol import ReconnectingRpcClient
 
 
 def _poll_slice() -> float:
@@ -47,8 +47,23 @@ class ClientContext:
     mode = "client"
 
     def __init__(self, host: str, port: int):
+        import uuid as _uuid
+
         self.server_addr = (host, port)
-        self._rpc = RpcClient((host, port))
+        # session id survives reconnects: the server keeps pinned refs,
+        # in-flight chunk state, and the submit dedup cache alive for a
+        # grace window, so a dropped socket resumes instead of losing
+        # every outstanding ref (reference: client session resume)
+        self.session_id = f"cs-{_uuid.uuid4().hex}"
+        self._rpc = ReconnectingRpcClient(
+            (host, port),
+            on_reconnect=lambda raw: raw.call(
+                "client_hello", session_id=self.session_id))
+        hello = self._rpc.call("client_hello", session_id=self.session_id)
+        self._chunk_bytes = int(hello.get("chunk_bytes") or 4 * 1024 * 1024)
+        import itertools as _it
+
+        self._req_counter = _it.count(1)   # thread-safe id mint
         self.reference_counter = ReferenceCounter(on_zero=self._release)
         self.gcs = _GcsProxy(self)
         self._func_cache: dict = {}
@@ -80,12 +95,36 @@ class ClientContext:
 
         return cloudpickle.dumps((args, kwargs))
 
+    def _next_req_id(self) -> str:
+        return f"{self.session_id}:{next(self._req_counter)}"
+
     # ------------------------------------------------------------ object api
     def put(self, value) -> ObjectRef:
+        import uuid as _uuid
+
         import cloudpickle
 
         blob = cloudpickle.dumps(value)
-        ref_id, owner = self._rpc.call("client_put", blob=blob)
+        if len(blob) <= self._chunk_bytes:
+            ref_id, owner = self._rpc.call(
+                "client_put", blob=blob, req_id=self._next_req_id())
+        else:
+            # stream bounded chunks so this put can't head-of-line-block
+            # the shared socket with one giant frame; chunks carry their
+            # index (a reconnect replay overwrites, never duplicates) and
+            # the commit carries a req_id (a replayed commit returns the
+            # first put's ref instead of consuming an empty upload)
+            upload_id = f"u-{_uuid.uuid4().hex}"
+            view = memoryview(blob)
+            for i, off in enumerate(range(0, len(blob),
+                                          self._chunk_bytes)):
+                self._rpc.call("client_put_chunk", upload_id=upload_id,
+                               index=i,
+                               blob_part=bytes(
+                                   view[off:off + self._chunk_bytes]))
+            ref_id, owner = self._rpc.call("client_put",
+                                           upload_id=upload_id,
+                                           req_id=self._next_req_id())
         return ObjectRef(ref_id, owner, worker=self)
 
     def get(self, refs, timeout=None):
@@ -122,6 +161,26 @@ class ClientContext:
             except GetTimeoutError:
                 if timeout is not None:
                     raise
+        reply = blob
+        if isinstance(reply, dict) and "chunked" in reply:
+            # large value: pull bounded chunks (the server parked the
+            # serialized reply in the session). The caller's deadline
+            # bounds every chunk pull; without one, 120s per chunk.
+            import time as _time
+
+            deadline = (None if timeout is None
+                        else _time.time() + timeout)
+            get_id, n = reply["chunked"], reply["n_chunks"]
+            pieces = []
+            for i in range(n):
+                per_chunk = 120.0 if deadline is None else max(
+                    0.001, deadline - _time.time())
+                pieces.append(self._rpc.call(
+                    "client_get_chunk", get_id=get_id, index=i,
+                    last=(i == n - 1), timeout=per_chunk))
+            blob = b"".join(pieces)
+        elif isinstance(reply, dict):
+            blob = reply["blob"]
         values = pickle.loads(blob)
         return values[0] if single else values
 
@@ -152,20 +211,23 @@ class ClientContext:
     def submit_task(self, func_hash: bytes, args, kwargs, **options):
         pairs = self._rpc.call(
             "client_submit_task", func_hash=func_hash,
-            payload=self._dumps_args(args, kwargs), options=options)
+            payload=self._dumps_args(args, kwargs), options=options,
+            req_id=self._next_req_id())
         return [ObjectRef(i, owner, worker=self) for i, owner in pairs]
 
     def create_actor(self, class_hash: bytes, args, kwargs, *, options):
         return self._rpc.call(
             "client_create_actor", class_hash=class_hash,
-            payload=self._dumps_args(args, kwargs), options=options)
+            payload=self._dumps_args(args, kwargs), options=options,
+            req_id=self._next_req_id())
 
     def submit_actor_task(self, actor_id: bytes, method_name: str, args,
                           kwargs, **options):
         pairs = self._rpc.call(
             "client_submit_actor_task", actor_id=actor_id,
             method_name=method_name,
-            payload=self._dumps_args(args, kwargs), options=options)
+            payload=self._dumps_args(args, kwargs), options=options,
+            req_id=self._next_req_id())
         return [ObjectRef(i, owner, worker=self) for i, owner in pairs]
 
     def cancel_task(self, ref: ObjectRef, force: bool = False):

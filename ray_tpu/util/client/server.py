@@ -6,42 +6,125 @@ ONE endpoint instead of dialing GCS/raylets/peers directly. This server
 runs inside a process that is already a driver (``ray_tpu.init()`` done);
 every client op is executed against the local CoreWorker.
 
-Per-connection bookkeeping: every ObjectRef handed to a client is pinned
-in a per-connection registry so the cluster doesn't GC it while the remote
-client still holds it; the registry is dropped when the client releases
-the ref (its local refcount hit zero) or disconnects (socket EOF — the
-reference's client data channel tracks liveness the same way).
+Sessions, not connections (reference: the client's session-resume +
+reconnect grace): state is keyed by a client-generated session id the
+client presents in ``client_hello``. Pinned refs, chunk uploads, and
+the submit dedup cache survive a dropped socket for
+``client_session_ttl_s``; a reconnecting client resumes exactly where
+it was. Large values move in bounded chunks (``client_chunk_bytes``)
+so one giant get/put frame can't head-of-line-block the shared socket.
+Submit ops carry a client request id; replaying one (the client retried
+across a reconnect) returns the cached result instead of double-
+submitting (reference: client req-id dedup on the data channel).
 """
 from __future__ import annotations
 
 import hashlib
 import pickle
 import threading
+import time
 
 from ray_tpu._private.protocol import RpcServer
+
+
+def _ttl() -> float:
+    from ray_tpu._private.config import get_config
+
+    return float(get_config("client_session_ttl_s"))
+
+
+def _chunk_bytes() -> int:
+    from ray_tpu._private.config import get_config
+
+    return int(get_config("client_chunk_bytes"))
+
+
+class _Session:
+    __slots__ = ("pinned", "uploads", "downloads", "dedup",
+                 "disconnected_at", "current_conn")
+
+    def __init__(self):
+        self.pinned: dict[bytes, object] = {}   # ref_id -> ObjectRef
+        # upload_id -> (created_at, {index: chunk}) — keyed by index so
+        # a retried chunk (reconnect replay) overwrites, not duplicates
+        self.uploads: dict[str, tuple] = {}
+        # get_id -> (created_at, blob) — reclaimed by AGE, never on the
+        # last fetch (a retried last-chunk pull must still succeed)
+        self.downloads: dict[str, tuple] = {}
+        self.dedup: dict[str, object] = {}      # req_id -> cached reply
+        self.disconnected_at: float | None = None
+        self.current_conn: str | None = None    # latest bound conn.id
 
 
 class _ClientHandler:
     def __init__(self):
         self._lock = threading.Lock()
-        # conn.id -> {ref_id: ObjectRef}
-        self._pinned: dict[str, dict] = {}
+        self._sessions: dict[str, _Session] = {}
+        self._conn_session: dict[str, str] = {}   # conn.id -> session_id
+        self._sweeper = threading.Thread(target=self._sweep, daemon=True,
+                                         name="client-session-sweeper")
+        self._sweeper.start()
 
     # ------------------------------------------------------------ lifecycle
     def on_connect(self, conn):
-        with self._lock:
-            self._pinned[conn.id] = {}
+        pass   # state binds at client_hello, not connect
 
     def on_disconnect(self, conn):
         with self._lock:
-            self._pinned.pop(conn.id, None)
+            sid = self._conn_session.pop(conn.id, None)
+            if sid is not None:
+                session = self._sessions.get(sid)
+                # only the session's CURRENT connection starts the grace
+                # clock — the late EOF of a half-open predecessor must
+                # not condemn a session a newer connection is using
+                if session is not None and \
+                        session.current_conn == conn.id:
+                    session.disconnected_at = time.time()
+
+    def _sweep(self):
+        while True:
+            time.sleep(5.0)
+            cutoff = time.time() - _ttl()
+            with self._lock:
+                for sid in [s for s, ses in self._sessions.items()
+                            if ses.disconnected_at is not None
+                            and ses.disconnected_at < cutoff]:
+                    del self._sessions[sid]
+                # abandoned transfers leak whole serialized values if
+                # only session expiry reclaims them (a live session can
+                # abort a chunked get forever) — age them out too
+                for ses in self._sessions.values():
+                    for table in (ses.uploads, ses.downloads):
+                        for key in [k for k, (ts, _v) in table.items()
+                                    if ts < cutoff]:
+                            del table[key]
+
+    def rpc_client_hello(self, conn, session_id: str):
+        """Bind this connection to a (new or resumed) session."""
+        with self._lock:
+            session = self._sessions.get(session_id)
+            resumed = session is not None
+            if session is None:
+                session = self._sessions[session_id] = _Session()
+            session.disconnected_at = None
+            session.current_conn = conn.id
+            self._conn_session[conn.id] = session_id
+        return {"resumed": resumed, "chunk_bytes": _chunk_bytes()}
+
+    def _session(self, conn) -> _Session:
+        with self._lock:
+            sid = self._conn_session.get(conn.id)
+            session = self._sessions.get(sid) if sid else None
+        if session is None:
+            raise RuntimeError("client connection has no session "
+                               "(client_hello missing)")
+        return session
 
     def _pin(self, conn, refs):
+        session = self._session(conn)
         with self._lock:
-            store = self._pinned.get(conn.id)
-            if store is not None:
-                for r in refs:
-                    store[r.id] = r
+            for r in refs:
+                session.pinned[r.id] = r
 
     def _worker(self):
         from ray_tpu._private.worker_runtime import current_worker
@@ -51,12 +134,73 @@ class _ClientHandler:
             raise RuntimeError("client server host process lost its driver")
         return worker
 
-    # ------------------------------------------------------------------ ops
-    def rpc_client_put(self, conn, blob: bytes):
-        ref = self._worker().put(pickle.loads(blob))
-        self._pin(conn, [ref])
-        return ref.id, ref.owner_addr
+    def _deduped(self, conn, req_id, fn):
+        """Submit-op dedup: a retried request (client reconnected before
+        the reply landed) returns the FIRST submission's result. An
+        in-flight marker parks a replay that arrives WHILE the first is
+        still executing — without it the check-then-act window would
+        run fn() twice, the exact double-submit this exists to stop."""
+        session = self._session(conn)
+        if not req_id:
+            return fn()
+        while True:
+            with self._lock:
+                entry = session.dedup.get(req_id)
+                if entry is None:
+                    event = threading.Event()
+                    session.dedup[req_id] = ("pending", event)
+                    break
+                state, value = entry
+                if state == "done":
+                    return value
+            # a first submission is mid-flight: wait for its outcome
+            value.wait(timeout=300)
+        try:
+            result = fn()
+        except BaseException:
+            with self._lock:
+                session.dedup.pop(req_id, None)   # retry may re-run
+            event.set()
+            raise
+        with self._lock:
+            session.dedup[req_id] = ("done", result)
+            if len(session.dedup) > 4096:   # bound the cache
+                for k in [k for k, (st, _v) in list(session.dedup.items())
+                          if st == "done"][:1024]:
+                    del session.dedup[k]
+        event.set()
+        return result
 
+    # ------------------------------------------------------- chunked upload
+    def rpc_client_put_chunk(self, conn, upload_id: str, blob_part: bytes,
+                             index: int = 0):
+        session = self._session(conn)
+        with self._lock:
+            entry = session.uploads.get(upload_id)
+            if entry is None:
+                entry = session.uploads[upload_id] = (time.time(), {})
+            entry[1][index] = blob_part   # replay overwrites, no dup
+        return True
+
+    def rpc_client_put(self, conn, blob: bytes = None,
+                       upload_id: str = None, req_id: str = None):
+        session = self._session(conn)
+
+        def run():
+            payload = blob
+            if upload_id is not None:
+                with self._lock:
+                    _ts, chunks = session.uploads.pop(
+                        upload_id, (0, {}))
+                payload = b"".join(chunks[i]
+                                   for i in sorted(chunks))
+            ref = self._worker().put(pickle.loads(payload))
+            self._pin(conn, [ref])
+            return ref.id, ref.owner_addr
+
+        return self._deduped(conn, req_id, run)
+
+    # ----------------------------------------------------- chunked download
     def rpc_client_get(self, conn, ids: list, op_timeout):
         from ray_tpu._private.object_ref import ObjectRef
 
@@ -65,7 +209,33 @@ class _ClientHandler:
         worker = self._worker()
         refs = [ObjectRef(i, worker=worker) for i in ids]
         values = worker.get(refs, timeout=op_timeout)
-        return cloudpickle.dumps(values)
+        blob = cloudpickle.dumps(values)
+        limit = _chunk_bytes()
+        if len(blob) <= limit:
+            return {"blob": blob}
+        # large reply: park it in the session, hand back a chunk handle —
+        # the client pulls bounded pieces so this one get can't head-of-
+        # line-block every other op on the shared socket
+        session = self._session(conn)
+        get_id = f"g{id(blob)}_{time.time_ns()}"
+        with self._lock:
+            session.downloads[get_id] = (time.time(), bytes(blob))
+        n = (len(blob) + limit - 1) // limit
+        return {"chunked": get_id, "n_chunks": n, "total": len(blob)}
+
+    def rpc_client_get_chunk(self, conn, get_id: str, index: int,
+                             last: bool = False):
+        # NEVER deleted on the last fetch: a retried last-chunk pull
+        # (reply lost to a reconnect) must still succeed. The age
+        # sweeper reclaims the parked blob.
+        session = self._session(conn)
+        limit = _chunk_bytes()
+        with self._lock:
+            entry = session.downloads.get(get_id)
+            if entry is None:
+                raise RuntimeError(f"stale get handle {get_id}")
+            part = entry[1][index * limit:(index + 1) * limit]
+        return part
 
     def rpc_client_wait(self, conn, ids: list, num_returns: int, op_timeout,
                         fetch_local: bool):
@@ -78,6 +248,7 @@ class _ClientHandler:
                                   fetch_local=fetch_local)
         return [r.id for r in ready], [r.id for r in rest]
 
+    # ------------------------------------------------------------------ ops
     def rpc_client_register_function(self, conn, blob: bytes):
         worker = self._worker()
         func_hash = hashlib.sha1(blob).digest()
@@ -86,28 +257,39 @@ class _ClientHandler:
         return func_hash
 
     def rpc_client_submit_task(self, conn, func_hash: bytes, payload: bytes,
-                               options: dict):
-        args, kwargs = pickle.loads(payload)
-        refs = self._worker().submit_task(func_hash, args, kwargs, **options)
-        self._pin(conn, refs)
-        # id AND owner travel back: the client re-pickles refs into later
-        # task args, and dependency resolution needs the owner address
-        return [(r.id, r.owner_addr) for r in refs]
+                               options: dict, req_id: str = None):
+        def run():
+            args, kwargs = pickle.loads(payload)
+            refs = self._worker().submit_task(func_hash, args, kwargs,
+                                              **options)
+            self._pin(conn, refs)
+            # id AND owner travel back: the client re-pickles refs into
+            # later task args, and dependency resolution needs the owner
+            return [(r.id, r.owner_addr) for r in refs]
+
+        return self._deduped(conn, req_id, run)
 
     def rpc_client_create_actor(self, conn, class_hash: bytes,
-                                payload: bytes, options: dict):
-        args, kwargs = pickle.loads(payload)
-        return self._worker().create_actor(class_hash, args, kwargs,
-                                           options=options)
+                                payload: bytes, options: dict,
+                                req_id: str = None):
+        def run():
+            args, kwargs = pickle.loads(payload)
+            return self._worker().create_actor(class_hash, args, kwargs,
+                                               options=options)
+
+        return self._deduped(conn, req_id, run)
 
     def rpc_client_submit_actor_task(self, conn, actor_id: bytes,
                                      method_name: str, payload: bytes,
-                                     options: dict):
-        args, kwargs = pickle.loads(payload)
-        refs = self._worker().submit_actor_task(actor_id, method_name,
-                                                args, kwargs, **options)
-        self._pin(conn, refs)
-        return [(r.id, r.owner_addr) for r in refs]
+                                     options: dict, req_id: str = None):
+        def run():
+            args, kwargs = pickle.loads(payload)
+            refs = self._worker().submit_actor_task(actor_id, method_name,
+                                                    args, kwargs, **options)
+            self._pin(conn, refs)
+            return [(r.id, r.owner_addr) for r in refs]
+
+        return self._deduped(conn, req_id, run)
 
     def rpc_client_cancel(self, conn, ref_id: bytes, force: bool):
         from ray_tpu._private.object_ref import ObjectRef
@@ -135,11 +317,10 @@ class _ClientHandler:
         return timeline()
 
     def rpc_client_release(self, conn, ids: list):
+        session = self._session(conn)
         with self._lock:
-            store = self._pinned.get(conn.id)
-            if store is not None:
-                for i in ids:
-                    store.pop(i, None)
+            for i in ids:
+                session.pinned.pop(i, None)
 
 
 class ClientServer:
