@@ -8,8 +8,12 @@ from ray_tpu.data.dataset import (  # noqa: F401
     from_numpy,
     from_pandas,
     range,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
+    read_numpy,
     read_parquet,
     read_text,
 )
+from ray_tpu.data.random_access import RandomAccessDataset  # noqa: F401

@@ -532,6 +532,83 @@ class Dataset:
                 label = batch.pop(label_column)
                 yield batch, label
 
+    def to_random_access_dataset(self, key: str, *,
+                                 num_workers: int = 2):
+        """Distributed key→row point-lookup index over this dataset
+        (reference: random_access_dataset.py:23): sorted by `key`,
+        partitioned across serving actors, O(log n) gets."""
+        from ray_tpu.data.random_access import RandomAccessDataset
+
+        return RandomAccessDataset(self, key, num_workers=num_workers)
+
+    def to_tf(self, *, feature_columns=None, label_columns=None,
+              batch_size: int = 256, drop_last: bool = False):
+        """tf.data.Dataset over this dataset's batches (reference:
+        dataset.py:2959 to_tf). Columnar batches become (features,
+        labels) tensor tuples when label_columns is given, else feature
+        dicts; shapes/dtypes are inferred from the first batch so
+        tf.data gets a full output_signature (None leading dim)."""
+        import tensorflow as tf
+
+        # Infer the signature from the first batch WITHOUT recomputing
+        # it: the partially-consumed iterator continues on the first
+        # epoch, later epochs iterate fresh.
+        it0 = self.iter_batches(batch_size=batch_size,
+                                batch_format="numpy",
+                                drop_last=drop_last)
+        first_batch = next(iter(it0), None)
+        if first_batch is None:
+            raise ValueError("to_tf on an empty dataset")
+        first = self._tf_split(first_batch, feature_columns,
+                               label_columns)
+        leftover = [it0]
+
+        def gen():
+            if leftover:
+                rest = leftover.pop()
+                yield first
+                for batch in rest:
+                    yield self._tf_split(batch, feature_columns,
+                                         label_columns)
+                return
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy",
+                                           drop_last=drop_last):
+                yield self._tf_split(batch, feature_columns,
+                                     label_columns)
+
+        def sig_of(x):
+            if isinstance(x, dict):
+                return {k: sig_of(v) for k, v in x.items()}
+            return tf.TensorSpec(shape=(None,) + x.shape[1:],
+                                 dtype=tf.as_dtype(x.dtype))
+
+        signature = (sig_of(first) if not isinstance(first, tuple)
+                     else tuple(sig_of(p) for p in first))
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=signature)
+
+    @staticmethod
+    def _tf_split(batch, feature_columns, label_columns):
+        if not isinstance(batch, dict):
+            return batch
+        if label_columns is None:
+            if feature_columns is not None:
+                return {k: batch[k] for k in feature_columns}
+            return batch
+        labels = ({k: batch[k] for k in label_columns}
+                  if not isinstance(label_columns, str)
+                  else batch[label_columns])
+        feats = (feature_columns if feature_columns is not None
+                 else [k for k in batch
+                       if (k != label_columns
+                           if isinstance(label_columns, str)
+                           else k not in label_columns)])
+        features = {k: batch[k] for k in feats}
+        if len(features) == 1:
+            features = next(iter(features.values()))
+        return features, labels
+
     def _write_blocks(self, path: str, ext: str, write_one):
         """One output file per block, written by remote tasks (reference:
         data/datasource/file_based_datasource.py write path). One cached
@@ -587,6 +664,23 @@ class Dataset:
                     f.write(_json.dumps(row) + "\n")
 
         return self._write_blocks(path, "json", write_one)
+
+    def write_numpy(self, path: str, *, column: str | None = None) -> list:
+        """One .npy file per block (reference:
+        data/datasource/numpy_datasource.py write path). Columnar blocks
+        need `column=` naming which array to save; plain-array blocks
+        save directly."""
+        def write_one(block, out_path):
+            if isinstance(block, dict):
+                if column is None:
+                    raise ValueError(
+                        f"dataset has named columns {sorted(block)}; "
+                        f"pass column=...")
+                np.save(out_path, np.asarray(block[column]))
+            else:
+                np.save(out_path, np.asarray(block))
+
+        return self._write_blocks(path, "npy", write_one)
 
     def _numeric_partials(self, on=None):
         """Per-block (count, sum, min, max, mean, M2) partials via remote
@@ -851,6 +945,90 @@ def read_parquet(paths, *, parallelism: int = 4) -> Dataset:
     refs = []
     for g in gens:
         refs.extend(ray_tpu.get(g))
+    return Dataset(refs)
+
+
+def _chunk_list(items: list, parallelism: int) -> list[list]:
+    """Split items into at most `parallelism` contiguous non-empty
+    chunks (the shared fan-out shape of the file readers)."""
+    n = max(1, min(parallelism, len(items) or 1))
+    chunk = (len(items) + n - 1) // n
+    return [items[i * chunk:(i + 1) * chunk]
+            for i in builtins.range(n) if items[i * chunk:(i + 1) * chunk]]
+
+
+def read_numpy(paths, *, parallelism: int = 4) -> Dataset:
+    """.npy files loaded by remote tasks, one block per file but at
+    most `parallelism` tasks (reference:
+    data/datasource/numpy_datasource.py)."""
+    if isinstance(paths, str):
+        paths = [paths]
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def _load(batch):
+        for p in batch:
+            yield np.load(p)
+
+    refs = []
+    for gen in [_load.remote(b) for b in _chunk_list(paths, parallelism)]:
+        refs.extend(ray_tpu.get(gen))
+    return Dataset(refs)
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      parallelism: int = 4) -> Dataset:
+    """Raw file bytes, one row per file (reference:
+    data/datasource/binary_datasource.py). Rows are {"bytes": ...} (+
+    {"path": ...} with include_paths) so downstream map stages see the
+    same dict-row shape as other sources."""
+    if isinstance(paths, str):
+        paths = [paths]
+
+    @ray_tpu.remote
+    def _load(batch, with_paths):
+        rows = []
+        for p in batch:
+            with open(p, "rb") as f:
+                row = {"bytes": f.read()}
+            if with_paths:
+                row["path"] = p
+            rows.append(row)
+        return rows
+
+    refs = [_load.remote(batch, include_paths)
+            for batch in _chunk_list(paths, parallelism)]
+    return Dataset(refs)
+
+
+def read_images(paths, *, size: tuple | None = None,
+                mode: str | None = None,
+                include_paths: bool = False,
+                parallelism: int = 4) -> Dataset:
+    """Images → numpy arrays, decoded by remote tasks (reference:
+    data/datasource/image_datasource.py — PIL decode, optional resize/
+    mode convert). Rows are {"image": HxWxC uint8} (+ path)."""
+    if isinstance(paths, str):
+        paths = [paths]
+
+    @ray_tpu.remote
+    def _load(batch, sz, md, with_paths):
+        from PIL import Image
+
+        rows = []
+        for p in batch:
+            img = Image.open(p)
+            if md is not None:
+                img = img.convert(md)
+            if sz is not None:
+                img = img.resize(sz)
+            row = {"image": np.asarray(img)}
+            if with_paths:
+                row["path"] = p
+            rows.append(row)
+        return rows
+
+    refs = [_load.remote(batch, size, mode, include_paths)
+            for batch in _chunk_list(paths, parallelism)]
     return Dataset(refs)
 
 

@@ -1,0 +1,118 @@
+"""RandomAccessDataset — distributed key→row point lookups.
+
+Reference: python/ray/data/random_access_dataset.py:23 — sort the
+dataset by a key column, partition the sorted blocks across N serving
+actors, and resolve get(key) by binary-searching the block-boundary
+index to the owning actor, which binary-searches inside its block.
+O(log n) per lookup, horizontally scaled by num_workers.
+"""
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+import ray_tpu
+
+
+class _AccessShard:
+    """Serving actor holding a contiguous run of sorted blocks. Receives
+    block REFS and fetches them itself — the driver never materializes
+    the dataset (reference: the serving actors own their blocks)."""
+
+    def __init__(self, block_refs: list, key: str):
+        self.key = key
+        from ray_tpu.data import block as B
+
+        blocks = [ray_tpu.get(r) for r in block_refs]
+        merged = B.concat_blocks(blocks) if len(blocks) > 1 else blocks[0]
+        self.cols = B.to_numpy_batch(merged)
+        self.keys = np.asarray(self.cols[key])
+
+    def first_key(self):
+        if len(self.keys) == 0:
+            return None   # all-empty sort ranges: driver drops the shard
+        return self.keys[0].item() if hasattr(self.keys[0], "item") \
+            else self.keys[0]
+
+    def multiget(self, keys: list) -> list:
+        out = []
+        for k in keys:
+            i = int(np.searchsorted(self.keys, k))
+            if i < len(self.keys) and self.keys[i] == k:
+                out.append({c: v[i].item() if hasattr(v[i], "item")
+                            else v[i]
+                            for c, v in self.cols.items()})
+            else:
+                out.append(None)
+        return out
+
+    def stats(self) -> dict:
+        return {"rows": int(len(self.keys))}
+
+
+class RandomAccessDataset:
+    """Created via ``Dataset.to_random_access_dataset(key,
+    num_workers=N)``."""
+
+    def __init__(self, dataset, key: str, num_workers: int = 2):
+        self.key = key
+        sorted_ds = dataset.sort(key=key)
+        refs = list(sorted_ds._materialized_refs())
+        if not refs:
+            raise ValueError("cannot index an empty dataset")
+        n = max(1, min(num_workers, len(refs)))
+        per = (len(refs) + n - 1) // n
+        shard_cls = ray_tpu.remote(_AccessShard)
+        # refs travel; each shard pulls its own blocks from the store —
+        # the driver holds O(num_workers) metadata, not the dataset
+        self._shards = [
+            shard_cls.options(num_cpus=0).remote(refs[i:i + per], key)
+            for i in range(0, len(refs), per)
+        ]
+        bounds = ray_tpu.get(
+            [s.first_key.remote() for s in self._shards], timeout=600)
+        live = [(b, s) for b, s in zip(bounds, self._shards)
+                if b is not None]
+        if not live:
+            raise ValueError("cannot index an empty dataset")
+        self._lower_bounds = [b for b, _s in live]
+        self._shards = [s for _b, s in live]
+
+    def _shard_for(self, key) -> int:
+        i = bisect.bisect_right(self._lower_bounds, key) - 1
+        return max(0, i)
+
+    def get_async(self, key):
+        """ObjectRef resolving to the row dict, or None if absent."""
+        shard = self._shards[self._shard_for(key)]
+        return _first.remote(shard.multiget.remote([key]))
+
+    def get(self, key):
+        return ray_tpu.get(self.get_async(key))
+
+    def multiget(self, keys: list) -> list:
+        """Batched lookups, one RPC per shard touched (reference:
+        random_access_dataset.py:142)."""
+        by_shard: dict[int, list] = {}
+        order: list[tuple[int, int]] = []   # (shard, idx-in-shard-batch)
+        for k in keys:
+            s = self._shard_for(k)
+            batch = by_shard.setdefault(s, [])
+            order.append((s, len(batch)))
+            batch.append(k)
+        results = {
+            s: ray_tpu.get(self._shards[s].multiget.remote(batch),
+                           timeout=300)
+            for s, batch in by_shard.items()
+        }
+        return [results[s][i] for s, i in order]
+
+    def stats(self) -> list[dict]:
+        return ray_tpu.get([s.stats.remote() for s in self._shards],
+                           timeout=300)
+
+
+@ray_tpu.remote(num_cpus=0)
+def _first(values):
+    return values[0]

@@ -61,9 +61,13 @@ class _ClientHandler:
         self._lock = threading.Lock()
         self._sessions: dict[str, _Session] = {}
         self._conn_session: dict[str, str] = {}   # conn.id -> session_id
+        self._stop = threading.Event()
         self._sweeper = threading.Thread(target=self._sweep, daemon=True,
                                          name="client-session-sweeper")
         self._sweeper.start()
+
+    def shutdown(self):
+        self._stop.set()
 
     # ------------------------------------------------------------ lifecycle
     def on_connect(self, conn):
@@ -82,8 +86,7 @@ class _ClientHandler:
                     session.disconnected_at = time.time()
 
     def _sweep(self):
-        while True:
-            time.sleep(5.0)
+        while not self._stop.wait(5.0):
             cutoff = time.time() - _ttl()
             with self._lock:
                 for sid in [s for s, ses in self._sessions.items()
@@ -178,7 +181,10 @@ class _ClientHandler:
         with self._lock:
             entry = session.uploads.get(upload_id)
             if entry is None:
-                entry = session.uploads[upload_id] = (time.time(), {})
+                entry = (time.time(), {})
+            # refresh the age stamp on EVERY chunk: a slow multi-minute
+            # transfer must not be swept mid-flight
+            session.uploads[upload_id] = (time.time(), entry[1])
             entry[1][index] = blob_part   # replay overwrites, no dup
         return True
 
@@ -234,6 +240,8 @@ class _ClientHandler:
             entry = session.downloads.get(get_id)
             if entry is None:
                 raise RuntimeError(f"stale get handle {get_id}")
+            # refresh on touch: a long pull outlives the TTL legitimately
+            session.downloads[get_id] = (time.time(), entry[1])
             part = entry[1][index * limit:(index + 1) * limit]
         return part
 
@@ -329,7 +337,8 @@ class ClientServer:
     ``ray_tpu.init(address="ray://host:port")``."""
 
     def __init__(self, port: int = 10001, host: str = "0.0.0.0"):
-        self._server = RpcServer(_ClientHandler(), host=host, port=port)
+        self._handler = _ClientHandler()
+        self._server = RpcServer(self._handler, host=host, port=port)
 
     @property
     def addr(self):
@@ -340,6 +349,7 @@ class ClientServer:
         return self
 
     def stop(self):
+        self._handler.shutdown()   # the sweeper must die with the server
         self._server.stop()
 
 
