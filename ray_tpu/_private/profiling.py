@@ -18,25 +18,51 @@ _MAX_EVENTS = 10_000
 _lock = threading.Lock()
 _events: collections.deque = collections.deque(maxlen=_MAX_EVENTS)
 
+# Collection defaults ON (ray_tpu.timeline() works out of the box, like
+# the reference's profiling events); RAY_TPU_TIMELINE=0 removes the
+# per-task dict+lock cost on latency-critical deployments.
+_ENABLED = os.environ.get("RAY_TPU_TIMELINE", "1") != "0"
 
-@contextlib.contextmanager
-def record_span(category: str, name: str, extra: dict | None = None):
-    start = time.time()
-    try:
-        yield
-    finally:
+
+class _SpanCM:
+    """Hand-rolled context manager: ~3µs cheaper per task than the
+    generator-based contextlib version, and this runs TWICE per task
+    on the execute hot path."""
+
+    __slots__ = ("cat", "name", "extra", "start")
+
+    def __init__(self, category, name, extra):
+        self.cat = category
+        self.name = name
+        self.extra = extra
+
+    def __enter__(self):
+        self.start = time.time()
+        return None
+
+    def __exit__(self, *exc):
         end = time.time()
         with _lock:
             _events.append({
-                "cat": category,
-                "name": name,
+                "cat": self.cat,
+                "name": self.name,
                 "pid": os.getpid(),
                 "tid": threading.get_ident() % 2**31,
-                "ts": int(start * 1e6),     # microseconds, chrome format
-                "dur": int((end - start) * 1e6),
+                "ts": int(self.start * 1e6),   # µs, chrome format
+                "dur": int((end - self.start) * 1e6),
                 "ph": "X",
-                "args": extra or {},
+                "args": self.extra or {},
             })
+        return False
+
+
+_NULL_CM = contextlib.nullcontext()
+
+
+def record_span(category: str, name: str, extra: dict | None = None):
+    if not _ENABLED:
+        return _NULL_CM
+    return _SpanCM(category, name, extra)
 
 
 def snapshot() -> list[dict]:

@@ -99,6 +99,16 @@ def main(min_time: float = 1.0):
            lambda: ray_tpu.get([noop_arg.remote(obj) for _ in range(20)]),
            multiplier=20, min_time=min_time, results=results)
 
+    @ray_tpu.remote(num_cpus=0, max_retries=0, inline_exec=True)
+    def noop_arg_inline(x):
+        return None
+
+    ray_tpu.get(noop_arg_inline.remote(obj))
+    timeit("single client tasks with object ref arg (inline exec)",
+           lambda: ray_tpu.get(
+               [noop_arg_inline.remote(obj) for _ in range(20)]),
+           multiplier=20, min_time=min_time, results=results)
+
     # --- actors -----------------------------------------------------------
     a = Sink.remote()
     ray_tpu.get(a.ping.remote())

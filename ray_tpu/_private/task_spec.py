@@ -30,6 +30,8 @@ class TaskSpec(TypedDict, total=False):
     job_id: int
     runtime_env: dict            # normalized (content keys, not paths)
     inline_exec: bool            # pump-safe: execute on the transport pump
+    inlined: dict                # {ref_id: frame bytes} for small resolved
+                                 # args (executor skips the owner round trip)
     dynamic_returns: bool        # num_returns="dynamic"/"streaming": the
                                  # task yields items, each its own object
     trace_ctx: dict              # {"trace_id", "parent_span_id"}
@@ -76,11 +78,12 @@ def validate_task_spec(spec: dict[str, Any], *, actor: bool = False):
         raise ValueError(
             f"task spec missing required keys {sorted(missing)} "
             f"(schema: _private/task_spec.py)")
-    unknown = {
-        k for k in spec
-        if not k.startswith(LOCAL_KEY_PREFIX)
-        and k not in _DECLARED_KEYS
-    }
+    # set-difference FIRST: the per-key startswith loop only runs over
+    # leftovers, which are empty for every well-formed spec (hot path)
+    unknown = spec.keys() - _DECLARED_KEYS
+    if unknown:
+        unknown = {k for k in unknown
+                   if not k.startswith(LOCAL_KEY_PREFIX)}
     if unknown:
         raise ValueError(
             f"task spec carries undeclared keys {sorted(unknown)} — "

@@ -136,8 +136,9 @@ class MemoryStore:
             return entry
 
     def put(self, object_id: bytes, data: bytes):
-        self.entry(object_id).data = data
-        self.entry(object_id).event.set()
+        entry = self.entry(object_id)   # ONE lock round, not two
+        entry.data = data
+        entry.event.set()
 
     def get_nowait(self, object_id: bytes):
         with self._lock:
@@ -660,6 +661,15 @@ class CoreWorker:
         self._task_futures: dict[bytes, PyFuture] = {}
         self._ref_to_task: dict[bytes, tuple] = {}  # rid -> (spec, queue)
         self._gen_streams: dict[bytes, _GenStream] = {}  # gen_id -> stream
+        # rid -> (frame bytes, inlinable?) for small resolved args
+        # (invalidated on ref-zero with the other per-object state)
+        self._inline_frame_cache: dict[bytes, tuple] = {}
+        # executor-side twin: rid -> deserialized value for inlined arg
+        # frames. Only IMMUTABLE values enter (numpy arrays are marked
+        # read-only first — the store's own zero-copy semantics), so
+        # sharing one object across tasks is safe. Objects are immutable
+        # by id, so entries never go stale; a size cap bounds memory.
+        self._inlined_value_cache: dict[bytes, object] = {}
         # Lineage for object reconstruction (reference:
         # core_worker/object_recovery_manager.h:30 + task_manager.h:93-110
         # lineage pinning): completed normal-task specs are retained, keyed
@@ -753,7 +763,11 @@ class CoreWorker:
         pass  # subscriptions are registered lazily where needed
 
     def _strip_spec(self, spec: dict) -> dict:
-        return {k: v for k, v in spec.items() if not k.startswith("_")}
+        for k in spec:
+            if k[0] == "_":
+                return {k: v for k, v in spec.items()
+                        if not k.startswith("_")}
+        return spec   # nothing local: ship as-is (no dict rebuild)
 
     def _cluster_cpu_total(self) -> float:
         """Sum of CPU across alive nodes, cached for 10 s (feeds the
@@ -1034,6 +1048,7 @@ class CoreWorker:
         with self._lock:
             task_entry = self._ref_to_task.pop(object_id, None)
             gen_stream = self._gen_streams.pop(object_id, None)
+            self._inline_frame_cache.pop(object_id, None)
             owned = object_id in self._owned
             self._owned.discard(object_id)
             tid = self._lineage_index.pop(object_id, None)
@@ -1157,8 +1172,14 @@ class CoreWorker:
         q.submit(spec)
         return True
 
-    def _pin_args(self, spec: dict, args, kwargs):
-        ids = [r.id for r in ser.contained_refs((args, kwargs))]
+    def _pin_args(self, spec: dict, args=None, kwargs=None, *, refs=None,
+                  skip=None):
+        if refs is None:
+            if not args and not kwargs:
+                return
+            refs = ser.contained_refs((args, kwargs))
+        ids = [r.id for r in refs
+               if skip is None or r.id not in skip]
         if not ids:
             return
         spec["_arg_ids"] = ids   # stripped before the wire (leading _)
@@ -1864,11 +1885,18 @@ class CoreWorker:
         dynamic = num_returns in ("dynamic", "streaming")
         return_ids = [self._new_id()
                       for _ in range(1 if dynamic else num_returns)]
-        args, kwargs = self._inline_small_args(args, kwargs)
+        inlined = None
+        arg_refs = ()
+        if args or kwargs:
+            args, kwargs, inlined = self._inline_small_args(args, kwargs)
+            args_blob = ser.serialize((args, kwargs))
+            arg_refs = ser.contained_refs((args, kwargs))   # walked ONCE
+        else:
+            args_blob = ser.serialize_empty_args()   # constant, cached
         spec = {
             "task_id": self._new_id(),
             "func_hash": func_hash,
-            "args": ser.serialize((args, kwargs)),
+            "args": args_blob,
             "return_ids": return_ids,
             "owner_addr": self.addr,
             "retries_left": max_retries,
@@ -1880,6 +1908,8 @@ class CoreWorker:
             "task_desc": task_desc,
             "job_id": self.job_id,
         }
+        if inlined:
+            spec["inlined"] = inlined
         if runtime_env:
             spec["runtime_env"] = runtime_env
         if dynamic:
@@ -1887,7 +1917,7 @@ class CoreWorker:
             with self._lock:
                 self._gen_streams[return_ids[0]] = _GenStream()
         if inline_exec and not runtime_env and not dynamic and \
-                not ser.contained_refs((args, kwargs)):
+                all(r.id in (inlined or ()) for r in arg_refs):
             # Only pump-safe if no arg resolution can block: a ref that
             # survived small-arg inlining would make the pump fetch it
             # (possibly a cross-node transfer) mid-dispatch. Such tasks
@@ -1901,7 +1931,9 @@ class CoreWorker:
 
         validate_task_spec(spec)
         with tracing.submit_span(spec, task_desc):
-            self._pin_args(spec, args, kwargs)
+            # refs whose bytes ride the spec need no pin: the task no
+            # longer depends on the object outliving the submission
+            self._pin_args(spec, refs=arg_refs, skip=inlined)
             self._owned.update(return_ids)
             refs = [ObjectRef(rid, self.addr, self) for rid in return_ids]
             for rid in return_ids:
@@ -1922,18 +1954,30 @@ class CoreWorker:
         return refs
 
     def _inline_small_args(self, args, kwargs):
-        """Replace top-level ObjectRef args whose values WE own, already
-        resolved and small, with the values themselves (reference:
+        """Attach the serialized bytes of small, locally-resolved
+        top-level ObjectRef args to the spec (reference:
         transport/dependency_resolver.h — the local dependency resolver
         inlines small args into the TaskSpec, sparing the executor an
-        owner round trip per task). Error payloads are never inlined:
+        owner round trip per task). The refs STAY in the arg tree and
+        the bytes ride out-of-band in spec["inlined"]: the producer
+        never deserializes-then-reserializes the value per submit (the
+        old form cost a full pickle round per task for a repeated
+        ref-arg — profiled round 5), and the executor deserializes the
+        attached frame exactly once. Error payloads are never inlined:
         getting them must raise on the executor."""
         from ray_tpu._private.config import get_config
 
         limit = int(get_config("inline_object_max_size_bytes"))
+        inlined: dict[bytes, bytes] = {}
 
         def maybe(v):
             if not isinstance(v, ObjectRef):
+                return v
+            cached = self._inline_frame_cache.get(v.id)
+            if cached is not None:
+                data, ok = cached
+                if ok:
+                    inlined[v.id] = data
                 return v
             data = self.memory_store.get_nowait(v.id)
             if data is None:
@@ -1942,31 +1986,33 @@ class CoreWorker:
                     try:
                         if len(buf) <= limit:
                             data = buf.to_bytes()
-                            # heap-cache the inlined bytes: passing the
-                            # same small ref to many tasks otherwise pays
-                            # a shm probe (C lock + spill stat) per
-                            # SUBMIT. Freed by the normal ref-zero path.
+                            # heap-cache: repeat submits of the same
+                            # small ref must not pay a shm probe each
+                            # (C lock + spill stat). Freed by ref-zero.
                             if self.reference_counter.count(v.id) > 0:
                                 self.memory_store.put(v.id, data)
                     finally:
                         buf.release()
             if data is None or len(data) > limit:
                 return v
+            # one-time verdict: error payloads must NOT inline (the
+            # executor's get must raise). Cached so repeat submits skip
+            # the meta parse.
             try:
-                value, meta = ser.deserialize(data, self, with_meta=True)
+                _value, meta = ser.deserialize(data, self, with_meta=True)
+                ok = not meta.get("raised")
             except Exception:
-                return v
-            if meta.get("raised"):
-                return v
-            if isinstance(value, ObjectRef):
-                # inlining would PROMOTE the inner ref to a top-level arg,
-                # which the executor auto-resolves — the task would receive
-                # the inner value instead of the ObjectRef
-                return v
-            return value
+                ok = False
+            data = bytes(data) if not isinstance(data, bytes) else data
+            if self.reference_counter.count(v.id) > 0:
+                self._inline_frame_cache[v.id] = (data, ok)
+            if ok:
+                inlined[v.id] = data
+            return v
 
-        return ([maybe(a) for a in args],
-                {k: maybe(v) for k, v in kwargs.items()})
+        args = [maybe(a) for a in args]
+        kwargs = {k: maybe(v) for k, v in kwargs.items()}
+        return args, kwargs, inlined
 
     def cancel_task(self, ref: ObjectRef, force: bool = False):
         """Best-effort cancel of the normal task producing `ref` (reference:
@@ -2091,7 +2137,15 @@ class CoreWorker:
             # BEFORE lineage retention: extends return_ids with the item
             # ids so reconstruction covers every streamed object
             self._finalize_gen(spec, reply)
-        self._retain_lineage(spec)
+        if spec.get("reconstructions_left", 0) > 0 or \
+                spec["task_id"] in self._lineage_specs:
+            # second clause: a reconstruction that just spent its LAST
+            # budget unit replies here with the spec already retained —
+            # _retain_lineage's in-table guard must run, not an unpin
+            # (the pins belong to the lineage entry)
+            self._retain_lineage(spec)
+        else:
+            self._unpin_args(spec)   # never retained: release arg pins now
         results = reply.get("results", {})
         for rid, data in results.items():
             # fire-and-forget: if every ref was dropped while the task was in
@@ -2341,10 +2395,40 @@ class CoreWorker:
             self._main_loop_running = False
 
     def _resolve_args(self, spec):
-        args, kwargs = ser.deserialize(spec["args"], self)
-        args = [self.get(a) if isinstance(a, ObjectRef) else a for a in args]
-        kwargs = {k: self.get(v) if isinstance(v, ObjectRef) else v
-                  for k, v in kwargs.items()}
+        blob = spec["args"]
+        if blob == ser.serialize_empty_args():
+            return (), {}        # constant no-arg frame: skip the parse
+        inlined = spec.get("inlined")
+        args, kwargs = ser.deserialize(blob, self)
+
+        def resolve(v):
+            if not isinstance(v, ObjectRef):
+                return v
+            if inlined is not None:
+                data = inlined.get(v.id)
+                if data is not None:
+                    cached = self._inlined_value_cache.get(v.id)
+                    if cached is not None:
+                        return cached
+                    value = ser.deserialize(data, self)
+                    import numpy as _np
+
+                    if isinstance(value, _np.ndarray):
+                        value.setflags(write=False)   # plasma semantics
+                        cacheable = True
+                    else:
+                        cacheable = isinstance(
+                            value, (int, float, bool, str, bytes,
+                                    type(None)))
+                    if cacheable:
+                        if len(self._inlined_value_cache) > 1024:
+                            self._inlined_value_cache.clear()
+                        self._inlined_value_cache[v.id] = value
+                    return value
+            return self.get(v)
+
+        args = [resolve(a) for a in args]
+        kwargs = {k: resolve(v) for k, v in kwargs.items()}
         return args, kwargs
 
     def _execute_normal_task(self, spec: dict) -> dict:
@@ -2369,20 +2453,31 @@ class CoreWorker:
         self._current_task_thread = \
             threading.get_ident() if interruptible else None
         self._current_task_started = time.time()   # OOM victim ranking
+        import contextlib
+
         from ray_tpu._private.profiling import record_span
 
         try:
             from ray_tpu.util import tracing
 
-            # tracing.span no-ops when no ctx arrived and tracing is
-            # off in this process — no guard needed
+            # skip the span generator entirely when no trace context
+            # arrived and tracing is off here — two context managers per
+            # task are measurable on the sync hot path
+            if spec.get("trace_ctx") is None and not tracing.is_enabled():
+                trace_cm = contextlib.nullcontext()
+            else:
+                trace_cm = tracing.span(
+                    f"execute {spec.get('task_desc', 'task')}",
+                    "CONSUMER", spec.get("trace_ctx"),
+                    {"task_id": task_id.hex()})
             with record_span("task", spec.get("task_desc", "task"),
-                             {"task_id": task_id.hex()}), \
-                 tracing.span(
-                     f"execute {spec.get('task_desc', 'task')}",
-                     "CONSUMER", spec.get("trace_ctx"),
-                     {"task_id": task_id.hex()}):
-                self._apply_runtime_env(spec.get("runtime_env"))
+                             {"task_id": task_id.hex()}), trace_cm:
+                if "runtime_env" in spec or \
+                        getattr(self, "_env_applied_key", None) is not None:
+                    # the second clause REVERTS a previous task's overlay
+                    # (env_vars/cwd/sys.path + pip-cache refcount) when
+                    # this env-less task reuses the worker
+                    self._apply_runtime_env(spec.get("runtime_env"))
                 fn = self._load_function(spec["func_hash"])
                 args, kwargs = self._resolve_args(spec)
                 result = fn(*args, **kwargs)
@@ -2544,6 +2639,9 @@ class CoreWorker:
         stored: list[bytes] = []
         sizes: dict[bytes, int] = {}
         for rid, value in zip(spec["return_ids"], values):
+            if value is None:
+                inline[rid] = ser.serialize_none()   # cached frame
+                continue
             parts = ser.serialize_parts(value)
             size = ser.parts_size(parts)
             if size <= INLINE_RESULT_LIMIT:

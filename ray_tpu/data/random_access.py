@@ -47,6 +47,9 @@ class _AccessShard:
                 out.append(None)
         return out
 
+    def get(self, key):
+        return self.multiget([key])[0]
+
     def stats(self) -> dict:
         return {"rows": int(len(self.keys))}
 
@@ -86,7 +89,7 @@ class RandomAccessDataset:
     def get_async(self, key):
         """ObjectRef resolving to the row dict, or None if absent."""
         shard = self._shards[self._shard_for(key)]
-        return _first.remote(shard.multiget.remote([key]))
+        return shard.get.remote(key)
 
     def get(self, key):
         return ray_tpu.get(self.get_async(key))
@@ -101,18 +104,16 @@ class RandomAccessDataset:
             batch = by_shard.setdefault(s, [])
             order.append((s, len(batch)))
             batch.append(k)
-        results = {
-            s: ray_tpu.get(self._shards[s].multiget.remote(batch),
-                           timeout=300)
-            for s, batch in by_shard.items()
-        }
+        # submit every shard RPC first, gather ONCE: latency is the
+        # slowest shard, not the sum of shard round trips
+        refs = {s: self._shards[s].multiget.remote(batch)
+                for s, batch in by_shard.items()}
+        shard_ids = list(refs)
+        values = ray_tpu.get([refs[s] for s in shard_ids], timeout=300)
+        results = dict(zip(shard_ids, values))
         return [results[s][i] for s, i in order]
 
     def stats(self) -> list[dict]:
         return ray_tpu.get([s.stats.remote() for s in self._shards],
                            timeout=300)
 
-
-@ray_tpu.remote(num_cpus=0)
-def _first(values):
-    return values[0]
