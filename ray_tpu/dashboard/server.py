@@ -65,16 +65,12 @@ class DashboardServer:
                                              prometheus=True)
                 return self._send(h, 200, text.encode(), "text/plain")
             if path in ("/", "/index.html"):
-                routes = ["/api/nodes", "/api/actors", "/api/objects",
-                          "/api/tasks", "/api/workers",
-                          "/api/placement_groups", "/api/jobs",
-                          "/api/serve", "/api/cluster_status",
-                          "/api/memory", "/api/timeline", "/api/reporter",
-                          "/api/grafana_dashboard", "/metrics"]
-                body = "<html><body><h2>ray_tpu dashboard</h2><ul>" + "".join(
-                    f'<li><a href="{r}">{r}</a></li>' for r in routes
-                ) + "</ul></body></html>"
-                return self._send(h, 200, body.encode(), "text/html")
+                # the browsable UI (reference: dashboard/client React SPA
+                # — here one dependency-free page over the JSON routes)
+                from ray_tpu.dashboard.web_ui import INDEX_HTML
+
+                return self._send(h, 200, INDEX_HTML.encode(),
+                                  "text/html")
             if path == "/api/cluster_status":
                 payload = {"summary":
                            state.cluster_status(address=self.address)}

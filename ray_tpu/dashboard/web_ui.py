@@ -1,0 +1,156 @@
+"""Browsable dashboard UI — one static page over the JSON routes.
+
+Reference: dashboard/client/ (the React SPA). TPU-first minimalism: a
+single dependency-free HTML file rendered by the existing state API
+routes — tabs for overview/nodes/actors/tasks/workers/placement
+groups/objects/jobs/serve, auto-refresh, zero build tooling. Operators
+get a browsable view; machines keep the JSON routes.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray-tpu dashboard</title>
+<style>
+  :root { --bg:#0f1419; --panel:#171d24; --border:#2b3540; --fg:#d8e1e8;
+          --dim:#8a99a6; --accent:#4fb3ff; --ok:#4fd68a; --bad:#ff6b6b; }
+  * { box-sizing: border-box; }
+  body { margin:0; background:var(--bg); color:var(--fg);
+         font:14px/1.45 system-ui, sans-serif; }
+  header { display:flex; align-items:baseline; gap:16px;
+           padding:14px 20px; border-bottom:1px solid var(--border); }
+  header h1 { font-size:17px; margin:0; }
+  header .sub { color:var(--dim); font-size:12px; }
+  nav { display:flex; gap:4px; padding:8px 16px;
+        border-bottom:1px solid var(--border); flex-wrap:wrap; }
+  nav button { background:none; border:1px solid transparent;
+               color:var(--dim); padding:6px 12px; border-radius:6px;
+               cursor:pointer; font:inherit; }
+  nav button.active { color:var(--fg); border-color:var(--border);
+                      background:var(--panel); }
+  main { padding:16px 20px; }
+  pre.summary { background:var(--panel); border:1px solid var(--border);
+                border-radius:8px; padding:14px; overflow-x:auto; }
+  table { border-collapse:collapse; width:100%; background:var(--panel);
+          border:1px solid var(--border); border-radius:8px;
+          overflow:hidden; }
+  th, td { text-align:left; padding:7px 12px;
+           border-bottom:1px solid var(--border); font-size:13px;
+           max-width:420px; overflow:hidden; text-overflow:ellipsis;
+           white-space:nowrap; }
+  th { color:var(--dim); font-weight:600; background:#131920;
+       position:sticky; top:0; }
+  tr:last-child td { border-bottom:none; }
+  .ok { color:var(--ok); } .bad { color:var(--bad); }
+  .meta { color:var(--dim); font-size:12px; margin:10px 2px; }
+  .err { color:var(--bad); padding:12px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray-tpu</h1>
+  <span class="sub" id="refreshed"></span>
+  <span class="sub" style="margin-left:auto">
+    <a href="/metrics" style="color:var(--accent)">prometheus</a> &middot;
+    <a href="/api/timeline" style="color:var(--accent)">timeline</a> &middot;
+    <a href="/api/grafana_dashboard" style="color:var(--accent)">grafana</a>
+  </span>
+</header>
+<nav id="tabs"></nav>
+<main id="content"></main>
+<script>
+const TABS = [
+  {id:"overview", label:"Overview"},
+  {id:"nodes", label:"Nodes", api:"/api/nodes"},
+  {id:"actors", label:"Actors", api:"/api/actors"},
+  {id:"tasks", label:"Tasks", api:"/api/tasks"},
+  {id:"workers", label:"Workers", api:"/api/workers"},
+  {id:"pgs", label:"Placement groups", api:"/api/placement_groups"},
+  {id:"objects", label:"Objects", api:"/api/objects"},
+  {id:"jobs", label:"Jobs", api:"/api/jobs"},
+  {id:"serve", label:"Serve", api:"/api/serve"},
+];
+let current = location.hash.slice(1) || "overview";
+if (!TABS.some(t => t.id === current)) current = "overview";
+let renderGen = 0;   // staleness guard: only the newest render may paint
+
+function fmt(v) {
+  if (v === null || v === undefined) return "";
+  if (typeof v === "boolean") return v ? "yes" : "no";
+  if (typeof v === "object") return JSON.stringify(v);
+  return String(v);
+}
+function esc(s) {
+  return String(s).replace(/&/g, "&amp;").replace(/</g, "&lt;")
+                  .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
+}
+function cellClass(k, v) {
+  const s = String(v);
+  if (/^(ALIVE|CREATED|RUNNING|SUCCEEDED|yes|true)$/i.test(s)) return "ok";
+  if (/^(DEAD|FAILED|REMOVED|no|false)$/i.test(s)) return "bad";
+  return "";
+}
+function renderTable(rows) {
+  if (!Array.isArray(rows)) rows = rows ? [rows] : [];
+  if (!rows.length) return "<div class='meta'>nothing here</div>";
+  const cols = [...new Set(rows.flatMap(r => Object.keys(r)))];
+  let h = "<table><tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
+  for (const r of rows) {
+    h += "<tr>" + cols.map(c =>
+      `<td class="${cellClass(c, r[c])}" title="${esc(fmt(r[c]))}">${esc(fmt(r[c]))}</td>`
+    ).join("") + "</tr>";
+  }
+  return h + "</table><div class='meta'>" + rows.length + " row(s)</div>";
+}
+async function jget(url) {
+  const r = await fetch(url);
+  if (!r.ok) throw new Error(url + " -> " + r.status);
+  return r.json();
+}
+async function render() {
+  const el = document.getElementById("content");
+  const gen = ++renderGen;
+  try {
+    let html;
+    if (current === "overview") {
+      const [status, mem, reporter] = await Promise.all([
+        jget("/api/cluster_status"), jget("/api/memory"),
+        jget("/api/reporter").catch(() => []),
+      ]);
+      html =
+        "<pre class='summary'>" + esc(status.summary) + "</pre>" +
+        "<pre class='summary'>" + esc(mem.summary) + "</pre>" +
+        (Array.isArray(reporter) && reporter.length
+          ? "<h3>Per-node stats</h3>" + renderTable(reporter) : "");
+    } else {
+      const tab = TABS.find(t => t.id === current) || TABS[0];
+      html = renderTable(await jget(tab.api));
+    }
+    if (gen !== renderGen) return;   // a newer render superseded us
+    el.innerHTML = html;
+    document.getElementById("refreshed").textContent =
+      "refreshed " + new Date().toLocaleTimeString();
+  } catch (e) {
+    if (gen === renderGen) el.innerHTML = "<div class='err'>" + esc(e) + "</div>";
+  }
+}
+function drawTabs() {
+  document.getElementById("tabs").innerHTML = TABS.map(t =>
+    `<button class="${t.id === current ? 'active' : ''}"
+             onclick="go('${t.id}')">${t.label}</button>`).join("");
+}
+function go(id) {
+  if (!TABS.some(t => t.id === id)) id = "overview";
+  current = id; location.hash = id; drawTabs(); render();
+}
+window.addEventListener("hashchange", () => {
+  const id = location.hash.slice(1) || "overview";
+  if (id !== current) go(id);   // browser back/forward updates the view
+});
+drawTabs(); render();
+setInterval(render, 5000);
+</script>
+</body>
+</html>
+"""
