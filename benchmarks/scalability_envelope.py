@@ -23,6 +23,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # many_actors spawns every worker process at once; on a small host the
 # spawns serialize on the CPU, so give registration a generous budget
 os.environ.setdefault("RAY_TPU_WORKER_REGISTER_TIMEOUT_S", "600")
+# A wedged axon tunnel makes EVERY worker-process startup pay a slow
+# plugin registration (~2.2s vs ~0.3s healthy), so a 400-actor storm can
+# legitimately take ~15 min on the 1-core box — don't fail creations that
+# are queued behind a draining spawn queue.
+os.environ.setdefault("RAY_TPU_ACTOR_CREATION_RPC_TIMEOUT_S", "1200")
 
 
 def bench(name, fn):

@@ -69,6 +69,12 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "pubsub_max_mailbox": 1000,           # long-poll mailbox bound (drop-oldest)
     "pubsub_subscriber_timeout_s": 60.0,  # GC long-pollers gone this long
     "client_poll_slice_s": 60.0,          # ray:// get/wait re-poll granularity
+    "actor_creation_rpc_timeout_s": 330.0,  # driver->raylet create_actor
+                                          # RPC; raise when worker spawn
+                                          # is slow (e.g. a wedged TPU
+                                          # tunnel makes every python
+                                          # startup pay a slow axon
+                                          # plugin registration)
     "client_session_ttl_s": 60.0,         # ray:// reconnect grace: session
                                           # state survives a dropped socket
                                           # this long

@@ -2208,8 +2208,12 @@ class CoreWorker:
                     spec = dict(spec)
                     spec["strategy"] = dict(spec.get("strategy") or {})
                     spec["strategy"]["no_spill"] = True
-                reply = target.call("create_actor", actor_id=actor_id,
-                                    spec=spec, timeout=330.0)
+                from ray_tpu._private.config import get_config
+
+                reply = target.call(
+                    "create_actor", actor_id=actor_id, spec=spec,
+                    timeout=float(get_config(
+                        "actor_creation_rpc_timeout_s")))
                 if "granted" in reply:
                     if opened is not None:
                         opened.close()
