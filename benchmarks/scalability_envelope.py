@@ -78,7 +78,10 @@ def main():
 
     def many_actors():
         actors = [A.remote() for _ in range(n_actors)]
-        out = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+        # wedged-tunnel worker spawn costs ~2.2s/process serialized on
+        # one core (PERF.md round 5f): the tail ping legitimately waits
+        # out most of the storm — time it honestly, don't fail it
+        out = ray_tpu.get([a.ping.remote() for a in actors], timeout=1800)
         assert sum(out) == n_actors
         for a in actors:
             ray_tpu.kill(a)

@@ -47,7 +47,6 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "health_check_period_ms": 1_000,
     "health_check_failure_threshold": 5,
     "gcs_rpc_timeout_s": 30.0,
-    "actor_creation_timeout_s": 60.0,
     # --- memory monitor ---
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,

@@ -7,3 +7,16 @@ from ray_tpu.air.config import (  # noqa: F401
     ScalingConfig,
 )
 from ray_tpu.air.result import Result  # noqa: F401
+from ray_tpu.air.preprocessors import (  # noqa: F401
+    BatchMapper,
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    OneHotEncoder,
+    OrdinalEncoder,
+    Preprocessor,
+    PreprocessorNotFittedError,
+    SimpleImputer,
+    StandardScaler,
+)

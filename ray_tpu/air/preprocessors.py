@@ -1,0 +1,365 @@
+"""AIR preprocessors — fit-on-Dataset / transform-anywhere feature prep.
+
+Reference: python/ray/data/preprocessor.py:23 (the Preprocessor
+contract: fit computes distributed statistics over a Dataset, transform
+applies them to Datasets or raw batches) and data/preprocessors/
+(scaler.py, encoder.py, imputer.py, concatenator.py, batch_mapper.py,
+chain.py). Fitting rides the Dataset's existing distributed aggregation
+(per-block partials merged with Chan's algorithm — dataset.py
+_numeric_partials) so no per-row Python runs on the hot path; transform
+is a vectorized map_batches stage, which means it fuses with downstream
+stages and feeds `iter_batches(device_put=True)` untouched.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class PreprocessorNotFittedError(RuntimeError):
+    pass
+
+
+class Preprocessor:
+    """fit(dataset) -> self; transform(dataset) -> Dataset;
+    transform_batch(dict-of-arrays) -> dict-of-arrays."""
+
+    _fitted = False
+
+    # -- contract ------------------------------------------------------------
+    def _fit(self, dataset) -> None:          # stats computation
+        raise NotImplementedError
+
+    def _transform_batch(self, batch: dict) -> dict:
+        raise NotImplementedError
+
+    _requires_fit = True
+
+    # -- public --------------------------------------------------------------
+    def fit(self, dataset) -> "Preprocessor":
+        self._fit(dataset)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, dataset):
+        return self.fit(dataset).transform(dataset)
+
+    def transform(self, dataset):
+        self._check_fitted()
+        fn = self._transform_batch
+
+        def apply(block):
+            from ray_tpu.data import block as B
+
+            cols = B.to_numpy_batch(block)
+            # plain-array blocks (from_numpy/range) pass through as-is:
+            # column-agnostic preprocessors (BatchMapper) handle them;
+            # column-based ones fail with their own KeyError
+            return fn(dict(cols) if isinstance(cols, dict) else cols)
+
+        return dataset.map_batches(apply)
+
+    def transform_batch(self, batch):
+        self._check_fitted()
+        return self._transform_batch(
+            dict(batch) if isinstance(batch, dict) else batch)
+
+    def _check_fitted(self):
+        if self._requires_fit and not self._fitted:
+            raise PreprocessorNotFittedError(
+                f"{type(self).__name__} must be fit() before transform")
+
+    def __repr__(self):
+        state = "fitted" if self._fitted else "unfitted"
+        return f"{type(self).__name__}({state})"
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference: scaler.py
+    StandardScaler)."""
+
+    def __init__(self, columns: list[str], ddof: int = 0):
+        self.columns = list(columns)
+        self.ddof = ddof
+        self.stats_: dict[str, tuple] = {}
+
+    def _fit(self, dataset):
+        for col, p in _fit_numeric_columns(dataset, self.columns).items():
+            count, _tot, _mn, _mx, mean, m2 = p
+            denom = max(1, count - self.ddof)
+            std = float(np.sqrt(m2 / denom))
+            self.stats_[col] = (mean, std if std > 0 else 1.0)
+
+    def _transform_batch(self, batch):
+        for col in self.columns:
+            mean, std = self.stats_[col]
+            batch[col] = (np.asarray(batch[col], np.float64) - mean) / std
+        return batch
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column (reference: scaler.py)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.stats_: dict[str, tuple] = {}
+
+    def _fit(self, dataset):
+        for col, p in _fit_numeric_columns(dataset, self.columns).items():
+            _c, _t, mn, mx, _mean, _m2 = p
+            span = mx - mn
+            self.stats_[col] = (mn, span if span > 0 else 1.0)
+
+    def _transform_batch(self, batch):
+        for col in self.columns:
+            mn, span = self.stats_[col]
+            batch[col] = (np.asarray(batch[col], np.float64) - mn) / span
+        return batch
+
+
+def _block_numeric_partials(block, cols):
+    """Per-column (n, sum, min, max, mean, M2) for one block — ONE task
+    covers every column; M2 merges across blocks with Chan's algorithm
+    (cancellation-safe, unlike sum-of-squares)."""
+    from ray_tpu.data import block as B
+
+    data = B.to_numpy_batch(block)
+    out = {}
+    for c in cols:
+        vals = np.asarray(data[c], np.float64)
+        if vals.size == 0:
+            out[c] = None
+            continue
+        mean = float(vals.mean())
+        out[c] = (int(vals.size), float(vals.sum()), float(vals.min()),
+                  float(vals.max()), mean,
+                  float(np.square(vals - mean).sum()))
+    return out
+
+
+def _block_nan_mean_partials(block, cols):
+    from ray_tpu.data import block as B
+
+    data = B.to_numpy_batch(block)
+    out = {}
+    for c in cols:
+        vals = np.asarray(data[c], np.float64)
+        mask = ~np.isnan(vals)
+        out[c] = (float(vals[mask].sum()), int(mask.sum()))
+    return out
+
+
+def _block_distinct(block, cols):
+    from ray_tpu.data import block as B
+
+    data = B.to_numpy_batch(block)
+    return {c: set(np.asarray(data[c]).tolist()) for c in cols}
+
+
+def _merge_partials(a, b):
+    """Chan's parallel merge of (n, sum, min, max, mean, M2)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    n = a[0] + b[0]
+    delta = b[4] - a[4]
+    mean = a[4] + delta * b[0] / n
+    m2 = a[5] + b[5] + delta * delta * a[0] * b[0] / n
+    return (n, a[1] + b[1], min(a[2], b[2]), max(a[3], b[3]), mean, m2)
+
+
+def _fit_numeric_columns(dataset, cols) -> dict:
+    """One distributed pass over ALL columns: one cached remote task per
+    block (the per-column _numeric_partials shape would cost
+    k_columns x n_blocks tasks plus k stage re-executions)."""
+    import ray_tpu
+
+    task = ray_tpu.remote(_block_numeric_partials)
+    refs = [task.remote(r, list(cols))
+            for r in dataset._materialized_refs()]
+    merged: dict = {c: None for c in cols}
+    for part in ray_tpu.get(refs, timeout=600):
+        for c in cols:
+            merged[c] = _merge_partials(merged[c], part[c])
+    return merged
+
+
+def _fit_distinct_columns(dataset, cols) -> dict:
+    import ray_tpu
+
+    task = ray_tpu.remote(_block_distinct)
+    refs = [task.remote(r, list(cols))
+            for r in dataset._materialized_refs()]
+    out = {c: set() for c in cols}
+    for part in ray_tpu.get(refs, timeout=600):
+        for c in cols:
+            out[c] |= part[c]
+    return {c: sorted(v) for c, v in out.items()}
+
+
+class OrdinalEncoder(Preprocessor):
+    """Category -> dense int id (reference: encoder.py OrdinalEncoder).
+    Unseen categories map to -1."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.stats_: dict[str, dict] = {}
+
+    def _fit(self, dataset):
+        for col, vals in _fit_distinct_columns(dataset,
+                                               self.columns).items():
+            self.stats_[col] = {v: i for i, v in enumerate(vals)}
+
+    def _transform_batch(self, batch):
+        for col in self.columns:
+            table = self.stats_[col]
+            batch[col] = np.asarray(
+                [table.get(v, -1) for v in np.asarray(batch[col]).tolist()],
+                np.int64)
+        return batch
+
+
+class LabelEncoder(OrdinalEncoder):
+    """OrdinalEncoder for one label column (reference: encoder.py
+    LabelEncoder keeps the same category->id semantics)."""
+
+    def __init__(self, label_column: str):
+        super().__init__([label_column])
+        self.label_column = label_column
+
+    def inverse_transform_batch(self, batch):
+        self._check_fitted()
+        inv = {i: v for v, i in self.stats_[self.label_column].items()}
+        batch = dict(batch)
+        batch[self.label_column] = np.asarray(
+            [inv.get(int(i)) for i in
+             np.asarray(batch[self.label_column]).tolist()])
+        return batch
+
+
+class OneHotEncoder(Preprocessor):
+    """Category -> indicator columns `{col}_{value}` (reference:
+    encoder.py OneHotEncoder); unseen categories one-hot to all-zeros."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.stats_: dict[str, list] = {}
+
+    def _fit(self, dataset):
+        self.stats_ = _fit_distinct_columns(dataset, self.columns)
+
+    def _transform_batch(self, batch):
+        for col in self.columns:
+            values = np.asarray(batch.pop(col))
+            for cat in self.stats_[col]:
+                batch[f"{col}_{cat}"] = (values == cat).astype(np.int8)
+        return batch
+
+
+class SimpleImputer(Preprocessor):
+    """Fill missing values (NaN) with mean/constant (reference:
+    imputer.py)."""
+
+    def __init__(self, columns: list[str], strategy: str = "mean",
+                 fill_value=None):
+        if strategy not in ("mean", "constant"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError("strategy='constant' needs fill_value")
+        self.columns = list(columns)
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: dict[str, float] = {}
+        if strategy == "constant":
+            self._requires_fit = False   # the fill needs no statistics
+
+    def _fit(self, dataset):
+        if self.strategy == "constant":
+            return
+        import ray_tpu
+
+        task = ray_tpu.remote(_block_nan_mean_partials)
+        refs = [task.remote(r, list(self.columns))
+                for r in dataset._materialized_refs()]
+        agg = {c: [0.0, 0] for c in self.columns}
+        for part in ray_tpu.get(refs, timeout=600):
+            for c in self.columns:
+                agg[c][0] += part[c][0]
+                agg[c][1] += part[c][1]
+        for c, (total, count) in agg.items():
+            self.stats_[c] = total / count if count else 0.0
+
+    def _transform_batch(self, batch):
+        for col in self.columns:
+            vals = np.asarray(batch[col], np.float64)
+            fill = (self.fill_value if self.strategy == "constant"
+                    else self.stats_[col])
+            batch[col] = np.where(np.isnan(vals), fill, vals)
+        return batch
+
+
+class Concatenator(Preprocessor):
+    """Merge numeric columns into one feature matrix column (reference:
+    concatenator.py) — the model-input shape for to_tf/iter_batches."""
+
+    _requires_fit = False
+    _fitted = True
+
+    def __init__(self, columns: list[str], output_column: str = "features",
+                 dtype=np.float32):
+        self.columns = list(columns)
+        self.output_column = output_column
+        self.dtype = dtype
+
+    def _fit(self, dataset):
+        pass
+
+    def _transform_batch(self, batch):
+        mat = np.stack([np.asarray(batch.pop(c), self.dtype)
+                        for c in self.columns], axis=1)
+        batch[self.output_column] = mat
+        return batch
+
+
+class BatchMapper(Preprocessor):
+    """User fn over batches (reference: batch_mapper.py)."""
+
+    _requires_fit = False
+    _fitted = True
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def _fit(self, dataset):
+        pass
+
+    def _transform_batch(self, batch):
+        return self.fn(batch)
+
+
+class Chain(Preprocessor):
+    """Sequential composition; fit_transform semantics per stage
+    (reference: chain.py — each stage fits on the PREVIOUS stage's
+    output)."""
+
+    def __init__(self, *stages: Preprocessor):
+        self.stages = list(stages)
+
+    def fit(self, dataset):
+        for stage in self.stages[:-1]:
+            dataset = stage.fit_transform(dataset).materialize()
+        if self.stages:
+            self.stages[-1].fit(dataset)
+        self._fitted = True
+        return self
+
+    def _transform_batch(self, batch):
+        for stage in self.stages:
+            batch = stage.transform_batch(batch)
+        return batch
+
+    def transform(self, dataset):
+        self._check_fitted()
+        for stage in self.stages:
+            dataset = stage.transform(dataset)
+        return dataset
