@@ -993,8 +993,19 @@ class Raylet:
             # Tell the worker to become this actor.
             client = RpcClient(worker.addr, timeout=60.0)
             try:
+                from ray_tpu._private.config import get_config
+
+                # Under a creation storm on a starved core a worker's
+                # become_actor (class-blob fetch + import) legitimately
+                # waits behind dozens of peers, so this scales with the
+                # storm-sized driver budget — but at 3/4 of it, leaving
+                # the driver's outer create_actor call margin to receive
+                # our reply (equal budgets would let the driver give up
+                # and mark the actor failed moments before the raylet
+                # succeeds, leaking the bound worker).
+                outer = float(get_config("actor_creation_rpc_timeout_s"))
                 client.call("become_actor", actor_id=actor_id, spec=spec,
-                            timeout=spec.get("creation_timeout", 60.0))
+                            timeout=max(60.0, 0.75 * outer))
             finally:
                 client.close()
             self._log_monitor.set_actor_name(

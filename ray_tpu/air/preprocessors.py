@@ -41,6 +41,10 @@ class Preprocessor:
         return self
 
     def fit_transform(self, dataset):
+        # materialize ONCE: fitting walks every block; re-running the
+        # lazy stages again inside transform would double the cluster
+        # work for nothing
+        dataset = dataset.materialize()
         return self.fit(dataset).transform(dataset)
 
     def transform(self, dataset):
@@ -116,16 +120,27 @@ class MinMaxScaler(Preprocessor):
         return batch
 
 
+def _block_cols(block, cols) -> dict | None:
+    """Columnar view of one block, or None for an EMPTY block (filter
+    stages can empty individual blocks; fits must skip them, not crash
+    indexing an empty ndarray with a column name)."""
+    from ray_tpu.data import block as B
+
+    data = B.to_numpy_batch(block)
+    if not isinstance(data, dict) or not data:
+        return None
+    return data
+
+
 def _block_numeric_partials(block, cols):
     """Per-column (n, sum, min, max, mean, M2) for one block — ONE task
     covers every column; M2 merges across blocks with Chan's algorithm
     (cancellation-safe, unlike sum-of-squares)."""
-    from ray_tpu.data import block as B
-
-    data = B.to_numpy_batch(block)
+    data = _block_cols(block, cols)
     out = {}
     for c in cols:
-        vals = np.asarray(data[c], np.float64)
+        vals = (np.asarray(data[c], np.float64)
+                if data is not None else np.empty(0))
         if vals.size == 0:
             out[c] = None
             continue
@@ -137,21 +152,20 @@ def _block_numeric_partials(block, cols):
 
 
 def _block_nan_mean_partials(block, cols):
-    from ray_tpu.data import block as B
-
-    data = B.to_numpy_batch(block)
+    data = _block_cols(block, cols)
     out = {}
     for c in cols:
-        vals = np.asarray(data[c], np.float64)
+        vals = (np.asarray(data[c], np.float64)
+                if data is not None else np.empty(0))
         mask = ~np.isnan(vals)
         out[c] = (float(vals[mask].sum()), int(mask.sum()))
     return out
 
 
 def _block_distinct(block, cols):
-    from ray_tpu.data import block as B
-
-    data = B.to_numpy_batch(block)
+    data = _block_cols(block, cols)
+    if data is None:
+        return {c: set() for c in cols}
     return {c: set(np.asarray(data[c]).tolist()) for c in cols}
 
 
@@ -168,32 +182,34 @@ def _merge_partials(a, b):
     return (n, a[1] + b[1], min(a[2], b[2]), max(a[3], b[3]), mean, m2)
 
 
-def _fit_numeric_columns(dataset, cols) -> dict:
-    """One distributed pass over ALL columns: one cached remote task per
-    block (the per-column _numeric_partials shape would cost
-    k_columns x n_blocks tasks plus k stage re-executions)."""
+def _fit_fanout(dataset, cols, block_fn, zero, merge) -> dict:
+    """THE shared fit shape: one cached remote task per block covering
+    ALL columns, per-column merge on the driver (a per-column fan-out
+    would cost k_columns x n_blocks tasks plus k stage re-runs)."""
     import ray_tpu
 
-    task = ray_tpu.remote(_block_numeric_partials)
+    task = ray_tpu.remote(block_fn)
     refs = [task.remote(r, list(cols))
             for r in dataset._materialized_refs()]
-    merged: dict = {c: None for c in cols}
+    merged = {c: zero() for c in cols}
     for part in ray_tpu.get(refs, timeout=600):
         for c in cols:
-            merged[c] = _merge_partials(merged[c], part[c])
+            merged[c] = merge(merged[c], part[c])
     return merged
 
 
-def _fit_distinct_columns(dataset, cols) -> dict:
-    import ray_tpu
+def _fit_numeric_columns(dataset, cols) -> dict:
+    out = _fit_fanout(dataset, cols, _block_numeric_partials,
+                      lambda: None, _merge_partials)
+    empty = [c for c, p in out.items() if p is None]
+    if empty:
+        raise ValueError(f"cannot fit on columns with no rows: {empty}")
+    return out
 
-    task = ray_tpu.remote(_block_distinct)
-    refs = [task.remote(r, list(cols))
-            for r in dataset._materialized_refs()]
-    out = {c: set() for c in cols}
-    for part in ray_tpu.get(refs, timeout=600):
-        for c in cols:
-            out[c] |= part[c]
+
+def _fit_distinct_columns(dataset, cols) -> dict:
+    out = _fit_fanout(dataset, cols, _block_distinct,
+                      set, lambda a, b: a | b)
     return {c: sorted(v) for c, v in out.items()}
 
 
@@ -204,18 +220,25 @@ class OrdinalEncoder(Preprocessor):
     def __init__(self, columns: list[str]):
         self.columns = list(columns)
         self.stats_: dict[str, dict] = {}
+        self._vocab_arrays: dict[str, np.ndarray] = {}
 
     def _fit(self, dataset):
+        self._vocab_arrays = {}
         for col, vals in _fit_distinct_columns(dataset,
                                                self.columns).items():
             self.stats_[col] = {v: i for i, v in enumerate(vals)}
 
     def _transform_batch(self, batch):
         for col in self.columns:
-            table = self.stats_[col]
-            batch[col] = np.asarray(
-                [table.get(v, -1) for v in np.asarray(batch[col]).tolist()],
-                np.int64)
+            vocab = self._vocab_arrays.setdefault(
+                col, np.asarray(sorted(self.stats_[col])))
+            values = np.asarray(batch[col])
+            # vectorized lookup: ids ARE searchsorted positions because
+            # the fit sorted the categories — no per-row Python
+            idx = np.searchsorted(vocab, values)
+            idx_c = np.clip(idx, 0, len(vocab) - 1)
+            valid = vocab[idx_c] == values
+            batch[col] = np.where(valid, idx_c, -1).astype(np.int64)
         return batch
 
 
@@ -276,16 +299,10 @@ class SimpleImputer(Preprocessor):
     def _fit(self, dataset):
         if self.strategy == "constant":
             return
-        import ray_tpu
-
-        task = ray_tpu.remote(_block_nan_mean_partials)
-        refs = [task.remote(r, list(self.columns))
-                for r in dataset._materialized_refs()]
-        agg = {c: [0.0, 0] for c in self.columns}
-        for part in ray_tpu.get(refs, timeout=600):
-            for c in self.columns:
-                agg[c][0] += part[c][0]
-                agg[c][1] += part[c][1]
+        agg = _fit_fanout(
+            dataset, self.columns, _block_nan_mean_partials,
+            lambda: (0.0, 0),
+            lambda a, b: (a[0] + b[0], a[1] + b[1]))
         for c, (total, count) in agg.items():
             self.stats_[c] = total / count if count else 0.0
 
@@ -344,6 +361,11 @@ class Chain(Preprocessor):
 
     def __init__(self, *stages: Preprocessor):
         self.stages = list(stages)
+        # a chain of stateless stages is itself stateless (reference:
+        # chain.py derives fit_status from its stages)
+        if not any(st._requires_fit for st in self.stages):
+            self._requires_fit = False
+            self._fitted = True
 
     def fit(self, dataset):
         for stage in self.stages[:-1]:
