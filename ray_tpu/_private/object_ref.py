@@ -194,27 +194,58 @@ class ReferenceCounter:
     """
 
     def __init__(self, on_zero=None):
+        import queue
+
         self._counts: dict[bytes, int] = {}
         self._lock = threading.Lock()
         self._on_zero = on_zero
+        # remove_local_ref runs from ObjectRef.__del__, which the GC can
+        # fire at ANY bytecode boundary — including INSIDE add_local_ref
+        # while this thread already holds the (non-reentrant) lock
+        # above. Taking the lock there self-deadlocks (observed: a
+        # 10k-ref release storm wedging the next 5000-return submit).
+        # So __del__ only ENQUEUES (SimpleQueue.put is reentrancy-safe
+        # by design); this drainer does the locked decrement.
+        self._defer_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._drainer = threading.Thread(target=self._drain, daemon=True,
+                                         name="refcount-drainer")
+        self._drainer.start()
 
     def add_local_ref(self, object_id: bytes):
         with self._lock:
             self._counts[object_id] = self._counts.get(object_id, 0) + 1
 
     def remove_local_ref(self, object_id: bytes):
-        notify = False
-        with self._lock:
-            n = self._counts.get(object_id)
-            if n is None:
+        """GC-safe: enqueue only (see __init__). Decrements lag
+        increments by one queue hop — the safe direction (frees are
+        delayed, never premature)."""
+        self._defer_q.put(object_id)
+
+    def shutdown(self):
+        """Stop the drainer (its bound-method target would otherwise pin
+        the whole owning worker graph alive forever)."""
+        self._defer_q.put(None)
+
+    def _drain(self):
+        while True:
+            object_id = self._defer_q.get()
+            if object_id is None:
                 return
-            if n <= 1:
-                del self._counts[object_id]
-                notify = True
-            else:
-                self._counts[object_id] = n - 1
-        if notify and self._on_zero is not None:
-            self._on_zero(object_id)
+            notify = False
+            with self._lock:
+                n = self._counts.get(object_id)
+                if n is None:
+                    continue
+                if n <= 1:
+                    del self._counts[object_id]
+                    notify = True
+                else:
+                    self._counts[object_id] = n - 1
+            if notify and self._on_zero is not None:
+                try:
+                    self._on_zero(object_id)
+                except Exception:
+                    pass
 
     def count(self, object_id: bytes) -> int:
         with self._lock:

@@ -1005,7 +1005,7 @@ class Raylet:
                 # succeeds, leaking the bound worker).
                 outer = float(get_config("actor_creation_rpc_timeout_s"))
                 client.call("become_actor", actor_id=actor_id, spec=spec,
-                            timeout=max(60.0, 0.75 * outer))
+                            timeout=0.75 * outer)
             finally:
                 client.close()
             self._log_monitor.set_actor_name(

@@ -2990,6 +2990,7 @@ class CoreWorker:
     def shutdown(self):
         self.stopped = True
         self._free_queue.put(None)   # unblock the ref reaper
+        self.reference_counter.shutdown()   # and the refcount drainer
         self._server.stop()
         with self._owner_client_lock:
             owner_clients = list(self._owner_clients.values())

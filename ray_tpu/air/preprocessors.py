@@ -41,10 +41,12 @@ class Preprocessor:
         return self
 
     def fit_transform(self, dataset):
-        # materialize ONCE: fitting walks every block; re-running the
-        # lazy stages again inside transform would double the cluster
-        # work for nothing
-        dataset = dataset.materialize()
+        if self._requires_fit:
+            # materialize ONCE: fitting walks every block; re-running
+            # the lazy stages again inside transform would double the
+            # cluster work (stateless preprocessors skip this and keep
+            # the lazy stage fusion)
+            dataset = dataset.materialize()
         return self.fit(dataset).transform(dataset)
 
     def transform(self, dataset):
@@ -230,9 +232,14 @@ class OrdinalEncoder(Preprocessor):
 
     def _transform_batch(self, batch):
         for col in self.columns:
-            vocab = self._vocab_arrays.setdefault(
-                col, np.asarray(sorted(self.stats_[col])))
+            if col not in self._vocab_arrays:   # setdefault would build
+                self._vocab_arrays[col] = np.asarray(  # eagerly per batch
+                    sorted(self.stats_[col]))
+            vocab = self._vocab_arrays[col]
             values = np.asarray(batch[col])
+            if len(vocab) == 0:
+                batch[col] = np.full(len(values), -1, np.int64)
+                continue
             # vectorized lookup: ids ARE searchsorted positions because
             # the fit sorted the categories — no per-row Python
             idx = np.searchsorted(vocab, values)

@@ -259,6 +259,7 @@ class ClientContext:
 
     def shutdown(self):
         self._closed = True
+        self.reference_counter.shutdown()   # stop the drainer thread
         try:
             self._rpc.close()
         except Exception:
