@@ -47,6 +47,11 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "health_check_period_ms": 1_000,
     "health_check_failure_threshold": 5,
     "gcs_rpc_timeout_s": 30.0,
+    # --- unified control-plane retry policy (_private/retry.py) ---
+    "rpc_retry_max_attempts": 5,        # per-call attempt cap
+    "rpc_retry_base_backoff_s": 0.05,   # full-jitter backoff base
+    "rpc_retry_max_backoff_s": 2.0,     # backoff cap
+    "rpc_retry_deadline_s": 90.0,       # total budget across attempts
     # --- memory monitor ---
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,

@@ -1,0 +1,364 @@
+"""Deterministic RPC fault-injection plane.
+
+Reference tier: python/ray/tests/test_chaos.py drives whole-process
+kills; the reference additionally hardens the *message* level with
+per-RPC retry policy (grpc channel args, client_call.h retries). This
+module adds the missing message-level chaos: a seeded, schedule-based
+injector threaded through both transports (protocol.py pure-Python and
+native_rpc.py C-core) that can drop, delay, duplicate, disconnect, or
+slow-reply individual RPCs — reproducibly.
+
+Design constraints:
+
+- **Zero overhead when disabled.** The transports do one module-global
+  load + ``is None`` check per call (``fault_injection.ACTIVE``); no
+  allocation, no dict lookup, no env read on the hot path.
+- **Deterministic.** Decisions are NOT drawn from a shared RNG (thread
+  interleaving would make the sequence irreproducible). Each rule keeps
+  a per-method call counter; the verdict for call *n* of method *m* is
+  ``sha256(seed, rule_index, m, n)`` mapped to [0, 1). Two runs issuing
+  the same calls per method get the identical fault sequence regardless
+  of scheduling — asserted via the event log in
+  tests/test_fault_injection.py.
+- **Reproducible from one line.** Any failure can be replayed from the
+  ``RAY_TPU_FAULT_SEED`` + ``RAY_TPU_FAULT_SCHEDULE`` pair (see
+  ``banner()``; tests/conftest.py prints it on failure).
+
+Schedule grammar (``;``-separated rules)::
+
+    rule     := action ":" role "." method ":" selector [":" param_ms]
+    action   := drop | delay | dup | disconnect | slow_reply
+    role     := "*" | gcs | raylet | worker | driver
+    method   := "*" | <rpc method name>
+    selector := "p" FLOAT    probability (hash-derived, deterministic)
+              | "%" INT      every K-th call (1-indexed: K, 2K, ...)
+              | "#" INT[,..] exact 1-indexed call numbers
+    param_ms := FLOAT        delay / slow_reply duration (default 10)
+
+Examples::
+
+    drop:*.kv_put:p0.1              # lose 10% of kv_put requests
+    delay:*.*:p0.05:20              # 5% of all sends wait 20ms first
+    dup:gcs.kv_put:%3               # every 3rd kv_put sent twice
+    disconnect:*.request_worker_lease:#2   # kill the conn on call 2
+    slow_reply:*.get_nodes:p0.2:15  # server stalls 15ms before replying
+
+Actions, and where the transports apply them:
+
+- ``drop``       client send: the request/push is never written. A sync
+                 call surfaces as TimeoutError after its per-call
+                 timeout (exactly what real message loss on a healthy
+                 TCP connection looks like) — schedules should only
+                 drop methods called with a finite timeout or under a
+                 RetryPolicy, or the caller hangs like it would in
+                 production. An ASYNC call's future never resolves (the
+                 caller's own timeout/retry layer owns recovery, as it
+                 must for real loss); its pending slot is reclaimed when
+                 the connection closes, so schedules dropping async-path
+                 methods (e.g. push_task) trade one pending slot per
+                 fault for the soak's duration.
+- ``delay``      client send: sleep param_ms before writing.
+- ``dup``        client send: the frame is written twice (same seq);
+                 exercises server-side idempotency. The duplicate reply
+                 is discarded by the reply-correlation map.
+- ``disconnect`` client send: the connection is closed and
+                 ConnectionLost raised; subsequent calls fail until the
+                 owner reconnects (ReconnectingRpcClient heals, plain
+                 clients surface the error).
+- ``slow_reply`` server dispatch: sleep param_ms before writing the
+                 reply (models a GC-pausing / overloaded peer).
+
+Role scoping is process-level: subprocess entrypoints tag themselves
+(gcs.main → "gcs", scripts/node → "raylet", worker_main → "worker",
+CoreWorker driver mode → "driver"). In-process test clusters share one
+process, so their schedules scope by method with role ``*``.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+import time
+
+ACTIONS = ("drop", "delay", "dup", "disconnect", "slow_reply")
+# actions applied at the client send boundary vs the server reply boundary
+_SEND_ACTIONS = frozenset({"drop", "delay", "dup", "disconnect"})
+_REPLY_ACTIONS = frozenset({"slow_reply"})
+
+_DEFAULT_PARAM_MS = 10.0
+
+
+class FaultPlan:
+    """What on_send decided for one outgoing call. Only allocated when at
+    least one rule fired (the common no-fault call returns None)."""
+
+    __slots__ = ("drop", "dup", "disconnect", "delay_s")
+
+    def __init__(self):
+        self.drop = False
+        self.dup = False
+        self.disconnect = False
+        self.delay_s = 0.0
+
+
+class _Rule:
+    __slots__ = ("action", "role", "method", "mode", "prob", "every",
+                 "calls", "param_s", "index", "_counts")
+
+    def __init__(self, action, role, method, mode, prob, every, calls,
+                 param_s, index):
+        self.action = action
+        self.role = role
+        self.method = method
+        self.mode = mode          # "p" | "%" | "#"
+        self.prob = prob
+        self.every = every
+        self.calls = calls        # frozenset of 1-indexed call numbers
+        self.param_s = param_s
+        self.index = index        # position in the schedule (hash input)
+        self._counts: dict[str, int] = {}   # method -> calls seen
+
+    def matches_scope(self, role: str, method: str) -> bool:
+        if self.method != "*" and self.method != method:
+            return False
+        return self.role == "*" or self.role == role
+
+    def fires(self, seed: int, method: str, lock: threading.Lock) -> int:
+        """Count this call; return its 1-indexed number if the rule fires,
+        else 0. The counter is per-method so wildcard rules stay
+        deterministic per method (global interleaving of different
+        methods across threads does not change any verdict)."""
+        with lock:
+            n = self._counts.get(method, 0) + 1
+            self._counts[method] = n
+        if self.mode == "%":
+            return n if n % self.every == 0 else 0
+        if self.mode == "#":
+            return n if n in self.calls else 0
+        return n if _hash01(seed, self.index, method, n) < self.prob else 0
+
+
+def _hash01(seed: int, rule_index: int, method: str, n: int) -> float:
+    """Deterministic uniform [0,1) from the decision coordinates."""
+    h = hashlib.sha256(
+        b"%d:%d:%s:%d" % (seed, rule_index, method.encode(), n)).digest()
+    return struct.unpack(">Q", h[:8])[0] / 2.0 ** 64
+
+
+class ScheduleError(ValueError):
+    pass
+
+
+def parse_schedule(schedule: str) -> list[_Rule]:
+    rules = []
+    for index, raw in enumerate(schedule.split(";")):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) not in (3, 4):
+            raise ScheduleError(
+                f"fault rule {raw!r}: want action:role.method:selector"
+                f"[:param_ms]")
+        action, scope, selector = parts[0], parts[1], parts[2]
+        if action not in ACTIONS:
+            raise ScheduleError(
+                f"fault rule {raw!r}: unknown action {action!r} "
+                f"(one of {'/'.join(ACTIONS)})")
+        if "." not in scope:
+            raise ScheduleError(
+                f"fault rule {raw!r}: scope must be role.method")
+        role, method = scope.split(".", 1)
+        prob, every, calls = 0.0, 0, frozenset()
+        if selector.startswith("p"):
+            mode, prob = "p", float(selector[1:])
+            if not 0.0 <= prob <= 1.0:
+                raise ScheduleError(
+                    f"fault rule {raw!r}: probability out of [0,1]")
+        elif selector.startswith("%"):
+            mode, every = "%", int(selector[1:])
+            if every < 1:
+                raise ScheduleError(f"fault rule {raw!r}: %K needs K >= 1")
+        elif selector.startswith("#"):
+            mode = "#"
+            calls = frozenset(int(c) for c in selector[1:].split(","))
+        else:
+            raise ScheduleError(
+                f"fault rule {raw!r}: selector must be pN / %K / #i,j")
+        param_s = (float(parts[3]) if len(parts) == 4
+                   else _DEFAULT_PARAM_MS) / 1000.0
+        rules.append(_Rule(action, role, method, mode, prob, every, calls,
+                           param_s, index))
+    return rules
+
+
+class FaultInjector:
+    """Seeded, schedule-based fault decisions + an event log.
+
+    The event log records every fired fault as
+    ``(action, role, method, call_n)``. Because verdicts are pure
+    functions of (seed, rule, method, call_n), two runs driving the same
+    per-method call sequences produce equal logs up to thread-order —
+    compare with ``trace()`` (sorted) for a stable assertion.
+    """
+
+    def __init__(self, seed: int, schedule: str, role: str | None = None):
+        self.seed = int(seed)
+        self.schedule = schedule
+        self.rules = parse_schedule(schedule)
+        self._send_rules = [r for r in self.rules
+                            if r.action in _SEND_ACTIONS]
+        self._reply_rules = [r for r in self.rules
+                             if r.action in _REPLY_ACTIONS]
+        self._lock = threading.Lock()
+        self.events: list[tuple] = []
+        # None = follow the process-global role (set_role); a role given
+        # here pins this injector's decisions regardless of the global
+        self._pinned_role = role
+
+    def _current_role(self) -> str:
+        return self._pinned_role if self._pinned_role is not None else _role
+
+    # ------------------------------------------------------------- decisions
+
+    def on_send(self, method: str) -> FaultPlan | None:
+        """Client send boundary. Returns the plan to apply, or None."""
+        plan = None
+        role = self._current_role()
+        for rule in self._send_rules:
+            if not rule.matches_scope(role, method):
+                continue
+            n = rule.fires(self.seed, method, self._lock)
+            if not n:
+                continue
+            if plan is None:
+                plan = FaultPlan()
+            if rule.action == "drop":
+                plan.drop = True
+            elif rule.action == "dup":
+                plan.dup = True
+            elif rule.action == "disconnect":
+                plan.disconnect = True
+            elif rule.action == "delay":
+                plan.delay_s = max(plan.delay_s, rule.param_s)
+            with self._lock:
+                self.events.append((rule.action, role, method, n))
+        return plan
+
+    def on_reply(self, method: str) -> float:
+        """Server dispatch boundary: seconds to stall before replying."""
+        delay = 0.0
+        role = self._current_role()
+        for rule in self._reply_rules:
+            if not rule.matches_scope(role, method):
+                continue
+            n = rule.fires(self.seed, method, self._lock)
+            if not n:
+                continue
+            delay = max(delay, rule.param_s)
+            with self._lock:
+                self.events.append((rule.action, role, method, n))
+        return delay
+
+    # ------------------------------------------------------------ inspection
+
+    def trace(self) -> list[tuple]:
+        """The event log in a thread-order-independent form (sorted) —
+        the reproducibility assertion compares these across runs."""
+        with self._lock:
+            return sorted(self.events)
+
+    def event_count(self) -> int:
+        with self._lock:
+            return len(self.events)
+
+    def banner(self) -> str:
+        """One line that reproduces this injector exactly."""
+        return (f"RAY_TPU_FAULT_SEED={self.seed} "
+                f"RAY_TPU_FAULT_SCHEDULE='{self.schedule}'")
+
+
+# ------------------------------------------------------------------ globals
+#
+# ACTIVE is read directly by the transports (module-global load + None
+# check = the entire disabled-mode cost). _role tags this process for
+# role-scoped rules.
+
+ACTIVE: FaultInjector | None = None
+_role: str = os.environ.get("RAY_TPU_FAULT_ROLE", "*")
+_env_checked = False
+_install_lock = threading.Lock()
+
+
+def set_role(role: str, weak: bool = False):
+    """Tag this process for role-scoped rules. ``weak=True`` only sets
+    the role if nothing claimed it yet (in-process test clusters host
+    several components; the subprocess entrypoint's tag wins)."""
+    global _role
+    if weak and _role != "*":
+        return
+    _role = role
+
+
+def get_role() -> str:
+    return _role
+
+
+def install(seed: int, schedule: str) -> FaultInjector:
+    """Activate an injector in this process (tests). Returns it so the
+    caller can read the event log."""
+    global ACTIVE
+    with _install_lock:
+        ACTIVE = FaultInjector(seed, schedule)
+        return ACTIVE
+
+
+def uninstall():
+    global ACTIVE
+    with _install_lock:
+        ACTIVE = None
+
+
+def maybe_init_from_env():
+    """Activate from RAY_TPU_FAULT_SCHEDULE (+ RAY_TPU_FAULT_SEED, default
+    0) — called once at transport import so spawned cluster processes
+    inherit the fault plane through their environment. A malformed
+    schedule raises: silently running chaos-free when chaos was asked
+    for would invalidate the test."""
+    global ACTIVE, _env_checked
+    if _env_checked:
+        return
+    with _install_lock:
+        if _env_checked:
+            return
+        _env_checked = True
+        schedule = os.environ.get("RAY_TPU_FAULT_SCHEDULE")
+        if schedule:
+            ACTIVE = FaultInjector(
+                int(os.environ.get("RAY_TPU_FAULT_SEED", "0")), schedule)
+
+
+# Self-activate on import (idempotent; protocol.py calls this again for
+# processes that import the transport first) so `import fault_injection`
+# and the transports always agree on whether the plane is live.
+maybe_init_from_env()
+
+
+def apply_send_plan(plan: FaultPlan, close, method: str):
+    """Shared pre-send application: sleep the delay, then close+raise on
+    disconnect. (drop/dup need transport-specific handling, so the
+    transports consume those flags themselves.)"""
+    if plan.delay_s:
+        time.sleep(plan.delay_s)
+    if plan.disconnect:
+        try:
+            close()
+        except Exception:
+            pass
+        # late import: protocol imports this module at its own top level
+        from ray_tpu._private.protocol import ConnectionLost
+
+        raise ConnectionLost(
+            f"[fault-injection] disconnect before {method!r} "
+            f"(reproduce: {ACTIVE.banner() if ACTIVE else 'n/a'})")

@@ -20,8 +20,14 @@ holding the cluster-global state machines —
 - pubsub (pubsub_handler.h): actor state and node membership channels pushed
   to subscribed connections.
 
-State is in-memory (the reference's default InMemoryStoreClient); a snapshot
-file provides GCS restart tolerance (the reference's Redis mode analog).
+State is held in memory (the reference's default InMemoryStoreClient) and
+made durable by a pluggable write-through store (gcs_store.py: sqlite or
+append-only log — the reference's redis_store_client.h fault-tolerant
+mode): every actor/PG/KV/job mutation lands in the store before the RPC
+returns, and a restarted GCS reloads the tables then reconciles against
+the raylets that re-register (_restore_from_store /
+_reconcile_after_restart). The periodic snapshot file remains only as a
+legacy fallback for deployments without a store.
 """
 from __future__ import annotations
 
@@ -976,6 +982,10 @@ def main():  # pragma: no cover - exercised as a subprocess
     """Entry point: `python -m ray_tpu._private.gcs <port> [snapshot]
     [--store sqlite:<path>|log:<path>] [--grace <s>]`."""
     import sys
+
+    from ray_tpu._private import fault_injection
+
+    fault_injection.set_role("gcs")
 
     argv = [a for a in sys.argv[1:]]
     store = grace = None

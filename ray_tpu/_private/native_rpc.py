@@ -18,6 +18,8 @@ import pickle
 import threading
 import time
 
+from ray_tpu._private import fault_injection as _fi
+
 _REQUEST, _REPLY, _PUSH = 0, 1, 2
 _EV_DISCONNECT, _EV_CONNECT = -1, -2
 
@@ -106,19 +108,22 @@ class NativeRpcClient:
     def __init__(self, addr, timeout: float = 30.0, on_push=None,
                  retry: int = 3):
         from ray_tpu._private.protocol import ConnectionLost
+        from ray_tpu._private.retry import RetryPolicy
 
         self.addr = tuple(addr)
         self._timeout = timeout   # None = calls block until reply/close
         self._on_push = on_push
         self._lib = load_lib()
         connect_ms = int((timeout if timeout is not None else 30.0) * 1000)
+        policy = RetryPolicy(max_attempts=retry, deadline_s=None)
         handle = None
         for attempt in range(retry):
             handle = self._lib.rpc_cl_connect(
                 str(self.addr[0]).encode(), int(self.addr[1]), connect_ms)
             if handle:
                 break
-            time.sleep(0.05 * (2 ** attempt))
+            if attempt + 1 < retry:
+                time.sleep(policy.backoff(attempt + 1))
         if not handle:
             raise ConnectionLost(f"cannot connect to {self.addr}")
         self._h = handle
@@ -154,15 +159,29 @@ class NativeRpcClient:
 
         if self._closed:
             raise self._lost_error()
+        t = timeout if timeout is not None else self._timeout
+        inj = _fi.ACTIVE
+        plan = inj.on_send(method) if inj is not None else None
+        if plan is not None:
+            _fi.apply_send_plan(plan, self.close, method)
+            if plan.drop:
+                # injected loss on a sync call: the caller experiences
+                # its timeout, exactly as if the frame left and vanished
+                # (None-timeout callers get the transport default so the
+                # chaos plane can't wedge a process forever)
+                time.sleep(t if t is not None else 30.0)
+                raise TimeoutError("rpc call timed out")
         seq = self._next_seq()
         payload = pickle.dumps((method, kwargs),
                                protocol=pickle.HIGHEST_PROTOCOL)
         rc = self._lib.rpc_cl_send(self._h, _REQUEST, seq, payload,
                                    len(payload), 1)
+        if rc == 0 and plan is not None and plan.dup:
+            rc = self._lib.rpc_cl_send(self._h, _REQUEST, seq, payload,
+                                       len(payload), 1)
         if rc != 0:
             self._closed = True
             raise self._lost_error()
-        t = timeout if timeout is not None else self._timeout
         out = ctypes.c_void_p()
         out_len = ctypes.c_size_t()
         rc = self._lib.rpc_cl_wait(
@@ -185,15 +204,24 @@ class NativeRpcClient:
 
         if self._closed:
             raise self._lost_error()
+        inj = _fi.ACTIVE
+        plan = inj.on_send(method) if inj is not None else None
+        if plan is not None:
+            _fi.apply_send_plan(plan, self.close, method)
         self._ensure_pump()
         seq = self._next_seq()
         fut = _Future()
         with self._pending_lock:
             self._pending[seq] = fut
+        if plan is not None and plan.drop:
+            return fut   # injected message loss: registered, never sent
         payload = pickle.dumps((method, kwargs),
                                protocol=pickle.HIGHEST_PROTOCOL)
         rc = self._lib.rpc_cl_send(self._h, _REQUEST, seq, payload,
                                    len(payload), 0)
+        if rc == 0 and plan is not None and plan.dup:
+            rc = self._lib.rpc_cl_send(self._h, _REQUEST, seq, payload,
+                                       len(payload), 0)
         if rc != 0:
             with self._pending_lock:
                 self._pending.pop(seq, None)
@@ -210,10 +238,19 @@ class NativeRpcClient:
     def push(self, method: str, **kwargs):
         if self._closed:
             raise self._lost_error()
+        inj = _fi.ACTIVE
+        plan = inj.on_send(method) if inj is not None else None
+        if plan is not None:
+            _fi.apply_send_plan(plan, self.close, method)
+            if plan.drop:
+                return   # injected loss: one-way messages vanish silently
         payload = pickle.dumps((method, kwargs),
                                protocol=pickle.HIGHEST_PROTOCOL)
         rc = self._lib.rpc_cl_send(self._h, _PUSH, 0, payload,
                                    len(payload), 0)
+        if rc == 0 and plan is not None and plan.dup:
+            rc = self._lib.rpc_cl_send(self._h, _PUSH, 0, payload,
+                                       len(payload), 0)
         if rc != 0:
             self._closed = True
             raise self._lost_error()
@@ -374,6 +411,11 @@ class NativeRpcServer:
             result = _RemoteError(e)
         if result is NO_REPLY:
             return
+        inj = _fi.ACTIVE
+        if inj is not None:
+            stall = inj.on_reply(method)
+            if stall:
+                time.sleep(stall)   # injected slow peer (GC pause analog)
         conn.reply(seq, result)
 
     def _pump_loop(self):

@@ -31,6 +31,13 @@ import time
 import traceback
 import uuid
 
+from ray_tpu._private import fault_injection as _fi
+
+# Chaos plane: RAY_TPU_FAULT_SCHEDULE activates the injector for every
+# transport in this process (and, via env inheritance, every spawned
+# cluster process). Disabled cost per call: one global load + None check.
+_fi.maybe_init_from_env()
+
 REQUEST, REPLY, PUSH = 0, 1, 2
 
 # Bump on any incompatible frame-layout/semantics change. Must match
@@ -110,9 +117,12 @@ class PyRpcClient:
 
     def __init__(self, addr: tuple[str, int], timeout: float = 30.0,
                  on_push=None, retry: int = 3):
+        from ray_tpu._private.retry import RetryPolicy
+
         self.addr = tuple(addr)
         self._timeout = timeout
         self._on_push = on_push
+        policy = RetryPolicy(max_attempts=retry, deadline_s=None)
         last = None
         for attempt in range(retry):
             try:
@@ -120,7 +130,8 @@ class PyRpcClient:
                 break
             except OSError as e:
                 last = e
-                time.sleep(0.05 * (2 ** attempt))
+                if attempt + 1 < retry:
+                    time.sleep(policy.backoff(attempt + 1))
         else:
             raise ConnectionLost(f"cannot connect to {self.addr}: {last}")
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -184,15 +195,30 @@ class PyRpcClient:
 
     def call(self, method: str, timeout: float | None = None, **kwargs):
         """Synchronous request/reply."""
-        return self.call_async(method, **kwargs).result(
-            timeout if timeout is not None else self._timeout)
+        fut = self.call_async(method, **kwargs)
+        try:
+            return fut.result(
+                timeout if timeout is not None else self._timeout)
+        except TimeoutError:
+            # Nobody will ever consume this future — reap its _pending
+            # slot now instead of carrying it for the connection's
+            # lifetime (a late reply finds the slot empty and is
+            # dropped; injected drops would otherwise leak one slot per
+            # fault over a long chaos soak).
+            self._pending.pop(fut.seq, None)
+            raise
 
     def call_async(self, method: str, **kwargs) -> "_Future":
         if self._closed:
             raise self._mismatch or ConnectionLost(
                 f"connection to {self.addr} closed")
+        inj = _fi.ACTIVE
+        plan = inj.on_send(method) if inj is not None else None
+        if plan is not None:
+            _fi.apply_send_plan(plan, self.close, method)
         seq = self._next_seq()
         fut = _Future()
+        fut.seq = seq   # lets the sync path reap _pending on timeout
         self._pending[seq] = fut
         # Re-check after registering: the reader may have drained _pending on
         # connection loss between the check above and the insert, which would
@@ -201,8 +227,15 @@ class PyRpcClient:
             self._pending.pop(seq, None)
             raise self._mismatch or ConnectionLost(
                 f"connection to {self.addr} closed")
+        if plan is not None and plan.drop:
+            return fut   # injected message loss: registered, never sent
         try:
             _send_frame(self._sock, REQUEST, seq, (method, kwargs), self._wlock)
+            if plan is not None and plan.dup:
+                # same seq twice: the duplicate reply is discarded by the
+                # _pending pop; the SERVER sees (and must tolerate) both
+                _send_frame(self._sock, REQUEST, seq, (method, kwargs),
+                            self._wlock)
         except OSError as e:
             self._pending.pop(seq, None)
             self._closed = True
@@ -214,8 +247,17 @@ class PyRpcClient:
         if self._closed:
             raise self._mismatch or ConnectionLost(
                 f"connection to {self.addr} closed")
+        inj = _fi.ACTIVE
+        plan = inj.on_send(method) if inj is not None else None
+        if plan is not None:
+            _fi.apply_send_plan(plan, self.close, method)
+            if plan.drop:
+                return   # injected loss: one-way messages vanish silently
         try:
             _send_frame(self._sock, PUSH, 0, (method, kwargs), self._wlock)
+            if plan is not None and plan.dup:
+                _send_frame(self._sock, PUSH, 0, (method, kwargs),
+                            self._wlock)
         except OSError as e:
             self._closed = True
             raise ConnectionLost(str(e)) from e
@@ -430,6 +472,11 @@ class PyRpcServer:
             result = _RemoteError(e)
         if result is NO_REPLY:
             return
+        inj = _fi.ACTIVE
+        if inj is not None:
+            stall = inj.on_reply(method)
+            if stall:
+                time.sleep(stall)   # injected slow peer (GC pause analog)
         try:
             _send_frame(conn.sock, REPLY, seq, result, conn.wlock)
         except OSError:
@@ -515,6 +562,17 @@ def RpcServer(handler, host: str = "127.0.0.1", port: int = 0):
     return PyRpcServer(handler, host=host, port=port)
 
 
+class _ReconnectFailed(Exception):
+    """Internal sentinel: the heal attempt found the endpoint DEAD (its
+    own connect failed). Deliberately NOT a ConnectionLost subclass so
+    the retry policy's retry_on can't catch it — the caller unwraps
+    `.cause` back into the original ConnectionLost."""
+
+    def __init__(self, cause: BaseException):
+        self.cause = cause
+        super().__init__(str(cause))
+
+
 class ReconnectingRpcClient:
     """Self-healing client for control-plane endpoints that may RESTART
     (the GCS in fault-tolerant mode). On ConnectionLost the call
@@ -523,12 +581,22 @@ class ReconnectingRpcClient:
     gcs_rpc_client.h reconnection + node_manager.cc:1179
     HandleNotifyGCSRestart re-registration).
 
-    Only safe for idempotent protocols — GCS table ops are (register_*
-    overwrite by id, kv_put overwrites, actor_started re-announces).
-    Non-idempotent ops may ride it ONLY when the server dedups them
-    (the ray:// client pairs every submit/put with a session req_id
-    the proxy caches); adding a new non-idempotent op without that
-    pairing reintroduces double-apply on retry.
+    Retry semantics ride the unified control-plane policy
+    (_private/retry.py): per-method idempotency decides whether a call
+    that MAY have been applied is re-sent at all (non-retry-safe
+    methods fail fast — actor_failed double-charges the restart budget
+    on replay), retries back off with full jitter under a wall-clock
+    deadline that also shrinks each attempt's RPC timeout, and a
+    process-wide budget bounds retry amplification during an outage.
+    Message shapes of the top control RPCs are validated HERE, at the
+    producer boundary (task_spec.validate_control_rpc), so a typo'd
+    field fails in the calling process, not as a KeyError in the GCS.
+
+    GCS table ops are retry-safe (register_* overwrite by id, kv_put
+    overwrites, actor_started re-announces). A new non-idempotent op
+    must either be listed in retry.NON_RETRY_SAFE_RPCS or be deduped
+    server-side (the ray:// client pairs every submit/put with a
+    session req_id the proxy caches).
     """
 
     def __init__(self, addr, timeout: float = 30.0, on_push=None,
@@ -541,6 +609,7 @@ class ReconnectingRpcClient:
         self._client = RpcClient(self.addr, timeout=timeout,
                                  on_push=on_push)
         self._shutdown = False
+        self._policy = None   # default-timeout RetryPolicy, built lazily
 
     def _reconnect(self):
         with self._lock:
@@ -562,28 +631,88 @@ class ReconnectingRpcClient:
             return fresh
 
     def call(self, method: str, timeout: float | None = None, **kwargs):
-        try:
+        from ray_tpu._private.retry import RetryPolicy, is_retry_safe
+        from ray_tpu._private.task_spec import validate_control_rpc
+
+        validate_control_rpc(method, kwargs)
+        if not is_retry_safe(method):
+            # fail fast: a replay of e.g. actor_failed after an
+            # applied-then-died server would double-apply
             return self._client.call(method, timeout=timeout, **kwargs)
-        except ConnectionLost:
-            return self._reconnect().call(method, timeout=timeout, **kwargs)
+        if timeout is None:
+            # default-timeout calls ride the full policy (config attempt
+            # timeout, config deadline — timeouts retried); cached, the
+            # config is static for the client's lifetime
+            policy = self._policy
+            if policy is None:
+                from ray_tpu._private.config import get_config
+
+                policy = RetryPolicy.from_config(
+                    attempt_timeout_s=float(
+                        get_config("gcs_rpc_timeout_s")))
+                self._policy = policy
+        else:
+            # an EXPLICIT timeout is the caller's liveness bound: honor
+            # it as the overall deadline (one full-length attempt; only
+            # ConnectionLost retries fit inside the remainder) instead
+            # of multiplying it per attempt
+            policy = RetryPolicy.from_config(attempt_timeout_s=timeout,
+                                             deadline_s=timeout)
+
+        def attempt(attempt_timeout):
+            try:
+                return self._client.call(method, timeout=attempt_timeout,
+                                         **kwargs)
+            except ConnectionLost:
+                if self._shutdown:
+                    raise
+                # Heal the channel, then charge this as one failed
+                # attempt (the policy sleeps + re-enters attempt()).
+                # If the reconnect ITSELF fails the server is down, not
+                # flaky — fail after this one reconnect attempt instead
+                # of burning the retry budget against a dead endpoint
+                # (teardown paths hit this on every post-shutdown call;
+                # pre-policy semantics). The sentinel wrapper keeps the
+                # policy's retry_on from catching the reconnect failure
+                # (a ConnectionLost subclass would still match).
+                try:
+                    self._reconnect()
+                except ConnectionLost as dead:
+                    raise _ReconnectFailed(dead) from dead
+                raise
+
+        try:
+            return policy.run(attempt, method=method,
+                              retry_on=(ConnectionLost, TimeoutError))
+        except _ReconnectFailed as rf:
+            raise rf.cause
 
     def call_once(self, method: str, timeout: float | None = None,
                   **kwargs):
         """Single attempt, NO retry — for ops that are not idempotent
         (e.g. actor_failed consumes restart budget: a retry after the
         server applied-then-died would double-charge it)."""
+        from ray_tpu._private.task_spec import validate_control_rpc
+
+        validate_control_rpc(method, kwargs)
         return self._client.call(method, timeout=timeout, **kwargs)
 
     def call_async(self, method: str, **kwargs):
         """Async submit; the retry covers only a dead connection at
         SUBMIT time — a future that later fails with ConnectionLost is
         the caller's to handle (retrying it here could double-apply)."""
+        from ray_tpu._private.task_spec import validate_control_rpc
+
+        validate_control_rpc(method, kwargs)
         try:
             return self._client.call_async(method, **kwargs)
         except ConnectionLost:
             return self._reconnect().call_async(method, **kwargs)
 
     def push(self, method: str, **kwargs):
+        from ray_tpu._private.task_spec import validate_control_rpc
+
+        validate_control_rpc(method, kwargs)
         try:
             self._client.push(method, **kwargs)
         except ConnectionLost:

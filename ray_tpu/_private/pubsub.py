@@ -255,30 +255,46 @@ class Subscriber:
         self._stopped.set()
 
     def _loop(self):
-        backoff = 0.1
+        from ray_tpu._private.retry import RetryPolicy
+        from ray_tpu._private.task_spec import validate_pubsub_ack
+
+        # consecutive-failure backoff rides the unified policy's
+        # full-jitter curve (was a hand-rolled *2-capped sleep); no
+        # attempt cap — a long-poll loop retries for the process
+        # lifetime, the policy only shapes the pauses
+        policy = RetryPolicy(base_backoff_s=0.1, max_backoff_s=5.0,
+                             deadline_s=None)
+        failures = 0
         while not self._stopped.is_set():
             try:
                 with self._lock:
                     sub_id = self._sub_id
                     after = self._last_seq
                     epoch = self._floor_epoch
+                validate_pubsub_ack(sub_id, after)   # producer-side shape
+                from ray_tpu._private.config import get_config
+
+                # transport slack past the server's park window rides the
+                # unified control-plane timeout (was a hardcoded +30s —
+                # a lost poll request then stalled the loop half a minute)
                 mail, max_seq, dropped = self._rpc.call(
                     "psub_poll", sub_id=sub_id,
                     after_seq=after,
                     poll_timeout=self._poll_timeout,
-                    timeout=self._poll_timeout + 30)
+                    timeout=self._poll_timeout +
+                    float(get_config("gcs_rpc_timeout_s")))
                 with self._lock:
                     # a resync while this poll was in flight makes its
                     # max_seq meaningless in the new seq space
                     if self._floor_epoch == epoch:
                         self._last_seq = max_seq
                 self._note_gap(dropped)   # mailbox-overflow losses
-                backoff = 0.1
+                failures = 0
             except Exception:
                 if self._stopped.is_set():
                     return
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 5.0)
+                failures += 1
+                time.sleep(policy.backoff(failures))
                 # re-announce (the publisher may have GC'd us)
                 gap = 0
                 try:

@@ -827,10 +827,15 @@ class Raylet:
         worker.assigned_lease = lease_id
         with self._lock:
             self._leases[lease_id] = lease
-        return {"granted": {"lease_id": lease_id,
-                            "worker_id": worker.worker_id,
-                            "worker_addr": worker.addr,
-                            "node_id": self.node_id}}
+        grant = {"lease_id": lease_id,
+                 "worker_id": worker.worker_id,
+                 "worker_addr": worker.addr,
+                 "node_id": self.node_id}
+        # producer-side shape check: the lessee reads exactly these keys
+        from ray_tpu._private.task_spec import validate_lease_grant
+
+        validate_lease_grant(grant)
+        return {"granted": grant}
 
     def _pg_lease(self, pg_id: bytes, bundle_index: int, resources: dict,
                   lessee: tuple | None = None):

@@ -1,0 +1,233 @@
+"""Unified control-plane retry policy: backoff, deadlines, idempotency.
+
+Before this module every retry decision was local folklore — a fixed
+``retry: int = 3`` connect loop in protocol.py, a hand-rolled
+exponential sleep in pubsub.py, one blind reconnect-and-retry in
+ReconnectingRpcClient, and bare ``except ConnectionLost: pass`` at
+assorted call sites. The reference concentrates this in one place
+(gRPC channel retry args + per-call-site policy in gcs_rpc_client.h);
+this module is our analog:
+
+- ``RetryPolicy``: exponential backoff with FULL jitter (AWS-style:
+  ``sleep = uniform(0, min(cap, base * 2**attempt))`` — decorrelated
+  herds beat synchronized ones), a per-call deadline that bounds total
+  time across attempts AND shrinks each attempt's RPC timeout to the
+  remaining budget, and a max-attempt count.
+- A process-wide ``RetryBudget``: a token bucket that bounds cluster
+  retry amplification. When a dependency is hard-down, unbounded
+  per-call retries turn N callers into N*attempts hammering it; once
+  the bucket drains, calls fail fast until it refills.
+- The idempotency registry: per-RPC-method flags saying whether a call
+  that MAY have been applied server-side can be safely re-sent.
+  Retry-safe here means "replay is harmless", which is weaker than
+  strictly idempotent — e.g. ``next_job_id`` replayed mints a fresh
+  (still unique) id. Non-retry-safe methods fail fast instead of
+  blind-retrying (``actor_failed`` double-charges the restart budget).
+
+Consumers: protocol.ReconnectingRpcClient (GCS table ops),
+worker_runtime.request_lease (raylet lease path) and _pull_rpc
+(object-pull chunks), pubsub.Subscriber (poll-loop backoff), and
+autoscaler.tpu_provider.GceTpuApi (HTTP 429/503).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+# --------------------------------------------------------------- idempotency
+#
+# Control-plane methods where re-sending a request that may already have
+# been applied is harmless. Everything NOT listed fails fast on
+# ConnectionLost/timeout — add a method here only after checking its
+# replay semantics (the comment says why each entry is safe).
+
+RETRY_SAFE_RPCS = frozenset({
+    # GCS tables: keyed overwrites / pure reads
+    "register_node", "subscribe", "get_nodes", "cluster_resources",
+    "get_cluster_load", "debug_state", "list_objects", "save_snapshot",
+    "kv_put", "kv_get", "kv_del", "kv_exists", "kv_keys",
+    "add_object_location", "remove_object_location",
+    "get_object_locations", "free_objects",
+    # actor table: registration dedups by actor_id, started/exited
+    # re-announce state the GCS overwrites by id
+    "register_actor", "actor_started", "actor_exited", "get_actor",
+    "list_actors", "list_named_actors",
+    # placement groups: create replays overwrite by pg_id; reads are pure
+    "create_placement_group", "get_placement_group",
+    "remove_placement_group", "list_placement_groups",
+    # replay mints a FRESH id — wastes one, ids stay unique
+    "next_job_id",
+    # pubsub: at-least-once by contract (subscribers dedup by seq floor);
+    # a duplicated publish is a duplicate delivery consumers tolerate
+    "publish", "psub_subscribe", "psub_unsubscribe", "psub_poll",
+    # raylet: a lease grant whose reply was lost leaks a lease the
+    # lessee-GC reaps (worker death / remote-lessee sweep); return is
+    # idempotent by lease_id
+    "request_worker_lease", "return_worker", "register_worker",
+    # object plane: pure reads
+    "fetch_object", "fetch_object_chunk", "get_owned_value",
+    "locate_object", "store_stats", "node_info", "ping", "task_state",
+    "report_resources", "drain_node",
+    # ray:// client protocol: the proxy DEDUPS every mutating op by the
+    # session-scoped req_id the client attaches (util/client/server.py),
+    # so replay across a proxy restart is safe — these were built to
+    # ride ReconnectingRpcClient's heal-and-retry (session resume via
+    # on_reconnect replaying client_hello)
+    "client_hello", "client_put", "client_put_chunk", "client_get",
+    "client_get_chunk", "client_wait", "client_submit_task",
+    "client_submit_actor_task", "client_create_actor",
+    "client_register_function", "client_gcs_call", "client_cancel",
+    "client_kill", "client_release", "client_available_resources",
+    "client_timeline",   # pure read (api.timeline())
+})
+
+# Methods whose replay is actively harmful — documented fail-fast. (Not
+# the complement of RETRY_SAFE_RPCS: unknown methods also fail fast; this
+# set exists so is_retry_safe(m, default=True) callers still refuse them.)
+NON_RETRY_SAFE_RPCS = frozenset({
+    # consumes the actor restart budget: applied-then-lost + retry
+    # double-charges it (protocol.ReconnectingRpcClient.call_once doc)
+    "actor_failed",
+    # task execution: at-most-once per attempt; retries are the task
+    # layer's job (retries_left) which knows about side effects
+    "push_task",
+    # actor creation is driven by _drive_actor_creation with its own
+    # spillback walk + actor_failed terminal path
+    "create_actor",
+})
+
+
+def is_retry_safe(method: str, default: bool = False) -> bool:
+    if method in NON_RETRY_SAFE_RPCS:
+        return False
+    if method in RETRY_SAFE_RPCS:
+        return True
+    return default
+
+
+# -------------------------------------------------------------------- budget
+
+
+class RetryBudget:
+    """Token bucket bounding process-wide retry amplification. take()
+    consumes one token per actual retry (first attempts are free);
+    tokens refill continuously at ``refill_per_s`` up to ``capacity``."""
+
+    def __init__(self, capacity: float = 100.0, refill_per_s: float = 10.0):
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self._tokens = float(capacity)
+        self._stamp = time.monotonic()
+        self._lock = threading.Lock()
+        self.exhausted_count = 0   # observability: fail-fasts due to budget
+
+    def take(self) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.capacity,
+                self._tokens + (now - self._stamp) * self.refill_per_s)
+            self._stamp = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            self.exhausted_count += 1
+            return False
+
+
+_default_budget = RetryBudget()
+
+
+def default_budget() -> RetryBudget:
+    return _default_budget
+
+
+# -------------------------------------------------------------------- policy
+
+
+class RetryPolicy:
+    """max_attempts × exponential-backoff-with-full-jitter, bounded by a
+    wall-clock deadline that also shrinks each attempt's RPC timeout.
+
+    ``attempt_timeout_s`` is the per-attempt RPC timeout; each attempt
+    actually gets ``min(attempt_timeout_s, deadline remainder)`` so the
+    last attempt cannot blow through the deadline.
+    """
+
+    def __init__(self, max_attempts: int = 5,
+                 base_backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0,
+                 deadline_s: float | None = 60.0,
+                 attempt_timeout_s: float | None = None,
+                 budget: RetryBudget | None = None):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.deadline_s = deadline_s
+        self.attempt_timeout_s = attempt_timeout_s
+        self.budget = budget if budget is not None else _default_budget
+
+    @classmethod
+    def from_config(cls, attempt_timeout_s: float | None = None,
+                    deadline_s: float | None = None) -> "RetryPolicy":
+        from ray_tpu._private.config import get_config
+
+        return cls(
+            max_attempts=int(get_config("rpc_retry_max_attempts")),
+            base_backoff_s=float(get_config("rpc_retry_base_backoff_s")),
+            max_backoff_s=float(get_config("rpc_retry_max_backoff_s")),
+            deadline_s=(deadline_s if deadline_s is not None
+                        else float(get_config("rpc_retry_deadline_s"))),
+            attempt_timeout_s=attempt_timeout_s)
+
+    def backoff(self, attempt: int) -> float:
+        """Sleep before retry number ``attempt`` (1-indexed): full
+        jitter over an exponentially growing cap."""
+        cap = min(self.max_backoff_s,
+                  self.base_backoff_s * (2 ** max(0, attempt - 1)))
+        return random.uniform(0.0, cap)
+
+    def run(self, fn, *, method: str | None = None,
+            retry_on: tuple = (), describe: str = ""):
+        """Run ``fn(attempt_timeout_s)`` under this policy.
+
+        ``fn`` receives the per-attempt timeout (None = no cap) and must
+        raise to signal failure. Exceptions whose type is in
+        ``retry_on`` are retried (subject to method retry-safety, the
+        attempt count, the deadline, and the global budget); everything
+        else propagates immediately.
+        """
+        deadline = (time.monotonic() + self.deadline_s
+                    if self.deadline_s is not None else None)
+        retry_allowed = method is None or is_retry_safe(method)
+        attempt = 0
+        while True:
+            attempt += 1
+            timeout = self.attempt_timeout_s
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    remaining = 0.001   # one last, effectively-instant try
+                timeout = (min(timeout, remaining)
+                           if timeout is not None else remaining)
+            try:
+                return fn(timeout)
+            except retry_on as e:
+                if not retry_allowed:
+                    raise
+                if attempt >= self.max_attempts:
+                    raise
+                if deadline is not None and \
+                        time.monotonic() >= deadline:
+                    raise
+                if not self.budget.take():
+                    raise   # budget drained: stop amplifying the outage
+                pause = self.backoff(attempt)
+                if deadline is not None:
+                    pause = min(pause,
+                                max(0.0, deadline - time.monotonic()))
+                if pause > 0:
+                    time.sleep(pause)
+                _ = e   # (kept for symmetry with debuggers' locals view)

@@ -18,6 +18,9 @@ def main():
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)  # `ray stack`
     faulthandler.enable()   # SIGSEGV/SIGABRT dump to stderr (worker logs)
+    from ray_tpu._private import fault_injection
+
+    fault_injection.set_role("worker")
     gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].split(":")
     raylet_host, raylet_port = os.environ["RAY_TPU_RAYLET_ADDR"].split(":")
 

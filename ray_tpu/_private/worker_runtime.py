@@ -360,15 +360,24 @@ class _SchedulingKeyQueue:
             client = RpcClient(tuple(grant["worker_addr"]), timeout=None)
             lw = _LeasedWorker(grant, client)
             self._lease_timeouts = 0
+            self._lease_conn_failures = 0
             with self._lock:
                 self.leased.append(lw)
         except ConnectionLost:
             # Transient: the raylet we were talking to (or spilled to) died
             # mid-request. The cluster view heals within a heartbeat —
             # back off and let the dispatch loop re-request instead of
-            # condemning every queued task (chaos-test finding).
+            # condemning every queued task (chaos-test finding). Pause
+            # shape comes from the unified policy (full jitter over
+            # consecutive failures) so a fleet of queues doesn't
+            # re-request in lockstep.
+            from ray_tpu._private.retry import RetryPolicy
+
             self._lease_timeouts = 0
-            time.sleep(0.2)
+            self._lease_conn_failures = getattr(
+                self, "_lease_conn_failures", 0) + 1
+            time.sleep(RetryPolicy(base_backoff_s=0.2, max_backoff_s=2.0)
+                       .backoff(self._lease_conn_failures))
         except TimeoutError as e:
             # A full 300s raylet queue timeout is retried (capacity may be
             # coming: autoscaler, chaos replacement) — but not forever: two
@@ -641,6 +650,11 @@ class CoreWorker:
                  store_name: str | None = None, spill_dir: str | None = None,
                  worker_id: str | None = None, job_id: int | None = None):
         self.mode = mode                      # "driver" | "worker"
+        # tag the process for role-scoped fault-injection rules (weak:
+        # in-process test clusters keep the subprocess entrypoint's tag)
+        from ray_tpu._private import fault_injection
+
+        fault_injection.set_role(mode, weak=True)
         self.worker_id = worker_id or uuid.uuid4().hex[:16]
         self.stopped = False
         # id mint: random 8-byte process prefix + counter. Ids need
@@ -1515,16 +1529,38 @@ class CoreWorker:
                     except OSError:
                         pass
 
-    def _pull_rpc(self, object_id: bytes, addr, chunk: int):
-        """Fallback chunk fetch over the Python RPC plane."""
-        try:
-            client = RpcClient(addr, timeout=120.0)
-        except ConnectionLost:
-            return None
+    def _pull_rpc(self, object_id: bytes, chunk_addr, chunk: int):
+        """Fallback chunk fetch over the Python RPC plane. Chunk reads
+        are pure (retry-safe), so transient connection loss or a timed-
+        out chunk reconnects and resumes AT THE CURRENT OFFSET under the
+        unified policy instead of abandoning the whole pull (and with it
+        possibly the object's only reachable copy)."""
+        from ray_tpu._private.retry import RetryPolicy
+
+        # few, fast attempts: a holder that refuses twice is usually
+        # DEAD (node removal), and the caller already falls back to
+        # other replicas / the owner poll — don't stall that failover
+        policy = RetryPolicy(max_attempts=3, base_backoff_s=0.05,
+                             max_backoff_s=0.5, deadline_s=240.0,
+                             attempt_timeout_s=120.0)
+        clientbox = [None]
+
+        def fetch(offset, attempt_timeout):
+            if clientbox[0] is None or clientbox[0].closed:
+                # retry=1: re-dialing a refused connect is the POLICY's
+                # job here; stacking the constructor's own retry loop
+                # under it would triple every failover pause
+                clientbox[0] = RpcClient(chunk_addr, timeout=120.0,
+                                         retry=1)
+            return clientbox[0].call("fetch_object_chunk",
+                                     object_id=object_id, offset=offset,
+                                     length=chunk, timeout=attempt_timeout)
+
         admitted = 0
         try:
-            first = client.call("fetch_object_chunk", object_id=object_id,
-                                offset=0, length=chunk)
+            first = policy.run(lambda t: fetch(0, t),
+                               method="fetch_object_chunk",
+                               retry_on=(ConnectionLost, TimeoutError))
             if first is None:
                 return None
             size = first["size"]
@@ -1532,9 +1568,9 @@ class CoreWorker:
             self._admit_pull(size)
             data = bytearray(first["data"])
             while len(data) < size:
-                part = client.call("fetch_object_chunk",
-                                   object_id=object_id,
-                                   offset=len(data), length=chunk)
+                part = policy.run(lambda t: fetch(len(data), t),
+                                  method="fetch_object_chunk",
+                                  retry_on=(ConnectionLost, TimeoutError))
                 if part is None:   # evicted mid-pull
                     return None
                 data += part["data"]
@@ -1544,7 +1580,8 @@ class CoreWorker:
         finally:
             if admitted:
                 self._release_pull(admitted)
-            client.close()
+            if clientbox[0] is not None:
+                clientbox[0].close()
 
     def _admit_pull(self, nbytes: int):
         """Block until the pull fits the in-flight budget (always admit when
@@ -2051,6 +2088,11 @@ class CoreWorker:
     def request_lease(self, resources, strategy, max_spillbacks: int = 16):
         """Walk the spillback chain until granted (reference:
         direct_task_transport RequestNewWorkerIfNeeded + spillback replies)."""
+        from ray_tpu._private.task_spec import validate_lease_request
+
+        # producer-side shape check: a typo'd resource/strategy key fails
+        # here, not as an ignored kwarg inside a remote raylet
+        validate_lease_request(resources, strategy)
         target = self.raylet
         opened = None
         try:

@@ -286,23 +286,63 @@ class MockTpuApi(TpuApi):
         return proc, node_id
 
 
-class GceTpuApi(TpuApi):
-    """Request shapes for the real GCE queued-resources API.
+class TpuApiError(RuntimeError):
+    """A GCE QR call failed terminally (after any retries)."""
 
-    Builds the exact REST bodies/URLs (tpu.googleapis.com v2alpha1
-    queuedResources); `_execute` performs the HTTP call and is the
-    single override point — tests inject a recorder, deployments can
-    wire real credentials. Reference request shape:
-    autoscaler/_private/gcp/node.py create_instance + the QR API docs'
-    tpu.nodeSpec form.
+    def __init__(self, status: int, message: str):
+        self.status = status
+        super().__init__(f"TPU API error {status}: {message}")
+
+
+class TpuAuthError(TpuApiError):
+    """401/403 — bad or missing credentials; never retried."""
+
+
+class TpuQuotaError(TpuApiError):
+    """429 that outlived the retry budget (QR quota exhaustion)."""
+
+
+class GceTpuApi(TpuApi):
+    """The real GCE queued-resources API (tpu.googleapis.com v2alpha1).
+
+    Builds the exact REST bodies/URLs and issues them through two
+    injectable seams so the whole path is testable against canned
+    responses (tests/test_tpu_provider.py replay fixtures) and
+    deployable without code changes:
+
+    - ``http(method, url, body_bytes, headers) -> (status, body_bytes)``
+      — the transport. Defaults to urllib; tests inject a recorder.
+    - ``token_provider() -> str`` — OAuth2 bearer token source.
+      Defaults to the GCE metadata server (the only ambient credential
+      on a TPU VM); tests inject a stub.
+
+    ``_execute`` layers the control-plane policy on top: every request
+    carries the bearer token, 429/503 (and 500) retry with full-jitter
+    backoff under the unified RetryPolicy, 401/403 map to TpuAuthError
+    with NO retry (re-sending bad credentials just burns quota), a 429
+    that outlives the budget maps to TpuQuotaError, DELETE 404 is
+    swallowed (releasing an already-released slice is a no-op — the
+    provider's terminate path double-asks by design), and any other
+    non-2xx maps to TpuApiError carrying the server's error message.
+    Reference request shape: autoscaler/_private/gcp/node.py
+    create_instance + the QR API docs' tpu.nodeSpec form.
     """
 
+    API_ROOT = "https://tpu.googleapis.com/v2alpha1"
+    METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata"
+                          "/v1/instance/service-accounts/default/token")
+    RETRY_STATUSES = (429, 500, 503)
+
     def __init__(self, project: str, zone: str,
-                 runtime_version: str = "v2-alpha-tpuv5-lite"):
+                 runtime_version: str = "v2-alpha-tpuv5-lite",
+                 token_provider=None, http=None):
         self.project = project
         self.zone = zone
         self.runtime_version = runtime_version
         self._parent = f"projects/{project}/locations/{zone}"
+        self._token_provider = token_provider
+        self._http = http if http is not None else self._urllib_http
+        self._token_cache: tuple[str, float] | None = None
 
     def create_slice(self, name, accelerator_type, topology, hosts,
                      node_config):
@@ -360,11 +400,121 @@ class GceTpuApi(TpuApi):
                         "hosts": hosts})
         return out
 
+    # ---------------------------------------------------------- transport
+
+    @staticmethod
+    def _urllib_http(method: str, url: str, body: bytes | None,
+                     headers: dict) -> tuple[int, bytes]:
+        """Default transport (only touched when no `http` was injected —
+        CI never reaches it). Returns (status, body) for ALL statuses so
+        _execute owns the error mapping."""
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(url, data=body, headers=headers,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def _token(self) -> str:
+        if self._token_provider is not None:
+            return self._token_provider()
+        # ambient credentials: the GCE/TPU-VM metadata server. Tokens
+        # live ~1h (expires_in); cache until near expiry so retries and
+        # the autoscaler's reconcile ticks don't double every API call
+        # with a metadata round trip.
+        cached = self._token_cache
+        if cached is not None and time.monotonic() < cached[1]:
+            return cached[0]
+        status, body = self._http(
+            "GET", self.METADATA_TOKEN_URL,
+            None, {"Metadata-Flavor": "Google"})
+        if status in self.RETRY_STATUSES:
+            # metadata-server hiccups are transient (Google's own auth
+            # libraries retry them) — surface as retryable, NOT as a
+            # credentials problem the operator would chase
+            raise TimeoutError(f"metadata server transient {status}")
+        if status != 200:
+            raise TpuAuthError(
+                status, "no token_provider and the metadata server "
+                        "returned no default service-account token")
+        payload = json.loads(body)
+        token = payload["access_token"]
+        # refresh 60s early; a missing expires_in means no caching
+        ttl = float(payload.get("expires_in", 0)) - 60.0
+        if ttl > 0:
+            self._token_cache = (token, time.monotonic() + ttl)
+        return token
+
+    @staticmethod
+    def _error_message(body: bytes) -> str:
+        try:
+            err = json.loads(body).get("error", {})
+            return err.get("message") or err.get("status") or repr(body)
+        except Exception:
+            return body[:200].decode("utf-8", "replace")
+
     def _execute(self, method: str, path: str, body: dict | None):
-        raise NotImplementedError(
-            "GceTpuApi builds QR request shapes; wire _execute to an "
-            "authenticated HTTP transport to issue them (no cloud "
-            "credentials/egress in this environment)")
+        from ray_tpu._private.retry import RetryPolicy
+
+        url = f"{self.API_ROOT}/{path}"
+        payload = (json.dumps(body).encode() if body is not None else None)
+        policy = RetryPolicy.from_config()
+        last = [None]   # (status, body) of the final attempt
+
+        def attempt(_timeout):
+            last[0] = None   # only the FINAL attempt's status may map
+            headers = {"Authorization": f"Bearer {self._token()}",
+                       "Content-Type": "application/json"}
+            try:
+                status, resp = self._http(method, url, payload, headers)
+            except TimeoutError:
+                raise
+            except OSError as e:
+                # network-level transport failure (URLError: refused /
+                # reset / DNS, connect timeout) — exactly the transient
+                # class the retry layer absorbs; surfaced as retryable
+                raise TimeoutError(f"transport error: {e}") from e
+            last[0] = (status, resp)
+            if status in self.RETRY_STATUSES:
+                # surfaced as TimeoutError so the policy's retry_on can
+                # stay exception-typed; mapped to the real error below
+                raise TimeoutError(f"retryable status {status}")
+            return status, resp
+
+        try:
+            # QR mutations replay safely: create is keyed by
+            # queued_resource_id (a replay of an applied create returns
+            # ALREADY_EXISTS, not a second slice), delete/get are
+            # idempotent — so 429/503/500 retry under the policy
+            status, resp = policy.run(
+                attempt, retry_on=(TimeoutError,))
+        except TimeoutError as e:
+            if last[0] is None:
+                # the transport itself failed on the final attempt
+                # (socket.timeout IS TimeoutError; URLError/metadata
+                # hiccups are re-surfaced as one) — no HTTP status to map
+                raise TpuApiError(
+                    0, f"transport failure talking to {url}: {e}") from e
+            status, resp = last[0]   # retries exhausted on 429/500/503
+        if 200 <= status < 300:
+            return json.loads(resp) if resp else {}
+        message = self._error_message(resp)
+        if status in (401, 403):
+            raise TpuAuthError(status, message)
+        if status == 429:
+            raise TpuQuotaError(status, f"QUOTA_EXHAUSTED: {message}")
+        if status == 404 and method == "DELETE":
+            return {}   # releasing an already-released slice is a no-op
+        if status == 409 and method == "POST":
+            # ALREADY_EXISTS: our earlier attempt was applied before its
+            # reply was lost (the very replay the retry comment above
+            # relies on) — the slice exists, so the create SUCCEEDED
+            return {}
+        raise TpuApiError(status, message)
 
 
 def _hosts_for(node: dict) -> int:

@@ -37,9 +37,14 @@ def main(argv=None):
                         "(head only; zero-window fault tolerance)")
     args = p.parse_args(argv)
 
+    from ray_tpu._private import fault_injection
     from ray_tpu._private.gcs import GcsServer
     from ray_tpu._private.raylet import Raylet, detect_resources
 
+    # role tag for role-scoped fault schedules; a head node hosts GCS +
+    # raylet in one process, so the finer "gcs" tag applies only to the
+    # dedicated gcs.main entrypoint
+    fault_injection.set_role("gcs" if args.head else "raylet", weak=True)
     os.makedirs(SESSION_ROOT, exist_ok=True)
     extra = json.loads(args.resources) if args.resources else None
 

@@ -26,6 +26,44 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def _fault_banner() -> str | None:
+    """The active fault-injection plane as one reproducible line (an
+    in-process install() wins over the env pair it was derived from)."""
+    from ray_tpu._private import fault_injection
+
+    if fault_injection.ACTIVE is not None:
+        return fault_injection.ACTIVE.banner()
+    schedule = os.environ.get("RAY_TPU_FAULT_SCHEDULE")
+    if schedule:
+        seed = os.environ.get("RAY_TPU_FAULT_SEED", "0")
+        return f"RAY_TPU_FAULT_SEED={seed} " \
+               f"RAY_TPU_FAULT_SCHEDULE='{schedule}'"
+    return None
+
+
+def pytest_report_header(config):
+    banner = _fault_banner()
+    if banner:
+        return [f"fault injection: ACTIVE — {banner}"]
+    return ["fault injection: disabled "
+            "(RAY_TPU_FAULT_SCHEDULE activates it; see "
+            "ray_tpu/_private/fault_injection.py)"]
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Stamp failures with the seed+schedule that reproduces the exact
+    injected-fault sequence (the injector is deterministic per call
+    index, so this one line replays the failure)."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed:
+        banner = _fault_banner()
+        if banner:
+            rep.sections.append(
+                ("fault injection", f"reproduce with: {banner}"))
+
+
 @pytest.fixture
 def ray_start_regular():
     """Start a fresh single-node runtime for a test, shut down after.

@@ -10,6 +10,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.chaos
+
 
 @pytest.fixture
 def chaos_cluster(ray_start_cluster):
