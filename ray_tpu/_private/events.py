@@ -1,0 +1,122 @@
+"""Bounded per-process structured event log for the runtime core.
+
+Reference: Ray's task events + GCS cluster events (task state
+transitions with per-state timestamps flow from workers through the
+agent into the dashboard/state API; `ray list cluster-events`). Here
+every process keeps a bounded ring of structured events; the state API
+(`ray_tpu.experimental.state.api.list_cluster_events`) unions the
+driver's ring with the GCS process's and every raylet's (which fans out
+over its workers, like `rpc_metrics_snapshot`), dedups by
+(node, pid, seq) and returns one time-ordered stream.
+
+Event kinds recorded by the runtime:
+
+- ``task_state``   — task lifecycle transitions with timestamps:
+                     SUBMITTED (owner, at submit) → LEASE_GRANTED
+                     (owner, at dispatch onto a leased worker) →
+                     RUNNING (executor) → FINISHED/FAILED (executor or
+                     owner), plus RESUBMITTED on dispatch failure /
+                     worker death retry. `summarize_tasks()` derives the
+                     queue/scheduling/execution latency breakdown from
+                     these.
+- ``actor_state``  — REGISTERED/ALIVE/RESTARTING/DEAD (GCS process).
+- ``node_state``   — ALIVE/DEAD with reason (GCS process).
+- ``retry_budget_exhausted`` — the process-wide retry budget drained
+                     and a retry was refused (_private/retry.py).
+- ``fault_injected`` — a fault-injection rule fired
+                     (_private/fault_injection.py): action, method,
+                     per-method call number.
+
+Design constraints match the metrics plane: recording is one lock +
+deque append (no allocation beyond the event dict), the ring is bounded
+(drop-oldest, counted), and ``RAY_TPU_INTERNAL_TELEMETRY=0`` turns the
+whole plane off.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import threading
+import time
+
+# One kill-switch for the internal telemetry plane (shared with
+# _private/telemetry.py): latency-critical deployments drop the
+# per-event lock+append and the per-RPC histogram observe together.
+ENABLED = os.environ.get("RAY_TPU_INTERNAL_TELEMETRY", "1") != "0"
+
+_MAX_EVENTS = int(os.environ.get("RAY_TPU_EVENT_LOG_SIZE", "4096"))
+
+TASK_STATES = ("SUBMITTED", "LEASE_GRANTED", "RUNNING", "FINISHED",
+               "FAILED", "RESUBMITTED")
+
+_lock = threading.Lock()
+_events: collections.deque = collections.deque(maxlen=_MAX_EVENTS)
+_seq = 0
+_dropped = 0
+# cached per process: workers are spawned (fresh interpreters), never forked
+_PID = os.getpid()
+_NODE = os.uname().nodename
+
+
+def _role() -> str:
+    """This process's cluster role, reusing the fault plane's tag (gcs /
+    raylet / worker / driver) without importing it into the module graph."""
+    fi = sys.modules.get("ray_tpu._private.fault_injection")
+    if fi is None:
+        return "driver"
+    role = fi.get_role()
+    return "driver" if role == "*" else role
+
+
+def record(kind: str, **fields):
+    """Append one structured event. Never raises; ~1µs when enabled."""
+    global _seq, _dropped
+    if not ENABLED:
+        return
+    with _lock:
+        _seq += 1
+        dropped = len(_events) == _events.maxlen
+        if dropped:
+            _dropped += 1
+        _events.append({"ts": time.time(), "seq": _seq, "pid": _PID,
+                        "node": _NODE, "role": _role(), "kind": kind,
+                        **fields})
+    if dropped:
+        # rare (ring full) — counted into /metrics so silent loss of the
+        # event stream's head is itself observable
+        try:
+            from ray_tpu._private import telemetry as _tm
+
+            _tm.counter_inc("ray_tpu_events_dropped_total")
+        except Exception:
+            pass
+
+
+def task_event(task_id, state: str, **extra):
+    """Record one task state transition (`kind="task_state"`)."""
+    if not ENABLED:
+        return
+    record("task_state",
+           task_id=task_id.hex() if isinstance(task_id, bytes) else task_id,
+           state=state, **extra)
+
+
+def snapshot() -> list[dict]:
+    """This process's events, oldest first (each a copy — callers and the
+    RPC pickle path must not alias the live ring entries)."""
+    with _lock:
+        return [dict(e) for e in _events]
+
+
+def clear():
+    global _dropped
+    with _lock:
+        _events.clear()
+        _dropped = 0
+
+
+def stats() -> dict:
+    with _lock:
+        return {"recorded": _seq, "buffered": len(_events),
+                "dropped": _dropped, "capacity": _events.maxlen}

@@ -146,6 +146,25 @@ def _hash01(seed: int, rule_index: int, method: str, n: int) -> float:
     return struct.unpack(">Q", h[:8])[0] / 2.0 ** 64
 
 
+def _note_fault(action: str, role: str, method: str, call_n: int):
+    """Mirror a fired rule into the internal telemetry plane: a
+    `ray_tpu_faults_injected_total` counter and a `fault_injected`
+    cluster event — so injected chaos is visible through the same
+    /metrics and list_cluster_events() surfaces as its consequences.
+    Lazy imports keep the injector import-light (and the transports'
+    disabled-mode cost untouched — this only runs when a rule fires)."""
+    try:
+        from ray_tpu._private import events as _events
+        from ray_tpu._private import telemetry as _tm
+
+        _tm.counter_inc("ray_tpu_faults_injected_total",
+                        tags={"action": action, "method": method})
+        _events.record("fault_injected", action=action, method=method,
+                       call=call_n, fault_role=role)
+    except Exception:
+        pass   # telemetry must never alter the injected fault sequence
+
+
 class ScheduleError(ValueError):
     pass
 
@@ -244,6 +263,7 @@ class FaultInjector:
                 plan.delay_s = max(plan.delay_s, rule.param_s)
             with self._lock:
                 self.events.append((rule.action, role, method, n))
+            _note_fault(rule.action, role, method, n)
         return plan
 
     def on_reply(self, method: str) -> float:
@@ -259,6 +279,7 @@ class FaultInjector:
             delay = max(delay, rule.param_s)
             with self._lock:
                 self.events.append((rule.action, role, method, n))
+            _note_fault(rule.action, role, method, n)
         return delay
 
     # ------------------------------------------------------------ inspection

@@ -37,6 +37,7 @@ import threading
 import time
 import uuid
 
+from ray_tpu._private import events as _events
 from ray_tpu._private.protocol import RpcServer
 
 PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
@@ -253,6 +254,8 @@ class GcsServer:
                     self._persist_pg(pg)
         self._publish("nodes", {"event": "dead", "node_id": node_id,
                                 "reason": reason})
+        _events.record("node_state", node_id=node_id, state="DEAD",
+                       reason=reason)
         # The dead node's raylet can't re-create its actors — pick a
         # surviving raylet to do it (reference: GcsActorScheduler re-leases
         # from another node, gcs_actor_scheduler.h).
@@ -276,6 +279,8 @@ class GcsServer:
             conn.meta["node_id"] = node_id
         self._publish("nodes", {"event": "alive", "node_id": node_id,
                                 "snapshot": self.nodes[node_id].snapshot()})
+        _events.record("node_state", node_id=node_id, state="ALIVE",
+                       hostname=meta.get("hostname"))
         return {"cluster_id": self.cluster_id}
 
     def rpc_report_resources(self, conn, node_id: str, available: dict,
@@ -464,6 +469,9 @@ class GcsServer:
             if name:
                 self.named_actors[(ns, name)] = actor_id
             self._persist_actor(info)
+        _events.record("actor_state", actor_id=actor_id.hex(),
+                       state="REGISTERED",
+                       class_name=spec.get("class_name", ""))
         return {"existing": None}
 
     def rpc_actor_started(self, conn, actor_id: bytes, addr, node_id: str):
@@ -479,6 +487,8 @@ class GcsServer:
         self._publish("actors", {"event": "alive",
                                  "actor_id": actor_id,
                                  "addr": tuple(addr)})
+        _events.record("actor_state", actor_id=actor_id.hex(),
+                       state="ALIVE", node_id=node_id)
         return True
 
     def rpc_actor_failed(self, conn, actor_id: bytes, reason: str):
@@ -500,6 +510,8 @@ class GcsServer:
             self._persist_actor(actor)
         self._publish("actors", {"event": "dead", "actor_id": actor_id,
                                  "reason": "exited"})
+        _events.record("actor_state", actor_id=actor_id.hex(),
+                       state="DEAD", reason="exited")
         return True
 
     def _drop_name(self, actor: ActorInfo):
@@ -519,6 +531,9 @@ class GcsServer:
             actor.addr = None
             self._publish("actors", {"event": "restarting",
                                      "actor_id": actor.actor_id})
+            _events.record("actor_state", actor_id=actor.actor_id.hex(),
+                           state="RESTARTING", reason=reason,
+                           num_restarts=actor.num_restarts)
             self._persist_actor(actor)
             return {"restart": True, "num_restarts": actor.num_restarts}
         actor.state = "DEAD"
@@ -527,6 +542,8 @@ class GcsServer:
         self._publish("actors", {"event": "dead",
                                  "actor_id": actor.actor_id,
                                  "reason": reason})
+        _events.record("actor_state", actor_id=actor.actor_id.hex(),
+                       state="DEAD", reason=reason)
         self._persist_actor(actor)
         return {"restart": False}
 
@@ -964,6 +981,18 @@ class GcsServer:
         self.named_actors = data["named_actors"]
         self.job_counter = data["job_counter"]
         self.cluster_id = data["cluster_id"]
+
+    def rpc_events_snapshot(self, conn):
+        """The GCS process's structured event ring (node membership, actor
+        lifecycle) for `list_cluster_events()`."""
+        return _events.snapshot()
+
+    def rpc_metrics_snapshot(self, conn):
+        """The GCS process's metric registry (pubsub backlog, gcs-store
+        ops, its own RPC-client latencies) for `metrics_summary()`."""
+        from ray_tpu.util.metrics import registry_snapshot
+
+        return registry_snapshot()
 
     def rpc_debug_state(self, conn):
         with self._lock:

@@ -238,14 +238,52 @@ class FileLogStoreClient(GcsStoreClient):
         self._f = open(self.path, "ab")
 
 
+class InstrumentedStoreClient(GcsStoreClient):
+    """Counts durable-store operations into the internal metric plane
+    (`ray_tpu_gcs_store_ops_total{backend,op}`) around any backend —
+    write-through durability is on the GCS mutation path, so op rates
+    and their growth are the first thing to check when control-plane
+    RPCs slow down."""
+
+    def __init__(self, inner: GcsStoreClient, backend: str):
+        self._inner = inner
+        self._backend = backend
+
+    def _count(self, op: str):
+        from ray_tpu._private import telemetry as _tm
+
+        _tm.counter_inc("ray_tpu_gcs_store_ops_total",
+                        tags={"backend": self._backend, "op": op})
+
+    def put(self, table, key, value):
+        self._count("put")
+        return self._inner.put(table, key, value)
+
+    def get(self, table, key):
+        self._count("get")
+        return self._inner.get(table, key)
+
+    def delete(self, table, key):
+        self._count("delete")
+        return self._inner.delete(table, key)
+
+    def get_all(self, table):
+        return self._inner.get_all(table)
+
+    def close(self):
+        return self._inner.close()
+
+
 def make_store(spec: str | None) -> GcsStoreClient:
     """Factory from a config string: None/"memory" | "sqlite:<path>" |
     "log:<path>" (reference analog: RAY_REDIS_ADDRESS selecting the
-    redis store client)."""
+    redis store client). Every backend is wrapped with op counters."""
     if not spec or spec == "memory":
-        return InMemoryStoreClient()
+        return InstrumentedStoreClient(InMemoryStoreClient(), "memory")
     if spec.startswith("sqlite:"):
-        return SqliteStoreClient(spec[len("sqlite:"):])
+        return InstrumentedStoreClient(
+            SqliteStoreClient(spec[len("sqlite:"):]), "sqlite")
     if spec.startswith("log:"):
-        return FileLogStoreClient(spec[len("log:"):])
+        return InstrumentedStoreClient(
+            FileLogStoreClient(spec[len("log:"):]), "log")
     raise ValueError(f"unknown GCS store spec {spec!r}")

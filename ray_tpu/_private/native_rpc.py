@@ -19,6 +19,7 @@ import threading
 import time
 
 from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private import telemetry as _tm
 
 _REQUEST, _REPLY, _PUSH = 0, 1, 2
 _EV_DISCONNECT, _EV_CONNECT = -1, -2
@@ -159,17 +160,24 @@ class NativeRpcClient:
 
         if self._closed:
             raise self._lost_error()
+        start = time.monotonic() if _tm.ENABLED else 0.0
         t = timeout if timeout is not None else self._timeout
         inj = _fi.ACTIVE
         plan = inj.on_send(method) if inj is not None else None
         if plan is not None:
-            _fi.apply_send_plan(plan, self.close, method)
+            try:
+                _fi.apply_send_plan(plan, self.close, method)
+            except BaseException:
+                # injected disconnect raises ConnectionLost at send time
+                self._count_error(method, "connection_lost")
+                raise
             if plan.drop:
                 # injected loss on a sync call: the caller experiences
                 # its timeout, exactly as if the frame left and vanished
                 # (None-timeout callers get the transport default so the
                 # chaos plane can't wedge a process forever)
                 time.sleep(t if t is not None else 30.0)
+                self._count_error(method, "timeout")
                 raise TimeoutError("rpc call timed out")
         seq = self._next_seq()
         payload = pickle.dumps((method, kwargs),
@@ -181,6 +189,7 @@ class NativeRpcClient:
                                        len(payload), 1)
         if rc != 0:
             self._closed = True
+            self._count_error(method, "connection_lost")
             raise self._lost_error()
         out = ctypes.c_void_p()
         out_len = ctypes.c_size_t()
@@ -189,14 +198,25 @@ class NativeRpcClient:
             ctypes.byref(out), ctypes.byref(out_len))
         if rc == 1:
             self._lib.rpc_cl_abandon(self._h, seq)
+            self._count_error(method, "timeout")
             raise TimeoutError("rpc call timed out")
         if rc != 0:
             self._closed = True
+            self._count_error(method, "connection_lost")
             raise self._lost_error()
         result = pickle.loads(_take_buf(self._lib, out, out_len.value))
         if isinstance(result, _RemoteError):
             raise result.exc
+        if _tm.ENABLED:
+            _tm.observe("ray_tpu_rpc_latency_seconds",
+                        time.monotonic() - start,
+                        tags={"method": method, "role": _tm.role()})
         return result
+
+    @staticmethod
+    def _count_error(method: str, kind: str):
+        _tm.counter_inc("ray_tpu_rpc_errors_total", tags={
+            "method": method, "role": _tm.role(), "kind": kind})
 
     # ------------------------------------------------------------ async path
     def call_async(self, method: str, **kwargs):

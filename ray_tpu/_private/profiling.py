@@ -18,6 +18,10 @@ _MAX_EVENTS = 10_000
 _lock = threading.Lock()
 _events: collections.deque = collections.deque(maxlen=_MAX_EVENTS)
 
+# pids collide across hosts: a merged multi-node timeline needs the
+# producing host on every event (tracing spans already carry `node`)
+_NODE = os.uname().nodename
+
 # Collection defaults ON (ray_tpu.timeline() works out of the box, like
 # the reference's profiling events); RAY_TPU_TIMELINE=0 removes the
 # per-task dict+lock cost on latency-critical deployments.
@@ -47,6 +51,7 @@ class _SpanCM:
                 "cat": self.cat,
                 "name": self.name,
                 "pid": os.getpid(),
+                "node": _NODE,
                 "tid": threading.get_ident() % 2**31,
                 "ts": int(self.start * 1e6),   # µs, chrome format
                 "dur": int((end - self.start) * 1e6),

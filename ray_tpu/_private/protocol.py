@@ -32,6 +32,7 @@ import traceback
 import uuid
 
 from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private import telemetry as _tm
 
 # Chaos plane: RAY_TPU_FAULT_SCHEDULE activates the injector for every
 # transport in this process (and, via env inheritance, every spawned
@@ -195,9 +196,17 @@ class PyRpcClient:
 
     def call(self, method: str, timeout: float | None = None, **kwargs):
         """Synchronous request/reply."""
-        fut = self.call_async(method, **kwargs)
+        start = time.monotonic() if _tm.ENABLED else 0.0
         try:
-            return fut.result(
+            fut = self.call_async(method, **kwargs)
+        except ConnectionLost:
+            # send-side failure (dead socket, injected disconnect)
+            _tm.counter_inc("ray_tpu_rpc_errors_total", tags={
+                "method": method, "role": _tm.role(),
+                "kind": "connection_lost"})
+            raise
+        try:
+            result = fut.result(
                 timeout if timeout is not None else self._timeout)
         except TimeoutError:
             # Nobody will ever consume this future — reap its _pending
@@ -206,7 +215,19 @@ class PyRpcClient:
             # dropped; injected drops would otherwise leak one slot per
             # fault over a long chaos soak).
             self._pending.pop(fut.seq, None)
+            _tm.counter_inc("ray_tpu_rpc_errors_total", tags={
+                "method": method, "role": _tm.role(), "kind": "timeout"})
             raise
+        except ConnectionLost:
+            _tm.counter_inc("ray_tpu_rpc_errors_total", tags={
+                "method": method, "role": _tm.role(),
+                "kind": "connection_lost"})
+            raise
+        if _tm.ENABLED:
+            _tm.observe("ray_tpu_rpc_latency_seconds",
+                        time.monotonic() - start,
+                        tags={"method": method, "role": _tm.role()})
+        return result
 
     def call_async(self, method: str, **kwargs) -> "_Future":
         if self._closed:

@@ -105,6 +105,7 @@ class Publisher:
     def publish(self, channel: str, message) -> int:
         """Deliver to every subscriber of `channel`; returns the seq."""
         now = time.monotonic()
+        overflow = 0
         with self._cond:
             self._seq += 1
             seq = self._seq
@@ -123,10 +124,20 @@ class Publisher:
                         # subscriber can surface it as a gap
                         n_drop = len(sub["mail"]) - self.max_mailbox
                         sub["dropped"] = sub.get("dropped", 0) + n_drop
+                        overflow += n_drop
                         del sub["mail"][:n_drop]
             for sub_id in stale:
                 del self._subs[sub_id]
+            backlog = sum(len(s["mail"]) for s in self._subs.values())
             self._cond.notify_all()
+        # telemetry outside the condition: publishers must not hold the
+        # delivery lock across the metrics registry's lock
+        from ray_tpu._private import telemetry as _tm
+
+        if _tm.ENABLED:
+            _tm.gauge_set("ray_tpu_pubsub_backlog_messages", backlog)
+            if overflow:
+                _tm.counter_inc("ray_tpu_pubsub_dropped_total", overflow)
         return seq
 
     # ------------------------------------------------ RpcServer handler glue

@@ -484,6 +484,11 @@ class Raylet:
                         demand = [dict(d) for d in self._queued_demand]
                         busy = len(self._leases) + sum(
                             1 for w in self._workers.values() if w.is_actor)
+                    from ray_tpu._private import telemetry as _tm
+
+                    _tm.gauge_set("ray_tpu_scheduler_queue_tasks",
+                                  len(demand),
+                                  tags={"node_id": self.node_id})
                     self._gcs.push("report_resources",
                                    node_id=self.node_id, available=avail,
                                    pending_demand=demand, busy=busy)
@@ -723,6 +728,7 @@ class Raylet:
                                  lessee: tuple | None = None):
         """Returns {"granted": {...}} | {"spillback": addr} | queues until
         resources free (long-poll: the reply is sent when granted)."""
+        t0 = time.monotonic()
         strategy = strategy or {}
         # Placement-group leases consume the reserved bundle resources.
         pg_id = strategy.get("placement_group_id")
@@ -745,7 +751,7 @@ class Raylet:
             if target is not None and os.urandom(1)[0] < 128:
                 return {"spillback": target}
         if self._try_reserve(resources):
-            return self._grant(resources, lessee)
+            return self._observe_grant(t0, self._grant(resources, lessee))
         # no_spill: the caller exhausted its spillback hops on a saturated
         # cluster — queue here instead of bouncing (the reference keeps the
         # request in ClusterTaskManager's queue in this state).
@@ -765,7 +771,8 @@ class Raylet:
                 if self._stopped:
                     raise ConnectionLost("raylet shutting down")
                 if self._try_reserve(resources):
-                    return self._grant(resources, lessee)
+                    return self._observe_grant(
+                        t0, self._grant(resources, lessee))
                 # Re-evaluate spillback while queued: a node that joined
                 # (autoscaler, chaos replacement) after we started waiting
                 # may be able to serve this request right now.
@@ -792,6 +799,17 @@ class Raylet:
                     self._queued_demand.remove(resources)
                 except ValueError:
                     pass
+
+    def _observe_grant(self, t0: float, reply: dict) -> dict:
+        """Record the lease-grant latency (request arrival → local grant;
+        spillbacks never reach here — they are another node's grant)."""
+        from ray_tpu._private import telemetry as _tm
+
+        if _tm.ENABLED:
+            _tm.observe("ray_tpu_lease_grant_latency_seconds",
+                        time.monotonic() - t0,
+                        tags={"node_id": self.node_id})
+        return reply
 
     def _try_reserve(self, resources: dict) -> bool:
         with self._lock:
@@ -1165,7 +1183,23 @@ class Raylet:
         return self._fanout_workers("trace_spans")
 
     def rpc_metrics_snapshot(self, conn):
-        return self._fanout_workers("metrics_snapshot")
+        """This node's metrics: the raylet process's own registry (the
+        scheduler gauges/histograms live HERE) plus every registered
+        worker's. aggregate_snapshots dedups by (node, pid) when the
+        raylet shares a process with the driver (in-process clusters)."""
+        from ray_tpu.util.metrics import registry_snapshot
+
+        return registry_snapshot() + self._fanout_workers(
+            "metrics_snapshot")
+
+    def rpc_events_snapshot(self, conn):
+        """This node's structured runtime events: the raylet process's own
+        ring plus every registered worker's (the state API dedups by
+        (node, pid, seq) — in-process clusters share a pid with the
+        driver)."""
+        from ray_tpu._private import events as _events
+
+        return _events.snapshot() + self._fanout_workers("events_snapshot")
 
     def rpc_ping(self, conn):
         return "pong"

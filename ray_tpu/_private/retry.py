@@ -70,6 +70,9 @@ RETRY_SAFE_RPCS = frozenset({
     "fetch_object", "fetch_object_chunk", "get_owned_value",
     "locate_object", "store_stats", "node_info", "ping", "task_state",
     "report_resources", "drain_node",
+    # telemetry plane: pure reads (per-process metric/event/span rings)
+    "metrics_snapshot", "events_snapshot", "profile_events",
+    "trace_spans",
     # ray:// client protocol: the proxy DEDUPS every mutating op by the
     # session-scoped req_id the client attaches (util/client/server.py),
     # so replay across a proxy restart is safe — these were built to
@@ -134,7 +137,18 @@ class RetryBudget:
                 self._tokens -= 1.0
                 return True
             self.exhausted_count += 1
-            return False
+        # outside the lock: exhaustion is rare and the answer to "why did
+        # this call fail fast during the outage" — surface it as both a
+        # counter and a structured cluster event
+        from ray_tpu._private import events as _events
+        from ray_tpu._private import telemetry as _tm
+
+        _tm.counter_inc("ray_tpu_retry_budget_exhausted_total")
+        _events.record("retry_budget_exhausted",
+                       capacity=self.capacity,
+                       refill_per_s=self.refill_per_s,
+                       exhausted_count=self.exhausted_count)
+        return False
 
 
 _default_budget = RetryBudget()
@@ -224,6 +238,10 @@ class RetryPolicy:
                     raise
                 if not self.budget.take():
                     raise   # budget drained: stop amplifying the outage
+                from ray_tpu._private import telemetry as _tm
+
+                _tm.counter_inc("ray_tpu_retry_attempts_total", tags={
+                    "method": method or describe or "?"})
                 pause = self.backoff(attempt)
                 if deadline is not None:
                     pause = min(pause,

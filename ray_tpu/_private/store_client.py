@@ -17,6 +17,7 @@ import os
 import threading
 import time
 
+from ray_tpu._private import telemetry as _tm
 from ray_tpu._private.native_build import ensure_lib
 
 _ERRORS = {
@@ -261,6 +262,8 @@ class StoreClient:
                         dst[off:off + len(v)] = v
                         off += len(v)
                     self.seal(object_id)
+                    _tm.counter_inc("ray_tpu_object_store_put_bytes_total",
+                                    total)
                     return True, total
                 except BaseException:
                     self.abort(object_id)
@@ -268,6 +271,7 @@ class StoreClient:
         if self.spill_dir is None:
             raise StoreError(-3, "put")
         self._spill_write(object_id, views)
+        _tm.counter_inc("ray_tpu_object_store_put_bytes_total", total)
         return True, total
 
     @_guarded
@@ -312,10 +316,14 @@ class StoreClient:
                                     ctypes.byref(size))
         if rc == -1:
             if self._spilled_path_if_exists(object_id) is None:
+                _tm.counter_inc("ray_tpu_object_store_get_total",
+                                tags={"result": "miss"})
                 return None
             fallback = self._spill_restore(object_id)
             if fallback is not None:
                 # Couldn't fit back in shm — serve the spilled bytes directly.
+                _tm.counter_inc("ray_tpu_object_store_get_total",
+                                tags={"result": "hit"})
                 return fallback
             rc = self._libref.store_get(self._h, object_id, ctypes.byref(ptr),
                                         ctypes.byref(size))
@@ -323,6 +331,8 @@ class StoreClient:
                 # Restored copy already evicted by a concurrent put; the
                 # spill file is still the source of truth.
                 with open(self._spill_path(object_id), "rb") as f:
+                    _tm.counter_inc("ray_tpu_object_store_get_total",
+                                    tags={"result": "hit"})
                     return _BytesBuffer(f.read())
             if rc != 0:
                 raise StoreError(rc, "get")
@@ -330,6 +340,8 @@ class StoreClient:
             raise StoreError(rc, "get")
         with self._guard:
             self._pins += 1   # close() waits for pins: the buffer's view
+        _tm.counter_inc("ray_tpu_object_store_get_total",
+                        tags={"result": "hit"})
         return PinnedBuffer(self, object_id, ptr.value, size.value)
 
     @_guarded

@@ -1,0 +1,185 @@
+"""Internal runtime metric catalog — every core metric declared in one place.
+
+The user-facing primitives live in ray_tpu/util/metrics.py (Counter /
+Gauge / Histogram, aggregated by `metrics_summary()` and rendered at the
+dashboard's /metrics). This module is the RUNTIME'S OWN use of them:
+transports, scheduler, object store, retry/fault plane. Reference tier:
+Ray's core "system metrics" (ray_grpc_server_*, ray_scheduler_*,
+ray_object_store_*) emitted by core components into the same Prometheus
+pipeline user metrics ride.
+
+Contract (enforced by the catalog lint in tests/test_telemetry_metrics.py):
+
+- every internal metric name is declared HERE, in ``CATALOG``;
+- names are ``ray_tpu_``-prefixed and end in a unit suffix from
+  ``ALLOWED_SUFFIXES`` (Prometheus naming conventions);
+- call sites reference metrics through ``counter_inc`` / ``gauge_set`` /
+  ``observe`` by catalog name — an undeclared name raises KeyError at
+  the call site, so instrumentation can't drift from the catalog.
+
+Overhead: the disabled path (``RAY_TPU_INTERNAL_TELEMETRY=0``) is one
+module-global bool check per call site. Enabled, a recording is one
+dict lookup + the util/metrics lock'd update (~1-2µs) — noise against
+the RPC/store operation it measures; nothing extra happens when no
+scraper reads /metrics (recording cost is the whole cost).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+ENABLED = os.environ.get("RAY_TPU_INTERNAL_TELEMETRY", "1") != "0"
+
+# Prometheus-convention unit suffixes internal metric names must end in
+# (counters additionally use `_total` per convention; `_tasks` /
+# `_messages` are the "unit is the thing counted" form for gauges).
+ALLOWED_SUFFIXES = ("_total", "_seconds", "_bytes", "_tasks", "_messages")
+
+_RPC_BOUNDARIES = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0]
+
+# name -> spec. `kind` is the util/metrics class name; `tags` the label
+# keys call sites pass (bounded cardinality: method names, roles,
+# node ids — never task/object ids).
+CATALOG: dict[str, dict] = {
+    # --- transports (protocol.py / native_rpc.py) ---
+    "ray_tpu_rpc_latency_seconds": {
+        "kind": "Histogram", "tags": ("method", "role"),
+        "boundaries": _RPC_BOUNDARIES,
+        "description": "Client-observed latency of synchronous "
+                       "control-plane RPC calls",
+    },
+    "ray_tpu_rpc_errors_total": {
+        "kind": "Counter", "tags": ("method", "role", "kind"),
+        "description": "Synchronous RPC calls that failed "
+                       "(kind=timeout|connection_lost)",
+    },
+    # --- unified retry policy (retry.py) ---
+    "ray_tpu_retry_attempts_total": {
+        "kind": "Counter", "tags": ("method",),
+        "description": "Actual retries executed under the control-plane "
+                       "retry policy (first attempts are not counted)",
+    },
+    "ray_tpu_retry_budget_exhausted_total": {
+        "kind": "Counter", "tags": (),
+        "description": "Retries refused because the process-wide retry "
+                       "budget was drained",
+    },
+    # --- fault injection (fault_injection.py) ---
+    "ray_tpu_faults_injected_total": {
+        "kind": "Counter", "tags": ("action", "method"),
+        "description": "Fault-injection rules fired, by action "
+                       "(drop/delay/dup/disconnect/slow_reply) and method",
+    },
+    # --- scheduler (raylet.py) ---
+    "ray_tpu_scheduler_queue_tasks": {
+        "kind": "Gauge", "tags": ("node_id",),
+        "description": "Lease/actor-creation requests queued on this "
+                       "raylet waiting for resources",
+    },
+    "ray_tpu_lease_grant_latency_seconds": {
+        "kind": "Histogram", "tags": ("node_id",),
+        "boundaries": _RPC_BOUNDARIES,
+        "description": "Time from lease request arrival to local grant "
+                       "(spillbacks excluded)",
+    },
+    # --- object store (store_client.py) ---
+    "ray_tpu_object_store_put_bytes_total": {
+        "kind": "Counter", "tags": (),
+        "description": "Bytes written into the local shared-memory "
+                       "object store (including spilled puts)",
+    },
+    "ray_tpu_object_store_get_total": {
+        "kind": "Counter", "tags": ("result",),
+        "description": "Local object-store lookups (result=hit|miss)",
+    },
+    # --- durable GCS store (gcs_store.py) ---
+    "ray_tpu_gcs_store_ops_total": {
+        "kind": "Counter", "tags": ("backend", "op"),
+        "description": "Durable GCS store operations, by backend "
+                       "(sqlite/log/memory) and op (put/get/delete)",
+    },
+    # --- pubsub (pubsub.py) ---
+    "ray_tpu_pubsub_backlog_messages": {
+        "kind": "Gauge", "tags": (),
+        "description": "Messages parked in long-poll subscriber "
+                       "mailboxes after the latest publish",
+    },
+    "ray_tpu_pubsub_dropped_total": {
+        "kind": "Counter", "tags": (),
+        "description": "Messages dropped by mailbox overflow "
+                       "(slow long-poll consumers)",
+    },
+    # --- event log (events.py) ---
+    "ray_tpu_events_dropped_total": {
+        "kind": "Counter", "tags": (),
+        "description": "Structured events dropped from the bounded "
+                       "per-process event ring",
+    },
+}
+
+_lock = threading.Lock()
+_metrics: dict[str, object] = {}
+
+
+def _get(name: str):
+    """The live metric instance for a CATALOG name. KeyError for an
+    undeclared name — drift from the catalog must fail loudly at the
+    instrumented call site, not silently record an unlintable metric."""
+    metric = _metrics.get(name)
+    if metric is not None:
+        return metric
+    spec = CATALOG[name]
+    from ray_tpu.util import metrics as um
+
+    cls = getattr(um, spec["kind"])
+    with _lock:
+        metric = _metrics.get(name)
+        if metric is None:
+            if spec["kind"] == "Histogram":
+                metric = cls(name, description=spec["description"],
+                             boundaries=spec["boundaries"],
+                             tag_keys=spec["tags"])
+            else:
+                metric = cls(name, description=spec["description"],
+                             tag_keys=spec["tags"])
+            _metrics[name] = metric
+    return metric
+
+
+def counter_inc(name: str, value: float = 1.0, tags: dict | None = None):
+    if not ENABLED:
+        return
+    metric = _get(name)
+    try:
+        metric.inc(value, tags=tags)
+    except Exception:
+        pass   # telemetry must never take down the operation it measures
+
+
+def gauge_set(name: str, value: float, tags: dict | None = None):
+    if not ENABLED:
+        return
+    metric = _get(name)
+    try:
+        metric.set(value, tags=tags)
+    except Exception:
+        pass
+
+
+def observe(name: str, value: float, tags: dict | None = None):
+    if not ENABLED:
+        return
+    metric = _get(name)
+    try:
+        metric.observe(value, tags=tags)
+    except Exception:
+        pass
+
+
+def role() -> str:
+    """This process's cluster role for the {role} label — the single
+    shared resolver lives in events.py so the metric label can never
+    diverge from the event `role` field for the same process."""
+    from ray_tpu._private.events import _role
+
+    return _role()

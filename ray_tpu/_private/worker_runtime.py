@@ -25,6 +25,7 @@ import uuid
 from concurrent.futures import Future as PyFuture
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import events as _events
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.object_ref import ObjectRef, ReferenceCounter
 from ray_tpu._private.protocol import ConnectionLost, RpcClient, RpcServer
@@ -400,6 +401,11 @@ class _SchedulingKeyQueue:
             self._wakeup.set()
 
     def _push(self, lw: _LeasedWorker, spec: dict) -> bool:
+        # LEASE_GRANTED marks the end of this task's queue wait: it is
+        # leaving the scheduling queue for a leased worker's pipeline.
+        _events.task_event(spec["task_id"], "LEASE_GRANTED",
+                           node_id=lw.node_id, worker_id=lw.worker_id,
+                           desc=spec.get("task_desc"))
         try:
             fut = lw.client.call_async("push_task", spec=self.worker._strip_spec(spec))
         except ConnectionLost:
@@ -414,6 +420,9 @@ class _SchedulingKeyQueue:
             with self._lock:
                 lw.dead = True
                 lw.in_flight -= 1
+            _events.task_event(spec["task_id"], "RESUBMITTED",
+                               reason="dispatch connection lost",
+                               desc=spec.get("task_desc"))
             self.submit(spec)
             return True
         # Reply lands as a callback on the client's reader/pump thread —
@@ -452,6 +461,10 @@ class _SchedulingKeyQueue:
         retries = spec.get("retries_left", 0)
         if retries > 0:
             spec["retries_left"] = retries - 1
+            _events.task_event(spec["task_id"], "RESUBMITTED",
+                               reason="worker died",
+                               retries_left=spec["retries_left"],
+                               desc=spec.get("task_desc"))
             self.submit(spec)
         else:
             self.worker._fail_task(spec, self.worker._worker_death_error(
@@ -1739,6 +1752,9 @@ class CoreWorker:
 
         return metrics.registry_snapshot()
 
+    def rpc_events_snapshot(self, conn):
+        return _events.snapshot()
+
     # ------------------------------------------- owner-based object directory
     # Reference: ownership_based_object_directory.h:1 — the owning worker is
     # the source of truth for which nodes hold copies of its objects. Nodes
@@ -1967,6 +1983,7 @@ class CoreWorker:
         from ray_tpu._private.task_spec import validate_task_spec
 
         validate_task_spec(spec)
+        _events.task_event(spec["task_id"], "SUBMITTED", desc=task_desc)
         with tracing.submit_span(spec, task_desc):
             # refs whose bytes ride the spec need no pin: the task no
             # longer depends on the object outliving the submission
@@ -2146,6 +2163,9 @@ class CoreWorker:
                 pass
 
     def _fail_task(self, spec: dict, error: BaseException):
+        _events.task_event(spec["task_id"], "FAILED",
+                           error=type(error).__name__,
+                           desc=spec.get("task_desc"))
         data = ser.serialize_error(error, spec.get("task_desc", "task"))
         if spec.get("dynamic_returns"):
             self._finalize_gen(spec, None, error=data)
@@ -2499,6 +2519,8 @@ class CoreWorker:
         self._current_task_thread = \
             threading.get_ident() if interruptible else None
         self._current_task_started = time.time()   # OOM victim ranking
+        _events.task_event(task_id, "RUNNING",
+                           desc=spec.get("task_desc"))
         import contextlib
 
         from ray_tpu._private.profiling import record_span
@@ -2527,8 +2549,14 @@ class CoreWorker:
                 fn = self._load_function(spec["func_hash"])
                 args, kwargs = self._resolve_args(spec)
                 result = fn(*args, **kwargs)
-            return self._package_results(spec, result)
+            out = self._package_results(spec, result)
+            _events.task_event(task_id, "FINISHED",
+                               desc=spec.get("task_desc"))
+            return out
         except BaseException as e:  # noqa: BLE001
+            _events.task_event(task_id, "FAILED",
+                               error=type(e).__name__,
+                               desc=spec.get("task_desc"))
             return self._package_error(spec, e)
         finally:
             self._current_task_id = None
@@ -2620,6 +2648,10 @@ class CoreWorker:
             # order (reference: concurrency_group_manager.h).
             sem.wait(ticket)
             acquired = True
+            _events.task_event(spec["task_id"], "RUNNING",
+                               desc=spec.get("task_desc"),
+                               actor_id=(self.actor_id.hex()
+                                         if self.actor_id else None))
             from ray_tpu._private.profiling import record_span
 
             from ray_tpu.util import tracing
@@ -2648,10 +2680,15 @@ class CoreWorker:
                         result = self._package_results(spec, result)
             finally:
                 sem.release()
+            _events.task_event(spec["task_id"], "FINISHED",
+                               desc=spec.get("task_desc"))
             if spec.get("dynamic_returns"):
                 return result
             return self._package_results(spec, result)
         except BaseException as e:  # noqa: BLE001
+            _events.task_event(spec["task_id"], "FAILED",
+                               error=type(e).__name__,
+                               desc=spec.get("task_desc"))
             return self._package_error(spec, e)
         finally:
             if not acquired:

@@ -12,20 +12,30 @@ from __future__ import annotations
 import json
 
 _PANELS = [
-    # (title, promql expr, unit)
-    ("Node CPU %", "ray_tpu_node_cpu_percent", "percent"),
-    ("Node memory used", "ray_tpu_node_mem_used_bytes", "bytes"),
-    ("Object store bytes", "ray_tpu_object_store_bytes_used", "bytes"),
-    ("Object store evictions", "rate(ray_tpu_object_store_evictions[5m])",
-     "ops"),
-    ("Tasks finished", "rate(ray_tpu_tasks_finished_total[1m])", "ops"),
-    ("Task failures", "rate(ray_tpu_tasks_failed_total[5m])", "ops"),
-    ("Live actors", "ray_tpu_actors_alive", "short"),
-    ("Pending lease requests", "ray_tpu_lease_requests_pending", "short"),
-    ("Serve QPS", "rate(ray_tpu_serve_requests_total[1m])", "reqps"),
-    ("Serve p50 latency",
-     "histogram_quantile(0.5, rate(ray_tpu_serve_latency_seconds_bucket"
+    # (title, promql expr, unit) — every expr is over a metric the
+    # runtime actually emits (_private/telemetry.py CATALOG + /metrics)
+    ("RPC p50 latency",
+     "histogram_quantile(0.5, rate(ray_tpu_rpc_latency_seconds_bucket"
      "[5m]))", "s"),
+    ("RPC p99 latency",
+     "histogram_quantile(0.99, rate(ray_tpu_rpc_latency_seconds_bucket"
+     "[5m]))", "s"),
+    ("RPC errors", "rate(ray_tpu_rpc_errors_total[5m])", "ops"),
+    ("Control-plane retries", "rate(ray_tpu_retry_attempts_total[5m])",
+     "ops"),
+    ("Retry-budget exhaustion",
+     "rate(ray_tpu_retry_budget_exhausted_total[5m])", "ops"),
+    ("Injected faults", "rate(ray_tpu_faults_injected_total[5m])", "ops"),
+    ("Scheduler queue depth", "ray_tpu_scheduler_queue_tasks", "short"),
+    ("Lease grant p50 latency",
+     "histogram_quantile(0.5, "
+     "rate(ray_tpu_lease_grant_latency_seconds_bucket[5m]))", "s"),
+    ("Object store put throughput",
+     "rate(ray_tpu_object_store_put_bytes_total[1m])", "Bps"),
+    ("Object store gets (hit/miss)",
+     "rate(ray_tpu_object_store_get_total[1m])", "ops"),
+    ("Pubsub backlog", "ray_tpu_pubsub_backlog_messages", "short"),
+    ("GCS store ops", "rate(ray_tpu_gcs_store_ops_total[1m])", "ops"),
 ]
 
 

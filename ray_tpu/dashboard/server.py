@@ -9,6 +9,7 @@ Routes (reference modules in parens — dashboard/modules/*):
     /api/workers            (reporter)
     /api/placement_groups   (state)
     /api/jobs               (job)
+    /api/events             structured runtime event log (cluster events)
     /api/reporter           per-node physical stats (reporter_agent)
     /api/grafana_dashboard  importable Grafana JSON (dashboard factory)
     /api/cluster_status     (`ray status`)
@@ -89,6 +90,8 @@ class DashboardServer:
                 payload = state.list_workers(address=self.address)
             elif path == "/api/placement_groups":
                 payload = state.list_placement_groups(address=self.address)
+            elif path == "/api/events":
+                payload = state.list_cluster_events(address=self.address)
             elif path == "/api/reporter":
                 payload = self._reporter()
             elif path == "/api/grafana_dashboard":

@@ -69,6 +69,7 @@ const TABS = [
   {id:"pgs", label:"Placement groups", api:"/api/placement_groups"},
   {id:"objects", label:"Objects", api:"/api/objects"},
   {id:"jobs", label:"Jobs", api:"/api/jobs"},
+  {id:"events", label:"Events", api:"/api/events"},
   {id:"serve", label:"Serve", api:"/api/serve"},
 ];
 let current = location.hash.slice(1) || "overview";

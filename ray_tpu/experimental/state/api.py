@@ -231,7 +231,20 @@ def summarize_actors(*, address: str | None = None) -> dict:
 def summarize_tasks(*, address: str | None = None) -> dict:
     """Running work grouped by description (leases + worker probes) plus
     queued demand by shape (reference: `ray summary tasks` groups by
-    func_or_class_name and state)."""
+    func_or_class_name and state), plus the per-task queue/scheduling/
+    execution latency breakdown derived from the runtime event log:
+
+    - ``queue_s``      SUBMITTED → last LEASE_GRANTED (waiting in the
+                       scheduling queue for a leased worker; retries of
+                       a failed dispatch accrue here),
+    - ``scheduling_s`` LEASE_GRANTED → RUNNING (push + dependency
+                       resolution on the executor),
+    - ``execution_s``  RUNNING → FINISHED/FAILED (the task body).
+
+    ``tasks`` holds one row per task seen in the event window (bounded
+    per-process rings — a long-running cluster only covers recent
+    tasks); ``latency`` aggregates count/mean/max per task description.
+    """
     running: dict[str, int] = {}
     for t in list_tasks(address=address, detail=True):
         key = t.get("task_desc") or (
@@ -244,7 +257,138 @@ def summarize_tasks(*, address: str | None = None) -> dict:
                 key = ",".join(f"{k}:{v:g}"
                                for k, v in sorted(shape.items()))
                 queued[key] = queued.get(key, 0) + 1
-    return {"running": running, "queued_by_shape": queued}
+    tasks = _task_latency_rows(
+        list_cluster_events(address=address,
+                            filters=[("kind", "=", "task_state")]))
+    latency: dict[str, dict] = {}
+    for row in tasks:
+        agg = latency.setdefault(row["desc"] or "task", {
+            "count": 0, "finished": 0, "failed": 0,
+            "queue_s": _PhaseAgg(), "scheduling_s": _PhaseAgg(),
+            "execution_s": _PhaseAgg()})
+        agg["count"] += 1
+        if row["state"] == "FINISHED":
+            agg["finished"] += 1
+        elif row["state"] == "FAILED":
+            agg["failed"] += 1
+        for phase in ("queue_s", "scheduling_s", "execution_s"):
+            if row.get(phase) is not None:
+                agg[phase].add(row[phase])
+    for agg in latency.values():
+        for phase in ("queue_s", "scheduling_s", "execution_s"):
+            agg[phase] = agg[phase].summary()
+    return {"running": running, "queued_by_shape": queued,
+            "tasks": tasks, "latency": latency}
+
+
+class _PhaseAgg:
+    __slots__ = ("n", "total", "max")
+
+    def __init__(self):
+        self.n = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def add(self, v: float):
+        self.n += 1
+        self.total += v
+        self.max = max(self.max, v)
+
+    def summary(self) -> dict:
+        return {"count": self.n,
+                "mean": (self.total / self.n) if self.n else 0.0,
+                "max": self.max}
+
+
+def _task_latency_rows(task_events: list[dict]) -> list[dict]:
+    """Fold task_state events into one row per task id. For retried
+    tasks the breakdown describes the attempt that reached RUNNING last
+    (latest LEASE_GRANTED/RUNNING/terminal timestamps), with `attempts`
+    counting dispatches; clock skew across hosts is clamped to >= 0."""
+    per_task: dict[str, dict] = {}
+    for e in task_events:
+        tid = e.get("task_id")
+        if tid is None:
+            continue
+        t = per_task.setdefault(tid, {
+            "task_id": tid, "desc": None, "state": None, "attempts": 0,
+            "_submitted": None, "_granted": None, "_running": None,
+            "_end": None})
+        state = e.get("state")
+        ts = e.get("ts", 0.0)
+        if e.get("desc"):
+            t["desc"] = e["desc"]
+        if state == "SUBMITTED":
+            if t["_submitted"] is None or ts < t["_submitted"]:
+                t["_submitted"] = ts
+        elif state == "LEASE_GRANTED":
+            t["attempts"] += 1
+            if t["_granted"] is None or ts > t["_granted"]:
+                t["_granted"] = ts
+        elif state == "RUNNING":
+            if t["_running"] is None or ts > t["_running"]:
+                t["_running"] = ts
+        elif state in ("FINISHED", "FAILED"):
+            if t["_end"] is None or ts > t["_end"]:
+                t["_end"] = ts
+                t["state"] = state
+        if state in ("SUBMITTED", "RESUBMITTED", "LEASE_GRANTED",
+                     "RUNNING") and t["state"] not in ("FINISHED",
+                                                       "FAILED"):
+            t["state"] = state
+    rows = []
+    for t in per_task.values():
+        sub, granted = t.pop("_submitted"), t.pop("_granted")
+        run, end = t.pop("_running"), t.pop("_end")
+        t["queue_s"] = (max(0.0, granted - sub)
+                        if sub is not None and granted is not None
+                        else None)
+        t["scheduling_s"] = (max(0.0, run - granted)
+                             if granted is not None and run is not None
+                             else None)
+        t["execution_s"] = (max(0.0, end - run)
+                            if run is not None and end is not None
+                            else None)
+        t["submitted_at"] = sub
+        rows.append(t)
+    rows.sort(key=lambda r: r.get("submitted_at") or 0.0)
+    return rows
+
+
+def list_cluster_events(*, address: str | None = None, filters=None,
+                        limit=None) -> list[dict]:
+    """The cluster's structured runtime event stream (_private/events.py):
+    task state transitions, actor lifecycle, node up/down, retry-budget
+    exhaustion, injected faults. Unions this process's ring with the GCS
+    process's and every raylet's (which fans out over its workers),
+    dedups by (node, pid, seq) — in-process test clusters reach the same
+    ring through several paths — and returns events time-ordered."""
+    from ray_tpu._private import events as _events
+
+    rows = _events.snapshot()
+    with _gcs(address) as call:
+        try:
+            rows.extend(call("events_snapshot"))
+        except Exception:
+            pass   # pre-telemetry GCS build: its ring just isn't visible
+        rows.extend(_each_raylet(call, "events_snapshot"))
+    seen: set[tuple] = set()
+    deduped = []
+    for r in rows:
+        key = (r.get("node"), r.get("pid"), r.get("seq"))
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append(r)
+    deduped.sort(key=lambda r: (r.get("ts", 0.0), r.get("node") or "",
+                                r.get("pid") or 0, r.get("seq") or 0))
+    rows = _apply_filters(deduped, filters, None)
+    if limit is not None:
+        # a time-ordered log truncates from the HEAD: keep the recent
+        # tail (an operator debugging an incident wants the last N
+        # events, not the cluster's first N)
+        rows = rows[-limit:]
+    return rows
 
 
 def summarize_objects(*, address: str | None = None) -> dict:
@@ -326,15 +470,28 @@ def memory_summary(*, address: str | None = None) -> str:
 
 def metrics_summary(*, address: str | None = None,
                     prometheus: bool = False):
-    """Aggregate user metrics (ray_tpu.util.metrics Counter/Gauge/
-    Histogram) across every worker process. prometheus=True renders the
-    text exposition format (reference: the dashboard agent's Prometheus
-    endpoint, reporter_agent.py:296)."""
-    from ray_tpu.util.metrics import prometheus_text, registry_snapshot
+    """Aggregate metrics (user Counter/Gauge/Histogram plus the runtime's
+    internal catalog, _private/telemetry.py) across every process: this
+    one, the GCS, and each raylet's workers. Snapshots are merged into
+    one family per metric name (counters/histograms sum per tag set,
+    gauges keep the last collected value; processes reachable via two
+    collection paths are deduped by (node, pid)). prometheus=True
+    renders the text exposition format (reference: the dashboard agent's
+    Prometheus endpoint, reporter_agent.py:296)."""
+    from ray_tpu.util.metrics import (
+        aggregate_snapshots,
+        prometheus_text,
+        registry_snapshot,
+    )
 
     with _gcs(address) as call:
         snaps = registry_snapshot()           # this process too
+        try:
+            snaps.extend(call("metrics_snapshot"))   # the GCS process
+        except Exception:
+            pass
         snaps.extend(_each_raylet(call, "metrics_snapshot"))
+    snaps = aggregate_snapshots(snaps)
     if prometheus:
         return prometheus_text(snaps)
     return snaps

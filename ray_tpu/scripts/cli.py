@@ -276,6 +276,20 @@ def cmd_job(args):
     return 0
 
 
+def cmd_events(args):
+    """Structured runtime event log (task transitions, actor/node
+    lifecycle, retry-budget exhaustion, injected faults) — the CLI face
+    of `experimental.state.api.list_cluster_events` (reference:
+    `ray list cluster-events`)."""
+    from ray_tpu.experimental.state.api import list_cluster_events
+
+    filters = [("kind", "=", args.kind)] if args.kind else None
+    rows = list_cluster_events(address=args.address, filters=filters,
+                               limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
 def cmd_microbenchmark(_args):
     from ray_tpu._private.ray_perf import main as perf_main
 
@@ -386,6 +400,16 @@ def main(argv=None):
     sp.add_argument("--env", action="append", default=[],
                     help="KEY=VALUE runtime env var (repeatable)")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("events",
+                        help="structured runtime event log "
+                             "(task/actor/node transitions, faults)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--kind", default=None,
+                    help="filter: task_state | actor_state | node_state "
+                         "| retry_budget_exhausted | fault_injected")
+    sp.add_argument("--limit", type=int, default=None)
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser("summary",
                         help="aggregated cluster state rollups")

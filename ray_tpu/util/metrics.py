@@ -3,34 +3,69 @@
 Reference: python/ray/util/metrics.py (backed by OpenCensus → dashboard
 agent → Prometheus, reporter_agent.py:296). Here each process keeps a
 registry; `ray_tpu.experimental.state.api.metrics_summary()` aggregates
-across live workers, and `prometheus_text()` renders the standard text
-exposition format for scraping.
+across live workers (summing counters/histograms per tag set via
+`aggregate_snapshots`), and `prometheus_text()` renders the standard
+text exposition format for scraping.
+
+Re-instantiating a metric with an already-registered name and the SAME
+type returns the live registered instance (a fresh object would silently
+drop every accumulated value — e.g. an actor re-creating its counters on
+restart); a different type under the same name still raises.
 """
 from __future__ import annotations
 
+import os
 import threading
 
 _lock = threading.Lock()
 _registry: dict[str, "_Metric"] = {}
 
+# snapshots carry the producing process so cross-worker aggregation can
+# dedup a process reachable via two collection paths (pids collide
+# across hosts; (node, pid) does not)
+_NODE = os.uname().nodename
+
 
 class _Metric:
+    def __new__(cls, name: str, *args, **kwargs):
+        if not name or not isinstance(name, str) or \
+                any(c in name for c in " \t\n"):
+            raise ValueError(f"bad metric name {name!r}")
+        # check-and-register under ONE lock hold: two threads creating
+        # the same name concurrently must converge on one instance (a
+        # split check/insert would let the loser shadow the winner in
+        # the registry — the silent value-drop bug all over again, just
+        # behind a race window). registry_snapshot() skips entries whose
+        # __init__ hasn't finished (_registered).
+        with _lock:
+            existing = _registry.get(name)
+            if existing is not None:
+                if type(existing) is not cls:
+                    raise ValueError(
+                        f"metric {name!r} already registered with a "
+                        f"different type")
+                # same name + same type: hand back the LIVE instance
+                # instead of shadowing it (which dropped all accumulated
+                # values); __init__ sees _registered and merges
+                return existing
+            self = super().__new__(cls)
+            _registry[name] = self
+            return self
+
     def __init__(self, name: str, description: str = "",
                  tag_keys: tuple = ()):
-        if not name or any(c in name for c in " \t\n"):
-            raise ValueError(f"bad metric name {name!r}")
+        if getattr(self, "_registered", False):
+            # re-instantiation of the registered instance: keep values,
+            # adopt a description if we never had one
+            if description and not self.description:
+                self.description = description
+            return
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys)
         self._default_tags: dict = {}
         self._values: dict[tuple, float] = {}
-        with _lock:
-            existing = _registry.get(name)
-            if existing is not None and type(existing) is not type(self):
-                raise ValueError(
-                    f"metric {name!r} already registered with a different "
-                    f"type")
-            _registry[name] = self
+        self._registered = True
 
     def set_default_tags(self, tags: dict):
         self._default_tags = dict(tags)
@@ -46,6 +81,8 @@ class _Metric:
                 "name": self.name,
                 "type": type(self).__name__,
                 "description": self.description,
+                "pid": os.getpid(),
+                "node": _NODE,
                 "values": [{"tags": dict(k), "value": v}
                            for k, v in self._values.items()],
             }
@@ -69,11 +106,20 @@ class Gauge(_Metric):
 class Histogram(_Metric):
     def __init__(self, name: str, description: str = "",
                  boundaries: list | None = None, tag_keys: tuple = ()):
-        super().__init__(name, description, tag_keys)
+        if getattr(self, "_registered", False):
+            # returned-existing path: merge description, keep the live
+            # boundaries/counts (changing bucket layout mid-flight would
+            # corrupt the accumulated distribution)
+            super().__init__(name, description, tag_keys)
+            return
+        # subclass storage BEFORE super().__init__: _registered (set
+        # there, last) is what tells registry_snapshot() this object is
+        # fully built and safe to snapshot
         self.boundaries = sorted(boundaries or
                                  [0.001, 0.01, 0.1, 1, 10, 100])
         self._counts: dict[tuple, list] = {}
         self._sums: dict[tuple, float] = {}
+        super().__init__(name, description, tag_keys)
 
     def observe(self, value: float, tags: dict | None = None):
         key = self._key(tags)
@@ -90,19 +136,98 @@ class Histogram(_Metric):
         base = super().snapshot()
         with _lock:
             base["boundaries"] = self.boundaries
-            base["counts"] = [{"tags": dict(k), "counts": v}
+            base["counts"] = [{"tags": dict(k), "counts": list(v)}
                               for k, v in self._counts.items()]
         return base
 
 
 def registry_snapshot() -> list[dict]:
     with _lock:
-        metrics = list(_registry.values())
+        # entries registered in __new__ but still mid-__init__ are not
+        # yet snapshot-safe; they appear in the next snapshot
+        metrics = [m for m in _registry.values()
+                   if getattr(m, "_registered", False)]
     return [m.snapshot() for m in metrics]
 
 
+def aggregate_snapshots(snapshots: list[dict]) -> list[dict]:
+    """Merge per-process registry snapshots into one family per metric
+    name: Counter values and Histogram bucket counts/sums are SUMMED per
+    tag set across processes; Gauges keep the last collected value per
+    tag set. Snapshots from the same (node, pid, name) are deduped first
+    — the driver process answers both the local registry read and its
+    raylet's worker fan-out, and double-counting it would inflate sums."""
+    merged: dict[str, dict] = {}
+    order: list[str] = []
+    seen: set[tuple] = set()
+    for snap in snapshots:
+        name = snap.get("name")
+        if name is None:
+            continue
+        ident = (snap.get("node"), snap.get("pid"), name)
+        if None not in ident:
+            if ident in seen:
+                continue
+            seen.add(ident)
+        out = merged.get(name)
+        if out is None:
+            out = merged[name] = {
+                "name": name, "type": snap["type"],
+                "description": snap.get("description", ""),
+                "_vals": {},
+            }
+            order.append(name)
+            if snap["type"] == "Histogram":
+                out["boundaries"] = list(snap.get("boundaries", []))
+                out["_counts"] = {}
+        if snap["type"] != out["type"]:
+            continue   # cross-process type clash: keep the first family
+        if snap["type"] == "Histogram" and \
+                out["boundaries"] != list(snap.get("boundaries", [])):
+            # bucket-layout clash across processes: drop this process's
+            # contribution ENTIRELY (sums and counts together) — summing
+            # its _sum while excluding its buckets would publish a
+            # family where _sum disagrees with _count/_bucket
+            continue
+        if not out["description"] and snap.get("description"):
+            out["description"] = snap["description"]
+        for row in snap.get("values", []):
+            key = tuple(sorted(row["tags"].items()))
+            if snap["type"] == "Gauge":
+                out["_vals"][key] = row["value"]
+            else:
+                out["_vals"][key] = out["_vals"].get(key, 0.0) + row["value"]
+        if snap["type"] == "Histogram":
+            for row in snap.get("counts", []):
+                key = tuple(sorted(row["tags"].items()))
+                cur = out["_counts"].get(key)
+                counts = list(row["counts"])
+                if cur is None or len(cur) != len(counts):
+                    out["_counts"][key] = counts
+                else:
+                    out["_counts"][key] = [a + b
+                                           for a, b in zip(cur, counts)]
+    result = []
+    for name in order:
+        out = merged[name]
+        out["values"] = [{"tags": dict(k), "value": v}
+                         for k, v in out.pop("_vals").items()]
+        if out["type"] == "Histogram":
+            out["counts"] = [{"tags": dict(k), "counts": v}
+                             for k, v in out.pop("_counts").items()]
+        result.append(out)
+    return result
+
+
+def _escape_label_value(value) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote and
+    newline must be escaped inside label values."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _label(tags: dict, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in tags.items()]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in tags.items()]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -130,11 +255,11 @@ def prometheus_text(snapshots: list[dict]) -> str:
                 cum = 0
                 for b, c in zip(bounds, counts):
                     cum += c
-                    lines.append(
-                        f"{name}_bucket{_label(tags, f'le=\"{b}\"')} {cum}")
+                    le = f'le="{b}"'
+                    lines.append(f"{name}_bucket{_label(tags, le)} {cum}")
                 cum += counts[len(bounds)] if len(counts) > len(bounds) else 0
-                lines.append(
-                    f"{name}_bucket{_label(tags, 'le=\"+Inf\"')} {cum}")
+                inf = 'le="+Inf"'
+                lines.append(f"{name}_bucket{_label(tags, inf)} {cum}")
                 lines.append(f"{name}_count{_label(tags)} {cum}")
                 key = tuple(sorted(tags.items()))
                 lines.append(f"{name}_sum{_label(tags)} "

@@ -1,0 +1,277 @@
+"""Internal metrics plane + Prometheus exposition (PR 2).
+
+- golden-text Prometheus histogram family (cumulative _bucket/+Inf,
+  _count, _sum, label escaping);
+- cross-worker metrics_summary() aggregation (counters sum per tag set);
+- registry re-instantiation keeps accumulated values (satellite fix);
+- retry/fault counters consistent with an injected schedule;
+- metric-catalog lint: every internal metric literal in the tree is
+  declared in _private/telemetry.py with a ray_tpu_ prefix and a unit
+  suffix.
+
+Late-alphabet on purpose (tier-1 wall-clock budget); keep fast.
+"""
+import re
+import time
+
+import pytest
+
+
+# ------------------------------------------------------------ pure units
+
+
+def test_prometheus_text_histogram_golden():
+    from ray_tpu.util.metrics import Histogram, prometheus_text
+
+    h = Histogram("golden_latency_seconds", description="golden help",
+                  boundaries=[0.1, 1.0], tag_keys=("k",))
+    tags = {"k": 'a"b\\c\nd'}
+    h.observe(0.0625, tags=tags)
+    h.observe(0.5, tags=tags)
+    h.observe(2.0, tags=tags)
+    text = prometheus_text([h.snapshot()])
+    lbl = 'k="a\\"b\\\\c\\nd"'
+    expected = "\n".join([
+        "# HELP golden_latency_seconds golden help",
+        "# TYPE golden_latency_seconds histogram",
+        "golden_latency_seconds_bucket{%s,le=\"0.1\"} 1" % lbl,
+        "golden_latency_seconds_bucket{%s,le=\"1.0\"} 2" % lbl,
+        "golden_latency_seconds_bucket{%s,le=\"+Inf\"} 3" % lbl,
+        "golden_latency_seconds_count{%s} 3" % lbl,
+        "golden_latency_seconds_sum{%s} 2.5625" % lbl,
+    ]) + "\n"
+    assert text == expected, text
+
+
+def test_metric_reregistration_keeps_values():
+    """Satellite fix: re-instantiating a same-name/same-type metric must
+    return the live instance, not silently drop accumulated values."""
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    c1 = Counter("rereg_requests_total", description="first")
+    c1.inc(3.0)
+    c2 = Counter("rereg_requests_total")
+    assert c2 is c1
+    assert c2.snapshot()["values"] == [{"tags": {}, "value": 3.0}]
+    with pytest.raises(ValueError):
+        Gauge("rereg_requests_total")   # same name, different type
+    h1 = Histogram("rereg_latency_seconds", boundaries=[0.1, 1.0])
+    h1.observe(0.05)
+    h2 = Histogram("rereg_latency_seconds", boundaries=[7.0])
+    assert h2 is h1
+    assert h2.boundaries == [0.1, 1.0]      # live layout kept
+    assert h2.snapshot()["counts"][0]["counts"][0] == 1
+
+
+def test_aggregate_snapshots_sums_and_dedups():
+    from ray_tpu.util.metrics import Counter, aggregate_snapshots
+
+    c = Counter("aggdedup_total", tag_keys=("t",))
+    c.inc(2.0, tags={"t": "x"})
+    a = c.snapshot()
+    b = dict(a)
+    b["pid"] = (a["pid"] or 0) + 1   # "another process"
+    merged = aggregate_snapshots([a, a, b])   # a twice: deduped
+    row = next(m for m in merged if m["name"] == "aggdedup_total")
+    assert row["values"] == [{"tags": {"t": "x"}, "value": 4.0}]
+
+
+def test_aggregate_snapshots_histogram_boundary_clash_drops_whole_snap():
+    """A process with a different bucket layout must contribute NEITHER
+    its sum NOR its counts — a summed _sum over excluded buckets would
+    publish an internally inconsistent family."""
+    from ray_tpu.util.metrics import Histogram, aggregate_snapshots
+
+    h = Histogram("bclash_latency_seconds", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    a = h.snapshot()
+    b = {**a, "pid": (a["pid"] or 0) + 1, "boundaries": [9.9],
+         "values": [{"tags": {}, "value": 100.0}],
+         "counts": [{"tags": {}, "counts": [1, 0]}]}
+    merged = aggregate_snapshots([a, b])
+    row = next(m for m in merged if m["name"] == "bclash_latency_seconds")
+    assert row["values"] == a["values"]
+    assert row["counts"][0]["counts"] == a["counts"][0]["counts"]
+
+
+def test_retry_budget_exhaustion_counter_and_event():
+    from ray_tpu._private import events
+    from ray_tpu._private.retry import RetryBudget
+    from ray_tpu.util.metrics import registry_snapshot
+
+    def counter_value():
+        for m in registry_snapshot():
+            if m["name"] == "ray_tpu_retry_budget_exhausted_total":
+                return sum(v["value"] for v in m["values"])
+        return 0.0
+
+    before = counter_value()
+    budget = RetryBudget(capacity=1.0, refill_per_s=0.0)
+    assert budget.take() is True
+    assert budget.take() is False
+    assert counter_value() == before + 1
+    assert any(e["kind"] == "retry_budget_exhausted"
+               for e in events.snapshot())
+
+
+def test_profiling_timeline_events_carry_node():
+    """Satellite: timeline pids collide across hosts — every span must
+    name its producing host like tracing spans already do."""
+    import os
+
+    from ray_tpu._private import profiling
+
+    with profiling.record_span("test", "node_tag_probe"):
+        pass
+    spans = [e for e in profiling.snapshot()
+             if e["name"] == "node_tag_probe"]
+    assert spans and all(e.get("node") == os.uname().nodename
+                         for e in spans)
+
+
+# ------------------------------------------------------- catalog lint
+
+
+def test_metric_catalog_lint():
+    """CI satellite: every internal metric literal in the tree must be
+    declared in the telemetry catalog (single source of truth), and every
+    catalog name must be ray_tpu_-prefixed with a unit suffix."""
+    import pathlib
+
+    import ray_tpu
+    from ray_tpu._private.telemetry import ALLOWED_SUFFIXES, CATALOG
+
+    for name, spec in CATALOG.items():
+        assert name.startswith("ray_tpu_"), name
+        assert name.endswith(ALLOWED_SUFFIXES), \
+            f"{name} lacks a unit suffix {ALLOWED_SUFFIXES}"
+        assert spec["kind"] in ("Counter", "Gauge", "Histogram"), name
+        if spec["kind"] == "Counter":
+            assert name.endswith("_total"), \
+                f"counter {name} must end in _total"
+    suffix_re = "|".join(s.lstrip("_") for s in ALLOWED_SUFFIXES)
+    pat = re.compile(
+        r"""["'](ray_tpu_[a-z0-9_]+_(?:%s))["']""" % suffix_re)
+    root = pathlib.Path(ray_tpu.__file__).parent
+    undeclared = {}
+    for path in root.rglob("*.py"):
+        if path.name == "telemetry.py":
+            continue
+        for m in pat.finditer(path.read_text()):
+            if m.group(1) not in CATALOG:
+                undeclared.setdefault(m.group(1), []).append(str(path))
+    assert not undeclared, (
+        f"internal metric names not declared in "
+        f"_private/telemetry.py CATALOG: {undeclared}")
+
+
+# ------------------------------------------------- cluster-level tests
+
+
+def test_cross_worker_metrics_aggregation(ray_start_regular):
+    """Satellite: metrics_summary() must SUM a same-named counter across
+    worker processes (per tag set), not report per-process fragments."""
+    ray_tpu = ray_start_regular
+    from ray_tpu.experimental.state.api import metrics_summary
+
+    @ray_tpu.remote
+    class XwService:
+        def __init__(self):
+            from ray_tpu.util.metrics import Counter
+
+            self.c = Counter("xw_requests_total", tag_keys=("who",))
+
+        def bump(self, n):
+            self.c.inc(n, tags={"who": "x"})
+            import os
+
+            return os.getpid()
+
+    a, b = XwService.remote(), XwService.remote()
+    pids = ray_tpu.get([a.bump.remote(2), b.bump.remote(3)], timeout=120)
+    assert pids[0] != pids[1], "actors unexpectedly share a process"
+    snaps = metrics_summary()
+    row = next(m for m in snaps if m["name"] == "xw_requests_total")
+    vals = {tuple(sorted(v["tags"].items())): v["value"]
+            for v in row["values"]}
+    assert vals[(("who", "x"),)] == 5.0, row
+
+
+def test_internal_rpc_and_store_metrics_flow(ray_start_regular):
+    ray_tpu = ray_start_regular
+    import numpy as np
+
+    from ray_tpu.experimental.state.api import metrics_summary
+
+    @ray_tpu.remote
+    def rpc_metric_probe():
+        return 1
+
+    assert ray_tpu.get(rpc_metric_probe.remote(), timeout=120) == 1
+    # >100KB: forced through the shm store (inline results bypass it)
+    ref = ray_tpu.put(np.zeros(300_000, np.uint8))
+    assert ray_tpu.get(ref, timeout=120).nbytes == 300_000
+    snaps = {m["name"]: m for m in metrics_summary()}
+    lat = snaps["ray_tpu_rpc_latency_seconds"]
+    methods = {r["tags"].get("method") for r in lat["values"]}
+    assert methods & {"register_worker", "request_worker_lease",
+                      "get_nodes", "kv_put"}, methods
+    hits = sum(v["value"] for v in snaps[
+        "ray_tpu_object_store_get_total"]["values"]
+        if v["tags"].get("result") == "hit")
+    assert hits >= 1
+    assert sum(v["value"] for v in snaps[
+        "ray_tpu_object_store_put_bytes_total"]["values"]) >= 300_000
+    assert "ray_tpu_lease_grant_latency_seconds" in snaps
+
+
+@pytest.mark.fault_injection
+def test_injected_faults_and_retries_consistent_with_schedule(
+        ray_start_regular):
+    """Acceptance: /metrics retry and fault counters line up with the
+    deterministic injected schedule."""
+    ray_tpu = ray_start_regular
+    from ray_tpu._private import fault_injection
+    from ray_tpu._private.worker_runtime import current_worker
+    from ray_tpu.experimental.state.api import metrics_summary
+
+    def counter(snaps, name, **tags):
+        row = next((m for m in snaps if m["name"] == name), None)
+        if row is None:
+            return 0.0
+        return sum(v["value"] for v in row["values"]
+                   if all(v["tags"].get(k) == tv
+                          for k, tv in tags.items()))
+
+    before = metrics_summary()
+    inj = fault_injection.install(3, "disconnect:*.kv_put:#1")
+    try:
+        w = current_worker()
+        # the disconnect kills the GCS channel mid-send; the unified
+        # retry policy heals it and re-sends (kv_put is retry-safe)
+        assert w.gcs.call("kv_put", ns="telemetry_test", key=b"k",
+                          value=b"v") is True
+        assert w.gcs.call("kv_get", ns="telemetry_test", key=b"k") == b"v"
+    finally:
+        fault_injection.uninstall()
+    n_faults = sum(1 for a, _r, m, _n in inj.trace()
+                   if a == "disconnect" and m == "kv_put")
+    assert n_faults == 1, inj.trace()
+    after = metrics_summary()
+    d_faults = (counter(after, "ray_tpu_faults_injected_total",
+                        action="disconnect", method="kv_put")
+                - counter(before, "ray_tpu_faults_injected_total",
+                          action="disconnect", method="kv_put"))
+    assert d_faults == n_faults, (d_faults, n_faults)
+    d_retries = (counter(after, "ray_tpu_retry_attempts_total",
+                         method="kv_put")
+                 - counter(before, "ray_tpu_retry_attempts_total",
+                           method="kv_put"))
+    assert d_retries >= 1, after
+    # the healed channel means the user-visible call still succeeded —
+    # and the transport error that triggered the retry was counted
+    d_errors = (counter(after, "ray_tpu_rpc_errors_total",
+                        method="kv_put", kind="connection_lost")
+                - counter(before, "ray_tpu_rpc_errors_total",
+                          method="kv_put", kind="connection_lost"))
+    assert d_errors >= 1, after
