@@ -66,6 +66,19 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # --- collective / mesh ---
     "collective_default_backend": "xla",
     "collective_op_timeout_s": 300.0,  # dead-member failure detector
+    # --- collective data-plane telemetry (util/collective/telemetry.py) ---
+    "collective_timing_flush_s": 0.25,      # rank-timing flush cadence
+    "collective_straggler_multiple": 3.0,   # lag > multiple * median lag
+    "collective_straggler_min_lag_s": 0.05,  # floor: ignore µs jitter in
+                                             # tight groups (median ~ 0)
+    # --- device telemetry (_private/tpu_probe.py) ---
+    "device_gauge_poll_s": 0.0,        # 0 = one probe at raylet start
+                                       # (before workers own the chips);
+                                       # recurring subprocess probes
+                                       # contend with training workers
+                                       # for TPU ownership — opt-in only.
+                                       # Live in-use HBM comes from the
+                                       # owning train workers in-process.
     "mesh_ici_axis_order": "dp,pp,ep,sp,tp",  # slowest→fastest varying axes
     # --- misc ---
     "rpc_max_message_bytes": 512 * 1024 * 1024,

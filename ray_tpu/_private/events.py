@@ -26,6 +26,18 @@ Event kinds recorded by the runtime:
 - ``fault_injected`` — a fault-injection rule fired
                      (_private/fault_injection.py): action, method,
                      per-method call number.
+- ``COLLECTIVE_STRAGGLER`` — ranks arrived at a collective op late
+                     (group rendezvous actor, util/collective/
+                     telemetry.py): group, op, seq, ranks, lags.
+- ``COMPILE_BEGIN`` / ``COMPILE_END`` — an instrumented jitted
+                     function hit a compile-cache miss
+                     (parallel/compile_watch.py): fn, duration.
+- ``train_step``   — a Train worker streamed a step report
+                     (train/worker_group.py): rank, iteration, device
+                     identity.
+- ``train_group``  — a Train worker gang came up
+                     (train/backend_executor.py): per-worker device
+                     identities.
 
 Design constraints match the metrics plane: recording is one lock +
 deque append (no allocation beyond the event dict), the ring is bounded
@@ -70,7 +82,14 @@ def _role() -> str:
 
 
 def record(kind: str, **fields):
-    """Append one structured event. Never raises; ~1µs when enabled."""
+    """Append one structured event. Never raises; ~1µs when enabled.
+
+    The envelope keys (ts/seq/pid/node/role/kind) are reserved and WIN
+    over same-named caller fields: `seq` is the (node, pid, seq) dedup
+    key `list_cluster_events` relies on — a caller shadowing it would
+    make its events silently vanish as "duplicates" of unrelated ones
+    (this bit the collective straggler events; carry domain sequence
+    numbers under another name, e.g. ``op_seq``)."""
     global _seq, _dropped
     if not ENABLED:
         return
@@ -79,9 +98,9 @@ def record(kind: str, **fields):
         dropped = len(_events) == _events.maxlen
         if dropped:
             _dropped += 1
-        _events.append({"ts": time.time(), "seq": _seq, "pid": _PID,
-                        "node": _NODE, "role": _role(), "kind": kind,
-                        **fields})
+        _events.append({**fields,
+                        "ts": time.time(), "seq": _seq, "pid": _PID,
+                        "node": _NODE, "role": _role(), "kind": kind})
     if dropped:
         # rare (ring full) — counted into /metrics so silent loss of the
         # event stream's head is itself observable

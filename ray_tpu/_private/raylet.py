@@ -149,6 +149,17 @@ class Raylet:
         # fake slices, real deployments auto-detect
         self.tpu_topology = (tpu_topology if tpu_topology is not None
                              else detect_tpu_topology())
+        if (tpu_topology is None and self.tpu_topology
+                and self.tpu_topology.get("chips")):
+            # real chips detected (not test-injected topology): seed the
+            # per-device HBM gauges now, while no worker owns the chips
+            # (one subprocess probe by default; recurring polling is the
+            # opt-in RAY_TPU_DEVICE_GAUGE_POLL_S — live in-use numbers
+            # come from the owning train workers in-process). Never runs
+            # on CPU CI boxes.
+            from ray_tpu._private.tpu_probe import start_device_gauge_poller
+
+            start_device_gauge_poller()
         self.resources_avail = dict(self.resources_total)
         self.session_dir = session_dir or os.path.join(
             "/tmp/ray_tpu", f"session_{os.getpid()}")

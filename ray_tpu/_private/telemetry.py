@@ -115,6 +115,59 @@ CATALOG: dict[str, dict] = {
         "description": "Structured events dropped from the bounded "
                        "per-process event ring",
     },
+    # --- collective data plane (util/collective/telemetry.py) ---
+    # group names are operator-chosen but bounded (one per worker gang /
+    # Tune trial family), same cardinality class as method names
+    "ray_tpu_collective_latency_seconds": {
+        "kind": "Histogram", "tags": ("op", "backend", "group"),
+        "boundaries": [0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                       5.0, 30.0],
+        "description": "Caller-observed wall time of one collective op "
+                       "on one rank (allreduce/broadcast/.../barrier, "
+                       "host and xla backends)",
+    },
+    "ray_tpu_collective_bytes_total": {
+        "kind": "Counter", "tags": ("op", "backend", "group"),
+        "description": "Per-rank payload bytes moved through collective "
+                       "ops (payload, not wire bytes — algorithm-"
+                       "independent)",
+    },
+    "ray_tpu_collective_stragglers_total": {
+        "kind": "Counter", "tags": ("group", "op"),
+        "description": "Ranks flagged by the straggler detector (arrival "
+                       "lag > configured multiple of the group median)",
+    },
+    # --- pjit compile path (parallel/compile_watch.py) ---
+    "ray_tpu_pjit_compile_seconds": {
+        "kind": "Histogram", "tags": ("fn",),
+        "boundaries": [0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1200.0],
+        "description": "Wall time of a compile-cache-miss call of an "
+                       "instrumented jitted function (trace + XLA "
+                       "compile + first run)",
+    },
+    "ray_tpu_pjit_cache_total": {
+        "kind": "Counter", "tags": ("fn", "result"),
+        "description": "Instrumented jitted-function calls by compile-"
+                       "cache outcome (result=hit|miss) — a miss burst "
+                       "mid-training means shape churn is recompiling "
+                       "the step",
+    },
+    "ray_tpu_mesh_build_seconds": {
+        "kind": "Histogram", "tags": ("kind",),
+        "boundaries": [0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 30.0],
+        "description": "Device-mesh construction time "
+                       "(kind=mesh|hybrid_mesh)",
+    },
+    # --- per-device telemetry (_private/tpu_probe.py) ---
+    # node tag is load-bearing: each host's probe subprocess numbers its
+    # local devices from 0 (no jax.distributed world), so without it a
+    # multi-host cluster's gauges would collide and last-write-wins
+    "ray_tpu_device_hbm_bytes": {
+        "kind": "Gauge", "tags": ("node", "device", "platform", "stat"),
+        "description": "Per-device memory from the subprocess device "
+                       "probe (stat=in_use|limit; HBM on TPU, host "
+                       "allocator bytes on the CPU fallback)",
+    },
 }
 
 _lock = threading.Lock()

@@ -1,16 +1,37 @@
-"""TPU reachability probe, shared by bench/benchmark entry points.
+"""TPU reachability + per-device telemetry probes.
 
 The axon tunnel can hang for hours and a hung tunnel blocks
 ``jax.devices()`` FOREVER in any process that touches the TPU backend —
-so the probe runs in a SUBPROCESS with a timeout, and callers decide the
-platform before their own first jax import (see bench.py for the
-retry-with-backoff policy layered on top).
+so every probe here runs in a SUBPROCESS with a timeout, and callers
+decide the platform before their own first jax import (see bench.py for
+the retry-with-backoff policy layered on top).
+
+Besides the reachability/chip probes the raylet uses at init, this
+module is the data-plane device-telemetry source (PR 3):
+
+- ``probe_devices()``      subprocess-safe per-device snapshot — HBM
+                           bytes in use/limit, platform/kind, coords
+                           and slice when the runtime exposes them;
+                           on CPU the same shape comes back with the
+                           host allocator stats jax reports (graceful
+                           fallback, never an error).
+- ``publish_device_gauges()`` folds a snapshot into the
+                           ``ray_tpu_device_hbm_bytes`` catalog gauge.
+- ``start_device_gauge_poller()`` background refresh loop the raylet
+                           starts when real chips were detected.
+- ``local_device_identity()`` IN-process identity for tagging train
+                           step events — consults jax only if the
+                           process already imported it (a train worker
+                           inevitably will), so it adds zero new
+                           backend-init hang risk.
 """
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
+import threading
 
 
 def tpu_reachable_once(timeout_s: float = 120.0) -> bool:
@@ -71,4 +92,207 @@ def _probe_chips_once(timeout_s: float) -> dict | None:
         return None
     if "coords" in info:
         info["coords"] = [tuple(c) for c in info["coords"]]
+    return info
+
+
+# ------------------------------------------------ per-device telemetry
+
+_DEVICE_PROBE_SRC = """
+import json, jax
+out = []
+for d in jax.local_devices():
+    rec = {"id": d.id, "platform": d.platform,
+           "kind": getattr(d, "device_kind", ""),
+           "process_index": d.process_index}
+    coords = getattr(d, "coords", None)
+    if coords:
+        rec["coords"] = list(coords)
+    si = getattr(d, "slice_index", None)
+    if si is not None:
+        rec["slice_index"] = si
+    try:
+        ms = d.memory_stats()
+    except Exception:
+        ms = None
+    if ms:
+        if ms.get("bytes_in_use") is not None:
+            rec["hbm_bytes_in_use"] = int(ms["bytes_in_use"])
+        if ms.get("bytes_limit") is not None:
+            rec["hbm_bytes_limit"] = int(ms["bytes_limit"])
+    out.append(rec)
+print(json.dumps(out))
+"""
+
+
+def probe_devices(timeout_s: float = 60.0) -> list[dict] | None:
+    """Per-device snapshot via a SUBPROCESS jax call (same hang
+    rationale as the chip probe): id, platform, kind, coords/slice when
+    exposed, HBM bytes in use / limit when the backend reports memory
+    stats. CPU fallback is the same record shape minus TPU-only fields;
+    None only when the probe itself failed or timed out. NOT memoized —
+    memory numbers are the point of polling."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", _DEVICE_PROBE_SRC],
+            timeout=timeout_s, capture_output=True, text=True)
+        if probe.returncode != 0:
+            return None
+        devices = json.loads(probe.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, OSError, ValueError, IndexError):
+        return None
+    return devices if isinstance(devices, list) else None
+
+
+def publish_device_gauges(devices: list[dict] | None = None,
+                          timeout_s: float = 60.0) -> int:
+    """Fold a device snapshot (probed here unless injected by the
+    caller) into the ``ray_tpu_device_hbm_bytes`` gauge, one
+    (node, device, platform, stat) series per reported stat. Returns
+    the number of devices seen; 0 when telemetry is off or the probe
+    failed.
+
+    When this function PROBES (devices=None), only ``stat=limit`` is
+    published: the subprocess's ``bytes_in_use`` is the fresh probe
+    process's own allocator state, not the training workload's — and a
+    stale near-zero value under the same tag set would race the owning
+    worker's live publishes last-write-wins. Injected records (owner
+    processes, tests) carry whatever stats the caller vouches for."""
+    from ray_tpu._private import telemetry as _tm
+
+    if not _tm.ENABLED:
+        return 0
+    probed = devices is None
+    if probed:
+        devices = probe_devices(timeout_s)
+    if not devices:
+        return 0
+    node = os.uname().nodename
+    for d in devices:
+        # node tag: local device ids restart at 0 on every host (the
+        # probe subprocess has no jax.distributed world) — without the
+        # hostname, multi-host gauges collide last-write-wins
+        tags = {"node": node, "device": str(d.get("id")),
+                "platform": str(d.get("platform", "?"))}
+        if not probed and d.get("hbm_bytes_in_use") is not None:
+            _tm.gauge_set("ray_tpu_device_hbm_bytes",
+                          float(d["hbm_bytes_in_use"]),
+                          tags={**tags, "stat": "in_use"})
+        if d.get("hbm_bytes_limit") is not None:
+            _tm.gauge_set("ray_tpu_device_hbm_bytes",
+                          float(d["hbm_bytes_limit"]),
+                          tags={**tags, "stat": "limit"})
+    return len(devices)
+
+
+_poller_lock = threading.Lock()
+_poller_thread: threading.Thread | None = None
+
+
+def start_device_gauge_poller(interval_s: float | None = None) -> bool:
+    """Background per-device gauge publisher (daemon thread, one per
+    process), started by the raylet only when REAL chips were detected.
+
+    Default behavior is ONE probe, at raylet start — i.e. before any
+    training worker exists. A subprocess `import jax` takes exclusive
+    TPU ownership under libtpu's single-process lock, so a RECURRING
+    probe on a busy host either fails every poll (worker owns the
+    chips: gauges silently absent exactly when they matter) or, worse,
+    wins the race between worker restarts and fails the worker's own
+    backend init. Recurring polling is therefore opt-in
+    (``RAY_TPU_DEVICE_GAUGE_POLL_S`` > 0), for hosts where probing is
+    known-safe; live in-use HBM during training comes from the OWNING
+    process instead via ``publish_local_device_gauges()`` (train
+    workers call it on every step report). Returns True if the
+    publisher thread is (now) running."""
+    global _poller_thread
+    from ray_tpu._private import telemetry as _tm
+
+    if not _tm.ENABLED:
+        return False
+    with _poller_lock:
+        if _poller_thread is not None and _poller_thread.is_alive():
+            return True
+
+        def _loop():
+            import time as _time
+
+            from ray_tpu._private.config import get_config
+
+            while True:
+                try:
+                    publish_device_gauges()
+                except Exception:
+                    pass   # telemetry must never take the raylet down
+                iv = (interval_s if interval_s is not None
+                      else float(get_config("device_gauge_poll_s")))
+                if iv <= 0:
+                    return     # one-shot seed (the safe default)
+                _time.sleep(iv)
+
+        _poller_thread = threading.Thread(
+            target=_loop, daemon=True, name="device-gauge-poller")
+        _poller_thread.start()
+    return True
+
+
+def publish_local_device_gauges() -> int:
+    """IN-process gauge publish from a process that already owns the
+    jax backend (train workers): ``memory_stats()`` on the live runtime
+    costs microseconds and cannot contend with anyone for chip
+    ownership — the right source for live HBM while training runs.
+    Consults jax only if this process already imported it (same
+    no-new-hang-risk rule as ``local_device_identity``)."""
+    from ray_tpu._private import telemetry as _tm
+
+    if not _tm.ENABLED:
+        return 0
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    try:
+        devs = jax.local_devices()
+    except Exception:
+        return 0
+    records = []
+    for d in devs:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        records.append({"id": d.id, "platform": d.platform,
+                        "hbm_bytes_in_use": ms.get("bytes_in_use"),
+                        "hbm_bytes_limit": ms.get("bytes_limit")})
+    if not records:
+        return 0
+    return publish_device_gauges(devices=records)
+
+
+def local_device_identity() -> dict:
+    """IN-process device identity for tagging train-step events: host +
+    pid always; platform/devices only when this process ALREADY imported
+    jax (a train worker does before its first step) — never triggers a
+    fresh backend init, so no new tunnel-hang exposure."""
+    info: dict = {"host": os.uname().nodename, "pid": os.getpid(),
+                  "platform": None, "device_count": 0}
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return info
+    try:
+        devs = jax.local_devices()
+    except Exception:
+        return info
+    if not devs:
+        return info
+    info["platform"] = devs[0].platform
+    info["device_count"] = len(devs)
+    info["device_kind"] = getattr(devs[0], "device_kind", "")
+    info["device_ids"] = [d.id for d in devs]
+    coords = [list(getattr(d, "coords", ()) or ()) for d in devs]
+    if any(coords):
+        info["coords"] = coords
+    si = getattr(devs[0], "slice_index", None)
+    if si is not None:
+        info["slice_index"] = si
     return info

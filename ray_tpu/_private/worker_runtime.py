@@ -2680,11 +2680,17 @@ class CoreWorker:
                         result = self._package_results(spec, result)
             finally:
                 sem.release()
+            if spec.get("dynamic_returns"):
+                _events.task_event(spec["task_id"], "FINISHED",
+                                   desc=spec.get("task_desc"))
+                return result
+            # package BEFORE recording FINISHED (matching the plain-task
+            # path): an unserializable result must yield FAILED alone,
+            # not a FINISHED→FAILED pair for one task
+            out = self._package_results(spec, result)
             _events.task_event(spec["task_id"], "FINISHED",
                                desc=spec.get("task_desc"))
-            if spec.get("dynamic_returns"):
-                return result
-            return self._package_results(spec, result)
+            return out
         except BaseException as e:  # noqa: BLE001
             _events.task_event(spec["task_id"], "FAILED",
                                error=type(e).__name__,
@@ -3043,18 +3049,79 @@ class CoreWorker:
             self._col_mailbox[key] = data
             self._col_cond.notify_all()
 
+    def col_purge(self, group: str) -> int:
+        """Drop every mailbox entry belonging to one collective group
+        (keys lead with the group name). Called on group destroy: a
+        stale message from a dead incarnation (e.g. a peer's payload
+        that landed after an op timeout) would otherwise trip the next
+        incarnation's seq validation as a phantom NEWER seq."""
+        with self._col_cond:
+            stale = [k for k in self._col_mailbox if k and k[0] == group]
+            for k in stale:
+                del self._col_mailbox[k]
+            return len(stale)
+
     def rpc_col_push(self, conn, key: tuple, data):
         self.col_push_local(tuple(key), data)
         return True
 
-    def col_take(self, key: tuple, timeout: float = 300.0):
+    def col_take(self, key: tuple, timeout: float = 300.0,
+                 seq_pos: int | None = None):
+        """Blocking take of one collective message.
+
+        ``seq_pos`` (index of the op sequence number within ``key``)
+        arms receiver-side sequence validation: if a message for the
+        SAME channel (identical key except the seq slot) carrying a
+        NEWER seq shows up while ours never does, the group's op
+        ordering has desynchronized — raise a clear mismatch error
+        immediately instead of hanging until the watchdog timeout or
+        silently pairing wrong payloads. Only a newer seq is proof:
+        per-peer delivery is in-order, so a newer message implies ours
+        would already have arrived. An OLDER same-channel seq is
+        ambiguous (a redelivered duplicate — e.g. the fault plane's
+        ``dup`` action — looks identical to a restarted peer), so it
+        never raises; it only annotates the eventual timeout. The exact
+        key is always preferred when present."""
         key = tuple(key)
+
+        def _same_channel(k):
+            return (len(k) == len(key) and k[:seq_pos] == key[:seq_pos]
+                    and k[seq_pos + 1:] == key[seq_pos + 1:]
+                    and k[seq_pos] != key[seq_pos])
+
+        def _newer(k):
+            return _same_channel(k) and k[seq_pos] > key[seq_pos]
+
+        def _ready():
+            if key in self._col_mailbox:
+                return True
+            return seq_pos is not None and any(
+                _newer(k) for k in self._col_mailbox)
+
         with self._col_cond:
-            ok = self._col_cond.wait_for(lambda: key in self._col_mailbox,
-                                         timeout=timeout)
+            ok = self._col_cond.wait_for(_ready, timeout=timeout)
             if not ok:
-                raise TimeoutError(f"collective recv timed out on {key}")
-            return self._col_mailbox.pop(key)
+                hint = ""
+                if seq_pos is not None:
+                    stale = sorted(k[seq_pos] for k in self._col_mailbox
+                                   if _same_channel(k))
+                    if stale:
+                        hint = (f" (same-channel messages with older seq "
+                                f"{stale} are waiting — a restarted peer "
+                                f"resets its op counters)")
+                raise TimeoutError(
+                    f"collective recv timed out on {key}{hint}")
+            if key in self._col_mailbox:
+                return self._col_mailbox.pop(key)
+            newer = sorted(k[seq_pos] for k in self._col_mailbox
+                           if _newer(k))
+            raise exc.CollectiveSeqMismatchError(
+                f"collective sequence mismatch on channel "
+                f"{key[:seq_pos] + key[seq_pos + 1:]}: this rank expects "
+                f"seq {key[seq_pos]} but the peer already sent seq "
+                f"{newer} — the group's op ordering has desynchronized "
+                f"(every rank must issue collective calls in the same "
+                f"order; a restarted member resets its counters)")
 
     def rpc_ping(self, conn):
         return "pong"

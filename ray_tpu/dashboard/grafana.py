@@ -36,6 +36,25 @@ _PANELS = [
      "rate(ray_tpu_object_store_get_total[1m])", "ops"),
     ("Pubsub backlog", "ray_tpu_pubsub_backlog_messages", "short"),
     ("GCS store ops", "rate(ray_tpu_gcs_store_ops_total[1m])", "ops"),
+    # --- data plane (PR 3: collective / compile / device telemetry) ---
+    ("Collective p50 latency",
+     "histogram_quantile(0.5, rate(ray_tpu_collective_latency_seconds"
+     "_bucket[5m]))", "s"),
+    ("Collective p99 latency",
+     "histogram_quantile(0.99, rate(ray_tpu_collective_latency_seconds"
+     "_bucket[5m]))", "s"),
+    ("Collective payload throughput",
+     "rate(ray_tpu_collective_bytes_total[1m])", "Bps"),
+    ("Collective stragglers",
+     "rate(ray_tpu_collective_stragglers_total[5m])", "ops"),
+    ("pjit compile time spent",
+     "rate(ray_tpu_pjit_compile_seconds_sum[5m])", "s"),
+    ("pjit compile cache (hit/miss)",
+     "rate(ray_tpu_pjit_cache_total[5m])", "ops"),
+    ("Mesh build p50",
+     "histogram_quantile(0.5, rate(ray_tpu_mesh_build_seconds_bucket"
+     "[5m]))", "s"),
+    ("Device HBM", "ray_tpu_device_hbm_bytes", "bytes"),
 ]
 
 

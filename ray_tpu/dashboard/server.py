@@ -10,6 +10,8 @@ Routes (reference modules in parens — dashboard/modules/*):
     /api/placement_groups   (state)
     /api/jobs               (job)
     /api/events             structured runtime event log (cluster events)
+    /api/collectives        data-plane summary: collective ops,
+                            stragglers, compile stats, device gauges
     /api/reporter           per-node physical stats (reporter_agent)
     /api/grafana_dashboard  importable Grafana JSON (dashboard factory)
     /api/cluster_status     (`ray status`)
@@ -92,6 +94,8 @@ class DashboardServer:
                 payload = state.list_placement_groups(address=self.address)
             elif path == "/api/events":
                 payload = state.list_cluster_events(address=self.address)
+            elif path == "/api/collectives":
+                payload = state.summarize_collectives(address=self.address)
             elif path == "/api/reporter":
                 payload = self._reporter()
             elif path == "/api/grafana_dashboard":

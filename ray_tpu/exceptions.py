@@ -94,6 +94,15 @@ class CrossLanguageError(RayTpuError):
     pass
 
 
+class CollectiveSeqMismatchError(RayTpuError):
+    """A collective recv found a message for the same (group, phase,
+    step, peer) channel carrying a DIFFERENT op sequence number than
+    expected: the group's op ordering has desynchronized (e.g. a rank
+    restarted and reset its counters, or ranks issued collectives in
+    different orders). Raised instead of the old behavior — hanging
+    until the op timeout or silently pairing the wrong payloads."""
+
+
 class RaySystemError(RayTpuError):
     """An internal framework component failed (narrow subclass — catching it
     must NOT swallow user-code TaskErrors, matching reference semantics)."""

@@ -386,8 +386,9 @@ def list_cluster_events(*, address: str | None = None, filters=None,
     if limit is not None:
         # a time-ordered log truncates from the HEAD: keep the recent
         # tail (an operator debugging an incident wants the last N
-        # events, not the cluster's first N)
-        rows = rows[-limit:]
+        # events, not the cluster's first N). limit=0 means zero rows,
+        # matching _apply_filters' semantics — rows[-0:] would be all.
+        rows = rows[-limit:] if limit else []
     return rows
 
 
@@ -466,6 +467,100 @@ def memory_summary(*, address: str | None = None) -> str:
         lines.append(f"  {o['ObjectID'][:16]}  {o['Size']:>12}  "
                      f"on {len(o['Locations'])} node(s)")
     return "\n".join(lines)
+
+
+def summarize_collectives(*, address: str | None = None) -> dict:
+    """Data-plane rollup (reference tier: `ray summary` — but over the
+    collective/compile/device telemetry this framework's PR 3 adds).
+    Reuses the PR 2 snapshot/aggregation RPCs — everything here is a
+    fold over ``metrics_summary()`` plus the cluster event stream, so
+    it works connected or standalone exactly like the other summaries:
+
+    - ``ops``        one row per (group, backend, op): call count,
+                     total/mean latency, payload bytes moved;
+    - ``stragglers`` the COLLECTIVE_STRAGGLER events (group, op, seq,
+                     late ranks with their lags);
+    - ``compile``    per-fn pjit compile time + cache hit/miss counts
+                     (parallel/compile_watch.py);
+    - ``devices``    per-device HBM gauges (tpu_probe device poller).
+    """
+    snaps = {m["name"]: m for m in metrics_summary(address=address)}
+
+    def _sums(name):
+        fam = snaps.get(name)
+        if not fam:
+            return {}
+        return {tuple(sorted(v["tags"].items())): v["value"]
+                for v in fam.get("values", [])}
+
+    def _counts(name):
+        fam = snaps.get(name)
+        if not fam:
+            return {}
+        return {tuple(sorted(row["tags"].items())): sum(row["counts"])
+                for row in fam.get("counts", [])}
+
+    ops: dict[tuple, dict] = {}
+    lat_sums = _sums("ray_tpu_collective_latency_seconds")
+    for key, count in _counts("ray_tpu_collective_latency_seconds").items():
+        tags = dict(key)
+        total = lat_sums.get(key, 0.0)
+        ops[key] = {"group": tags.get("group"),
+                    "backend": tags.get("backend"), "op": tags.get("op"),
+                    "count": int(count), "total_s": total,
+                    "mean_s": (total / count) if count else 0.0,
+                    "bytes": 0.0}
+    for key, value in _sums("ray_tpu_collective_bytes_total").items():
+        tags = dict(key)
+        row = ops.setdefault(key, {
+            "group": tags.get("group"), "backend": tags.get("backend"),
+            "op": tags.get("op"), "count": 0, "total_s": 0.0,
+            "mean_s": 0.0, "bytes": 0.0})
+        row["bytes"] = value
+
+    compile_fns: dict[str, dict] = {}
+    comp_sums = _sums("ray_tpu_pjit_compile_seconds")
+    for key, count in _counts("ray_tpu_pjit_compile_seconds").items():
+        fn = dict(key).get("fn") or "?"
+        total = comp_sums.get(key, 0.0)
+        compile_fns[fn] = {"compiles": int(count), "total_s": total,
+                           "mean_s": (total / count) if count else 0.0,
+                           "cache_hits": 0, "cache_misses": 0}
+    for key, value in _sums("ray_tpu_pjit_cache_total").items():
+        tags = dict(key)
+        fn = tags.get("fn") or "?"
+        row = compile_fns.setdefault(fn, {
+            "compiles": 0, "total_s": 0.0, "mean_s": 0.0,
+            "cache_hits": 0, "cache_misses": 0})
+        if tags.get("result") == "hit":
+            row["cache_hits"] = int(value)
+        elif tags.get("result") == "miss":
+            row["cache_misses"] = int(value)
+
+    devices: dict[tuple, dict] = {}
+    for key, value in _sums("ray_tpu_device_hbm_bytes").items():
+        tags = dict(key)
+        # keyed by (node, device): local device ids restart at 0 on
+        # every host, so the hostname disambiguates multi-host clusters
+        dev = devices.setdefault(
+            (tags.get("node"), tags.get("device"), tags.get("platform")),
+            {"node": tags.get("node"), "device": tags.get("device"),
+             "platform": tags.get("platform")})
+        if tags.get("stat") == "in_use":
+            dev["hbm_bytes_in_use"] = value
+        elif tags.get("stat") == "limit":
+            dev["hbm_bytes_limit"] = value
+
+    stragglers = list_cluster_events(
+        address=address, filters=[("kind", "=", "COLLECTIVE_STRAGGLER")])
+    return {
+        "ops": sorted(ops.values(),
+                      key=lambda r: (r["group"] or "", r["op"] or "")),
+        "stragglers": stragglers,
+        "compile": compile_fns,
+        "devices": [devices[k] for k in sorted(devices,
+                                               key=lambda k: str(k))],
+    }
 
 
 def metrics_summary(*, address: str | None = None,

@@ -1,0 +1,244 @@
+"""Compile-path observability for the pjit data plane.
+
+A slow training step is either a slow step or a RECOMPILING step, and
+without instrumentation the two are indistinguishable from the driver.
+This module wraps the jitted callables ``make_train_step`` /
+``eval_step`` / ``make_train_state`` hand out so every call is
+classified against a per-signature compile cache:
+
+- cache hit: one counter inc (``ray_tpu_pjit_cache_total{result=hit}``),
+  then straight into the jitted function;
+- cache miss: ``COMPILE_BEGIN``/``COMPILE_END`` cluster events, a span
+  in BOTH the chrome-trace timeline (_private/profiling.py, µs) and
+  util/tracing (ns — joins the surrounding task's trace), and the
+  wall time into ``ray_tpu_pjit_compile_seconds``.
+
+Classification is O(1) on the hit path: jitted callables expose
+``_cache_size()`` (~0.1µs), so a call that grew the cache IS a
+trace+compile — no signature re-derivation duplicating jit's own C++
+dispatch on every training step. Callables without ``_cache_size``
+fall back to a per-signature key at jit's abstraction level ((shape,
+dtype) per array leaf + pytree structure). The measured duration is
+trace + compile + first execution (the recompile-attribution signal
+operators need), not a pure XLA compile timer; on the cache-size path
+the COMPILE_BEGIN event is materialized after the fact (the miss is
+only knowable once the call returns) and carries ``started_at``.
+
+Mesh construction gets the same treatment through ``mesh_build_timer``
+(``ray_tpu_mesh_build_seconds{kind}``): on a multi-slice pod,
+``mesh_utils.create_device_mesh`` does real topology work worth seeing.
+
+Everything is behind the ``RAY_TPU_INTERNAL_TELEMETRY=0`` kill switch;
+disabled, a wrapped call costs one attribute read and one bool check.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+
+from ray_tpu._private import events as _events
+from ray_tpu._private import profiling as _prof
+from ray_tpu._private import telemetry as _tm
+
+
+def _abstract_key(args, kwargs):
+    """Hashable per-call signature at jit's abstraction level: pytree
+    structure + (shape, dtype) per array leaf, value for hashable
+    scalar leaves (static-ish), type name otherwise. The PyTreeDef goes
+    into the key AS-IS (it is hashable): rendering it to a string would
+    cost a multi-KB format of the whole param tree on the cache-HIT
+    path of every training step."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype)))
+        elif isinstance(leaf, (int, float, bool, complex, str,
+                               bytes, type(None))):
+            sig.append((type(leaf).__name__, leaf))
+        else:
+            sig.append(type(leaf).__name__)
+    return (treedef, tuple(sig))
+
+
+class CompiledFunction:
+    """Wraps a jitted callable with compile-cache observability.
+    Transparent otherwise: unknown attributes (``lower``,
+    ``clear_cache``, ...) delegate to the wrapped function."""
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self._name = name
+        self._seen: set = set()
+        self._seen_lock = threading.Lock()
+        functools.update_wrapper(self, fn, updated=())
+
+    def __getattr__(self, item):
+        if item == "_fn":
+            # only reachable mid-unpickle (before __setstate__ ran);
+            # without this guard delegation recurses to a stack overflow
+            raise AttributeError(item)
+        return getattr(self._fn, item)
+
+    # The bare jax.jit return value cloudpickles across task boundaries;
+    # the wrapper must too (the lock is unpicklable, and the _seen cache
+    # is deliberately dropped: the receiving process's jit cache is
+    # empty, so its first call IS a compile — a fresh cache keeps the
+    # hit/miss classification truthful there).
+    def __getstate__(self):
+        return {"fn": self._fn, "name": self._name}
+
+    def __setstate__(self, state):
+        self.__init__(state["fn"], state["name"])
+
+    def __call__(self, *args, **kwargs):
+        if not _tm.ENABLED:
+            return self._fn(*args, **kwargs)
+        cache_size = getattr(self._fn, "_cache_size", None)
+        if cache_size is None:
+            return self._call_classified_by_signature(args, kwargs)
+        # O(1) hot path: jit's own cache is the source of truth (it
+        # also respects static args / weak types the signature key
+        # can't see). A failed compile never grows the cache, so the
+        # retry naturally counts as a miss again.
+        before = cache_size()
+        start = time.time()
+        t0 = time.perf_counter()
+        tags = {"fn": self._name}
+        try:
+            out = self._fn(*args, **kwargs)
+        except BaseException:
+            # NOT gated on the cache delta: some jax versions grow the
+            # pjit cache even when tracing raises, so the delta can't
+            # distinguish failure modes — the _seen set can (below)
+            self._record_failed_call(args, kwargs, start,
+                                     time.perf_counter() - t0, tags)
+            raise
+        if cache_size() == before:
+            _tm.counter_inc("ray_tpu_pjit_cache_total",
+                            tags={**tags, "result": "hit"})
+            return out
+        # a compile happened: remember the signature (cheap relative to
+        # the compile it just paid for) so a LATER failing call of the
+        # same signature classifies as a runtime error, not a compile
+        # failure
+        with self._seen_lock:
+            self._seen.add(_abstract_key(args, kwargs))
+        self._record_miss(start, time.perf_counter() - t0, tags)
+        return out
+
+    def _record_failed_call(self, args, kwargs, start, dur, tags):
+        """Error-path classification (cost is irrelevant here): the
+        cache did not grow, so either the compile itself failed (XLA
+        error, OOM during lowering — signature never seen to succeed)
+        or an already-compiled program failed at runtime (signature in
+        _seen; not a compile event at all). Without this, a
+        crash-looping worker shows ZERO compile activity on the common
+        _cache_size path while the fallback path reports COMPILE_END
+        ok=False."""
+        try:
+            key = _abstract_key(args, kwargs)
+        except Exception:
+            return
+        with self._seen_lock:
+            if key in self._seen:
+                return   # runtime failure of a compiled program
+        _tm.counter_inc("ray_tpu_pjit_cache_total",
+                        tags={**tags, "result": "miss"})
+        _events.record("COMPILE_BEGIN", fn=self._name, started_at=start)
+        _events.record("COMPILE_END", fn=self._name, ok=False,
+                       duration_s=dur)
+
+    def _record_miss(self, start: float, dur: float, tags: dict):
+        """Metrics + BEGIN/END events + both span planes for one
+        compile, materialized after the fact (the cache-size delta is
+        only knowable once the call returned)."""
+        from ray_tpu.util import tracing
+
+        _tm.counter_inc("ray_tpu_pjit_cache_total",
+                        tags={**tags, "result": "miss"})
+        _tm.observe("ray_tpu_pjit_compile_seconds", dur, tags=tags)
+        _events.record("COMPILE_BEGIN", fn=self._name, started_at=start)
+        _events.record("COMPILE_END", fn=self._name, ok=True,
+                       duration_s=dur)
+        start_ns = int(start * 1e9)
+        end_ns = start_ns + int(dur * 1e9)
+        _prof.record_completed_span("compile", f"compile::{self._name}",
+                                    start, dur, {"fn": self._name})
+        tracing.record_completed_span(f"compile {self._name}", "INTERNAL",
+                                      start_ns, end_ns,
+                                      attributes={"fn": self._name})
+
+    def _call_classified_by_signature(self, args, kwargs):
+        """Fallback for callables without ``_cache_size``: classify by
+        a per-signature key. The signature is taken BEFORE the call —
+        donated buffers are unreadable after."""
+        key = _abstract_key(args, kwargs)
+        with self._seen_lock:
+            hit = key in self._seen
+            if not hit:
+                self._seen.add(key)
+        tags = {"fn": self._name}
+        if hit:
+            _tm.counter_inc("ray_tpu_pjit_cache_total",
+                            tags={**tags, "result": "hit"})
+            return self._fn(*args, **kwargs)
+        from ray_tpu.util import tracing
+
+        _tm.counter_inc("ray_tpu_pjit_cache_total",
+                        tags={**tags, "result": "miss"})
+        _events.record("COMPILE_BEGIN", fn=self._name)
+        t0 = time.perf_counter()
+        try:
+            with _prof.record_span("compile", f"compile::{self._name}"):
+                with tracing.span(f"compile {self._name}", "INTERNAL",
+                                  attributes={"fn": self._name}):
+                    out = self._fn(*args, **kwargs)
+        except BaseException:
+            # a failed compile must not be remembered as compiled —
+            # the retry should count (and be timed) as a miss again
+            with self._seen_lock:
+                self._seen.discard(key)
+            _events.record("COMPILE_END", fn=self._name, ok=False,
+                           duration_s=time.perf_counter() - t0)
+            raise
+        dur = time.perf_counter() - t0
+        _tm.observe("ray_tpu_pjit_compile_seconds", dur, tags=tags)
+        _events.record("COMPILE_END", fn=self._name, ok=True,
+                       duration_s=dur)
+        return out
+
+
+@contextlib.contextmanager
+def mesh_build_timer(kind: str):
+    """Time one device-mesh construction into
+    ``ray_tpu_mesh_build_seconds{kind}`` + both span planes."""
+    if not _tm.ENABLED:
+        yield
+        return
+    from ray_tpu.util import tracing
+
+    t0 = time.perf_counter()
+    with _prof.record_span("mesh", f"mesh_build::{kind}"):
+        with tracing.span(f"mesh_build {kind}", "INTERNAL",
+                          attributes={"kind": kind}):
+            yield
+    _tm.observe("ray_tpu_mesh_build_seconds",
+                time.perf_counter() - t0, tags={"kind": kind})
+
+
+def timed_mesh_build(kind: str):
+    """Decorator form of ``mesh_build_timer`` for the mesh factories."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with mesh_build_timer(kind):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco

@@ -27,6 +27,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from ray_tpu.parallel.compile_watch import timed_mesh_build
+
 # Canonical axis order, slowest- to fastest-varying. Matches
 # GlobalConfig.mesh_ici_axis_order.
 AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
@@ -67,6 +69,7 @@ class MeshConfig:
         return {a: getattr(self, a) for a in AXIS_ORDER}
 
 
+@timed_mesh_build("mesh")
 def create_mesh(
     config: MeshConfig | None = None,
     *,
@@ -156,6 +159,7 @@ def group_devices_by_slice(devices: Sequence[jax.Device]) -> Dict[int, list]:
     return groups
 
 
+@timed_mesh_build("hybrid_mesh")
 def create_hybrid_mesh(
     config: MeshConfig | None = None,
     *,

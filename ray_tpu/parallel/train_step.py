@@ -18,6 +18,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.parallel import sharding as sh
+from ray_tpu.parallel.compile_watch import CompiledFunction
 
 
 @jax.tree_util.register_dataclass
@@ -71,7 +72,7 @@ def make_train_state(
         opt_state = optimizer.init(params)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 
-    return jax.jit(init_fn)(rng)
+    return CompiledFunction(jax.jit(init_fn), "train_state_init")(rng)
 
 
 def make_train_step(
@@ -107,7 +108,11 @@ def make_train_step(
             metrics,
         )
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    # compile observability: cache hit/miss counters, compile timing,
+    # COMPILE_BEGIN/END events — a slow step becomes attributable to
+    # recompilation (shape churn) instead of guessed at
+    return CompiledFunction(
+        jax.jit(step, donate_argnums=(0,) if donate else ()), "train_step")
 
 
 def eval_step(loss_fn, mesh: Optional[Mesh] = None, batch_spec: P = P(("dp",), "sp")):
@@ -122,4 +127,4 @@ def eval_step(loss_fn, mesh: Optional[Mesh] = None, batch_spec: P = P(("dp",), "
         _, metrics = loss_fn(params, batch)
         return metrics
 
-    return jax.jit(step)
+    return CompiledFunction(jax.jit(step), "eval_step")

@@ -290,6 +290,18 @@ def cmd_events(args):
     return 0
 
 
+def cmd_collectives(args):
+    """Data-plane summary — the CLI face of
+    `experimental.state.api.summarize_collectives`: per-(group, backend,
+    op) collective latency/bytes, COLLECTIVE_STRAGGLER events, pjit
+    compile/cache stats, per-device HBM gauges."""
+    from ray_tpu.experimental.state.api import summarize_collectives
+
+    print(json.dumps(summarize_collectives(address=args.address),
+                     indent=2, default=str))
+    return 0
+
+
 def cmd_microbenchmark(_args):
     from ray_tpu._private.ray_perf import main as perf_main
 
@@ -407,9 +419,18 @@ def main(argv=None):
     sp.add_argument("--address", default=None)
     sp.add_argument("--kind", default=None,
                     help="filter: task_state | actor_state | node_state "
-                         "| retry_budget_exhausted | fault_injected")
+                         "| retry_budget_exhausted | fault_injected | "
+                         "COLLECTIVE_STRAGGLER | COMPILE_BEGIN | "
+                         "COMPILE_END | train_step | train_group")
     sp.add_argument("--limit", type=int, default=None)
     sp.set_defaults(fn=cmd_events)
+
+    sp = sub.add_parser("collectives",
+                        help="data-plane summary: collective op "
+                             "latency/bytes, stragglers, pjit compile "
+                             "stats, device HBM gauges")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_collectives)
 
     sp = sub.add_parser("summary",
                         help="aggregated cluster state rollups")

@@ -126,7 +126,29 @@ class BackendExecutor:
             placement_group=self.pg)
         self.backend = self.backend_config.backend_cls()
         self.backend.on_start(self.worker_group, self.scaling)
+        self.worker_devices = self._record_group_devices()
         return self
+
+    def _record_group_devices(self):
+        """Gather per-worker device identities after backend setup (the
+        collective/jax.distributed init just ran, so jax is loaded where
+        it will be used) and record one train_group cluster event — the
+        gang's rank -> device map, the join key between step events and
+        the physical topology. Skipped entirely under the telemetry
+        kill-switch; never fails startup."""
+        from ray_tpu._private import events as _events
+
+        if not _events.ENABLED:
+            return None
+        try:
+            devices = self.worker_group.execute("device_identity",
+                                                timeout=60.0)
+        except Exception:
+            return None
+        _events.record("train_group",
+                       num_workers=len(self.worker_group),
+                       devices=devices)
+        return devices
 
     def set_dataset_shards(self, name: str, shards: list):
         for worker, shard in zip(self.worker_group.workers, shards):

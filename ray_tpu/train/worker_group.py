@@ -24,6 +24,19 @@ class TrainWorker:
         self.world_size = world_size
         self.session = _session._Session(world_rank, world_size)
         self._thread = None
+        self._device_identity = None
+
+    def device_identity(self) -> dict:
+        """This worker's device identity (host/pid always; platform and
+        device ids once the train function has imported jax). Resolved
+        lazily and re-resolved until jax shows up, so the first report
+        AFTER the backend initialized carries the real device info."""
+        if (self._device_identity is None
+                or self._device_identity.get("platform") is None):
+            from ray_tpu._private.tpu_probe import local_device_identity
+
+            self._device_identity = local_device_identity()
+        return self._device_identity
 
     def setup_collective_group(self, world_size, rank, backend, group_name):
         from ray_tpu.util import collective as col
@@ -88,7 +101,7 @@ class TrainWorker:
         waited_dead = 0.0
         while True:
             try:
-                return self.session.results.get(timeout=0.1)
+                row = self.session.results.get(timeout=0.1)
             except _q.Empty:
                 if self.session.finished.is_set() and \
                         self.session.results.empty():
@@ -101,6 +114,33 @@ class TrainWorker:
                     if waited_dead >= timeout:
                         raise TimeoutError(
                             "train thread gone without reporting a result")
+            else:
+                self._record_step_event(row)
+                return row
+
+    def _record_step_event(self, row: dict):
+        """Tag one streamed step report with this worker's device
+        identity (data-plane observability: which chip produced which
+        step). Never fails the report path."""
+        from ray_tpu._private import events as _events
+
+        if not _events.ENABLED:
+            return
+        try:
+            _events.record("train_step", rank=self.world_rank,
+                           iteration=row.get("iteration"),
+                           device=self.device_identity())
+            # this process OWNS the jax backend, so it is the one place
+            # live HBM gauges can come from without contending for the
+            # chips (the raylet's subprocess probe can't run while
+            # training holds them)
+            from ray_tpu._private.tpu_probe import (
+                publish_local_device_gauges,
+            )
+
+            publish_local_device_gauges()
+        except Exception:
+            pass
 
     def shutdown(self):
         return True

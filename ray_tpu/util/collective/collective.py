@@ -33,6 +33,7 @@ import threading
 import numpy as np
 
 import ray_tpu
+from ray_tpu.util.collective import telemetry as _coltel
 
 
 class _Rendezvous:
@@ -42,11 +43,31 @@ class _Rendezvous:
     through this actor; see host_backend.py for why that was replaced)."""
 
     def __init__(self, world_size: int):
+        from ray_tpu.util.collective.telemetry import (
+            GroupTimingAggregator,
+        )
+
         self.world_size = world_size
         self._cond = threading.Condition()
         self._members: dict[int, tuple] = {}
         self._epoch = 0
         self._coordinator_port = None
+        # eager, not lazy: all ranks' first timing flushes land ~one
+        # flush interval after the group's first op, on CONCURRENT
+        # actor threads (max_concurrency > 1) — a lazy check-then-set
+        # here would let two threads build rival aggregators and lose
+        # one side's records
+        self._timing_agg = GroupTimingAggregator(world_size)
+
+    def report_timings(self, records: list):
+        """Rank-timing ingest (fire-and-forget from members' flush
+        threads): once every rank reported a (group, seq), the straggler
+        detector runs here — the rendezvous actor is the only process
+        that sees all ranks — and a COLLECTIVE_STRAGGLER event lands in
+        this process's ring (picked up by list_cluster_events)."""
+        if records:
+            self._timing_agg.ingest(records)
+        return True
 
     def join(self, rank: int, addr, timeout: float = 300.0,
              coordinator_port: int | None = None):
@@ -142,9 +163,12 @@ class GroupManager:
             raise RuntimeError("init_collective_group requires ray_tpu to "
                                "be initialized in this process")
         store_cls = ray_tpu.remote(_Rendezvous)
+        # +2 over world_size: during a join storm every member blocks one
+        # actor thread in the rendezvous condvar; telemetry's
+        # report_timings calls need their own headroom to drain
         handle = store_cls.options(
             name=f"_collective_{group_name}", get_if_exists=True,
-            num_cpus=0, max_concurrency=max(world_size, 2),
+            num_cpus=0, max_concurrency=max(world_size + 2, 4),
         ).remote(world_size)
         coord_port = None
         if rank == 0 and backend == "xla":
@@ -187,6 +211,18 @@ class GroupManager:
             return False
         try:
             state.impl.close()
+        except Exception:
+            pass
+        # purge this process's mailbox of the dead incarnation's
+        # messages: a payload that landed after an op timeout would
+        # otherwise masquerade as a NEWER seq to a re-created group
+        # under the same name and trip its seq validation
+        try:
+            from ray_tpu._private.worker_runtime import current_worker
+
+            worker = current_worker()
+            if worker is not None:
+                worker.col_purge(group_name)
         except Exception:
             pass
         # Kill the rendezvous actor so a future group under the same name
@@ -278,36 +314,61 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum"):
     """In the reference (collective.py:258) this mutates in place via NCCL;
     here the reduced array is returned (functional, jax-style)."""
     g = _manager.get(group_name)
-    return g.impl.allreduce(_coerce(g, tensor), op, g.next_seq())
+    arr = _coerce(g, tensor)
+    seq = g.next_seq()
+    return _coltel.run_op(g, "allreduce", seq,
+                          lambda: g.impl.allreduce(arr, op, seq),
+                          payload=arr)
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: str = "sum"):
     g = _manager.get(group_name)
-    return g.impl.reduce(_coerce(g, tensor), dst_rank, op, g.next_seq())
+    arr = _coerce(g, tensor)
+    seq = g.next_seq()
+    return _coltel.run_op(g, "reduce", seq,
+                          lambda: g.impl.reduce(arr, dst_rank, op, seq),
+                          payload=arr)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _manager.get(group_name)
-    return g.impl.broadcast(_coerce(g, tensor), src_rank, g.next_seq())
+    arr = _coerce(g, tensor)
+    seq = g.next_seq()
+    return _coltel.run_op(g, "broadcast", seq,
+                          lambda: g.impl.broadcast(arr, src_rank, seq),
+                          payload=arr)
 
 
 def allgather(tensor, group_name: str = "default") -> list:
     g = _manager.get(group_name)
-    return g.impl.allgather(_coerce(g, tensor), g.next_seq())
+    arr = _coerce(g, tensor)
+    seq = g.next_seq()
+    return _coltel.run_op(g, "allgather", seq,
+                          lambda: g.impl.allgather(arr, seq),
+                          payload=arr)
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
     """Each rank gets the rank-th equal chunk of the reduction."""
     g = _manager.get(group_name)
-    return g.impl.reducescatter(_coerce(g, tensor), op, g.next_seq())
+    arr = _coerce(g, tensor)
+    seq = g.next_seq()
+    return _coltel.run_op(g, "reducescatter", seq,
+                          lambda: g.impl.reducescatter(arr, op, seq),
+                          payload=arr)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
     g = _manager.get(group_name)
+    arr = (_coerce(g, tensor) if getattr(g, "backend", None) != "xla"
+           else np.asarray(tensor))
     seq = g.next_p2p_seq(g.rank, dst_rank)
-    _p2p(g).send(_coerce(g, tensor) if getattr(g, "backend", None) != "xla"
-             else np.asarray(tensor), dst_rank, seq)
+    # p2p seq is per-channel, not group-wide: no straggler record
+    # (seq=None), but latency/bytes metrics and spans still apply
+    _coltel.run_op(g, "send", None,
+                   lambda: _p2p(g).send(arr, dst_rank, seq),
+                   payload=arr)
 
 
 def recv(src_rank: int, group_name: str = "default"):
@@ -315,7 +376,9 @@ def recv(src_rank: int, group_name: str = "default"):
     received array."""
     g = _manager.get(group_name)
     seq = g.next_p2p_seq(src_rank, g.rank)
-    return _p2p(g).recv(src_rank, seq)
+    return _coltel.run_op(g, "recv", None,
+                          lambda: _p2p(g).recv(src_rank, seq),
+                          measure_result=True)
 
 
 def send_device(tensor, dst_rank: int, group_name: str = "default"):
@@ -330,7 +393,10 @@ def send_device(tensor, dst_rank: int, group_name: str = "default"):
         raise ValueError("send_device requires an xla collective group")
     # _coerce keeps jax arrays ON DEVICE for xla groups and converts
     # foreign inputs (torch tensors incl. requires_grad, lists)
-    g.impl.send_device(_coerce(g, tensor), dst_rank)
+    arr = _coerce(g, tensor)
+    _coltel.run_op(g, "send_device", None,
+                   lambda: g.impl.send_device(arr, dst_rank),
+                   payload=arr)
 
 
 def recv_device(shape, dtype, src_rank: int, group_name: str = "default"):
@@ -339,12 +405,16 @@ def recv_device(shape, dtype, src_rank: int, group_name: str = "default"):
     g = _manager.get(group_name)
     if getattr(g, "backend", None) != "xla":
         raise ValueError("recv_device requires an xla collective group")
-    return g.impl.recv_device(shape, dtype, src_rank)
+    return _coltel.run_op(g, "recv_device", None,
+                          lambda: g.impl.recv_device(shape, dtype,
+                                                     src_rank),
+                          measure_result=True)
 
 
 def barrier(group_name: str = "default"):
     g = _manager.get(group_name)
-    g.impl.barrier(g.next_seq())
+    seq = g.next_seq()
+    _coltel.run_op(g, "barrier", seq, lambda: g.impl.barrier(seq))
 
 
 def _p2p(g: _GroupState):

@@ -67,10 +67,13 @@ class HostGroup:
     def _recv(self, src: int, key: tuple, timeout: float | None = None):
         # Timeout doubles as the failure detector (the NCCL-watchdog analog):
         # a dead member makes the op raise instead of hanging forever.
+        # seq_pos=2: every op keys as (group, phase, seq, *step, src), so
+        # the receiver validates the peer's op sequence and raises a
+        # CollectiveSeqMismatchError on desync instead of hanging.
         if timeout is None:
             timeout = self._op_timeout()
         return self._worker.col_take((self.name,) + key + (src,),
-                                     timeout=timeout)
+                                     timeout=timeout, seq_pos=2)
 
     def close(self):
         for c in self._clients.values():

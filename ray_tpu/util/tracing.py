@@ -121,6 +121,37 @@ def span(name: str, kind: str, ctx: dict | None = None,
             })
 
 
+def record_completed_span(name: str, kind: str, start_ns: int,
+                          end_ns: int, attributes: dict | None = None):
+    """Append an already-timed span linked under the CURRENT context
+    (same linkage rule as span(); no-op when tracing is inactive).
+    For observers that only learn a span happened after the fact —
+    e.g. a compile-cache miss detected by cache-size delta — so the
+    span can't wrap the work as a context manager."""
+    inherited = _current.get()
+    if inherited is None:
+        if not _enabled:
+            return None
+        trace_id, parent = _new_id(16), None
+    else:
+        trace_id, parent = inherited["trace_id"], inherited["span_id"]
+    span_id = _new_id(8)
+    with _lock:
+        _spans.append({
+            "traceId": trace_id,
+            "spanId": span_id,
+            "parentSpanId": parent,
+            "name": name,
+            "kind": kind,
+            "startTimeUnixNano": int(start_ns),
+            "endTimeUnixNano": int(end_ns),
+            "pid": os.getpid(),
+            "node": os.uname().nodename,
+            "attributes": attributes or {},
+        })
+    return {"trace_id": trace_id, "span_id": span_id}
+
+
 def submit_span(spec: dict, name: str):
     """Context manager for an outgoing task/actor submission: opens the
     PRODUCER span (enclosing the submission work — arg pinning, queue

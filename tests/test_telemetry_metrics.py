@@ -165,6 +165,43 @@ def test_metric_catalog_lint():
         f"_private/telemetry.py CATALOG: {undeclared}")
 
 
+def test_undeclared_collective_metric_fails_fast():
+    """PR 3 satellite: an undeclared ray_tpu_collective_* name must
+    raise at the instrumented call site (KeyError from the catalog
+    lookup), not silently record an unlintable metric."""
+    from ray_tpu._private import telemetry
+
+    if not telemetry.ENABLED:
+        pytest.skip("RAY_TPU_INTERNAL_TELEMETRY=0: the call-site lint "
+                    "only fires with telemetry on")
+    with pytest.raises(KeyError):
+        telemetry.observe("ray_tpu_collective_bogus_seconds", 0.1)
+    with pytest.raises(KeyError):
+        telemetry.counter_inc("ray_tpu_collective_bogus_total")
+
+
+def test_grafana_panels_reference_cataloged_metrics():
+    """PR 3 satellite: the default Grafana dashboard may only chart
+    metrics the runtime actually emits — every ray_tpu_* name in a
+    panel expr (minus Prometheus histogram sub-series suffixes) must be
+    declared in the telemetry CATALOG."""
+    from ray_tpu._private.telemetry import CATALOG
+    from ray_tpu.dashboard.grafana import generate_default_dashboard
+
+    dash = generate_default_dashboard()
+    assert dash["panels"], "default dashboard lost its panels"
+    unknown = {}
+    for panel in dash["panels"]:
+        for target in panel["targets"]:
+            for name in re.findall(r"ray_tpu_[a-z0-9_]+", target["expr"]):
+                base = re.sub(r"_(?:bucket|sum|count)$", "", name)
+                if base not in CATALOG and name not in CATALOG:
+                    unknown.setdefault(panel["title"], []).append(name)
+    assert not unknown, (
+        f"grafana panels chart metrics the runtime never emits: "
+        f"{unknown}")
+
+
 # ------------------------------------------------- cluster-level tests
 
 
