@@ -66,7 +66,11 @@ def _host_bench_actor_cls():
 
     @ray_tpu.remote
     class BenchRank(CollectiveActorMixin):
-        def bench(self, op: str, size_bytes: int, repeats: int) -> float:
+        def bench(self, op: str, size_bytes: int, repeats: int) -> list:
+            """Returns per-op wall times (seconds), one per repeat —
+            the caller derives mean (headline, comparable to earlier
+            rounds) plus p50/min (steady-state vs scheduler-outlier
+            split on shared boxes)."""
             from ray_tpu.util import collective as col
 
             n = col.get_collective_group_size()
@@ -86,15 +90,18 @@ def _host_bench_actor_cls():
             }[op]
             fn()                      # warmup
             col.barrier()             # synchronized start
-            t0 = time.perf_counter()
+            out = []
             for _ in range(repeats):
+                t0 = time.perf_counter()
                 fn()
-            return time.perf_counter() - t0
+                out.append(time.perf_counter() - t0)
+            return out
 
     return BenchRank
 
 
-def run_host(world: int, sizes: list[int], repeats: int) -> list[dict]:
+def run_host(world: int, sizes: list[int], repeats: int,
+             extra: dict | None = None) -> list[dict]:
     import ray_tpu
     from ray_tpu.util import collective as col
 
@@ -109,21 +116,55 @@ def run_host(world: int, sizes: list[int], repeats: int) -> list[dict]:
         out = []
         for op in OPS:
             for size in sizes:
-                times = ray_tpu.get(
+                per_rank = ray_tpu.get(
                     [a.bench.remote(op, size, repeats) for a in actors],
                     timeout=1800)
-                dt = max(times) / repeats   # slowest rank bounds the op
+                # slowest rank bounds the op; mean is the headline
+                # (comparable to earlier rounds), p50/min expose the
+                # scheduler-outlier share on shared dev boxes
+                per_op = [max(ts) for ts in zip(*per_rank)]
+                dt = sum(per_op) / len(per_op)
+                p50 = sorted(per_op)[len(per_op) // 2]
+                best = min(per_op)
+                bf = bus_factor(op, world)
                 algbw = size / dt / 1e9
                 out.append({
                     "backend": "host", "op": op, "size_bytes": size,
                     "world": world, "time_s": round(dt, 6),
                     "algbw_GBps": round(algbw, 4),
-                    "busbw_GBps": round(algbw * bus_factor(op, world), 4),
+                    "busbw_GBps": round(algbw * bf, 4),
+                    "p50_busbw_GBps": round(size / p50 / 1e9 * bf, 4),
+                    "best_busbw_GBps": round(size / best / 1e9 * bf, 4),
+                    **(extra or {}),
                 })
                 emit(out[-1])
         return out
     finally:
         ray_tpu.shutdown()
+
+
+def run_host_sweep(world: int, sizes: list[int], repeats: int,
+                   segment_sweep: list[int] | None,
+                   pipeline: str | None) -> list[dict]:
+    """Host-backend runs across the pipeline knobs. Each configuration
+    gets a fresh cluster (the knobs ride env vars that member worker
+    processes inherit at spawn), and each row records the knob values so
+    the JSON artifact is self-describing."""
+    if pipeline is not None:
+        os.environ["RAY_TPU_COLLECTIVE_PIPELINE"] = \
+            "1" if pipeline == "on" else "0"
+    pipe_on = os.environ.get("RAY_TPU_COLLECTIVE_PIPELINE", "1") != "0"
+    rows = []
+    for seg in (segment_sweep or [None]):
+        if seg is not None:
+            os.environ["RAY_TPU_COLLECTIVE_SEGMENT_BYTES"] = str(int(seg))
+        from ray_tpu._private.config import get_config
+
+        rows += run_host(world, sizes, repeats, extra={
+            "pipeline": pipe_on,
+            "segment_bytes": int(get_config("collective_segment_bytes")),
+        })
+    return rows
 
 
 # ---------------------------------------------------------- xla-local backend
@@ -237,11 +278,21 @@ def main(argv=None):
     ap.add_argument("--sizes-mb", type=float, nargs="+",
                     default=[1, 8, 64])
     ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--segment-bytes", type=int, nargs="+", default=None,
+                    help="host backend: sweep collective_segment_bytes "
+                         "(one fresh cluster per value)")
+    ap.add_argument("--pipeline", choices=["on", "off"], default=None,
+                    help="host backend: force the pipelined data path "
+                         "on/off (default: env/config)")
+    ap.add_argument("--json-out", default=None,
+                    help="write all rows as one machine-readable JSON "
+                         "record (busbw artifact, e.g. BENCH_r06.json)")
     args = ap.parse_args(argv)
     sizes = [int(mb * 2**20) for mb in args.sizes_mb]
 
     if args.backend == "host":
-        rows = run_host(args.world, sizes, args.repeats)
+        rows = run_host_sweep(args.world, sizes, args.repeats,
+                              args.segment_bytes, args.pipeline)
     elif args.backend == "xla-local":
         rows = run_xla_local(sizes, args.repeats, force_cpu=True)
     else:  # tpu
@@ -251,6 +302,17 @@ def main(argv=None):
             return 0
         rows = run_xla_local(sizes, args.repeats, force_cpu=False)
     summarize(rows)
+    if args.json_out:
+        record = {
+            "harness": "benchmarks/collective_bench.py",
+            "argv": list(argv) if argv is not None else sys.argv[1:],
+            "rows": rows,
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.json_out} ({len(rows)} rows)",
+              file=sys.stderr)
     return 0
 
 

@@ -66,6 +66,23 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # --- collective / mesh ---
     "collective_default_backend": "xla",
     "collective_op_timeout_s": 300.0,  # dead-member failure detector
+    # Pipelined host-collective data path (util/collective/host_backend):
+    # one-way zero-copy segment sends, double-buffered so the reduce of
+    # segment k overlaps the transfer of segment k+1. Pipeline kill
+    # switch: RAY_TPU_COLLECTIVE_PIPELINE=0 restores the legacy
+    # synchronous request/reply ring exactly.
+    "collective_pipeline": True,
+    "collective_segment_bytes": 4 * 1024 * 1024,  # ring segment size
+    # Same-node segment transport: ranks sharing a node exchange ring
+    # segments as shared-memory store references (one copy in, zero-copy
+    # pinned view out; forwarded hops pass the same object id) instead
+    # of socket bytes. RAY_TPU_COLLECTIVE_SHM=0 forces sockets.
+    "collective_shm": True,
+    # Intra-host-first hierarchy: "auto" reduces within each host and
+    # rings one leader per host when the membership spans >1 host with
+    # co-located ranks (the DCN/ICI split); "1" forces it (tests), "0"
+    # disables.
+    "collective_hierarchy": "auto",
     # --- collective data-plane telemetry (util/collective/telemetry.py) ---
     "collective_timing_flush_s": 0.25,      # rank-timing flush cadence
     "collective_straggler_multiple": 3.0,   # lag > multiple * median lag

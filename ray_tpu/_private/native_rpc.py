@@ -22,6 +22,8 @@ from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import telemetry as _tm
 
 _REQUEST, _REPLY, _PUSH = 0, 1, 2
+_PUSH_OOB = 3   # one-way out-of-band frame (protocol.PUSH_OOB) — the C
+                # core treats `kind` opaquely, so no C change is needed
 _EV_DISCONNECT, _EV_CONNECT = -1, -2
 
 _lib = None
@@ -101,6 +103,51 @@ def _take_buf(lib, ptr, length) -> bytes:
         return ctypes.string_at(ptr, length) if length else b""
     finally:
         lib.rpc_buf_free(ptr)
+
+
+# top-level import is cycle-safe: protocol only imports native_rpc
+# lazily inside functions (load_lib / the transport factories)
+from ray_tpu._private.protocol import OobFrame as _OobFrame  # noqa: E402
+
+
+class _NativeOobFrame(_OobFrame):
+    """protocol.OobFrame (isinstance-compatible — consumers type-check
+    against the base) over the C reader's malloc'd payload: the tensor
+    body is consumed as a zero-copy view of the C buffer (no string_at
+    copy per segment); release() frees it exactly once. A dropped frame
+    (handler bug) leaks its buffer — the same contract as the pooled
+    Python frames, which just lose a pool slot."""
+
+    __slots__ = ("_lib", "_ptr", "_mem")
+
+    def __init__(self, lib, ptr, length):   # noqa: super-init not useful
+        self._lib = lib
+        self._ptr = ptr
+        self._mem = memoryview(
+            (ctypes.c_char * length).from_address(ptr)).cast("B")
+        self.view = None   # body view, set by parse_head
+
+    def parse_head(self):
+        import struct
+
+        (head_len,) = struct.unpack_from(">I", self._mem, 0)
+        method, kwargs, _pool = pickle.loads(self._mem[4:4 + head_len])
+        self.view = self._mem[4 + head_len:]
+        return method, kwargs
+
+    @property
+    def nbytes(self) -> int:
+        return self.view.nbytes if self.view is not None else 0
+
+    def release(self):
+        ptr, self._ptr = self._ptr, None
+        if ptr is not None:
+            # drop every export of the ctypes memory before freeing —
+            # a live memoryview over freed heap would be use-after-free
+            self.view = None
+            self._mem.release()
+            self._mem = None
+            self._lib.rpc_buf_free(ptr)
 
 
 class NativeRpcClient:
@@ -271,6 +318,42 @@ class NativeRpcClient:
         if rc == 0 and plan is not None and plan.dup:
             rc = self._lib.rpc_cl_send(self._h, _PUSH, 0, payload,
                                        len(payload), 0)
+        if rc != 0:
+            self._closed = True
+            raise self._lost_error()
+
+    def push_parts(self, method: str, kwargs: dict, parts,
+                   pool: str | None = None):
+        """One-way out-of-band send (protocol.PyRpcClient.push_parts
+        surface). rpc_cl_send takes one contiguous buffer, so the parts
+        are assembled into a single preallocated bytearray — one copy,
+        versus pickle-into-frame + frame concat on the legacy path."""
+        if self._closed:
+            raise self._lost_error()
+        inj = _fi.ACTIVE
+        plan = inj.on_send(method) if inj is not None else None
+        if plan is not None:
+            _fi.apply_send_plan(plan, self.close, method)
+            if plan.drop:
+                return   # injected loss: one-way messages vanish silently
+        import struct
+
+        head = pickle.dumps((method, kwargs, pool),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        views = [memoryview(p) for p in parts]
+        total = 4 + len(head) + sum(v.nbytes for v in views)
+        payload = bytearray(total)
+        struct.pack_into(">I", payload, 0, len(head))
+        payload[4:4 + len(head)] = head
+        off = 4 + len(head)
+        for v in views:
+            payload[off:off + v.nbytes] = v
+            off += v.nbytes
+        buf = ctypes.cast((ctypes.c_char * total).from_buffer(payload),
+                          ctypes.c_char_p)
+        rc = self._lib.rpc_cl_send(self._h, _PUSH_OOB, 0, buf, total, 0)
+        if rc == 0 and plan is not None and plan.dup:
+            rc = self._lib.rpc_cl_send(self._h, _PUSH_OOB, 0, buf, total, 0)
         if rc != 0:
             self._closed = True
             raise self._lost_error()
@@ -452,8 +535,9 @@ class NativeRpcServer:
                 break
             if rc != 0:
                 continue
-            data = _take_buf(self._lib, out, out_len.value)
             cid = conn_id.value
+            if kind.value in (_EV_CONNECT, _EV_DISCONNECT):
+                _take_buf(self._lib, out, out_len.value)  # 1-byte event buf
             if kind.value == _EV_CONNECT:
                 conn = NativeConnection(self, cid)
                 self._conns[cid] = conn
@@ -477,7 +561,21 @@ class NativeRpcServer:
                 continue
             conn = self._conns.get(cid)
             if conn is None:
+                _take_buf(self._lib, out, out_len.value)
                 continue
+            if kind.value == _PUSH_OOB:
+                # zero-copy hand-off: the handler's frame views the C
+                # reader's malloc'd buffer in place (no string_at copy
+                # of the tensor body); frame.release() frees it
+                frame = _NativeOobFrame(self._lib, out.value,
+                                        out_len.value)
+                try:
+                    method, kwargs = frame.parse_head()
+                    self._lookup(method)(conn, frame=frame, **kwargs)
+                except Exception:
+                    frame.release()
+                continue
+            data = _take_buf(self._lib, out, out_len.value)
             try:
                 method, kwargs = pickle.loads(data)
             except Exception:

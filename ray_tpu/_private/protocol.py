@@ -40,6 +40,15 @@ from ray_tpu._private import telemetry as _tm
 _fi.maybe_init_from_env()
 
 REQUEST, REPLY, PUSH = 0, 1, 2
+# One-way frame carrying an out-of-band payload: the wire payload is
+# [u32 head_len][pickle (method, kwargs, pool_hint)][raw body bytes...]
+# instead of one monolithic pickle, so tensor segments travel as raw
+# buffers (scatter-gather written, received straight into a reusable
+# buffer) and the receiver hands the handler a zero-copy OobFrame.
+# Kinds ride the frame header's low nibble end-to-end through the native
+# C core untouched (rpc_core.cc passes `kind` opaquely), so this needs
+# no C change; PROTOCOL_VERSION gates cross-build mixes as usual.
+PUSH_OOB = 3
 
 # Bump on any incompatible frame-layout/semantics change. Must match
 # kProtocolVersion in src/rpc/rpc_core.cc.
@@ -50,9 +59,13 @@ REQUEST, REPLY, PUSH = 0, 1, 2
 # legacy pairing disappears once every node runs any versioned build.
 # v2: owner-based object directory (free_objects locations kwarg,
 # register_worker node snapshot, task-reply stored_sizes/node keys).
-PROTOCOL_VERSION = 2
+# v3: PUSH_OOB frames (kind 3 carries an out-of-band payload layout a
+# v2 receiver would misparse as a pickle — the data-plane collective
+# frames, worker_runtime rpc_col_push_frame).
+PROTOCOL_VERSION = 3
 
 _HDR = struct.Struct(">QBq")   # total-after-len, ver<<4|kind, seq
+_U32 = struct.Struct(">I")     # PUSH_OOB head length prefix
 
 # Sentinel a handler returns to suppress the automatic reply; it must
 # then answer later via conn.reply(seq, result) (deferred replies let
@@ -74,12 +87,63 @@ class ProtocolMismatch(RpcError):
     unusable and gets dropped (both ends must run the same wire rev)."""
 
 
+# Receive-buffer pool for PUSH_OOB bodies. The consumer side
+# (worker_runtime's collective mailbox) registers an object with
+# acquire(pool_key, nbytes) -> writable buffer and
+# release(pool_key, buf); with one registered, steady-state segment
+# receives recycle the same buffers instead of allocating per message.
+_OOB_POOL = None
+
+
+def set_oob_buffer_pool(pool):
+    global _OOB_POOL
+    _OOB_POOL = pool
+
+
+class OobFrame:
+    """A received PUSH_OOB body: a zero-copy view plus its (possibly
+    pooled) backing buffer. The HANDLER owns it — call release() once
+    the bytes are consumed so a pooled buffer returns to the pool.
+    release() is idempotent; frames over non-pooled memory no-op."""
+
+    __slots__ = ("view", "_buf", "_pool_key")
+
+    def __init__(self, buf, view, pool_key=None):
+        self._buf = buf
+        self.view = view
+        self._pool_key = pool_key
+
+    @property
+    def nbytes(self) -> int:
+        return self.view.nbytes
+
+    def release(self):
+        buf, self._buf, self.view = self._buf, None, None
+        if buf is not None and self._pool_key is not None \
+                and _OOB_POOL is not None:
+            _OOB_POOL.release(self._pool_key, buf)
+
+
 def _send_frame(sock: socket.socket, kind: int, seq: int, payload,
                 lock: threading.Lock):
     data = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     hdr = _HDR.pack(len(data) + 9, (PROTOCOL_VERSION << 4) | kind, seq)
     with lock:
         sock.sendall(hdr + data)
+
+
+def _send_frame_parts(sock: socket.socket, head: bytes, parts,
+                      lock: threading.Lock):
+    """Write one PUSH_OOB frame scatter-gather: header + head pickle,
+    then each body part straight from its source buffer (numpy segment
+    memory, a forwarded frame view) — no assembled intermediate."""
+    body = sum(memoryview(p).nbytes for p in parts)
+    hdr = _HDR.pack(9 + 4 + len(head) + body,
+                    (PROTOCOL_VERSION << 4) | PUSH_OOB, 0)
+    with lock:
+        sock.sendall(hdr + _U32.pack(len(head)) + head)
+        for p in parts:
+            sock.sendall(p)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -93,6 +157,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+def _recv_exact_into(sock: socket.socket, view: memoryview):
+    n = view.nbytes
+    off = 0
+    while off < n:
+        r = sock.recv_into(view[off:], min(n - off, 1 << 20))
+        if not r:
+            raise ConnectionLost("peer closed")
+        off += r
+
+
 def _recv_frame(sock: socket.socket):
     length, kind_byte, seq = _HDR.unpack(_recv_exact(sock, 17))
     ver = kind_byte >> 4
@@ -101,7 +175,36 @@ def _recv_frame(sock: socket.socket):
             f"rpc protocol version mismatch: peer sent v{ver}, this "
             f"process speaks v{PROTOCOL_VERSION} — both ends of a cluster "
             f"must run the same ray-tpu wire revision")
-    return kind_byte & 0x0F, seq, pickle.loads(_recv_exact(sock, length - 9))
+    kind = kind_byte & 0x0F
+    if kind == PUSH_OOB:
+        (head_len,) = _U32.unpack(_recv_exact(sock, 4))
+        method, kwargs, pool_hint = pickle.loads(_recv_exact(sock, head_len))
+        body_len = length - 9 - 4 - head_len
+        pool = _OOB_POOL
+        buf = None
+        pool_key = None
+        if pool is not None and pool_hint is not None:
+            pool_key = (pool_hint, body_len)
+            buf = pool.acquire(pool_key, body_len)
+        if buf is None:
+            buf, pool_key = bytearray(body_len), None
+        try:
+            _recv_exact_into(sock, memoryview(buf))
+        except BaseException:
+            # connection died mid-body: hand the buffer back so the
+            # pool's recycled capacity survives reconnect cycles
+            if pool_key is not None and pool is not None:
+                pool.release(pool_key, buf)
+            raise
+        return kind, seq, (method, kwargs,
+                           OobFrame(buf, memoryview(buf), pool_key))
+    return kind, seq, pickle.loads(_recv_exact(sock, length - 9))
+
+
+# (The native transport's already-contiguous PUSH_OOB payloads are
+# parsed by native_rpc._NativeOobFrame.parse_head — same
+# [u32 head_len][pickle head][body] layout as the incremental socket
+# read above; keep the two in sync on any layout change.)
 
 
 class _RemoteError:
@@ -166,6 +269,10 @@ class PyRpcClient:
                         self._on_push(payload)
                     except Exception:
                         pass
+                elif kind == PUSH_OOB:
+                    # servers never OOB-push to clients today; reclaim
+                    # the buffer instead of leaking it from the pool
+                    payload[2].release()
         except ProtocolMismatch as e:
             mismatch = self._mismatch = e
             print(f"ray-tpu rpc: {e} (peer {self.addr})",
@@ -279,6 +386,35 @@ class PyRpcClient:
             if plan is not None and plan.dup:
                 _send_frame(self._sock, PUSH, 0, (method, kwargs),
                             self._wlock)
+        except OSError as e:
+            self._closed = True
+            raise ConnectionLost(str(e)) from e
+
+    def push_parts(self, method: str, kwargs: dict, parts,
+                   pool: str | None = None):
+        """One-way out-of-band send: `parts` (a serialize_parts frame or
+        any buffer sequence) is written scatter-gather after a small
+        pickled head — no monolithic payload pickle, no reply. The
+        receiver's handler gets the body as a zero-copy OobFrame kwarg
+        ``frame``; `pool` names the receive-buffer pool the peer should
+        draw from (and return to, via frame.release()). Completion is
+        detected by the CONSUMER (e.g. the collective op timeout), so an
+        injected drop surfaces there, exactly like real one-way loss."""
+        if self._closed:
+            raise self._mismatch or ConnectionLost(
+                f"connection to {self.addr} closed")
+        inj = _fi.ACTIVE
+        plan = inj.on_send(method) if inj is not None else None
+        if plan is not None:
+            _fi.apply_send_plan(plan, self.close, method)
+            if plan.drop:
+                return   # injected loss: one-way messages vanish silently
+        head = pickle.dumps((method, kwargs, pool),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        try:
+            _send_frame_parts(self._sock, head, parts, self._wlock)
+            if plan is not None and plan.dup:
+                _send_frame_parts(self._sock, head, parts, self._wlock)
         except OSError as e:
             self._closed = True
             raise ConnectionLost(str(e)) from e
@@ -438,6 +574,15 @@ class PyRpcServer:
         try:
             while not self._stopped:
                 kind, seq, payload = _recv_frame(conn.sock)
+                if kind == PUSH_OOB:
+                    # inline on the reader thread, like PUSH: OOB
+                    # handlers (mailbox stores) must not block
+                    method, kwargs, frame = payload
+                    try:
+                        self._lookup(method)(conn, frame=frame, **kwargs)
+                    except Exception:
+                        frame.release()
+                    continue
                 method, kwargs = payload
                 if kind == REQUEST:
                     if method in self._inline:

@@ -274,6 +274,48 @@ class StoreClient:
         _tm.counter_inc("ray_tpu_object_store_put_bytes_total", total)
         return True, total
 
+    def put_ephemeral(self, object_id: bytes, parts: list) -> int:
+        """put_parts for TRANSIENT objects (the collective data plane's
+        same-node segments): skips the spill-existence probe and the
+        spill fallback — these ids are freshly minted per message, are
+        consumed within one op, and must never hit disk. Raises
+        StoreError when the segment can't fit (callers fall back to the
+        socket path). An id that already EXISTS can only be a stranded
+        leftover from a crashed prior incarnation (live processes mint
+        unique ids) — serving its stale bytes to the new consumer would
+        be silent corruption, so the stale object is deleted and the
+        create retried; if it still exists (e.g. pinned by a zombie),
+        raise so the caller takes the socket path."""
+        views = [memoryview(p).cast("B") for p in parts]
+        total = sum(len(v) for v in views)
+        buf = self.create(object_id, total)
+        if buf is None:
+            self.delete_ephemeral(object_id)
+            buf = self.create(object_id, total)
+            if buf is None:
+                # still present (e.g. pinned by a zombie consumer)
+                raise StoreError(-2, "put_ephemeral")
+        try:
+            dst = memoryview(buf).cast("B")
+            off = 0
+            for v in views:
+                dst[off:off + len(v)] = v
+                off += len(v)
+            self.seal(object_id)
+        except BaseException:
+            self.abort(object_id)
+            raise
+        _tm.counter_inc("ray_tpu_object_store_put_bytes_total", total)
+        return total
+
+    @_guarded
+    def delete_ephemeral(self, object_id: bytes):
+        """delete() for objects known never to spill: skips the spill-
+        path stat (a per-call filesystem probe the segment hot path
+        can't afford)."""
+        self._check_id(object_id)
+        self._libref.store_delete(self._h, object_id)  # best-effort
+
     @_guarded
     def create(self, object_id: bytes, size: int):
         """Reserve a writable buffer; caller fills it then calls seal().

@@ -137,6 +137,12 @@ CATALOG: dict[str, dict] = {
         "description": "Ranks flagged by the straggler detector (arrival "
                        "lag > configured multiple of the group median)",
     },
+    "ray_tpu_collective_segments_total": {
+        "kind": "Counter", "tags": ("op", "group"),
+        "description": "Ring segments sent by the pipelined host "
+                       "collective data path (one-way zero-copy frames; "
+                       "0 when RAY_TPU_COLLECTIVE_PIPELINE=0)",
+    },
     # --- pjit compile path (parallel/compile_watch.py) ---
     "ray_tpu_pjit_compile_seconds": {
         "kind": "Histogram", "tags": ("fn",),

@@ -3043,11 +3043,38 @@ class CoreWorker:
     # processes instead of funnelling every tensor through one rendezvous
     # actor (the reference's gloo backend is likewise peer-to-peer,
     # gloo_collective_group.py; the named actor only rendezvouses metadata).
+    # Two ingest paths: rpc_col_push (legacy sync request/reply, payload
+    # pickled in the control frame) and rpc_col_push_frame (pipelined
+    # one-way PUSH_OOB, payload as a zero-copy OobFrame drawn from the
+    # per-(group, nbytes) receive-buffer pool below).
 
     def col_push_local(self, key: tuple, data):
         with self._col_cond:
+            old = self._col_mailbox.get(key)
             self._col_mailbox[key] = data
             self._col_cond.notify_all()
+        if old is not None and old is not data:
+            # a redelivered duplicate (fault plane `dup`, peer retry)
+            # overwrote a message nobody consumed — reclaim its backing
+            self._discard_col_msg(old, replacement=data)
+
+    def _discard_col_msg(self, msg, replacement=None):
+        """Reclaim an unconsumed mailbox message's backing resource: a
+        transport frame's pooled buffer, or a shm segment's store
+        object. A duplicate-delivered shm ref (fault plane `dup`) is a
+        DISTINCT ColShmRef wrapping the SAME object — deleting the old
+        ref's object would tear the store out from under the surviving
+        one, so same-oid replacements skip the delete."""
+        if isinstance(msg, ColShmRef):
+            if isinstance(replacement, ColShmRef) \
+                    and replacement.oid == msg.oid:
+                return
+            try:
+                self.store.delete_ephemeral(msg.oid)
+            except Exception:
+                pass
+        else:
+            _release_col_msg(msg)
 
     def col_purge(self, group: str) -> int:
         """Drop every mailbox entry belonging to one collective group
@@ -3057,13 +3084,46 @@ class CoreWorker:
         incarnation's seq validation as a phantom NEWER seq."""
         with self._col_cond:
             stale = [k for k in self._col_mailbox if k and k[0] == group]
-            for k in stale:
-                del self._col_mailbox[k]
-            return len(stale)
+            dropped = [self._col_mailbox.pop(k) for k in stale]
+        for msg in dropped:
+            self._discard_col_msg(msg)
+        COL_RECV_POOL.purge(group)
+        # sweep STRANDED shm segments too: a dropped col_push_shm notify
+        # (or a receiver that died first) leaves the object in the store
+        # with no mailbox ref anywhere — reachable only via its group-
+        # tagged id prefix
+        try:
+            prefix = col_oid_prefix(group)
+            for oid, _size in self.store.list_objects():
+                if oid.startswith(prefix):
+                    self.store.delete_ephemeral(oid)
+        except Exception:
+            pass
+        return len(stale)
 
     def rpc_col_push(self, conn, key: tuple, data):
         self.col_push_local(tuple(key), data)
         return True
+
+    def rpc_col_push_frame(self, conn, key: tuple, frame):
+        """PUSH_OOB ingest (runs inline on the transport reader/pump —
+        a mailbox store, never blocks). `frame` is the transport's
+        OobFrame; the taker deserializes the view in place and releases
+        the buffer back to the pool."""
+        self.col_push_local(tuple(key), frame)
+
+    def rpc_col_push_shm(self, conn, key: tuple, oid: bytes, nbytes: int):
+        """Same-node segment hand-off: the payload already sits in the
+        node's shared-memory store under `oid` (the sender put it
+        there); only this tiny reference crosses the socket. The taker
+        maps the object zero-copy and deletes it once consumed."""
+        self.col_push_local(tuple(key), ColShmRef(oid, nbytes))
+
+    def rpc_col_meta(self, conn):
+        """Peer identity for the collective data plane: ranks with the
+        same node_id share this node's shm store, so segments can move
+        as store references instead of socket bytes."""
+        return {"node_id": self.node_id}
 
     def col_take(self, key: tuple, timeout: float = 300.0,
                  seq_pos: int | None = None):
@@ -3150,6 +3210,94 @@ class CoreWorker:
             self.store.close()
         except Exception:
             pass
+
+
+class ColShmRef:
+    """Mailbox marker for a collective segment parked in the node's shm
+    store (see rpc_col_push_shm)."""
+
+    __slots__ = ("oid", "nbytes")
+
+    def __init__(self, oid: bytes, nbytes: int):
+        self.oid = oid
+        self.nbytes = nbytes
+
+
+def col_oid_prefix(group: str) -> bytes:
+    """6-byte object-id prefix tagging one group's shm segments, so a
+    stranded segment (its notify dropped / receiver died before the
+    take) is findable: group destroy sweeps the node store for this
+    prefix and deletes leftovers — without it, an untagged orphan would
+    occupy the bounded segment until eviction pressure."""
+    return b"\xc0" + hashlib.blake2b(group.encode(),
+                                     digest_size=5).digest()
+
+
+def _release_col_msg(msg):
+    release = getattr(msg, "release", None)
+    if release is not None:
+        try:
+            release()
+        except Exception:
+            pass
+
+
+class _ColBufferPool:
+    """Receive-buffer pool for the pipelined collective data path,
+    keyed (group, nbytes). The transport's PUSH_OOB reader acquires a
+    buffer per incoming segment; the host backend's take side releases
+    it after reducing — steady-state allreduce cycles the same few
+    buffers with zero per-step allocations. Bounded per key and in
+    total so a burst (or a leak) degrades to plain allocation instead
+    of growing forever; purge(group) drops a destroyed group's buffers.
+    Process-wide (in-process test clusters share it), like the
+    transports themselves."""
+
+    MAX_PER_KEY = 8
+    MAX_TOTAL_BYTES = 256 * 1024 * 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free: dict[tuple, list] = {}
+        self._bytes = 0
+
+    def acquire(self, key: tuple, nbytes: int):
+        with self._lock:
+            bucket = self._free.get(key)
+            if bucket:
+                self._bytes -= nbytes
+                return bucket.pop()
+        return bytearray(nbytes)
+
+    def release(self, key: tuple, buf):
+        nbytes = len(buf)
+        with self._lock:
+            bucket = self._free.setdefault(key, [])
+            if (len(bucket) < self.MAX_PER_KEY
+                    and self._bytes + nbytes <= self.MAX_TOTAL_BYTES):
+                bucket.append(buf)
+                self._bytes += nbytes
+
+    def purge(self, group: str):
+        with self._lock:
+            for key in [k for k in self._free if k[0] == group]:
+                self._bytes -= sum(len(b) for b in self._free.pop(key))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"keys": len(self._free), "bytes": self._bytes,
+                    "buffers": sum(len(v) for v in self._free.values())}
+
+
+COL_RECV_POOL = _ColBufferPool()
+
+# Hand the transports the pool: PUSH_OOB bodies tagged with a pool hint
+# (the collective group name) are received straight into recycled
+# buffers instead of fresh allocations (pure-Python transport; the
+# native C core allocates in C and release() no-ops there).
+from ray_tpu._private import protocol as _protocol  # noqa: E402
+
+_protocol.set_oob_buffer_pool(COL_RECV_POOL)
 
 
 def _freeze(obj):

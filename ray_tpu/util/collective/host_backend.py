@@ -8,16 +8,50 @@ NCCL/gloo groups use (nccl_collective_group.py rings, pygloo rings). The
 named group actor now rendezvouses MEMBERSHIP ONLY (rank -> worker addr);
 data rides each member CoreWorker's mailbox (worker_runtime.rpc_col_push).
 
-All algorithms key messages by (group, op-seq, phase, step) so concurrent
-ops and late arrivals never cross wires; collective calls must be issued in
-the same order by every rank (standard collective contract, as NCCL).
+Data path (PR: pipelined zero-copy host collectives). Two modes:
+
+- **pipelined** (default; kill switch ``RAY_TPU_COLLECTIVE_PIPELINE=0``):
+  every hop is a one-way PUSH_OOB frame (``RpcClient.push_parts``) — no
+  request/reply round trip; completion is detected by the receiver's own
+  ``col_take`` with the op timeout as the failure detector, the shape
+  NCCL/Gloo rings use. Ring payloads are split into
+  ``collective_segment_bytes`` segments and double-buffered: the send of
+  segment *k* for step *s+1* is posted the moment step *s*'s reduce of
+  that segment finishes, so reduction overlaps transfer (cf. Horovod
+  tensor fusion / DDP gradient bucketing). Tensors are framed via
+  ``serialization.serialize_parts`` out-of-band buffers — the sender
+  writes straight from the array memory, the receiver reduces in place
+  from a pooled buffer (worker_runtime's per-(group, nbytes)
+  receive-buffer pool), so steady-state allreduce does zero per-step
+  allocations. When the membership spans several hosts with co-located
+  ranks, allreduce reduces intra-host first and runs the inter-host ring
+  over one leader per host (``collective_hierarchy``) — the DCN/ICI
+  split the paper's topology-aware scheduler assumes.
+- **legacy**: the original synchronous ``col_push`` request/reply ring,
+  kept bit-for-bit as the kill-switch fallback and semantic reference.
+
+All algorithms key messages by (group, op-seq, phase, step[, segment]) so
+concurrent ops and late arrivals never cross wires; collective calls must
+be issued in the same order by every rank (standard collective contract,
+as NCCL). Whenever the FLAT ring runs (hierarchy disabled or not
+engaged — on a single host it never engages), both modes produce
+bit-identical results: the pipelined path applies the same reduce
+operands in the same order, just segment-wise. The intra-host-first
+hierarchy necessarily changes the floating-point reduction NESTING
+(locals fold at the leader before the inter-host ring), like any
+hierarchical allreduce — exact to the flat ring for integer dtypes and
+commutative-exact ops, within rounding for floats.
 """
 from __future__ import annotations
 
 import numpy as np
 
-from ray_tpu._private.protocol import RpcClient
-from ray_tpu._private.worker_runtime import current_worker
+from ray_tpu._private import protocol as _protocol
+from ray_tpu._private import serialization as ser
+from ray_tpu._private import telemetry as _tm
+from ray_tpu._private.protocol import PyRpcClient, RpcClient
+from ray_tpu._private.worker_runtime import (ColShmRef, col_oid_prefix,
+                                             current_worker)
 
 _OPS = {
     "sum": np.add,
@@ -25,6 +59,74 @@ _OPS = {
     "min": np.minimum,
     "max": np.maximum,
 }
+
+
+def _split_bounds(total: int, parts: int) -> list[tuple[int, int]]:
+    """np.array_split boundaries: the first (total % parts) chunks are
+    one element longer. Every rank derives the same bounds locally."""
+    base, extra = divmod(total, parts)
+    bounds, lo = [], 0
+    for i in range(parts):
+        hi = lo + base + (1 if i < extra else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def _segments(lo: int, hi: int, step: int) -> list[tuple[int, int]]:
+    out = []
+    a = lo
+    while a < hi:
+        b = min(a + step, hi)
+        out.append((a, b))
+        a = b
+    return out
+
+
+def _materialize(val):
+    """Copy frame-backed arrays out of their (pooled, about to be
+    released) receive buffer before they escape to the caller. Values
+    that own their memory pass through."""
+    if isinstance(val, np.ndarray) and not val.flags["OWNDATA"]:
+        return np.array(val)
+    if isinstance(val, (list, tuple)):
+        return type(val)(_materialize(v) for v in val)
+    return val
+
+
+class _ShmFrame(_protocol.OobFrame):
+    """OobFrame over a pinned shm-store object (same-node segment
+    transport): the view maps the store segment zero-copy. release()
+    unpins, and by default also DELETES the object — pass delete=False
+    when the same object id is being forwarded to the next ring hop
+    (the last consumer in the chain deletes)."""
+
+    __slots__ = ("_store", "oid", "_pin")
+
+    def __init__(self, store, oid: bytes, pin):
+        self._store = store
+        self.oid = oid
+        self._pin = pin
+        self.view = pin.memoryview()
+
+    @property
+    def nbytes(self) -> int:
+        return self.view.nbytes if self.view is not None else 0
+
+    def release(self, delete: bool = True):
+        pin, self._pin = self._pin, None
+        if pin is None:
+            return
+        self.view = None
+        try:
+            pin.release()
+        except Exception:
+            pass
+        if delete:
+            try:
+                self._store.delete_ephemeral(self.oid)
+            except Exception:
+                pass
 
 
 class HostGroup:
@@ -37,6 +139,12 @@ class HostGroup:
         self.rank = rank
         self.members = {int(r): tuple(a) for r, a in members.items()}
         self._clients: dict[int, RpcClient] = {}
+        self._client_mode: dict[int, bool] = {}    # rank -> built-for-
+                                                   # pipelined?
+        self._peer_nodes: dict[int, object] = {}   # rank -> node_id |
+                                                   # (None, retry_at)
+        self._oid_prefix = col_oid_prefix(name)
+        self._seg_count = 0
         self._worker = current_worker()
         if self._worker is None:
             raise RuntimeError("collective group requires a ray_tpu worker "
@@ -50,23 +158,150 @@ class HostGroup:
 
         return float(get_config("collective_op_timeout_s"))
 
+    @staticmethod
+    def _pipelined() -> bool:
+        from ray_tpu._private.config import get_config
+
+        return bool(get_config("collective_pipeline"))
+
+    def _segment_elems(self, itemsize: int) -> int:
+        from ray_tpu._private.config import get_config
+
+        return max(1, int(get_config("collective_segment_bytes"))
+                   // max(1, itemsize))
+
     def _client(self, rank: int) -> RpcClient:
+        # Pipelined mode deliberately uses the pure-Python client even
+        # when the native core is available: push_parts writes segment
+        # frames scatter-gather straight from the array memory (sendall
+        # per part, zero assembly copy), where the native binding must
+        # assemble one contiguous buffer per send. The wire format is
+        # shared, so it talks to native AND Python servers alike; the
+        # receive side stays on the peer's (native, off-GIL) server.
+        # Legacy (kill-switch) mode keeps the default transport factory
+        # so RAY_TPU_COLLECTIVE_PIPELINE=0 restores the round-4 data
+        # path exactly, native client included.
+        want_py = self._pipelined()
         c = self._clients.get(rank)
-        if c is None or c.closed:
-            c = RpcClient(self.members[rank], timeout=self._op_timeout())
+        addr = tuple(self.members[rank])
+        # flavor staleness is judged against the mode the client was
+        # BUILT under, not isinstance — the legacy factory legitimately
+        # returns a PyRpcClient on pure-Python builds, and an
+        # isinstance check would condemn it on every call
+        if c is not None and (c.closed or tuple(c.addr) != addr
+                              or self._client_mode.get(rank) != want_py):
+            # stale: dead connection, the peer address changed under a
+            # group reincarnation (a cached client to the OLD address
+            # would win until it errored, landing frames on a ghost),
+            # or the pipeline mode flipped transport flavor
+            try:
+                c.close()
+            except Exception:
+                pass
+            c = None
+            # a reincarnated peer may sit on a different node now —
+            # its shm-eligibility verdict must be re-learned too
+            self._peer_nodes.pop(rank, None)
+        if c is None:
+            cls = PyRpcClient if want_py else RpcClient
+            c = cls(addr, timeout=self._op_timeout())
             self._clients[rank] = c
+            self._client_mode[rank] = want_py
         return c
 
     def _send(self, dst: int, key: tuple, payload):
         full_key = (self.name,) + key + (self.rank,)
         if dst == self.rank:
             self._worker.col_push_local(full_key, payload)
+        elif self._pipelined():
+            self._seg_count += 1
+            self._client(dst).push_parts(
+                "col_push_frame", {"key": full_key},
+                ser.serialize_parts(payload), pool=self.name)
         else:
             self._client(dst).call("col_push", key=full_key, data=payload)
 
-    def _recv(self, src: int, key: tuple, timeout: float | None = None):
-        # Timeout doubles as the failure detector (the NCCL-watchdog analog):
-        # a dead member makes the op raise instead of hanging forever.
+    def _push_frame(self, dst: int, key: tuple, parts):
+        """One-way pre-framed send (hot path: ring segments, forwarded
+        frames). `parts` is a serialize_parts list or [frame_view]."""
+        full_key = (self.name,) + key + (self.rank,)
+        self._seg_count += 1
+        self._client(dst).push_parts("col_push_frame", {"key": full_key},
+                                     parts, pool=self.name)
+
+    def _shm_ok(self, dst: int) -> bool:
+        """Segments to `dst` may ride the node's shm store: enabled, and
+        the peer reports the same node_id (one cached col_meta round per
+        peer). A TRANSIENT meta failure is negative-cached with a TTL —
+        permanently pinning a same-node peer to the ~4x-slower socket
+        path over one startup blip would be silent and unrecoverable."""
+        import time as _time
+
+        from ray_tpu._private.config import get_config
+
+        if not get_config("collective_shm"):
+            return False
+        cached = self._peer_nodes.get(dst)
+        if isinstance(cached, tuple):        # (None, retry_at): failed meta
+            if _time.monotonic() < cached[1]:
+                return False
+            cached = None
+        if cached is None:
+            try:
+                meta = self._client(dst).call("col_meta", timeout=30.0)
+                cached = meta.get("node_id")
+                self._peer_nodes[dst] = cached
+            except Exception:
+                self._peer_nodes[dst] = (None, _time.monotonic() + 30.0)
+                return False
+        return cached == self._worker.node_id
+
+    # below this, the shm put/pin round costs more than just writing the
+    # bytes to the socket — tiny segments and barrier tokens stay on TCP
+    _SHM_MIN_BYTES = 64 * 1024
+
+    def _push_seg(self, dst: int, key: tuple, seg: np.ndarray):
+        parts = ser.serialize_parts(seg)
+        if ser.parts_size(parts) >= self._SHM_MIN_BYTES \
+                and self._shm_ok(dst):
+            full_key = (self.name,) + key + (self.rank,)
+            # group-tag(6) + rank(2) + process counter(8) — unique
+            # across ranks (rank byte-pair) and ops (worker id mint; no
+            # per-segment urandom syscall), and the tag lets group
+            # destroy sweep stranded segments whose notify never
+            # arrived (worker_runtime.col_purge)
+            oid = self._oid_prefix + self.rank.to_bytes(2, "big") \
+                + self._worker._new_id()[8:]
+            try:
+                nbytes = self._worker.store.put_ephemeral(oid, parts)
+            except Exception:
+                pass   # store full/unavailable: socket fallback below
+            else:
+                self._seg_count += 1
+                self._client(dst).push("col_push_shm", key=full_key,
+                                       oid=oid, nbytes=nbytes)
+                return
+        self._push_frame(dst, key, parts)
+
+    def _forward(self, dst: int, key: tuple, frame):
+        """Forward a received frame to the next ring hop without
+        re-framing: a same-node shm frame travels as its object id
+        (zero copy; the LAST hop deletes the object), anything else
+        re-sends the received bytes. Consumes (releases) the frame."""
+        if isinstance(frame, _ShmFrame) and self._shm_ok(dst):
+            full_key = (self.name,) + key + (self.rank,)
+            self._seg_count += 1
+            self._client(dst).push("col_push_shm", key=full_key,
+                                   oid=frame.oid, nbytes=frame.nbytes)
+            frame.release(delete=False)
+            return
+        self._push_frame(dst, key, [frame.view])
+        frame.release()
+
+    def _take(self, src: int, key: tuple, timeout: float | None = None):
+        # Timeout doubles as the failure detector (the NCCL-watchdog
+        # analog): a dead member — or a dropped one-way frame — makes the
+        # op raise instead of hanging forever.
         # seq_pos=2: every op keys as (group, phase, seq, *step, src), so
         # the receiver validates the peer's op sequence and raises a
         # CollectiveSeqMismatchError on desync instead of hanging.
@@ -74,6 +309,72 @@ class HostGroup:
             timeout = self._op_timeout()
         return self._worker.col_take((self.name,) + key + (src,),
                                      timeout=timeout, seq_pos=2)
+
+    def _recv_view(self, src: int, key: tuple,
+                   timeout: float | None = None):
+        """Take one message as (value, frame): frame-backed values view
+        the receive buffer (transport frame or pinned shm segment)
+        zero-copy; the CALLER must frame.release() after consuming
+        (frame is None for legacy/local messages)."""
+        msg = self._take(src, key, timeout)
+        if isinstance(msg, ColShmRef):
+            pin = self._worker.store.get(msg.oid)
+            if pin is None:
+                raise TimeoutError(
+                    f"collective shm segment for {key} vanished from the "
+                    f"store (evicted or deleted out of band)")
+            frame = _ShmFrame(self._worker.store, msg.oid, pin)
+            try:
+                return ser.deserialize(frame.view), frame
+            except BaseException:
+                frame.release()   # or the pin would strand the segment
+                raise
+        if isinstance(msg, _protocol.OobFrame):
+            try:
+                return ser.deserialize(msg.view), msg
+            except BaseException:
+                msg.release()     # return the pooled buffer
+                raise
+        return msg, None
+
+    def _recv(self, src: int, key: tuple, timeout: float | None = None):
+        """Take one message as an OWNED value (safe to hand to callers:
+        frame-backed arrays are copied out, the buffer goes back to the
+        pool)."""
+        val, frame = self._recv_view(src, key, timeout)
+        if frame is not None:
+            try:
+                return _materialize(val)
+            finally:
+                frame.release()
+        return val
+
+    def _note_segs(self, op: str):
+        n, self._seg_count = self._seg_count, 0
+        if n and _tm.ENABLED:
+            _tm.counter_inc("ray_tpu_collective_segments_total", float(n),
+                            tags={"op": op, "group": self.name})
+
+    def _hierarchy_plan(self):
+        """(local_ranks_on_my_host, one_leader_per_host) when the
+        intra-host-first hierarchy applies, else None. Auto mode needs
+        >1 host AND co-located ranks; "1" forces it (single-host tests
+        exercise the degenerate one-leader ring)."""
+        from ray_tpu._private.config import get_config
+
+        mode = str(get_config("collective_hierarchy")).lower()
+        if mode in ("0", "false", "off"):
+            return None
+        by_host: dict[str, list[int]] = {}
+        for r in sorted(self.members):
+            by_host.setdefault(str(self.members[r][0]), []).append(r)
+        if mode not in ("1", "true", "force"):
+            if len(by_host) < 2 or \
+                    max(len(v) for v in by_host.values()) < 2:
+                return None
+        locals_ = next(v for v in by_host.values() if self.rank in v)
+        leaders = sorted(v[0] for v in by_host.values())
+        return locals_, leaders
 
     def close(self):
         for c in self._clients.values():
@@ -83,6 +384,132 @@ class HostGroup:
                 pass
         self._clients.clear()
 
+    # -- pipelined ring core ------------------------------------------------
+
+    def _ring_allreduce(self, src: np.ndarray, acc: np.ndarray, op: str,
+                        seq: int, ring: list[int], tag_r: str,
+                        tag_g: str):
+        """Segmented pipelined ring allreduce over `ring` (a sorted list
+        of member ranks; every participant passes the same list),
+        reading this rank's contribution from `src` and assembling the
+        full reduction into `acc` (src may alias acc). Classic
+        2(m-1)-step ring, but each chunk moves as fixed-size segments
+        over one-way frames: the reduced segment k of step s is
+        forwarded as step s+1's segment k immediately — before step s
+        touches segment k+1 — so the peer's transfer of the next
+        segment overlaps this rank's reduce. The src/acc split avoids
+        the upfront whole-array copy an in-place ring needs: every
+        reduce reads the ORIGINAL contribution and writes acc, and each
+        acc chunk is written exactly once (reduce-scatter) or copied in
+        exactly once (allgather phase)."""
+        m = len(ring)
+        if m == 1:
+            if acc is not src:
+                np.copyto(acc, src)
+            return
+        fn = _OPS[op]
+        pos = ring.index(self.rank)
+        if m == 2:
+            # pairwise exchange: one round instead of two. Each rank
+            # pushes its full contribution segment-wise and reduces the
+            # peer's locally — same bytes on the wire as the 2-ring,
+            # half the notify->wake round trips on the critical path.
+            return self._pair_allreduce(src, acc, fn, seq, ring, tag_r)
+        right, left = ring[(pos + 1) % m], ring[(pos - 1) % m]
+        bounds = _split_bounds(acc.size, m)
+        step = self._segment_elems(acc.itemsize)
+        lo, hi = bounds[pos]
+        for k, (a, b) in enumerate(_segments(lo, hi, step)):
+            self._push_seg(right, (tag_r, seq, 0, k), src[a:b])
+        # reduce-scatter: after step s this rank holds the running
+        # reduction of chunk (pos - s - 1); the final step leaves the
+        # FULL reduction of chunk (pos + 1), which doubles as the
+        # allgather phase's step-0 send.
+        for s in range(m - 1):
+            lo, hi = bounds[(pos - s - 1) % m]
+            last = s == m - 2
+            for k, (a, b) in enumerate(_segments(lo, hi, step)):
+                seg = acc[a:b]
+                incoming, frame = self._recv_view(left, (tag_r, seq, s, k))
+                fn(src[a:b], incoming, out=seg)
+                if frame is not None:
+                    frame.release()
+                self._push_seg(right,
+                               (tag_g, seq, 0, k) if last
+                               else (tag_r, seq, s + 1, k), seg)
+        # allgather the reduced chunks around the ring (store-and-forward
+        # per segment; forwarded segments reuse the received frame's
+        # memory or shm object — no re-pickle, no copy)
+        for s in range(m - 1):
+            lo, hi = bounds[(pos - s) % m]
+            for k, (a, b) in enumerate(_segments(lo, hi, step)):
+                incoming, frame = self._recv_view(left, (tag_g, seq, s, k))
+                np.copyto(acc[a:b], incoming)
+                if s < m - 2:
+                    if frame is not None:
+                        self._forward(right, (tag_g, seq, s + 1, k), frame)
+                    else:
+                        self._push_seg(right, (tag_g, seq, s + 1, k),
+                                       acc[a:b])
+                elif frame is not None:
+                    frame.release()
+
+    def _pair_allreduce(self, src: np.ndarray, acc: np.ndarray, fn, seq,
+                        ring: list[int], tag: str):
+        """2-member allreduce as a segmented full exchange. Operand
+        order per chunk matches the 2-ring EXACTLY (bit-identical to
+        the legacy path even for non-commutative corner cases like
+        NaN-payload propagation): the chunk this rank owns in ring
+        terms, bounds[pos], arrives pre-reduced as fn(peer, mine); the
+        other chunk is reduced locally as fn(mine, peer)."""
+        pos = ring.index(self.rank)
+        peer = ring[1 - pos]
+        bounds = _split_bounds(acc.size, 2)
+        step = self._segment_elems(acc.itemsize)
+        segs = _segments(0, acc.size, step)
+        for k, (a, b) in enumerate(segs):
+            self._push_seg(peer, (tag, seq, 0, k), src[a:b])
+        mlo, mhi = bounds[pos]
+        for k, (a, b) in enumerate(segs):
+            incoming, frame = self._recv_view(peer, (tag, seq, 0, k))
+            # split the segment at the chunk boundary so each half gets
+            # the ring's operand order
+            for lo, hi, mine_first in (
+                    (*bounds[1 - pos], True), (mlo, mhi, False)):
+                s0, s1 = max(a, lo), min(b, hi)
+                if s0 >= s1:
+                    continue
+                inc = incoming[s0 - a:s1 - a]
+                if mine_first:
+                    fn(src[s0:s1], inc, out=acc[s0:s1])
+                else:
+                    fn(inc, src[s0:s1], out=acc[s0:s1])
+            if frame is not None:
+                frame.release()
+
+    def _allreduce_hier(self, src: np.ndarray, acc: np.ndarray, op: str,
+                        seq: int, locals_: list[int], leaders: list[int]):
+        """Intra-host reduce to the host leader, inter-host ring among
+        leaders, intra-host broadcast back (result lands in acc)."""
+        fn = _OPS[op]
+        leader = locals_[0]
+        if self.rank != leader:
+            self._push_seg(leader, ("hr", seq, 0, 0), src)
+            incoming, frame = self._recv_view(leader, ("hb", seq, 0, 0))
+            np.copyto(acc, incoming)
+            if frame is not None:
+                frame.release()
+            return
+        np.copyto(acc, src)
+        for r in locals_[1:]:   # deterministic rank order
+            incoming, frame = self._recv_view(r, ("hr", seq, 0, 0))
+            fn(acc, incoming, out=acc)
+            if frame is not None:
+                frame.release()
+        self._ring_allreduce(acc, acc, op, seq, leaders, "hra", "hga")
+        for r in locals_[1:]:
+            self._push_seg(r, ("hb", seq, 0, 0), acc)
+
     # -- collectives --------------------------------------------------------
 
     def allreduce(self, arr: np.ndarray, op: str, seq: int) -> np.ndarray:
@@ -91,6 +518,25 @@ class HostGroup:
         n = self.world_size
         if n == 1:
             return arr
+        if not self._pipelined():
+            return self._allreduce_sync(arr, op, seq)
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        acc = np.empty_like(flat)   # owned result; src (the input) is
+                                    # only read, never copied up front
+        plan = self._hierarchy_plan()
+        if plan is not None:
+            self._allreduce_hier(flat, acc, op, seq, *plan)
+        else:
+            self._ring_allreduce(flat, acc, op, seq, list(range(n)),
+                                 "ar", "ag")
+        self._note_segs("allreduce")
+        return acc.reshape(arr.shape)
+
+    def _allreduce_sync(self, arr: np.ndarray, op: str,
+                        seq: int) -> np.ndarray:
+        """Legacy synchronous ring (kill-switch path; the semantic
+        reference the pipelined path must match bit-for-bit)."""
+        n = self.world_size
         flat = np.ascontiguousarray(arr).reshape(-1)
         chunks = np.array_split(flat, n)
         fn = _OPS[op]
@@ -114,10 +560,71 @@ class HostGroup:
 
     def reducescatter(self, arr: np.ndarray, op: str, seq: int) -> np.ndarray:
         n = self.world_size
+        if n == 1:
+            # the 1-way "shard" is the whole reduction: return the input
+            # unchanged (shape intact), consistent with allreduce's n==1
+            # behavior — NOT a flattened alias of the caller's array
+            return arr
+        if not self._pipelined():
+            return self._reducescatter_sync(arr, op, seq)
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        fn = _OPS[op]
+        pos = self.rank
+        bounds = _split_bounds(flat.size, n)
+        step = self._segment_elems(flat.itemsize)
+        if n == 2:
+            # pairwise: each rank sends only the PEER's shard and
+            # reduces its own as fn(theirs, mine) — half the traffic of
+            # the ring+rotation, one round, and the exact operand order
+            # the legacy path's final rotation delivers.
+            peer = 1 - pos
+            plo, phi = bounds[peer]
+            for k, (a, b) in enumerate(_segments(plo, phi, step)):
+                self._push_seg(peer, ("rs", seq, 0, k), flat[a:b])
+            mlo, mhi = bounds[pos]
+            out = np.empty(mhi - mlo, dtype=flat.dtype)
+            for k, (a, b) in enumerate(_segments(mlo, mhi, step)):
+                incoming, frame = self._recv_view(peer, ("rs", seq, 0, k))
+                fn(incoming, flat[a:b], out=out[a - mlo:b - mlo])
+                if frame is not None:
+                    frame.release()
+            self._note_segs("reducescatter")
+            return out
+        acc = np.empty_like(flat)
+        right, left = (pos + 1) % n, (pos - 1) % n
+        lo, hi = bounds[pos]
+        for k, (a, b) in enumerate(_segments(lo, hi, step)):
+            self._push_seg(right, ("rs", seq, 0, k), flat[a:b])
+        for s in range(n - 1):
+            lo, hi = bounds[(pos - s - 1) % n]
+            last = s == n - 2
+            for k, (a, b) in enumerate(_segments(lo, hi, step)):
+                seg = acc[a:b]
+                incoming, frame = self._recv_view(left, ("rs", seq, s, k))
+                fn(flat[a:b], incoming, out=seg)
+                if frame is not None:
+                    frame.release()
+                # after the last reduce this segment is fully reduced
+                # chunk (pos+1): one final rotation puts chunk[pos]
+                # everywhere (same "rsf" hop as the legacy path)
+                self._push_seg(right,
+                               ("rsf", seq, 0, k) if last
+                               else ("rs", seq, s + 1, k), seg)
+        lo, hi = bounds[pos]
+        out = np.empty(hi - lo, dtype=acc.dtype)
+        for k, (a, b) in enumerate(_segments(lo, hi, step)):
+            incoming, frame = self._recv_view(left, ("rsf", seq, 0, k))
+            np.copyto(out[a - lo:b - lo], incoming)
+            if frame is not None:
+                frame.release()
+        self._note_segs("reducescatter")
+        return out
+
+    def _reducescatter_sync(self, arr: np.ndarray, op: str,
+                            seq: int) -> np.ndarray:
+        n = self.world_size
         flat = np.ascontiguousarray(arr).reshape(-1)
         chunks = np.array_split(flat, n)
-        if n == 1:
-            return chunks[0]
         fn = _OPS[op]
         right = (self.rank + 1) % n
         left = (self.rank - 1) % n
@@ -132,10 +639,39 @@ class HostGroup:
         self._send(right, ("rsf", seq, 0), chunks[(self.rank + 1) % n])
         return self._recv(left, ("rsf", seq, 0))
 
-    def allgather(self, arr: np.ndarray, seq: int) -> list:
+    def allgather(self, arr, seq: int) -> list:
         n = self.world_size
         if n == 1:
             return [arr]
+        if not self._pipelined():
+            return self._allgather_sync(arr, seq)
+        pos = self.rank
+        right, left = (pos + 1) % n, (pos - 1) % n
+        out: list = [None] * n
+        out[pos] = arr
+        # whole-array frames (per-rank shapes may differ, so hops are
+        # not byte-segmented); one-way store-and-forward still pipelines
+        # the ring, and forwarded hops reuse the received frame's bytes
+        # (or pass the same shm object id on a shared node)
+        self._push_seg(right, ("gat", seq, 0, 0), np.asarray(arr))
+        for s in range(n - 1):
+            recv_idx = (pos - s - 1) % n
+            incoming, frame = self._recv_view(left, ("gat", seq, s, 0))
+            out[recv_idx] = _materialize(incoming)
+            if s < n - 2:
+                if frame is not None:
+                    self._forward(right, ("gat", seq, s + 1, 0), frame)
+                else:
+                    self._push_frame(right, ("gat", seq, s + 1, 0),
+                                     ser.serialize_parts(
+                                         np.asarray(incoming)))
+            elif frame is not None:
+                frame.release()
+        self._note_segs("allgather")
+        return out
+
+    def _allgather_sync(self, arr, seq: int) -> list:
+        n = self.world_size
         out: list = [None] * n
         out[self.rank] = arr
         right = (self.rank + 1) % n
@@ -164,6 +700,7 @@ class HostGroup:
             elif rel % (2 * d) == d:
                 value = self._recv((self.rank - d) % n, ("bc", seq, d))
             d //= 2
+        self._note_segs("broadcast")
         return value
 
     def reduce(self, arr: np.ndarray, dst: int, op: str, seq: int):
@@ -178,11 +715,13 @@ class HostGroup:
         while d < n:
             if rel % (2 * d) == d:
                 self._send((self.rank - d) % n, ("rd", seq, d), value)
+                self._note_segs("reduce")
                 return arr  # non-dst ranks return their input unchanged
             if rel % (2 * d) == 0 and rel + d < n:
                 incoming = self._recv((self.rank + d) % n, ("rd", seq, d))
                 value = fn(value, incoming)
             d *= 2
+        self._note_segs("reduce")
         return value if rel == 0 else arr
 
     def barrier(self, seq: int):
@@ -193,9 +732,11 @@ class HostGroup:
             self._send((self.rank + d) % n, ("bar", seq, d), None)
             self._recv((self.rank - d) % n, ("bar", seq, d))
             d *= 2
+        self._note_segs("barrier")
 
     def send(self, arr, dst: int, seq: int):
         self._send(dst, ("p2p", seq), arr)
+        self._note_segs("send")
 
     def recv(self, src: int, seq: int):
         return self._recv(src, ("p2p", seq))

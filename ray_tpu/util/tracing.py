@@ -34,6 +34,11 @@ import time
 
 _MAX_SPANS = 10_000
 
+# cached per process (workers are spawned, not forked): getpid/uname are
+# real syscalls on this container runtime — measurable per-span cost
+_PID = os.getpid()
+_NODE = os.uname().nodename
+
 _lock = threading.Lock()
 _spans: collections.deque = collections.deque(maxlen=_MAX_SPANS)
 _enabled = False
@@ -113,10 +118,10 @@ def span(name: str, kind: str, ctx: dict | None = None,
                 "kind": kind,                # "PRODUCER"/"CONSUMER"/...
                 "startTimeUnixNano": start,
                 "endTimeUnixNano": end,
-                "pid": os.getpid(),
+                "pid": _PID,
                 # pids collide across hosts; (node, pid) identifies the
                 # producing process cluster-wide
-                "node": os.uname().nodename,
+                "node": _NODE,
                 "attributes": attributes or {},
             })
 
@@ -145,8 +150,8 @@ def record_completed_span(name: str, kind: str, start_ns: int,
             "kind": kind,
             "startTimeUnixNano": int(start_ns),
             "endTimeUnixNano": int(end_ns),
-            "pid": os.getpid(),
-            "node": os.uname().nodename,
+            "pid": _PID,
+            "node": _NODE,
             "attributes": attributes or {},
         })
     return {"trace_id": trace_id, "span_id": span_id}

@@ -54,7 +54,9 @@ namespace {
 
 // Bump when the frame layout or frame semantics change incompatibly.
 // Must match PROTOCOL_VERSION in ray_tpu/_private/protocol.py.
-constexpr int kProtocolVersion = 2;
+// v3: PUSH_OOB frames (kind 3, out-of-band payload layout) — a v2
+// receiver would misparse the head-prefixed body as pickle.
+constexpr int kProtocolVersion = 3;
 
 constexpr int kReq = 0;
 constexpr int kReply = 1;

@@ -64,6 +64,69 @@ def pytest_runtest_makereport(item, call):
                 ("fault injection", f"reproduce with: {banner}"))
 
 
+# ---------------------------------------------------------------------------
+# Tier-1 duration guard. The tier-1 budget is a hard 870 s wall-clock
+# timeout over the alphabetical file order, so one slow EARLY file
+# silently starves every file behind it out of the run (DOTS_PASSED is
+# wall-clock sensitive). This guard turns that silent starvation into an
+# attributable failure: any early-alphabet test file whose summed test
+# durations (the same per-phase numbers --durations reports) exceed the
+# per-file budget fails the session at the end. Late-alphabet files
+# (test_z*) are exempt by design — they are sequenced last precisely so
+# they spill past the timeout, not displace others. Override/disable via
+# RAY_TPU_TEST_FILE_BUDGET_S (0 disables).
+
+_FILE_BUDGET_DEFAULT_S = 120.0
+_file_durations: dict = {}
+
+
+def _file_budget_s() -> float:
+    try:
+        return float(os.environ.get("RAY_TPU_TEST_FILE_BUDGET_S",
+                                    _FILE_BUDGET_DEFAULT_S))
+    except ValueError:
+        return _FILE_BUDGET_DEFAULT_S
+
+
+def pytest_runtest_logreport(report):
+    fname = report.nodeid.split("::", 1)[0]
+    _file_durations[fname] = \
+        _file_durations.get(fname, 0.0) + report.duration
+
+
+def _early_alphabet(fname: str) -> bool:
+    base = os.path.basename(fname)
+    return base.startswith("test_") and not base.startswith("test_z")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    budget = _file_budget_s()
+    if budget <= 0:
+        return
+    if len(_file_durations) < 10:
+        return   # targeted run (one file / a few tests), not the suite:
+                 # a developer iterating on a slow file shouldn't fail
+                 # their own focused run
+    over = sorted(((f, d) for f, d in _file_durations.items()
+                   if _early_alphabet(f) and d > budget),
+                  key=lambda p: -p[1])
+    if not over:
+        return
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    lines = [f"  {f}: {d:.1f}s > {budget:.0f}s budget" for f, d in over]
+    msg = ("tier-1 duration guard: early-alphabet test file(s) over the "
+           "per-file wall-clock budget (slow early files starve the "
+           "870s tier-1 run; mark tests `slow`, speed them up, or raise "
+           "RAY_TPU_TEST_FILE_BUDGET_S):\n" + "\n".join(lines))
+    if tr is not None:
+        tr.write_sep("=", "tier-1 duration guard", red=True)
+        tr.write_line(msg)
+    if session.exitstatus in (0, 1):
+        # escalate only from ok/tests-failed — an interrupted (2) or
+        # internally-errored (3) session keeps its more-severe code
+        session.exitstatus = 1
+
+
 @pytest.fixture
 def ray_start_regular():
     """Start a fresh single-node runtime for a test, shut down after.
