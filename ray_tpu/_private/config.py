@@ -65,7 +65,21 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "serve_stream_chunk_timeout_s": 300.0,  # first chunk may be a compile
     # --- collective / mesh ---
     "collective_default_backend": "xla",
-    "collective_op_timeout_s": 300.0,  # dead-member failure detector
+    "collective_op_timeout_s": 300.0,  # dead-member detector of last resort
+    # Gang fault tolerance (ray_tpu.train + util/collective): the group's
+    # rendezvous actor watches the GCS actor-death feed and POISONS the
+    # group when a member dies — surviving ranks' pending/future
+    # collective ops raise CollectiveGroupError (naming the dead rank)
+    # well under the op timeout, and members that directly observe a peer
+    # connection drop poison the group themselves.
+    # RAY_TPU_COLLECTIVE_DEATH_POISONING=0 falls back to timeout-only
+    # detection.
+    "collective_death_poisoning": True,
+    # Driver-side gang death monitor (train.BackendExecutor): subscribes
+    # to actor-death events for the training workers so a rank death
+    # surfaces as TrainWorkerGroupError(dead_ranks=...) within seconds.
+    # Kill switch: RAY_TPU_TRAIN_DEATH_MONITOR=0.
+    "train_death_monitor": True,
     # Pipelined host-collective data path (util/collective/host_backend):
     # one-way zero-copy segment sends, double-buffered so the reduce of
     # segment k overlaps the transfer of segment k+1. Pipeline kill

@@ -26,14 +26,18 @@ Design constraints:
 
 Schedule grammar (``;``-separated rules)::
 
-    rule     := action ":" role "." method ":" selector [":" param_ms]
-    action   := drop | delay | dup | disconnect | slow_reply
-    role     := "*" | gcs | raylet | worker | driver
+    rule     := action ":" scope "." method ":" selector [":" param_ms]
+    action   := drop | delay | dup | disconnect | slow_reply | kill_actor
+    scope    := "*" | gcs | raylet | worker | driver | <process tag>
     method   := "*" | <rpc method name>
     selector := "p" FLOAT    probability (hash-derived, deterministic)
               | "%" INT      every K-th call (1-indexed: K, 2K, ...)
               | "#" INT[,..] exact 1-indexed call numbers
     param_ms := FLOAT        delay / slow_reply duration (default 10)
+
+The scope matches the process ROLE or any of its TAGS (``add_tag``):
+train workers tag themselves ``rank<N>``, so rank-death chaos can target
+exactly one gang member deterministically.
 
 Examples::
 
@@ -42,6 +46,10 @@ Examples::
     dup:gcs.kv_put:%3               # every 3rd kv_put sent twice
     disconnect:*.request_worker_lease:#2   # kill the conn on call 2
     slow_reply:*.get_nodes:p0.2:15  # server stalls 15ms before replying
+    kill_actor:rank1.next_result:#2 # train rank 1's process dies (hard,
+                                    # os._exit) when it serves its 2nd
+                                    # next_result — deterministic rank
+                                    # death for gang-FT tests
 
 Actions, and where the transports apply them:
 
@@ -67,6 +75,13 @@ Actions, and where the transports apply them:
                  clients surface the error).
 - ``slow_reply`` server dispatch: sleep param_ms before writing the
                  reply (models a GC-pausing / overloaded peer).
+- ``kill_actor`` server dispatch: the process dies via os._exit before
+                 the reply is written — the SIGKILL/preemption analog
+                 for actors, reproducible from the seed+schedule pair
+                 like every other action. Scope it by role or tag
+                 (``rank<N>`` for train workers); a wildcard scope
+                 would kill whatever process serves the call first,
+                 including the driver.
 
 Role scoping is process-level: subprocess entrypoints tag themselves
 (gcs.main → "gcs", scripts/node → "raylet", worker_main → "worker",
@@ -81,10 +96,11 @@ import struct
 import threading
 import time
 
-ACTIONS = ("drop", "delay", "dup", "disconnect", "slow_reply")
+ACTIONS = ("drop", "delay", "dup", "disconnect", "slow_reply",
+           "kill_actor")
 # actions applied at the client send boundary vs the server reply boundary
 _SEND_ACTIONS = frozenset({"drop", "delay", "dup", "disconnect"})
-_REPLY_ACTIONS = frozenset({"slow_reply"})
+_REPLY_ACTIONS = frozenset({"slow_reply", "kill_actor"})
 
 _DEFAULT_PARAM_MS = 10.0
 
@@ -119,10 +135,11 @@ class _Rule:
         self.index = index        # position in the schedule (hash input)
         self._counts: dict[str, int] = {}   # method -> calls seen
 
-    def matches_scope(self, role: str, method: str) -> bool:
+    def matches_scope(self, role: str, method: str,
+                      tags: frozenset = frozenset()) -> bool:
         if self.method != "*" and self.method != method:
             return False
-        return self.role == "*" or self.role == role
+        return self.role == "*" or self.role == role or self.role in tags
 
     def fires(self, seed: int, method: str, lock: threading.Lock) -> int:
         """Count this call; return its 1-indexed number if the rule fires,
@@ -245,8 +262,9 @@ class FaultInjector:
         """Client send boundary. Returns the plan to apply, or None."""
         plan = None
         role = self._current_role()
+        tags = get_tags()
         for rule in self._send_rules:
-            if not rule.matches_scope(role, method):
+            if not rule.matches_scope(role, method, tags):
                 continue
             n = rule.fires(self.seed, method, self._lock)
             if not n:
@@ -267,19 +285,26 @@ class FaultInjector:
         return plan
 
     def on_reply(self, method: str) -> float:
-        """Server dispatch boundary: seconds to stall before replying."""
+        """Server dispatch boundary: seconds to stall before replying —
+        or, for a fired ``kill_actor`` rule, the process dies right here
+        (os._exit, the preemption/SIGKILL analog; the caller observes a
+        dropped connection, the raylet reaps the corpse and reports
+        actor_failed exactly as for a real chip/host loss)."""
         delay = 0.0
         role = self._current_role()
+        tags = get_tags()
         for rule in self._reply_rules:
-            if not rule.matches_scope(role, method):
+            if not rule.matches_scope(role, method, tags):
                 continue
             n = rule.fires(self.seed, method, self._lock)
             if not n:
                 continue
-            delay = max(delay, rule.param_s)
             with self._lock:
                 self.events.append((rule.action, role, method, n))
             _note_fault(rule.action, role, method, n)
+            if rule.action == "kill_actor":
+                os._exit(1)
+            delay = max(delay, rule.param_s)
         return delay
 
     # ------------------------------------------------------------ inspection
@@ -308,6 +333,7 @@ class FaultInjector:
 
 ACTIVE: FaultInjector | None = None
 _role: str = os.environ.get("RAY_TPU_FAULT_ROLE", "*")
+_tags: frozenset = frozenset()
 _env_checked = False
 _install_lock = threading.Lock()
 
@@ -324,6 +350,22 @@ def set_role(role: str, weak: bool = False):
 
 def get_role() -> str:
     return _role
+
+
+def add_tag(tag: str):
+    """Add a scope tag to this process (e.g. a train worker's gang rank,
+    ``rank3``): schedule rules may target tags exactly like roles, which
+    is what makes rank-death chaos (`kill_actor:rank1....`) land on one
+    deterministic gang member instead of every worker at once. Tags are
+    additive and process-global; an immutable snapshot is read per
+    decision so concurrent adds never tear a match."""
+    global _tags
+    with _install_lock:
+        _tags = frozenset(_tags | {str(tag)})
+
+
+def get_tags() -> frozenset:
+    return _tags
 
 
 def install(seed: int, schedule: str) -> FaultInjector:

@@ -62,7 +62,12 @@ PUSH_OOB = 3
 # v3: PUSH_OOB frames (kind 3 carries an out-of-band payload layout a
 # v2 receiver would misparse as a pickle — the data-plane collective
 # frames, worker_runtime rpc_col_push_frame).
-PROTOCOL_VERSION = 3
+# v4: collective incarnation epochs (col frame keys gain an epoch slot —
+# seq_pos 2→3 — and shm oids re-lay as group(6)+epoch(4)+rank(2)+ctr(4));
+# a v3 peer's frames would never match a v4 receiver's mailbox keys and
+# every op would ride out the full collective timeout instead of failing
+# fast here.
+PROTOCOL_VERSION = 4
 
 _HDR = struct.Struct(">QBq")   # total-after-len, ver<<4|kind, seq
 _U32 = struct.Struct(">I")     # PUSH_OOB head length prefix
@@ -220,12 +225,19 @@ class PyRpcClient:
     rpc/client_call.h)."""
 
     def __init__(self, addr: tuple[str, int], timeout: float = 30.0,
-                 on_push=None, retry: int = 3):
+                 on_push=None, retry: int = 3, on_close=None):
         from ray_tpu._private.retry import RetryPolicy
 
         self.addr = tuple(addr)
         self._timeout = timeout
         self._on_push = on_push
+        # Fired (once, from the reader thread) when the connection is
+        # LOST — peer died, reset, protocol mismatch — but NOT on a
+        # deliberate local close(). Liveness consumers (the collective
+        # data plane's peer-death detector) key off exactly that
+        # asymmetry: our own teardown is not a peer failure.
+        self._on_close = on_close
+        self._deliberate_close = False
         policy = RetryPolicy(max_attempts=retry, deadline_s=None)
         last = None
         for attempt in range(retry):
@@ -300,6 +312,11 @@ class PyRpcClient:
             for fut in list(self._pending.values()):
                 fut.set(err)
             self._pending.clear()
+            if self._on_close is not None and not self._deliberate_close:
+                try:
+                    self._on_close()
+                except Exception:
+                    traceback.print_exc()
 
     def call(self, method: str, timeout: float | None = None, **kwargs):
         """Synchronous request/reply."""
@@ -424,7 +441,8 @@ class PyRpcClient:
         return self._closed
 
     def close(self):
-        self._closed = True
+        self._deliberate_close = True   # before the shutdown wakes the
+        self._closed = True             # reader into its finally block
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

@@ -324,3 +324,75 @@ class Subscriber:
                         cb(message)
                     except Exception:
                         pass
+
+
+class ActorDeathWatch:
+    """Handle for one GCS actor-death subscription (see
+    ``watch_actor_deaths``); ``stop()`` tears down both the poll loop
+    and its dedicated GCS connection."""
+
+    def __init__(self, rpc, sub):
+        self._rpc = rpc
+        self._sub = sub
+
+    def stop(self):
+        sub, self._sub = self._sub, None
+        rpc, self._rpc = self._rpc, None
+        if sub is not None:
+            try:
+                sub.stop()
+            except Exception:
+                pass
+        if rpc is not None:
+            try:
+                rpc.close()
+            except Exception:
+                pass
+
+
+def watch_actor_deaths(on_death, poll_timeout: float = 5.0):
+    """Subscribe to the GCS actor-lifecycle feed from this process and
+    invoke ``on_death(actor_id, reason)`` for every actor death or
+    out-from-under restart. The one place that knows the feed's event
+    vocabulary — every "watch these actors, tell me when one dies"
+    consumer (train gang monitor, collective rendezvous) filters its own
+    actor_ids in the callback rather than re-implementing the
+    subscription. Returns an ``ActorDeathWatch`` (call ``stop()``), or
+    ``None`` when no worker runtime is attached to this process;
+    transport errors propagate so callers choose their degraded mode.
+
+    The connection is a ``ReconnectingRpcClient``: the GCS may RESTART
+    in fault-tolerant mode, and a plain client would leave this watch
+    dead forever after one — every psub_poll raising into the
+    Subscriber's backoff loop while ``active()`` still reads True, so
+    rank-death detection would silently degrade to op-timeout-only. On
+    heal, the poll's unknown-subscriber KeyError drives the
+    Subscriber's own re-announce, restoring the feed.
+    """
+    from ray_tpu._private.protocol import ReconnectingRpcClient
+    from ray_tpu._private.worker_runtime import current_worker
+
+    worker = current_worker()
+    if worker is None:
+        return None
+    rpc = ReconnectingRpcClient(tuple(worker.gcs.addr), timeout=30.0)
+    try:
+        sub = Subscriber(rpc, poll_timeout=poll_timeout)
+
+        def _cb(msg):
+            if not isinstance(msg, dict) or \
+                    msg.get("event") not in ("dead", "restarting"):
+                return
+            actor_id = msg.get("actor_id")
+            if actor_id is None:
+                return
+            on_death(actor_id, str(msg.get("reason") or msg["event"]))
+
+        sub.subscribe("actors", _cb)
+    except Exception:
+        try:
+            rpc.close()
+        except Exception:
+            pass
+        raise
+    return ActorDeathWatch(rpc, sub)

@@ -198,9 +198,13 @@ class RetryPolicy:
 
     def backoff(self, attempt: int) -> float:
         """Sleep before retry number ``attempt`` (1-indexed): full
-        jitter over an exponentially growing cap."""
+        jitter over an exponentially growing cap. The exponent is
+        clamped: unlimited-retry callers (gang restarts with
+        max_failures=-1) pass an unbounded attempt counter, and
+        ``2 ** 1079`` no longer converts to float (OverflowError) —
+        past ~60 doublings every base overshoots max_backoff_s anyway."""
         cap = min(self.max_backoff_s,
-                  self.base_backoff_s * (2 ** max(0, attempt - 1)))
+                  self.base_backoff_s * (2 ** min(60, max(0, attempt - 1))))
         return random.uniform(0.0, cap)
 
     def run(self, fn, *, method: str | None = None,

@@ -143,6 +143,26 @@ CATALOG: dict[str, dict] = {
                        "collective data path (one-way zero-copy frames; "
                        "0 when RAY_TPU_COLLECTIVE_PIPELINE=0)",
     },
+    # --- gang fault tolerance (train/, util/collective) ---
+    "ray_tpu_train_gang_restarts_total": {
+        "kind": "Counter", "tags": ("group",),
+        "description": "Training gang restarts driven by fit()'s "
+                       "FailureConfig retry loop (teardown + rebuild + "
+                       "checkpoint resume after a worker/rank failure)",
+    },
+    "ray_tpu_collective_groups_poisoned_total": {
+        "kind": "Counter", "tags": ("group",),
+        "description": "Collective groups poisoned in this process after "
+                       "a member death (pending/future ops raise "
+                       "CollectiveGroupError instead of hanging)",
+    },
+    "ray_tpu_collective_stale_epoch_total": {
+        "kind": "Counter", "tags": ("group",),
+        "description": "Collective frames / shm notifies rejected at "
+                       "ingest because they carried a previous group "
+                       "incarnation's epoch (plus dead-epoch mailbox "
+                       "entries swept at group rejoin)",
+    },
     # --- pjit compile path (parallel/compile_watch.py) ---
     "ray_tpu_pjit_compile_seconds": {
         "kind": "Histogram", "tags": ("fn",),

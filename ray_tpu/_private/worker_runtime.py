@@ -26,6 +26,7 @@ from concurrent.futures import Future as PyFuture
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import events as _events
+from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.object_ref import ObjectRef, ReferenceCounter
 from ray_tpu._private.protocol import ConnectionLost, RpcClient, RpcServer
@@ -734,6 +735,10 @@ class CoreWorker:
         self._seq_cond = threading.Condition()
         self._col_mailbox: dict[tuple, object] = {}
         self._col_cond = threading.Condition()
+        # gang fault tolerance (see col_set_epoch / col_poison_local):
+        # group -> current incarnation epoch, and group -> poison record
+        self._col_epochs: dict[str, int] = {}
+        self._col_poison: dict[str, tuple[tuple, str]] = {}
         self._ready = threading.Event()
         # Normal tasks execute serially: the lease under which tasks are
         # pushed accounts for exactly one task's resources at a time
@@ -2642,6 +2647,19 @@ class CoreWorker:
                                  daemon=True).start()
                 return self._package_results(spec, None)
             method = getattr(self._actor_instance, method_name)
+            # Actor-method dispatch is a fault-injection boundary too:
+            # actor calls ride the deferred push_task RPC (replies are
+            # written asynchronously), so the transport's on_reply hook
+            # never sees them — consult the injector here with the ACTOR
+            # method name. This is what lets a seeded schedule like
+            # `kill_actor:rank1.next_result:#2` kill one deterministic
+            # gang member mid-training (the rank-death chaos the gang-FT
+            # tests replay), and lets slow_reply model a stalling actor.
+            inj = _fi.ACTIVE
+            if inj is not None:
+                stall = inj.on_reply(method_name)
+                if stall:
+                    time.sleep(stall)
             args, kwargs = self._resolve_args(spec)
             # concurrency gate: the method's group semaphore (or the
             # actor-wide default, 1 slot) admits executions in dispatch
@@ -3050,13 +3068,132 @@ class CoreWorker:
 
     def col_push_local(self, key: tuple, data):
         with self._col_cond:
-            old = self._col_mailbox.get(key)
-            self._col_mailbox[key] = data
-            self._col_cond.notify_all()
+            # stale check must happen under the same lock col_set_epoch
+            # sweeps under — checked outside, a frame could pass the check
+            # concurrently with the sweep and then park AFTER it, stranding
+            # its backing shm segment past the reclaim the sweep promised
+            if self._col_stale_epoch(key):
+                stale = True
+            else:
+                # traffic from a live incarnation: park it for col_take
+                stale = False
+                old = self._col_mailbox.get(key)
+                self._col_mailbox[key] = data
+                self._col_cond.notify_all()
+        if stale:
+            # traffic from a previous incarnation of this group (the full
+            # key carries the incarnation epoch at slot 1): a rebuilt gang
+            # must never consume a dead gang's frames — reject instead of
+            # parking it where it could masquerade as this epoch's payload
+            self._note_stale_epoch(key)
+            self._discard_col_msg(data)
+            return
         if old is not None and old is not data:
             # a redelivered duplicate (fault plane `dup`, peer retry)
             # overwrote a message nobody consumed — reclaim its backing
             self._discard_col_msg(old, replacement=data)
+
+    def _col_stale_epoch(self, key: tuple) -> bool:
+        """True when `key` belongs to an OLDER incarnation of its group
+        than the one this process last joined. Only a strictly older
+        epoch is rejected: a NEWER one means a peer already joined the
+        next incarnation this process hasn't rejoined yet — parking that
+        frame is harmless (col_set_epoch's purge or group destroy sweeps
+        it if this process never catches up)."""
+        if len(key) < 2 or not isinstance(key[1], int):
+            return False
+        cur = self._col_epochs.get(key[0])
+        return cur is not None and key[1] < cur
+
+    def _note_stale_epoch(self, key: tuple):
+        from ray_tpu._private import telemetry as _tm
+
+        if _tm.ENABLED:
+            try:
+                _tm.counter_inc("ray_tpu_collective_stale_epoch_total",
+                                tags={"group": str(key[0])})
+            except Exception:
+                pass
+
+    def col_set_epoch(self, group: str, epoch: int):
+        """Register this process's current incarnation epoch for one
+        collective group (called at group join). Frames/shm notifies
+        stamped with an older epoch are rejected at ingest from now on;
+        anything the dead incarnation already parked here — mailbox
+        entries AND stranded shm segments (their 4-byte epoch tag rides
+        the object id, see col_oid_prefix) — is swept immediately, so a
+        rebuilt gang under the same name starts from clean state even
+        when the previous gang died too abruptly to destroy itself."""
+        with self._col_cond:
+            prev = self._col_epochs.get(group)
+            self._col_epochs[group] = epoch
+            if prev is not None and epoch < prev:
+                # never move backwards (a late joiner re-announcing an
+                # older incarnation must not resurrect swept traffic)
+                self._col_epochs[group] = prev
+                return
+            self._col_poison.pop(group, None)   # new incarnation: clean
+            stale = [k for k in self._col_mailbox
+                     if k and k[0] == group and len(k) > 1
+                     and isinstance(k[1], int) and k[1] < epoch]
+            dropped = [self._col_mailbox.pop(k) for k in stale]
+        for msg in dropped:
+            self._note_stale_epoch((group, 0))
+            self._discard_col_msg(msg)
+        # sweep the dead epochs' stranded shm segments: group-prefixed
+        # oids whose epoch tag differs from the new epoch's
+        try:
+            prefix = col_oid_prefix(group)
+            tag = col_epoch_tag(epoch)
+            for oid, _size in self.store.list_objects():
+                if oid.startswith(prefix) and oid[6:10] != tag:
+                    self.store.delete_ephemeral(oid)
+        except Exception:
+            pass
+
+    def col_poison_local(self, group: str, dead_ranks, reason: str,
+                         epoch: int | None = None):
+        """Poison one collective group in this process: every pending
+        col_take wakes and raises CollectiveGroupError immediately, and
+        future takes fail the same way until the group is destroyed or
+        rejoined under a new epoch. Idempotent; first record wins (it
+        names the original dead rank). An epoch-stamped poison from an
+        incarnation this process has already left is ignored — a stale
+        HostGroup's on_close handler firing after a rejoin would
+        otherwise kill the healthy successor gang."""
+        with self._col_cond:
+            if epoch is not None:
+                cur = self._col_epochs.get(group)
+                if cur is not None and epoch < cur:
+                    return False
+            if group in self._col_poison:
+                return False
+            self._col_poison[group] = (tuple(dead_ranks), str(reason))
+            self._col_cond.notify_all()
+        from ray_tpu._private import telemetry as _tm
+
+        if _tm.ENABLED:
+            try:
+                _tm.counter_inc("ray_tpu_collective_groups_poisoned_total",
+                                tags={"group": group})
+            except Exception:
+                pass
+        return True
+
+    def rpc_col_poison(self, conn, group: str, dead_ranks, reason: str,
+                       epoch: int | None = None):
+        """Group-poison ingest (pushed by the group's rendezvous actor on
+        member death, or by a member that directly observed a peer's
+        connection drop). The epoch guard lives in col_poison_local,
+        under the mailbox lock."""
+        self.col_poison_local(group, tuple(dead_ranks), reason,
+                              epoch=epoch)
+        return True
+
+    def col_poisoned(self, group: str):
+        """(dead_ranks, reason) if `group` is poisoned in this process."""
+        with self._col_cond:
+            return self._col_poison.get(group)
 
     def _discard_col_msg(self, msg, replacement=None):
         """Reclaim an unconsumed mailbox message's backing resource: a
@@ -3085,6 +3222,8 @@ class CoreWorker:
         with self._col_cond:
             stale = [k for k in self._col_mailbox if k and k[0] == group]
             dropped = [self._col_mailbox.pop(k) for k in stale]
+            self._col_poison.pop(group, None)
+            self._col_epochs.pop(group, None)
         for msg in dropped:
             self._discard_col_msg(msg)
         COL_RECV_POOL.purge(group)
@@ -3152,7 +3291,11 @@ class CoreWorker:
         def _newer(k):
             return _same_channel(k) and k[seq_pos] > key[seq_pos]
 
+        group = key[0] if key else None
+
         def _ready():
+            if group in self._col_poison:
+                return True
             if key in self._col_mailbox:
                 return True
             return seq_pos is not None and any(
@@ -3160,6 +3303,14 @@ class CoreWorker:
 
         with self._col_cond:
             ok = self._col_cond.wait_for(_ready, timeout=timeout)
+            poison = self._col_poison.get(group)
+            if poison is not None:
+                # a member died: fail fast with the culprit named instead
+                # of hanging out the rest of the op timeout (the group is
+                # unusable until it is destroyed and rebuilt)
+                dead_ranks, reason = poison
+                raise exc.CollectiveGroupError(str(group), dead_ranks,
+                                               reason)
             if not ok:
                 hint = ""
                 if seq_pos is not None:
@@ -3231,6 +3382,16 @@ def col_oid_prefix(group: str) -> bytes:
     occupy the bounded segment until eviction pressure."""
     return b"\xc0" + hashlib.blake2b(group.encode(),
                                      digest_size=5).digest()
+
+
+def col_epoch_tag(epoch: int) -> bytes:
+    """4-byte incarnation-epoch tag following the group prefix in a
+    collective shm object id (layout: group-prefix(6) + epoch(4) +
+    rank(2) + counter(4) — 16 bytes). Lets col_set_epoch sweep a DEAD incarnation's
+    stranded segments — including incarnations this process never knew —
+    by deleting group-prefixed objects whose tag differs from the live
+    epoch's, without ever touching the live epoch's in-flight segments."""
+    return (int(epoch) % (1 << 32)).to_bytes(4, "big")
 
 
 def _release_col_msg(msg):

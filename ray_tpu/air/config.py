@@ -38,9 +38,17 @@ class ScalingConfig:
 @dataclass
 class FailureConfig:
     """(reference: air/config.py FailureConfig) max_failures=-1 → unlimited
-    retries of the whole training run (gang restart, not per-worker)."""
+    retries of the whole training run (gang restart, not per-worker).
+
+    With max_failures != 0, a failed attempt (dead rank, poisoned
+    collective group, worker exception) tears the gang down and rebuilds
+    it; `restore_from_latest_checkpoint` (default) resumes the train loop
+    from the failed attempt's latest successfully persisted checkpoint —
+    surfaced to workers via session.get_checkpoint() — instead of
+    restarting from step 0. Set it False to restart attempts cold."""
 
     max_failures: int = 0
+    restore_from_latest_checkpoint: bool = True
 
 
 @dataclass

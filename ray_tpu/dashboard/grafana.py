@@ -55,6 +55,13 @@ _PANELS = [
      "histogram_quantile(0.5, rate(ray_tpu_mesh_build_seconds_bucket"
      "[5m]))", "s"),
     ("Device HBM", "ray_tpu_device_hbm_bytes", "bytes"),
+    # --- gang fault tolerance (PR 5: detection / poisoning / restart) ---
+    ("Training gang restarts",
+     "rate(ray_tpu_train_gang_restarts_total[5m])", "ops"),
+    ("Collective groups poisoned",
+     "rate(ray_tpu_collective_groups_poisoned_total[5m])", "ops"),
+    ("Stale-epoch traffic rejected",
+     "rate(ray_tpu_collective_stale_epoch_total[5m])", "ops"),
 ]
 
 

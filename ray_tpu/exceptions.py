@@ -103,6 +103,63 @@ class CollectiveSeqMismatchError(RayTpuError):
     until the op timeout or silently pairing the wrong payloads."""
 
 
+class CollectiveGroupError(RayTpuError):
+    """The collective group was poisoned: a member rank died (or the
+    group was torn down) while ops were pending. Raised by pending and
+    future collective calls on every surviving rank — naming the dead
+    rank(s) — well under the collective op timeout, instead of letting
+    each rank hang until its own watchdog fires. The group is unusable;
+    recovery is a gang restart (destroy + re-create the group, which
+    mints a new incarnation epoch so stale traffic is fenced off)."""
+
+    def __init__(self, group: str, dead_ranks=(), reason: str = ""):
+        self.group = group
+        self.dead_ranks = tuple(sorted(set(int(r) for r in dead_ranks)))
+        self.reason = reason
+        ranks = (f" (dead ranks: {list(self.dead_ranks)})"
+                 if self.dead_ranks else "")
+        super().__init__(
+            f"collective group {group!r} poisoned{ranks}: "
+            f"{reason or 'member death'}")
+
+    def __reduce__(self):
+        return (type(self), (self.group, self.dead_ranks, self.reason))
+
+
+class TrainWorkerGroupError(RayTpuError):
+    """One or more workers of a training gang failed. ``errors`` maps
+    world rank -> the exception that rank's call raised; ``dead_ranks``
+    names the ranks whose worker actor died (as opposed to raising a
+    user-code error). Raised by ``WorkerGroup.execute`` so one dead
+    worker's failure is attributed per rank instead of poisoning the
+    whole gang result with a generic timeout."""
+
+    def __init__(self, errors: dict | None = None, dead_ranks=(),
+                 message: str = ""):
+        self.errors = dict(errors or {})
+        self.dead_ranks = tuple(sorted(set(int(r) for r in dead_ranks)))
+        summary = ", ".join(
+            f"rank {r}: {type(e).__name__}: {e}" if not isinstance(e, str)
+            else f"rank {r}: {e}"
+            for r, e in sorted(self.errors.items()))
+        super().__init__(
+            message or f"training worker group failure "
+                       f"(dead ranks: {list(self.dead_ranks)}) — {summary}")
+
+    def __reduce__(self):
+        # per-rank causes may not pickle; degrade them to strings
+        errs = {}
+        import pickle
+
+        for r, e in self.errors.items():
+            try:
+                pickle.dumps(e)
+                errs[r] = e
+            except Exception:
+                errs[r] = f"{type(e).__name__}: {e}"
+        return (type(self), (errs, self.dead_ranks, str(self)))
+
+
 class RaySystemError(RayTpuError):
     """An internal framework component failed (narrow subclass — catching it
     must NOT swallow user-code TaskErrors, matching reference semantics)."""

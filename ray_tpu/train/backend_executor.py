@@ -5,15 +5,66 @@ python/ray/train/_internal/backend_executor.py:42 — _create_placement_group
 """
 from __future__ import annotations
 
+import threading
 import time
 
 import ray_tpu
+from ray_tpu import exceptions as exc
 from ray_tpu.air.config import ScalingConfig
 from ray_tpu.train.worker_group import WorkerGroup
 from ray_tpu.util.placement_group import (
     placement_group,
     remove_placement_group,
 )
+
+
+class _GangDeathMonitor:
+    """Driver-side fast rank-death detector: subscribes to the GCS
+    actor-lifecycle feed for the gang's worker actors so a rank death
+    surfaces within seconds — as a named TrainWorkerGroupError listing
+    the dead rank(s) — instead of whenever the next per-worker RPC
+    happens to fail. Kill switch: RAY_TPU_TRAIN_DEATH_MONITOR=0
+    (config `train_death_monitor`). Detection degrades gracefully to
+    per-rank RPC failure attribution when off or unavailable."""
+
+    def __init__(self, worker_group: WorkerGroup):
+        self._rank_of = {w._actor_id: rank
+                         for rank, w in enumerate(worker_group.workers)}
+        self._lock = threading.Lock()
+        self._dead: dict[int, str] = {}      # rank -> reason
+        self._watch = None
+        from ray_tpu._private.config import get_config
+
+        if not get_config("train_death_monitor"):
+            return
+        try:
+            from ray_tpu._private.pubsub import watch_actor_deaths
+
+            self._watch = watch_actor_deaths(self._on_death)
+        except Exception:
+            pass   # detection degrades to per-rank RPC attribution
+
+    def _on_death(self, actor_id, reason: str):
+        rank = self._rank_of.get(actor_id)
+        if rank is None:
+            return
+        with self._lock:
+            self._dead.setdefault(rank, reason)
+
+    def dead_ranks(self) -> dict[int, str]:
+        with self._lock:
+            return dict(self._dead)
+
+    def active(self) -> bool:
+        """True only while the GCS subscription is live — callers should
+        not pay an abort-check poll loop for a monitor that can never
+        learn anything (kill switch off, or the subscribe failed)."""
+        return self._watch is not None
+
+    def stop(self):
+        watch, self._watch = self._watch, None
+        if watch is not None:
+            watch.stop()
 
 
 class Backend:
@@ -78,11 +129,15 @@ class JaxBackend(Backend):
 
     def on_shutdown(self, worker_group):
         # Tear the group down on every member: drops the per-process state
-        # and kills the rendezvous actor so the next run under this group
+        # (mailbox purge + stranded-shm sweep + poison clear) and kills
+        # the rendezvous actor so the next incarnation under this group
         # name starts clean (advisor finding: the actor used to leak).
+        # Surviving ranks answer fast; dead ranks resolve quickly as
+        # ActorDiedError — the timeout only bounds pathological hangs so
+        # a gang teardown can never wedge the restart loop.
         try:
             worker_group.execute("destroy_collective",
-                                 self.config.group_name)
+                                 self.config.group_name, timeout=60.0)
         except Exception:
             pass
 
@@ -126,6 +181,7 @@ class BackendExecutor:
             placement_group=self.pg)
         self.backend = self.backend_config.backend_cls()
         self.backend.on_start(self.worker_group, self.scaling)
+        self._monitor = _GangDeathMonitor(self.worker_group)
         self.worker_devices = self._record_group_devices()
         return self
 
@@ -164,10 +220,39 @@ class BackendExecutor:
         next_result only returns when a report arrives or the function
         ends, so a driver-side deadline would spuriously kill long steps
         (first-step XLA compile, big evals). Pass a timeout only to bound
-        a run you are willing to abandon."""
-        return self.worker_group.execute("next_result", timeout=timeout)
+        a run you are willing to abandon.
+
+        A rank death surfaces here as TrainWorkerGroupError: the death
+        monitor's pubsub knowledge is polled WHILE the gang call blocks
+        (abort_check — a death interrupts the wait within seconds even
+        if the transport never surfaces it), per-rank attribution comes
+        from WorkerGroup.execute, and anything the monitor learned is
+        merged into the raised error's dead_ranks."""
+        monitor = getattr(self, "_monitor", None)
+        try:
+            rows = self.worker_group.execute(
+                "next_result", timeout=timeout,
+                abort_check=(monitor.dead_ranks
+                             if monitor is not None and monitor.active()
+                             else None))
+        except exc.TrainWorkerGroupError as e:
+            if monitor is not None:
+                known = monitor.dead_ranks()
+                if set(known) - set(e.dead_ranks):
+                    for r, reason in known.items():
+                        e.errors.setdefault(
+                            r, exc.ActorDiedError("", reason))
+                    raise exc.TrainWorkerGroupError(
+                        e.errors,
+                        set(e.dead_ranks) | set(known)) from e
+            raise
+        return rows
 
     def shutdown(self):
+        monitor = getattr(self, "_monitor", None)
+        if monitor is not None:
+            monitor.stop()
+            self._monitor = None
         if self.worker_group is not None:
             if getattr(self, "backend", None) is not None:
                 self.backend.on_shutdown(self.worker_group)

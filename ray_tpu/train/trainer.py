@@ -80,16 +80,72 @@ class DataParallelTrainer(BaseTrainer):
             resume_from_checkpoint=self.resume_from_checkpoint)
 
     def fit(self) -> Result:
-        max_failures = self.run_config.failure_config.max_failures
+        """Run training with gang-level fault tolerance.
+
+        A failed attempt — a dead rank (TrainWorkerGroupError), a
+        poisoned collective group (CollectiveGroupError in survivors),
+        or any worker exception — tears the gang down cleanly (destroy
+        the collective group, kill the workers with restarts suppressed,
+        release the placement group), then rebuilds it and RESUMES the
+        train loop from the latest successfully persisted checkpoint of
+        the failed attempt (surfaced to workers via
+        session.get_checkpoint()), up to FailureConfig.max_failures
+        times. Exhausting the budget re-raises the last failure.
+
+        Retry pacing reuses the unified control-plane policy
+        (_private/retry.py): full-jitter exponential backoff, and each
+        gang retry draws one token from the process-wide retry budget so
+        restart storms surface through the budget-exhaustion event."""
+        from ray_tpu._private import events as _events
+        from ray_tpu._private import telemetry as _tm
+        from ray_tpu._private.retry import RetryPolicy, default_budget
+
+        fc = self.run_config.failure_config
+        max_failures = fc.max_failures
+        # non-Jax backends (TorchConfig) have no group name; the metric
+        # tag falls back to the trainer run name
+        group = getattr(self.backend_config, "group_name", None) \
+            or self.run_config.name or "train"
+        # gang restarts are heavyweight (teardown + reschedule + rebuild):
+        # a larger base than the RPC default, same full-jitter shape.
+        # Only backoff() is consulted — the retry budget here is
+        # FailureConfig.max_failures (checked below), not the policy's
+        # attempt cap
+        policy = RetryPolicy(base_backoff_s=0.5, max_backoff_s=10.0)
         attempt = 0
+        self._resume_ckpt = self.resume_from_checkpoint
+        self._latest_checkpoint = None
+        self._latest_iteration = None
         while True:
             try:
                 return self._fit_once()
-            except Exception:
+            except Exception as e:
                 attempt += 1
+                dead = sorted(getattr(e, "dead_ranks", ()) or ())
+                _events.record("GANG_FAILED", group=group,
+                               attempt=attempt, dead_ranks=list(dead),
+                               error=f"{type(e).__name__}: {e}")
                 if max_failures != -1 and attempt > max_failures:
                     raise
-                time.sleep(min(2.0 * attempt, 10.0))
+                if getattr(fc, "restore_from_latest_checkpoint", True) \
+                        and self._latest_checkpoint is not None:
+                    self._resume_ckpt = self._latest_checkpoint
+                # retry-budget event on every gang retry: take() records
+                # budget exhaustion as a cluster event; the retry itself
+                # proceeds regardless (failing training over an RPC-storm
+                # budget would punish the victim)
+                budget_ok = default_budget().take()
+                _events.record("train_gang_retry", group=group,
+                               attempt=attempt,
+                               max_failures=max_failures,
+                               budget_ok=budget_ok,
+                               resume_iteration=self._latest_iteration)
+                time.sleep(policy.backoff(attempt))
+                _tm.counter_inc("ray_tpu_train_gang_restarts_total",
+                                tags={"group": group})
+                _events.record("GANG_RESTARTED", group=group,
+                               attempt=attempt,
+                               resume_iteration=self._latest_iteration)
 
     def _fit_once(self) -> Result:
         executor = BackendExecutor(self.backend_config,
@@ -97,8 +153,10 @@ class DataParallelTrainer(BaseTrainer):
         try:
             self._setup_datasets(executor)
             config = dict(self.train_loop_config)
-            if self.resume_from_checkpoint is not None:
-                config["_resume_checkpoint"] = self.resume_from_checkpoint
+            resume = getattr(self, "_resume_ckpt", None) \
+                or self.resume_from_checkpoint
+            if resume is not None:
+                config["_resume_checkpoint"] = resume
             executor.start_training(self.train_loop_per_worker, config)
             return self._drive(executor)
         finally:
@@ -129,20 +187,29 @@ class DataParallelTrainer(BaseTrainer):
             os.makedirs(ckpt_dir, exist_ok=True)
         kept: list[str] = []
         num_keep = self.run_config.checkpoint_config.num_to_keep
+        if ckpt_dir:
+            # re-seed the pruning window from disk: _drive runs once per
+            # gang attempt, and without this a failed attempt's dirs fall
+            # out of the window forever — each restart would strand up to
+            # num_to_keep dirs and the run's disk use grows unboundedly
+            kept = sorted(
+                os.path.join(ckpt_dir, d) for d in os.listdir(ckpt_dir)
+                if d.startswith("checkpoint_"))
         # Drive until RANK 0's stream ends. Workers report at different
         # cadences (e.g. HF callbacks report only on the world-zero
         # process), so a faster worker's completion sentinel must not
         # truncate rank 0's remaining reports — a finished worker's
         # next_result just keeps answering "done", making extra rounds
         # harmless.
-        errors: list = []
+        errors: dict[int, BaseException] = {}
+        retryable = self.run_config.failure_config.max_failures != 0
         while True:
             rows = executor.next_results()
             rank0_done = False
             for rank, r in enumerate(rows):   # rows arrive in gang order
                 if r.get("done"):
                     if r.get("error"):
-                        errors.append(r["error"])
+                        errors.setdefault(rank, r["error"])
                     if rank == 0:
                         rank0_done = True
                     continue
@@ -155,17 +222,37 @@ class DataParallelTrainer(BaseTrainer):
                         path = os.path.join(
                             ckpt_dir, f"checkpoint_{r['iteration']:06d}")
                         final_checkpoint.to_directory(path)
+                        if path in kept:
+                            # session iteration counters restart per
+                            # attempt, so a resumed gang re-uses dir
+                            # names — treat the rewrite as newest, never
+                            # as a prune candidate for itself
+                            kept.remove(path)
                         kept.append(path)
                         if num_keep and len(kept) > num_keep:
                             import shutil
 
                             shutil.rmtree(kept.pop(0),
                                           ignore_errors=True)
+                    # remembered across attempts: a gang restart resumes
+                    # from here ("successfully persisted" = written to
+                    # storage when storage is configured, else the last
+                    # checkpoint streamed off the workers)
+                    self._latest_checkpoint = final_checkpoint
+                    self._latest_iteration = r.get("iteration")
             if errors:
+                if retryable:
+                    # hand the failure to fit()'s gang-restart loop with
+                    # per-rank attribution (FailureConfig.max_failures
+                    # != 0 opted into restart-from-checkpoint semantics)
+                    from ray_tpu import exceptions as exc
+
+                    raise exc.TrainWorkerGroupError(errors)
+                first = errors[min(errors)]
                 return Result(
                     metrics=history[-1] if history else {},
                     checkpoint=final_checkpoint,
-                    error=errors[0], metrics_history=history,
+                    error=first, metrics_history=history,
                     path=ckpt_dir)
             if rank0_done:
                 break

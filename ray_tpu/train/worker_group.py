@@ -9,6 +9,7 @@ group.
 from __future__ import annotations
 
 import threading
+import time
 
 import ray_tpu
 from ray_tpu._private import api as _api
@@ -18,6 +19,7 @@ class TrainWorker:
     """Actor body for one training worker."""
 
     def __init__(self, world_rank: int, world_size: int):
+        from ray_tpu._private import fault_injection as _fi
         from ray_tpu.air import session as _session
 
         self.world_rank = world_rank
@@ -25,6 +27,10 @@ class TrainWorker:
         self.session = _session._Session(world_rank, world_size)
         self._thread = None
         self._device_identity = None
+        # tag this process with its gang rank so rank-scoped chaos rules
+        # (e.g. `kill_actor:rank1.next_result:#2`) target exactly one
+        # member deterministically
+        _fi.add_tag(f"rank{world_rank}")
 
     def device_identity(self) -> dict:
         """This worker's device identity (host/pid always; platform and
@@ -72,6 +78,11 @@ class TrainWorker:
     def start_training(self, train_fn, config):
         from ray_tpu.air import session as _session
 
+        if config is not None and "_resume_checkpoint" in config:
+            # gang restart / resume_from_checkpoint: surfaced through
+            # session.get_checkpoint() so the train loop can restore
+            self.session.resume_checkpoint = config.pop(
+                "_resume_checkpoint")
         _session._set_session(self.session)
 
         def _run():
@@ -98,7 +109,7 @@ class TrainWorker:
         spurious (advisor finding on the old hard 300s deadline)."""
         import queue as _q
 
-        waited_dead = 0.0
+        dead_deadline = None
         while True:
             try:
                 row = self.session.results.get(timeout=0.1)
@@ -110,8 +121,14 @@ class TrainWorker:
                             "error": err if err is None else
                             _stringify_error(err)}
                 if self._thread is None or not self._thread.is_alive():
-                    waited_dead += 0.1
-                    if waited_dead >= timeout:
+                    # measure against a monotonic deadline: counting 0.1s
+                    # per Empty undercounts under load (each get() may
+                    # block longer than its timeout), letting the
+                    # deadline drift arbitrarily late
+                    now = time.monotonic()
+                    if dead_deadline is None:
+                        dead_deadline = now + timeout
+                    elif now >= dead_deadline:
                         raise TimeoutError(
                             "train thread gone without reporting a result")
             else:
@@ -167,6 +184,13 @@ class WorkerGroup:
             kwargs = {
                 "num_cpus": opts.pop("CPU", 1),
                 "resources": opts or None,
+                # Gang members must NEVER be silently actor-restarted by
+                # the raylet mid-incarnation: a restarted rank has fresh
+                # collective counters and no session state, which
+                # corrupts the group. Restarts are a GANG-level decision
+                # (fit()'s FailureConfig loop tears down and rebuilds
+                # everything from the latest checkpoint).
+                "max_restarts": 0,
             }
             if "TPU" in (resources_per_worker or {}):
                 kwargs["num_tpus"] = resources_per_worker["TPU"]
@@ -188,19 +212,95 @@ class WorkerGroup:
     def __len__(self):
         return len(self.workers)
 
-    def execute(self, method_name: str, *args, timeout=None, **kwargs):
+    # how often a gang-blocking execute consults abort_check while a
+    # ref is still unresolved (the death monitor's fast-fail cadence)
+    ABORT_POLL_S = 1.0
+
+    def execute(self, method_name: str, *args, timeout=None,
+                abort_check=None, **kwargs):
+        """Run one method on every worker; results in gang (rank) order.
+
+        Failures are attributed PER RANK: one dead worker no longer
+        poisons the whole gang's result with whichever exception its
+        `get` happened to raise first — every rank's ref is resolved,
+        and the aggregate surfaces as TrainWorkerGroupError carrying
+        {rank: error} plus the subset of ranks whose actor died.
+
+        `abort_check` (optional, () -> {rank: reason}) is polled while a
+        ref is pending: the moment it reports dead ranks the whole call
+        raises, even if the RPC layer never surfaces the death (e.g. a
+        partition where no TCP reset arrives) — this is how the gang
+        death monitor's pubsub knowledge interrupts a blocked gang call
+        within seconds instead of waiting out the transport."""
+        from ray_tpu import exceptions as exc
+
         refs = [getattr(w, method_name).remote(*args, **kwargs)
                 for w in self.workers]
-        return ray_tpu.get(refs, timeout=timeout)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        results: list = [None] * len(refs)
+        errors: dict[int, BaseException] = {}
+        dead: list[int] = []
+
+        def _resolve():
+            for rank, ref in enumerate(refs):
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                try:
+                    results[rank] = ray_tpu.get(ref, timeout=remaining)
+                except (exc.ActorDiedError, exc.ActorUnavailableError,
+                        exc.WorkerCrashedError) as e:
+                    errors[rank] = e
+                    dead.append(rank)
+                except Exception as e:  # noqa: BLE001 — per rank
+                    errors[rank] = e
+
+        if abort_check is None:
+            _resolve()
+        else:
+            # Resolve on a waiter thread so the gang call blocks in ONE
+            # get per rank: re-entering get(timeout=1.0) in a loop would
+            # re-run its store/directory probe rounds (and reset its
+            # poll escalation) every tick for the whole training run.
+            # The main thread polls only in-process state — abort_check
+            # is a lock-guarded dict copy, done.wait a futex.
+            done = threading.Event()
+
+            def _run():
+                try:
+                    _resolve()
+                finally:
+                    done.set()
+
+            # daemon + abandoned on abort: teardown kills the gang's
+            # workers (no_restart), which fails the pending get and
+            # lets the waiter exit
+            threading.Thread(target=_run, daemon=True,
+                             name="gang-execute-waiter").start()
+            while not done.wait(self.ABORT_POLL_S):
+                known = abort_check()
+                if known:
+                    errs = dict(errors)
+                    for r, reason in known.items():
+                        errs.setdefault(
+                            r, exc.ActorDiedError("", str(reason)))
+                    raise exc.TrainWorkerGroupError(
+                        errs, sorted(set(dead) | set(known)))
+        if errors:
+            raise exc.TrainWorkerGroupError(errors, dead)
+        return results
 
     def execute_single(self, rank: int, method_name: str, *args, **kwargs):
         return ray_tpu.get(
             getattr(self.workers[rank], method_name).remote(*args, **kwargs))
 
     def shutdown(self):
+        # no_restart suppresses any raylet-side restart race: a gang
+        # teardown must leave zero members behind to leak stale frames
+        # into the next incarnation
         for w in self.workers:
             try:
-                ray_tpu.kill(w)
+                ray_tpu.kill(w, no_restart=True)
             except Exception:
                 pass
         self.workers = []

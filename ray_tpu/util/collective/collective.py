@@ -37,20 +37,43 @@ from ray_tpu.util.collective import telemetry as _coltel
 
 
 class _Rendezvous:
-    """Named actor backing one collective group: membership exchange only.
+    """Named actor backing one collective group: membership exchange,
+    incarnation epoch minting, and gang fault handling.
 
     Carries no tensor data (round 1's design funnelled all ranks' tensors
-    through this actor; see host_backend.py for why that was replaced)."""
+    through this actor; see host_backend.py for why that was replaced).
 
-    def __init__(self, world_size: int):
+    Fault tolerance (gang FT PR): this actor is the one place that knows
+    the full membership, so it is also the group's failure detector hub —
+    it watches the GCS actor-death feed for member actors and POISONS the
+    group on a death: every member's worker runtime gets a `col_poison`
+    push, making pending and future collective takes raise a named
+    CollectiveGroupError (dead rank included) well under the op timeout.
+    It also mints the group's incarnation epoch (time-based, so a rebuilt
+    group under the same name always gets a LARGER one): members stamp it
+    into every col frame/shm notify, and ingest-side fencing rejects
+    stale-epoch traffic from a dead incarnation."""
+
+    def __init__(self, world_size: int, group_name: str = ""):
+        import time as _time
+
         from ray_tpu.util.collective.telemetry import (
             GroupTimingAggregator,
         )
 
         self.world_size = world_size
+        self.group_name = group_name
         self._cond = threading.Condition()
         self._members: dict[int, tuple] = {}
+        self._actor_ids: dict[int, bytes] = {}
         self._epoch = 0
+        # monotonic across incarnations: a rebuilt group's rendezvous
+        # actor mints a strictly larger base than any predecessor's, so
+        # epoch comparisons order incarnations correctly
+        self._incarnation = _time.time_ns()
+        self._poisoned: tuple | None = None   # (dead_ranks, reason)
+        self._watch = None                    # ActorDeathWatch | ()
+        self._watch_lock = threading.Lock()
         self._coordinator_port = None
         # eager, not lazy: all ranks' first timing flushes land ~one
         # flush interval after the group's first op, on CONCURRENT
@@ -58,6 +81,109 @@ class _Rendezvous:
         # here would let two threads build rival aggregators and lose
         # one side's records
         self._timing_agg = GroupTimingAggregator(world_size)
+
+    def current_epoch(self) -> int:
+        return self._incarnation + self._epoch
+
+    # ------------------------------------------------------ fault handling
+
+    def _ensure_death_watch(self):
+        """Subscribe (once) to the GCS actor-lifecycle feed and poison
+        the group when a member actor dies or is restarted out from
+        under it. Config kill-switch: collective_death_poisoning
+        (RAY_TPU_COLLECTIVE_DEATH_POISONING=0) falls back to op-timeout
+        detection only."""
+        if self._watch is not None:
+            return
+        with self._watch_lock:
+            # every rank's join() races here at group creation (the actor
+            # runs with max_concurrency > 1); unguarded, each loser of the
+            # check-then-act leaks a GCS subscription + poll thread
+            if self._watch is not None:
+                return
+            from ray_tpu._private.config import get_config
+
+            if not get_config("collective_death_poisoning"):
+                self._watch = ()
+                return
+            try:
+                from ray_tpu._private.pubsub import watch_actor_deaths
+
+                self._watch = watch_actor_deaths(self._on_member_death) or ()
+            except Exception:
+                self._watch = ()   # detection degraded to the op timeout
+
+    def _on_member_death(self, actor_id, reason: str):
+        with self._cond:
+            dead = [r for r, a in self._actor_ids.items() if a == actor_id]
+        if dead:
+            self.poison(dead, f"member actor died ({reason})")
+
+    def poison(self, dead_ranks, reason: str, epoch: int | None = None):
+        """Poison the group: push col_poison to every surviving member's
+        worker runtime (their pending col_take calls raise immediately).
+        Called by the death watcher, or remotely by a member that
+        directly observed a peer connection drop. Idempotent — the first
+        record (naming the original culprit) wins. `epoch` guards a late
+        report from a previous incarnation of a REBUILT group: stale
+        reports are ignored."""
+        with self._cond:
+            # the staleness guard must share the lock with join()'s
+            # incarnation reset: checked outside, a late report from the
+            # dead incarnation could pass the guard, lose the race to a
+            # concurrent rebuild, and poison the healthy successor gang.
+            # Judge against _incarnation ALONE: a member holds the
+            # current_epoch() of its join (>= _incarnation), but the
+            # membership _epoch counter can bump after formation (rank
+            # restart under a new addr) — comparing against the sum
+            # would silently reject every existing member's live report
+            if epoch is not None and epoch < self._incarnation:
+                return False
+            if self._poisoned is not None:
+                return False
+            dead_set = tuple(sorted(dead_ranks))
+            self._poisoned = (dead_set, str(reason))
+            members = dict(self._members)
+            cur = self.current_epoch()
+            self._cond.notify_all()   # wake blocked joiners
+        # read the locals from here on: a concurrent rebuild's join()
+        # may clear self._poisoned the moment the lock is released
+        from ray_tpu._private import events as _events
+        from ray_tpu._private.protocol import RpcClient
+
+        _events.record("COLLECTIVE_GROUP_POISONED",
+                       group=self.group_name,
+                       dead_ranks=list(dead_set), reason=reason)
+        survivors = []
+
+        def _push(addr):
+            try:
+                c = RpcClient(tuple(addr), timeout=5.0)
+                try:
+                    c.push("col_poison", group=self.group_name,
+                           dead_ranks=list(dead_set),
+                           reason=str(reason), epoch=cur)
+                finally:
+                    c.close()
+            except Exception:
+                pass   # dead/unreachable member: its takes time out
+        # fan out concurrently: a SECOND unreachable member's connect
+        # retries must not stall the fast-path poison for the remaining
+        # survivors (they'd keep blocking in col_take meanwhile)
+        for rank, addr in members.items():
+            if rank in dead_set:
+                continue
+            t = threading.Thread(target=_push, args=(addr,), daemon=True,
+                                 name="col-poison-fanout")
+            t.start()
+            survivors.append(t)
+        for t in survivors:
+            t.join(timeout=6.0)
+        return True
+
+    def poisoned(self):
+        with self._cond:
+            return self._poisoned
 
     def report_timings(self, records: list):
         """Rank-timing ingest (fire-and-forget from members' flush
@@ -70,13 +196,44 @@ class _Rendezvous:
         return True
 
     def join(self, rank: int, addr, timeout: float = 300.0,
-             coordinator_port: int | None = None):
+             coordinator_port: int | None = None,
+             actor_id: bytes | None = None):
         """Register and block until the full membership is present.
-        Returns (members, coordinator_addr)."""
+        Returns (members, coordinator_addr, incarnation_epoch)."""
         import time as _time
 
+        self._ensure_death_watch()
         deadline = _time.time() + timeout
         with self._cond:
+            if self._poisoned is not None:
+                if self._members.get(rank) == tuple(addr):
+                    # a member of the DOOMED incarnation itself (e.g. a
+                    # survivor's lazy p2p join re-presenting the exact
+                    # (rank, addr) it registered at group creation):
+                    # fail fast with the poison record — resetting here
+                    # would erase state surviving ranks still depend on
+                    # and strand this joiner waiting for peers that are
+                    # never coming
+                    from ray_tpu import exceptions as _exc
+
+                    raise _exc.CollectiveGroupError(
+                        self.group_name, self._poisoned[0],
+                        self._poisoned[1])
+                # Unknown (rank, addr): a rebuilt gang under the same
+                # name whose destroy never ran (e.g. every member died
+                # at once, so no surviving worker could kill this
+                # actor — rebuilt workers are new processes on new
+                # ports). Every joiner PENDING at poison time was
+                # already woken and failed (in-wait check below), so
+                # reset to a fresh incarnation instead of bricking the
+                # group name until max_failures exhausts.
+                self._poisoned = None
+                self._members = {}
+                self._actor_ids = {}
+                self._epoch += 1
+                self._incarnation = _time.time_ns()
+            if actor_id is not None:
+                self._actor_ids[rank] = actor_id
             if rank in self._members and tuple(addr) != self._members[rank]:
                 # a new worker took this rank (restart): new membership epoch
                 self._epoch += 1
@@ -105,8 +262,15 @@ class _Rendezvous:
                 epoch = self._epoch
                 ok = self._cond.wait_for(
                     lambda: (len(self._members) == self.world_size or
-                             self._epoch != epoch),
+                             self._epoch != epoch or
+                             self._poisoned is not None),
                     timeout=max(0.0, deadline - _time.time()))
+                if self._poisoned is not None:
+                    from ray_tpu import exceptions as _exc
+
+                    raise _exc.CollectiveGroupError(
+                        self.group_name, self._poisoned[0],
+                        self._poisoned[1])
                 if not ok:
                     raise TimeoutError(
                         f"collective group rendezvous timed out with "
@@ -115,17 +279,21 @@ class _Rendezvous:
                         len(self._members) == self.world_size:
                     break
             host = self._members[0][0]
-            return dict(self._members), f"{host}:{self._coordinator_port}"
+            return (dict(self._members),
+                    f"{host}:{self._coordinator_port}",
+                    self.current_epoch())
 
 
 class _GroupState:
-    def __init__(self, name, world_size, rank, backend, impl, store_handle):
+    def __init__(self, name, world_size, rank, backend, impl, store_handle,
+                 epoch: int = 0):
         self.name = name
         self.world_size = world_size
         self.rank = rank
         self.backend = backend
         self.impl = impl              # HostGroup or XlaGroup
         self.store = store_handle     # rendezvous actor handle
+        self.epoch = epoch            # incarnation epoch (fencing key)
         self.seq = 0
         self.p2p_seq: dict[tuple, int] = {}   # (src,dst) channel counters
         self.lock = threading.Lock()
@@ -169,7 +337,7 @@ class GroupManager:
         handle = store_cls.options(
             name=f"_collective_{group_name}", get_if_exists=True,
             num_cpus=0, max_concurrency=max(world_size + 2, 4),
-        ).remote(world_size)
+        ).remote(world_size, group_name)
         coord_port = None
         if rank == 0 and backend == "xla":
             import socket
@@ -178,9 +346,14 @@ class GroupManager:
             probe.bind(("0.0.0.0", 0))
             coord_port = probe.getsockname()[1]
             probe.close()
-        members, coordinator = ray_tpu.get(
+        members, coordinator, epoch = ray_tpu.get(
             handle.join.remote(rank, worker.addr,
-                               coordinator_port=coord_port), timeout=330.0)
+                               coordinator_port=coord_port,
+                               actor_id=worker.actor_id), timeout=330.0)
+        # arm ingest-side fencing BEFORE any peer can push: frames/shm
+        # notifies stamped with an older incarnation's epoch are rejected
+        # from here on, and the dead incarnation's strays are swept
+        worker.col_set_epoch(group_name, epoch)
 
         if backend == "xla":
             from ray_tpu.util.collective.xla_backend import XlaGroup
@@ -189,9 +362,10 @@ class GroupManager:
         else:
             from ray_tpu.util.collective.host_backend import HostGroup
 
-            impl = HostGroup(group_name, world_size, rank, members)
+            impl = HostGroup(group_name, world_size, rank, members,
+                             epoch=epoch, rendezvous=handle)
         state = _GroupState(group_name, world_size, rank, backend, impl,
-                            handle)
+                            handle, epoch)
         with self._lock:
             self._groups[group_name] = state
         return state
@@ -213,6 +387,16 @@ class GroupManager:
             state.impl.close()
         except Exception:
             pass
+        # the lazily-built p2p HostGroup (xla groups route send/recv
+        # through it) holds its own peer clients — with death-poisoning
+        # on_close handlers attached, leaking them would let a LATER
+        # peer exit poison a healthy successor group under this name
+        host_p2p = getattr(state, "_host_p2p", None)
+        if host_p2p is not None:
+            try:
+                host_p2p.close()
+            except Exception:
+                pass
         # purge this process's mailbox of the dead incarnation's
         # messages: a payload that landed after an op timeout would
         # otherwise masquerade as a NEWER seq to a re-created group
@@ -427,9 +611,10 @@ def _p2p(g: _GroupState):
     if host is None:
         from ray_tpu.util.collective.host_backend import HostGroup
 
-        members, _ = ray_tpu.get(g.store.join.remote(
+        members, _, epoch = ray_tpu.get(g.store.join.remote(
             g.rank, _current_addr()), timeout=330.0)
-        host = HostGroup(g.name, g.world_size, g.rank, members)
+        host = HostGroup(g.name, g.world_size, g.rank, members,
+                         epoch=epoch, rendezvous=g.store)
         g._host_p2p = host
     return host
 

@@ -44,14 +44,18 @@ commutative-exact ops, within rounding for floats.
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
+from ray_tpu import exceptions as exc
 from ray_tpu._private import protocol as _protocol
 from ray_tpu._private import serialization as ser
 from ray_tpu._private import telemetry as _tm
-from ray_tpu._private.protocol import PyRpcClient, RpcClient
-from ray_tpu._private.worker_runtime import (ColShmRef, col_oid_prefix,
-                                             current_worker)
+from ray_tpu._private.protocol import (ConnectionLost, PyRpcClient,
+                                       RpcClient)
+from ray_tpu._private.worker_runtime import (ColShmRef, col_epoch_tag,
+                                             col_oid_prefix, current_worker)
 
 _OPS = {
     "sum": np.add,
@@ -133,17 +137,27 @@ class HostGroup:
     """This process's membership in one collective group."""
 
     def __init__(self, name: str, world_size: int, rank: int,
-                 members: dict[int, tuple]):
+                 members: dict[int, tuple], epoch: int = 0,
+                 rendezvous=None):
         self.name = name
         self.world_size = world_size
         self.rank = rank
+        # Incarnation epoch (minted by the rendezvous actor at group
+        # creation): stamped into every col frame key and shm object id
+        # so a REBUILT gang under the same name rejects stale traffic
+        # from this one at ingest (worker_runtime.col_push_local).
+        self.epoch = int(epoch)
+        # rendezvous actor handle (None for bare unit-test groups): the
+        # gang-wide poison fan-out rides it when this rank directly
+        # observes a peer's death (connection loss)
+        self._rendezvous = rendezvous
         self.members = {int(r): tuple(a) for r, a in members.items()}
         self._clients: dict[int, RpcClient] = {}
         self._client_mode: dict[int, bool] = {}    # rank -> built-for-
                                                    # pipelined?
         self._peer_nodes: dict[int, object] = {}   # rank -> node_id |
                                                    # (None, retry_at)
-        self._oid_prefix = col_oid_prefix(name)
+        self._oid_prefix = col_oid_prefix(name) + col_epoch_tag(self.epoch)
         self._seg_count = 0
         self._worker = current_worker()
         if self._worker is None:
@@ -163,6 +177,83 @@ class HostGroup:
         from ray_tpu._private.config import get_config
 
         return bool(get_config("collective_pipeline"))
+
+    @staticmethod
+    def _death_poisoning() -> bool:
+        from ray_tpu._private.config import get_config
+
+        return bool(get_config("collective_death_poisoning"))
+
+    def _full_key(self, key: tuple, src: int) -> tuple:
+        """(group, epoch, *op-key, src): every message is fenced by the
+        incarnation epoch right after the group name."""
+        return (self.name, self.epoch) + key + (src,)
+
+    def _conn_dropped(self, rank: int, addr):
+        """PyRpcClient on_close hook: the connection to `rank` died with
+        no send in flight (in-flight failures raise ConnectionLost at
+        the call site and go straight to _raise_peer_lost). Probe before
+        poisoning: an idle drop whose peer still accepts connections
+        (peer-side server hiccup, OS reaping an idle socket across a
+        minutes-long compile/eval gap) must self-heal through _client's
+        closed-client rebuild path — poisoning would convert a transport
+        blip into a full gang checkpoint-restore restart and burn a
+        FailureConfig.max_failures token. A genuinely dead peer refuses
+        the probe within a connect round trip, keeping the fast path."""
+        def _probe():
+            import socket as _socket
+
+            try:
+                s = _socket.create_connection(tuple(addr)[:2], timeout=2.0)
+                s.close()
+                return   # peer alive: benign drop, client rebuilds lazily
+            except OSError:
+                pass
+            self._peer_lost(rank)
+
+        threading.Thread(target=_probe, daemon=True,
+                         name="col-conn-probe").start()
+
+    def _peer_lost(self, rank: int, cause: str = "connection lost"):
+        """A peer's death was directly observed (its connection dropped
+        or a send to it failed). Poison this process's view of the group
+        so every pending/future take fails fast, fan the poison out
+        gang-wide through the rendezvous actor (off-thread: this may run
+        on a transport reader thread), and return the error to raise.
+        Returns None when the death-poisoning kill switch is off —
+        callers re-raise the original transport error, so
+        RAY_TPU_COLLECTIVE_DEATH_POISONING=0 restores the legacy
+        ConnectionLost/timeout contract exactly."""
+        if not self._death_poisoning():
+            return None
+        reason = f"rank {rank} {cause}"
+        err = exc.CollectiveGroupError(self.name, (rank,), reason)
+        try:
+            first = self._worker.col_poison_local(self.name, (rank,),
+                                                  reason, epoch=self.epoch)
+        except Exception:
+            return err
+        if first and self._rendezvous is not None:
+            rdv, epoch = self._rendezvous, self.epoch
+
+            def _notify():
+                try:
+                    rdv.poison.remote([rank], reason, epoch)
+                except Exception:
+                    pass
+
+            threading.Thread(target=_notify, daemon=True,
+                             name="col-poison-notify").start()
+        return err
+
+    def _raise_peer_lost(self, rank: int, e: BaseException, cause: str):
+        """Raise for a transport failure talking to `rank`: the
+        poison-path CollectiveGroupError, or — when the kill switch has
+        death-poisoning off — the original transport error unchanged."""
+        err = self._peer_lost(rank, cause)
+        if err is None:
+            raise e
+        raise err from e
 
     def _segment_elems(self, itemsize: int) -> int:
         from ray_tpu._private.config import get_config
@@ -203,31 +294,55 @@ class HostGroup:
             # its shm-eligibility verdict must be re-learned too
             self._peer_nodes.pop(rank, None)
         if c is None:
-            cls = PyRpcClient if want_py else RpcClient
-            c = cls(addr, timeout=self._op_timeout())
+            try:
+                if want_py:
+                    # on_close fires only on connection LOSS (deliberate
+                    # close() suppresses it): a dead peer poisons the
+                    # group within TCP-reset + liveness-probe latency,
+                    # not the op timeout — the NCCL-watchdog-beating
+                    # fast path (the probe keeps an idle drop of a LIVE
+                    # peer from gang-restarting the run)
+                    c = PyRpcClient(
+                        addr, timeout=self._op_timeout(),
+                        on_close=(lambda r=rank, a=addr:
+                                  self._conn_dropped(r, a))
+                        if self._death_poisoning() else None)
+                else:
+                    c = RpcClient(addr, timeout=self._op_timeout())
+            except ConnectionLost as e:
+                self._raise_peer_lost(rank, e, f"unreachable: {e}")
             self._clients[rank] = c
             self._client_mode[rank] = want_py
         return c
 
     def _send(self, dst: int, key: tuple, payload):
-        full_key = (self.name,) + key + (self.rank,)
+        full_key = self._full_key(key, self.rank)
         if dst == self.rank:
             self._worker.col_push_local(full_key, payload)
-        elif self._pipelined():
-            self._seg_count += 1
-            self._client(dst).push_parts(
-                "col_push_frame", {"key": full_key},
-                ser.serialize_parts(payload), pool=self.name)
-        else:
-            self._client(dst).call("col_push", key=full_key, data=payload)
+            return
+        try:
+            if self._pipelined():
+                self._seg_count += 1
+                self._client(dst).push_parts(
+                    "col_push_frame", {"key": full_key},
+                    ser.serialize_parts(payload), pool=self.name)
+            else:
+                self._client(dst).call("col_push", key=full_key,
+                                       data=payload)
+        except ConnectionLost as e:
+            self._raise_peer_lost(dst, e, f"send failed: {e}")
 
     def _push_frame(self, dst: int, key: tuple, parts):
         """One-way pre-framed send (hot path: ring segments, forwarded
         frames). `parts` is a serialize_parts list or [frame_view]."""
-        full_key = (self.name,) + key + (self.rank,)
+        full_key = self._full_key(key, self.rank)
         self._seg_count += 1
-        self._client(dst).push_parts("col_push_frame", {"key": full_key},
-                                     parts, pool=self.name)
+        try:
+            self._client(dst).push_parts("col_push_frame",
+                                         {"key": full_key},
+                                         parts, pool=self.name)
+        except ConnectionLost as e:
+            self._raise_peer_lost(dst, e, f"send failed: {e}")
 
     def _shm_ok(self, dst: int) -> bool:
         """Segments to `dst` may ride the node's shm store: enabled, and
@@ -264,22 +379,28 @@ class HostGroup:
         parts = ser.serialize_parts(seg)
         if ser.parts_size(parts) >= self._SHM_MIN_BYTES \
                 and self._shm_ok(dst):
-            full_key = (self.name,) + key + (self.rank,)
-            # group-tag(6) + rank(2) + process counter(8) — unique
-            # across ranks (rank byte-pair) and ops (worker id mint; no
-            # per-segment urandom syscall), and the tag lets group
-            # destroy sweep stranded segments whose notify never
-            # arrived (worker_runtime.col_purge)
+            full_key = self._full_key(key, self.rank)
+            # group-tag(6) + epoch(4) + rank(2) + process counter(4) —
+            # exactly the store's 16-byte id, unique across ranks (rank
+            # byte-pair) and ops (low 4 counter bytes of the worker id
+            # mint; no per-segment urandom syscall); the group tag lets
+            # destroy sweep stranded segments whose notify never arrived
+            # (worker_runtime.col_purge) and the epoch tag lets a rebuilt
+            # gang sweep the DEAD incarnation's strays without touching
+            # its own in-flight segments (col_set_epoch)
             oid = self._oid_prefix + self.rank.to_bytes(2, "big") \
-                + self._worker._new_id()[8:]
+                + self._worker._new_id()[12:]
             try:
                 nbytes = self._worker.store.put_ephemeral(oid, parts)
             except Exception:
                 pass   # store full/unavailable: socket fallback below
             else:
                 self._seg_count += 1
-                self._client(dst).push("col_push_shm", key=full_key,
-                                       oid=oid, nbytes=nbytes)
+                try:
+                    self._client(dst).push("col_push_shm", key=full_key,
+                                           oid=oid, nbytes=nbytes)
+                except ConnectionLost as e:
+                    self._raise_peer_lost(dst, e, f"send failed: {e}")
                 return
         self._push_frame(dst, key, parts)
 
@@ -289,26 +410,32 @@ class HostGroup:
         (zero copy; the LAST hop deletes the object), anything else
         re-sends the received bytes. Consumes (releases) the frame."""
         if isinstance(frame, _ShmFrame) and self._shm_ok(dst):
-            full_key = (self.name,) + key + (self.rank,)
+            full_key = self._full_key(key, self.rank)
             self._seg_count += 1
-            self._client(dst).push("col_push_shm", key=full_key,
-                                   oid=frame.oid, nbytes=frame.nbytes)
+            try:
+                self._client(dst).push("col_push_shm", key=full_key,
+                                       oid=frame.oid, nbytes=frame.nbytes)
+            except ConnectionLost as e:
+                self._raise_peer_lost(dst, e, f"send failed: {e}")
             frame.release(delete=False)
             return
         self._push_frame(dst, key, [frame.view])
         frame.release()
 
     def _take(self, src: int, key: tuple, timeout: float | None = None):
-        # Timeout doubles as the failure detector (the NCCL-watchdog
-        # analog): a dead member — or a dropped one-way frame — makes the
-        # op raise instead of hanging forever.
-        # seq_pos=2: every op keys as (group, phase, seq, *step, src), so
-        # the receiver validates the peer's op sequence and raises a
-        # CollectiveSeqMismatchError on desync instead of hanging.
+        # Timeout is the failure detector of last resort (the
+        # NCCL-watchdog analog): a dropped one-way frame makes the op
+        # raise instead of hanging forever; a DEAD member usually beats
+        # it by poisoning the group (col_take raises
+        # CollectiveGroupError the moment the poison lands).
+        # seq_pos=3: every op keys as (group, epoch, phase, seq, *step,
+        # src), so the receiver validates the peer's op sequence and
+        # raises a CollectiveSeqMismatchError on desync instead of
+        # hanging.
         if timeout is None:
             timeout = self._op_timeout()
-        return self._worker.col_take((self.name,) + key + (src,),
-                                     timeout=timeout, seq_pos=2)
+        return self._worker.col_take(self._full_key(key, src),
+                                     timeout=timeout, seq_pos=3)
 
     def _recv_view(self, src: int, key: tuple,
                    timeout: float | None = None):

@@ -56,7 +56,9 @@ namespace {
 // Must match PROTOCOL_VERSION in ray_tpu/_private/protocol.py.
 // v3: PUSH_OOB frames (kind 3, out-of-band payload layout) — a v2
 // receiver would misparse the head-prefixed body as pickle.
-constexpr int kProtocolVersion = 3;
+// v4: collective incarnation epochs (epoch slot in col frame keys and
+// shm oid layout) — a v3 peer's frames never match a v4 mailbox key.
+constexpr int kProtocolVersion = 4;
 
 constexpr int kReq = 0;
 constexpr int kReply = 1;

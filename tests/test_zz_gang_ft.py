@@ -1,0 +1,486 @@
+"""Elastic gang fault tolerance (late-alphabet; sequenced after the
+tier-1 timeout horizon by design).
+
+Covers the gang-FT tentpole end to end: deterministic rank death via the
+fault plane's `kill_actor` action (seeded + schedule-driven, reproducible
+from the RAY_TPU_FAULT_SEED/RAY_TPU_FAULT_SCHEDULE pair), fast detection
+(`TrainWorkerGroupError` with per-rank attribution instead of a hang),
+collective group poisoning (surviving ranks raise a named
+`CollectiveGroupError` well under the op timeout), incarnation-epoch
+fencing (stale frames rejected at ingest, dead epochs' stranded shm
+segments swept at rejoin), and `fit()`'s checkpoint-resume gang restart
+loop under `FailureConfig.max_failures`.
+
+The chaos-marked tests set the fault env BEFORE `ray_tpu.init` so every
+spawned worker process inherits the schedule; rank scoping rides the
+`rank<N>` process tags train workers add at construction.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = []
+
+GROUP = "gang_ft_dp"
+STEPS = 4
+
+
+# ------------------------------------------------------------- pure units
+
+def test_kill_actor_schedule_parsing():
+    from ray_tpu._private.fault_injection import (ACTIONS, _REPLY_ACTIONS,
+                                                  FaultInjector)
+
+    assert "kill_actor" in ACTIONS
+    assert "kill_actor" in _REPLY_ACTIONS
+    inj = FaultInjector(7, "kill_actor:rank1.next_result:#2")
+    [rule] = inj._reply_rules
+    assert rule.action == "kill_actor"
+    assert rule.role == "rank1"
+    assert rule.method == "next_result"
+    assert inj._send_rules == []
+    with pytest.raises(ValueError):
+        FaultInjector(0, "explode:*.foo:p1.0")
+
+
+def test_tag_scope_matching():
+    from ray_tpu._private import fault_injection as fi
+    from ray_tpu._private.fault_injection import FaultInjector
+
+    inj = FaultInjector(0, "kill_actor:rank3.next_result:#1")
+    [rule] = inj._reply_rules
+    # scope is neither this process's role nor a tag: no match
+    assert not rule.matches_scope("worker", "next_result")
+    # the gang-rank tag is what makes the rule land on one member
+    assert rule.matches_scope("worker", "next_result",
+                              frozenset({"rank3"}))
+    assert not rule.matches_scope("worker", "other_method",
+                                  frozenset({"rank3"}))
+    fi.add_tag("zz_gang_ft_test_tag")
+    assert "zz_gang_ft_test_tag" in fi.get_tags()
+
+
+def test_gang_exceptions_pickle_roundtrip():
+    import pickle
+
+    from ray_tpu.exceptions import (CollectiveGroupError,
+                                    TrainWorkerGroupError)
+
+    e = pickle.loads(pickle.dumps(
+        CollectiveGroupError("g", (2, 0), "rank 2 died")))
+    assert e.group == "g" and e.dead_ranks == (0, 2)
+    assert "rank 2 died" in str(e)
+
+    class Unpicklable(Exception):
+        def __reduce__(self):
+            raise TypeError("nope")
+
+    t = TrainWorkerGroupError({0: "boom", 1: Unpicklable("x")},
+                              dead_ranks=(1,))
+    t2 = pickle.loads(pickle.dumps(t))   # degrades rank 1's cause to str
+    assert t2.dead_ranks == (1,)
+    assert t2.errors[0] == "boom"
+    assert "Unpicklable" in str(t2.errors[1])
+
+
+def test_next_result_monotonic_deadline():
+    """`waited_dead` used to accrue 0.1s per Empty regardless of how long
+    the get actually blocked, so a loaded box drifted the dead-thread
+    deadline arbitrarily late. The wait is now measured against a
+    monotonic deadline: with each get() blocking 3.5x its nominal poll
+    interval, the timeout still lands ~on time (the old counter would
+    take ~3.5x the budget)."""
+    import queue
+
+    from ray_tpu.train.worker_group import TrainWorker
+
+    class SlowEmptyQueue:
+        def get(self, timeout=None):
+            time.sleep(0.35)           # "under load": poll overruns
+            raise queue.Empty
+
+        def empty(self):
+            return True
+
+    w = TrainWorker(0, 1)
+    w.session.results = SlowEmptyQueue()   # no train thread started
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        w.next_result(timeout=0.7)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.8, f"deadline drifted: {elapsed:.2f}s for 0.7s"
+
+
+# ---------------------------------------------------- per-rank attribution
+
+def test_worker_group_execute_per_rank_attribution(ray_start_regular):
+    """One failing rank must not poison the whole gang result with a
+    generic error: execute resolves every rank's ref and surfaces a
+    TrainWorkerGroupError mapping world rank -> that rank's exception."""
+    from ray_tpu.exceptions import TrainWorkerGroupError
+    from ray_tpu.train import WorkerGroup
+
+    def setup(rank, world):
+        if rank == 1:
+            raise RuntimeError(f"rank {rank} exploded")
+        return rank * 10
+
+    wg = WorkerGroup(3, {"CPU": 1})
+    try:
+        with pytest.raises(TrainWorkerGroupError) as ei:
+            wg.execute("run_setup", (setup, (), {}))
+        err = ei.value
+        assert set(err.errors) == {1}
+        assert "rank 1 exploded" in str(err.errors[1])
+        assert err.dead_ranks == ()        # raised, not died
+        # healthy ranks answer normally once the culprit is gone
+        assert wg.execute("run_setup",
+                          ((lambda r, w: r), (), {})) == [0, 1, 2]
+    finally:
+        wg.shutdown()
+
+
+def test_execute_abort_check_interrupts_blocked_call(ray_start_regular):
+    """The death monitor's knowledge interrupts a BLOCKED gang call:
+    `abort_check` is polled while refs are pending, so a death the
+    transport never surfaces (e.g. a partition with no TCP reset) still
+    fails the gang within the poll cadence — not the worker-side call's
+    own multi-minute budget."""
+    from ray_tpu.exceptions import TrainWorkerGroupError
+    from ray_tpu.train import WorkerGroup
+
+    wg = WorkerGroup(1, {"CPU": 1})
+    try:
+        # next_result blocks worker-side (~300s default: no train thread)
+        t0 = time.monotonic()
+        with pytest.raises(TrainWorkerGroupError) as ei:
+            wg.execute("next_result", timeout=60.0,
+                       abort_check=lambda: {0: "node lost"})
+        assert time.monotonic() - t0 < 30
+        assert 0 in ei.value.dead_ranks
+        assert "node lost" in str(ei.value.errors[0])
+    finally:
+        wg.shutdown()
+
+
+def test_fit_retries_then_reraises_on_exhaustion(ray_start_regular):
+    """fit() honors FailureConfig.max_failures: a deterministic rank-1
+    failure is retried (gang teardown + rebuild) exactly max_failures
+    times, then the last TrainWorkerGroupError is re-raised with the
+    culprit rank attributed. GANG_FAILED / train_gang_retry /
+    GANG_RESTARTED cluster events trace each attempt."""
+    from ray_tpu._private import events
+    from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.exceptions import TrainWorkerGroupError
+    from ray_tpu.train import JaxTrainer
+
+    def bad_on_rank1(config):
+        from ray_tpu.air import session
+
+        if session.get_world_rank() == 1:
+            raise RuntimeError("chip fell out")
+        session.report({"ok": 1})
+
+    def count(kind):
+        return sum(1 for e in events.snapshot() if e["kind"] == kind)
+
+    base_failed = count("GANG_FAILED")
+    base_restarted = count("GANG_RESTARTED")
+    trainer = JaxTrainer(
+        bad_on_rank1,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=1)),
+    )
+    with pytest.raises(TrainWorkerGroupError) as ei:
+        trainer.fit()
+    assert "chip fell out" in str(ei.value)
+    assert 1 in ei.value.errors
+    assert count("GANG_FAILED") - base_failed == 2      # both attempts
+    assert count("GANG_RESTARTED") - base_restarted == 1
+
+
+def test_fit_max_failures_zero_keeps_result_semantics(ray_start_regular):
+    """max_failures=0 (the default) opts out of gang restarts entirely:
+    a worker failure comes back as Result.error, exactly the pre-FT
+    contract."""
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train import JaxTrainer
+
+    def bad_loop(config):
+        raise RuntimeError("train exploded")
+
+    result = JaxTrainer(
+        bad_loop,
+        scaling_config=ScalingConfig(num_workers=1)).fit()
+    assert result.error is not None
+    assert "train exploded" in str(result.error)
+
+
+# --------------------------------------------------------------- chaos E2E
+
+@pytest.fixture
+def ray_chaos_env():
+    """ray_start_regular, plus a seeded fault schedule exported BEFORE
+    init so every spawned cluster process inherits the fault plane."""
+    import ray_tpu
+
+    started = []
+
+    def _start(seed, schedule):
+        os.environ["RAY_TPU_FAULT_SEED"] = str(seed)
+        os.environ["RAY_TPU_FAULT_SCHEDULE"] = schedule
+        ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+        started.append(True)
+        return ray_tpu
+
+    yield _start
+    if started:
+        ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_FAULT_SEED", None)
+    os.environ.pop("RAY_TPU_FAULT_SCHEDULE", None)
+
+
+def _resumable_loop(config):
+    from ray_tpu.air import Checkpoint, session
+    from ray_tpu.util import collective as col
+
+    start, total = 0, 0.0
+    ckpt = session.get_checkpoint()
+    if ckpt is not None:
+        state = ckpt.to_dict()
+        start = int(state["step"]) + 1
+        total = float(state["total"])
+    rank = session.get_world_rank()
+    for step in range(start, STEPS):
+        contrib = np.full(2, float((step + 1) * (rank + 1)))
+        s = col.allreduce(contrib, GROUP)
+        total += float(s[0])
+        session.report({"step": step, "total": total},
+                       checkpoint=Checkpoint.from_dict(
+                           {"step": step, "total": total}))
+
+
+@pytest.mark.chaos
+@pytest.mark.fault_injection
+def test_rank_death_checkpoint_resume(ray_chaos_env, tmp_path):
+    """The tentpole, end to end and fully deterministic: rank 1's worker
+    process is killed (os._exit via the seeded `kill_actor` schedule)
+    while serving its 4th next_result — i.e. mid-training, after three
+    checkpointed steps. The death must surface fast as a gang failure
+    (no hang), fit() must tear down + rebuild the gang exactly once, and
+    the resumed attempt must continue FROM THE CHECKPOINT (not step 0)
+    to the bit-correct final total."""
+    from ray_tpu._private import events
+    from ray_tpu._private import telemetry as tm
+    from ray_tpu.air.config import (CheckpointConfig, FailureConfig,
+                                    RunConfig, ScalingConfig)
+    from ray_tpu.train import JaxTrainer
+    from ray_tpu.train.backend_executor import JaxConfig
+
+    ray = ray_chaos_env(7, "kill_actor:rank1.next_result:#4")
+
+    def count(kind):
+        return sum(1 for e in events.snapshot() if e["kind"] == kind)
+
+    def restarts_metric():
+        m = tm._metrics.get("ray_tpu_train_gang_restarts_total")
+        if m is None:
+            return 0.0
+        return sum(v["value"] for v in m.snapshot()["values"]
+                   if v["tags"].get("group") == GROUP)
+
+    base_failed = count("GANG_FAILED")
+    base_restarted = count("GANG_RESTARTED")
+    base_metric = restarts_metric()
+    t0 = time.monotonic()
+    result = JaxTrainer(
+        _resumable_loop,
+        backend_config=JaxConfig(group_name=GROUP),
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(
+            name="gang_ft", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2),
+            checkpoint_config=CheckpointConfig(num_to_keep=2)),
+    ).fit()
+    elapsed = time.monotonic() - t0
+    # detection + teardown + rebuild + resume — nowhere near the 300s
+    # collective op timeout a hang would burn
+    assert elapsed < 120, f"gang restart took {elapsed:.0f}s"
+    assert result.error is None, result.error
+    # oracle: step s contributes (s+1)*(1+2) summed over all STEPS
+    oracle = 3.0 * STEPS * (STEPS + 1) / 2
+    assert result.metrics["total"] == oracle
+    assert result.metrics["step"] == STEPS - 1
+    # resumed from the step-2 checkpoint: the final attempt replayed
+    # only the remaining step(s), not the whole run
+    assert len(result.metrics_history) < STEPS
+    assert count("GANG_FAILED") - base_failed == 1
+    assert count("GANG_RESTARTED") - base_restarted == 1
+    assert restarts_metric() - base_metric == 1.0
+    # num_to_keep survives the restart: the resumed attempt's pruning
+    # window is re-seeded from disk, so the failed attempt's dirs still
+    # count against the budget instead of being stranded forever
+    dirs = [d for d in os.listdir(tmp_path / "gang_ft")
+            if d.startswith("checkpoint_")]
+    assert len(dirs) <= 2, dirs
+
+
+def _rank_cls(ray):
+    @ray.remote
+    class Rank:
+        def configure(self, env):
+            os.environ.update({k: str(v) for k, v in env.items()})
+            return True
+
+        def join(self, world, rank, name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, "host", name)
+            return rank
+
+        def epoch(self, name):
+            from ray_tpu.util.collective.collective import _manager
+
+            return _manager.get(name).epoch
+
+        def allreduce(self, arr, name):
+            from ray_tpu.util import collective as col
+
+            return col.allreduce(arr, name)
+
+        def destroy(self, name):
+            from ray_tpu.util import collective as col
+
+            col.destroy_collective_group(name)
+            return True
+
+        def inject_stale_frame(self, name, old_epoch, payload):
+            """A late frame from a dead incarnation arrives after the
+            group was rebuilt under the same name."""
+            from ray_tpu._private.worker_runtime import current_worker
+
+            w = current_worker()
+            # key shape: (group, epoch, phase, seq, *step, src)
+            w.col_push_local((name, old_epoch, "rs", 1, 0, 1), payload)
+            return sorted(str(k) for k in w._col_mailbox
+                          if k[0] == name)
+
+        def stale_counter(self):
+            from ray_tpu._private import telemetry as tm
+
+            m = tm._metrics.get("ray_tpu_collective_stale_epoch_total")
+            if m is None:
+                return 0.0
+            return sum(v["value"] for v in m.snapshot()["values"])
+
+        def plant_stranded_shm(self, name, old_epoch):
+            from ray_tpu._private.worker_runtime import (col_epoch_tag,
+                                                         col_oid_prefix,
+                                                         current_worker)
+
+            w = current_worker()
+            oid = col_oid_prefix(name) + col_epoch_tag(old_epoch) \
+                + (1).to_bytes(2, "big") + b"\x00\x00\x00\x01"
+            w.store.put_ephemeral(oid, [b"x" * 70000])
+            return oid
+
+        def store_has(self, oid):
+            from ray_tpu._private.worker_runtime import current_worker
+
+            return any(o == oid for o, _ in
+                       current_worker().store.list_objects())
+
+    return Rank
+
+
+@pytest.mark.chaos
+def test_surviving_rank_poison_latency(ray_start_regular):
+    """A member death poisons the group: the surviving rank's pending
+    collective op raises a named CollectiveGroupError naming the dead
+    rank — well under the (deliberately huge) op timeout, instead of
+    hanging out the watchdog."""
+    ray = ray_start_regular
+    from ray_tpu.exceptions import CollectiveGroupError
+
+    name = "gft_poison"
+    Rank = _rank_cls(ray)
+    actors = [Rank.options(num_cpus=0).remote() for _ in range(2)]
+    ray.get([a.configure.remote(
+        {"RAY_TPU_COLLECTIVE_OP_TIMEOUT_S": "120"}) for a in actors])
+    ray.get([a.join.remote(2, i, name)
+             for i, a in enumerate(actors)], timeout=60)
+    # rank 0 blocks in the op (rank 1 never participates), then rank 1
+    # dies out from under it
+    ref = actors[0].allreduce.remote(np.ones(4), name)
+    time.sleep(1.0)
+    t0 = time.monotonic()
+    ray.kill(actors[1], no_restart=True)
+    with pytest.raises(CollectiveGroupError) as ei:
+        ray.get(ref, timeout=90)
+    latency = time.monotonic() - t0
+    assert latency < 30, f"poison took {latency:.1f}s (op timeout 120s)"
+    assert 1 in ei.value.dead_ranks
+    assert name in str(ei.value)
+    ray.kill(actors[0], no_restart=True)
+
+
+@pytest.mark.chaos
+def test_stale_epoch_rejection_and_shm_sweep(ray_start_regular):
+    """Incarnation-epoch fencing: a rebuilt group under the same name
+    mints a strictly larger epoch; a frame stamped with the dead
+    incarnation's epoch is rejected at ingest (never parked where it
+    could masquerade as live traffic), the dead epoch's stranded shm
+    segments are swept at rejoin, and the rebuilt group's results stay
+    correct."""
+    ray = ray_start_regular
+    name = "gft_epoch"
+    Rank = _rank_cls(ray)
+    actors = [Rank.options(num_cpus=0).remote() for _ in range(2)]
+    ray.get([a.configure.remote(
+        {"RAY_TPU_COLLECTIVE_OP_TIMEOUT_S": "15"}) for a in actors])
+    try:
+        ray.get([a.join.remote(2, i, name)
+                 for i, a in enumerate(actors)], timeout=60)
+        e1 = ray.get(actors[0].epoch.remote(name))
+        out = ray.get([a.allreduce.remote(np.ones(4) * (i + 1), name)
+                       for i, a in enumerate(actors)], timeout=60)
+        assert np.allclose(out[0], 3.0)
+
+        # incarnation 1 dies: destroy, plant a stranded shm segment
+        # tagged with the dead epoch, rebuild under the same name
+        ray.get([a.destroy.remote(name) for a in actors], timeout=30)
+        oid = ray.get(actors[0].plant_stranded_shm.remote(name, e1))
+        assert ray.get(actors[0].store_has.remote(oid))
+
+        ray.get([a.join.remote(2, i, name)
+                 for i, a in enumerate(actors)], timeout=60)
+        e2 = ray.get(actors[0].epoch.remote(name))
+        assert e2 > e1
+        # rejoin swept the dead incarnation's stranded segment
+        assert not ray.get(actors[0].store_has.remote(oid))
+
+        # stale-epoch ingest rejection: nothing parked, counter bumped
+        keys = ray.get(actors[0].inject_stale_frame.remote(
+            name, e1, np.zeros(4)))
+        assert keys == []
+        assert ray.get(actors[0].stale_counter.remote()) >= 1
+
+        # the rebuilt group still computes bit-correct results
+        out = ray.get([a.allreduce.remote(np.ones(4) * (i + 2), name)
+                       for i, a in enumerate(actors)], timeout=60)
+        assert np.allclose(out[0], 5.0)
+    finally:
+        try:
+            ray.get([a.destroy.remote(name) for a in actors], timeout=30)
+        except Exception:
+            pass
+        for a in actors:
+            try:
+                ray.kill(a)
+            except Exception:
+                pass
