@@ -1,0 +1,337 @@
+"""Serve-plane benchmark: sustained QPS + latency under seeded chaos.
+
+Round 7 (PR 6). Three phases, one JSON artifact:
+
+1. **shape_proof** — the shape-aware batching acceptance claim, run
+   hermetically (no cluster): a fixed mixed batch-size traffic stream is
+   replayed through the bucketing batcher and through the legacy
+   (``RAY_TPU_SERVE_SHAPE_BUCKETS=0``) batcher, recording the
+   ``ray_tpu_pjit_cache_total`` miss curve after every batch. Bucketed
+   must go flat after warmup (one compile per bucket); legacy keeps
+   compiling — one miss per distinct raw batch size.
+
+2. **steady** — closed-loop load (``--threads`` callers, ``--duration``
+   seconds) against an unchaosed deployment: sustained QPS, p50/p99
+   latency, batching stats (mean executed batch size, pad waste).
+
+3. **chaos** — the same load against a deployment whose replicas are
+   killed mid-load by the seeded fault DSL
+   (``kill_actor:serve-bench-Model.handle_request:#N`` — every replica
+   process os._exits at its Nth request dispatch, so kills keep landing
+   as the controller back-fills). Acceptance: ZERO lost accepted
+   requests (every non-shed request returns the correct result) and
+   sub-second p99 recovery for the kill-affected tail.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_serve.py \
+        --duration 8 --threads 12 --replicas 3 --json-out BENCH_r07.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+# --------------------------------------------------------------- phase 1
+
+TRAFFIC = [3, 1, 5, 2, 7, 4, 8, 6, 3, 5, 7, 1, 6, 2, 8, 4,
+           5, 3, 6, 1, 7, 2, 4, 8]
+
+
+def shape_proof() -> dict:
+    import numpy as np
+
+    from ray_tpu.serve.batching import _Batcher
+    from ray_tpu.util.metrics import registry_snapshot
+
+    def misses(name):
+        fam = next((m for m in registry_snapshot()
+                    if m["name"] == "ray_tpu_pjit_cache_total"), None)
+        if fam is None:
+            return 0.0
+        return sum(v["value"] for v in fam["values"]
+                   if v["tags"].get("fn") == f"serve_batch::{name}"
+                   and v["tags"].get("result") == "miss")
+
+    def replay(name):
+        b = _Batcher(lambda xs: [x.sum() for x in xs], 8, 0.001, name=name)
+        curve = []
+        for n in TRAFFIC:
+            items, _ = b._pad_to_bucket([np.zeros((16, 8))] * n)
+            b._fn(items)
+            curve.append(misses(name))
+        return curve
+
+    bucketed = replay("bench_bucketed")
+    os.environ["RAY_TPU_SERVE_SHAPE_BUCKETS"] = "0"
+    try:
+        legacy = replay("bench_legacy")
+    finally:
+        os.environ.pop("RAY_TPU_SERVE_SHAPE_BUCKETS", None)
+    warm = 4                       # traffic touches buckets {1,2,4,8}
+    return {
+        "traffic_batch_sizes": TRAFFIC,
+        "bucketed_miss_curve": bucketed,
+        "legacy_miss_curve": legacy,
+        "bucketed_misses_total": bucketed[-1],
+        "legacy_misses_total": legacy[-1],
+        "bucketed_flat_after_warmup": bucketed[warm - 1] == bucketed[-1],
+        "claim": "bucketed compiles once per bucket then goes flat; "
+                 "legacy recompiles for every distinct raw batch size",
+    }
+
+
+# ----------------------------------------------------------- load driver
+
+def drive_load(handle, duration_s: float, threads: int, dim: int):
+    """Closed-loop load: each thread issues one request and blocks on
+    its result. Returns per-request (latency, ok) plus shed count."""
+    import numpy as np
+
+    results = []           # (latency_s, ok)
+    sheds = [0]
+    lost = [0]
+    lock = threading.Lock()
+    stop = time.monotonic() + duration_s
+    rng = np.random.default_rng(7)
+    payloads = [rng.standard_normal(dim).astype(np.float32)
+                for _ in range(32)]
+
+    def worker(widx):
+        from ray_tpu.exceptions import ServeOverloadedError
+
+        i = 0
+        while time.monotonic() < stop:
+            x = payloads[(widx + i) % len(payloads)]
+            t0 = time.monotonic()
+            try:
+                resp = handle.remote(x)
+                out = resp.result(timeout_s=30)
+                ok = bool(np.isfinite(out))
+                with lock:
+                    results.append((time.monotonic() - t0, ok,
+                                    resp.num_failovers))
+                    if not ok:
+                        lost[0] += 1
+            except ServeOverloadedError:
+                with lock:
+                    sheds[0] += 1
+                time.sleep(0.01)   # honor the backpressure contract
+            except Exception:
+                with lock:
+                    results.append((time.monotonic() - t0, False, 0))
+                    lost[0] += 1
+            i += 1
+
+    ts = [threading.Thread(target=worker, args=(w,)) for w in range(threads)]
+    t_start = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.monotonic() - t_start
+    return results, sheds[0], lost[0], wall
+
+
+def summarize_load(results, sheds, lost, wall) -> dict:
+    lats = sorted(l for l, ok, _ in results if ok)
+    # recovery = latency of exactly the requests that FAILED OVER (their
+    # first replica died or drained mid-request) — attributed per
+    # request via DeploymentResponse.num_failovers, not guessed from a
+    # latency threshold that cgroup stragglers also cross
+    failed_over = sorted(l for l, ok, nf in results if ok and nf > 0)
+    return {
+        "requests_ok": len(lats),
+        "requests_lost": lost,
+        "requests_shed": sheds,
+        "wall_s": round(wall, 3),
+        "qps": round(len(lats) / wall, 1) if wall else 0.0,
+        "p50_ms": round(_percentile(lats, 0.50) * 1e3, 2),
+        "p99_ms": round(_percentile(lats, 0.99) * 1e3, 2),
+        "worst_ms": round((lats[-1] if lats else 0.0) * 1e3, 2),
+        "mean_ms": round(statistics.fmean(lats) * 1e3, 2) if lats else 0.0,
+        "failed_over_requests": len(failed_over),
+        "recovery_p99_s": round(_percentile(failed_over, 0.99), 3),
+        "recovery_worst_s": round(failed_over[-1] if failed_over else 0.0,
+                                  3),
+    }
+
+
+# --------------------------------------------------------------- serving
+
+def build_model(serve, app_name: str, replicas: int, dim: int):
+    import numpy as np
+
+    @serve.deployment(num_replicas=replicas, max_ongoing_requests=8,
+                      max_queued_requests=64)
+    class Model:
+        def __init__(self, dim):
+            rng = np.random.default_rng(0)
+            self._w = rng.standard_normal((dim, dim)).astype(np.float32)
+
+        @serve.batch(max_batch_size=16, batch_wait_timeout_s=0.002)
+        def predict(self, xs):
+            batch = np.stack(xs)
+            out = batch @ self._w
+            return [float(abs(row).sum()) for row in out]
+
+        def __call__(self, x):
+            return self.predict(x)
+
+    return serve.run(Model.bind(dim), name=app_name, route_prefix=None)
+
+
+def failover_count(deployment: str) -> float:
+    from ray_tpu.util.metrics import registry_snapshot
+
+    fam = next((m for m in registry_snapshot()
+                if m["name"] == "ray_tpu_serve_failovers_total"), None)
+    return sum(v["value"] for v in (fam["values"] if fam else [])
+               if v["tags"].get("deployment") == deployment)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=8.0)
+    ap.add_argument("--threads", type=int, default=12)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--kill-every", type=int, default=60,
+                    help="each replica process dies at its Nth request")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--json-out", default=None)
+    args = ap.parse_args()
+
+    out = {
+        "round": 7,
+        "harness": "benchmarks/bench_serve.py",
+        "config": {"duration_s": args.duration, "threads": args.threads,
+                   "replicas": args.replicas, "dim": args.dim,
+                   "kill_every": args.kill_every, "seed": args.seed},
+        "methodology": (
+            "closed-loop load from one driver (threads blocking on "
+            "result()); chaos phase arms the seeded kill_actor DSL so "
+            "every replica process of the chaos app os._exits at its "
+            "Nth handle_request dispatch (replacements inherit the "
+            "schedule and the slot tag, so kills continue all run); "
+            "recovery_p99_s = p99 end-to-end latency of exactly the "
+            "requests that failed over (DeploymentResponse."
+            "num_failovers > 0), i.e. accepted requests whose replica "
+            "died mid-request — the failover-recovery claim"),
+    }
+
+    print("== phase 1: shape-aware batching proof (hermetic)")
+    out["shape_proof"] = shape_proof()
+    print(json.dumps(out["shape_proof"], indent=2))
+
+    # chaos env must precede init so replica processes inherit it; the
+    # schedule is scoped to the chaos app's process tag, so the steady
+    # phase (different app name → different tag) runs unchaosed
+    # Target ONE slot's replica lineage: a deployment-wide rule fires at
+    # the same per-process call count in every (identical) replica, so
+    # all replicas die in synchronized waves — a fleet-annihilation
+    # benchmark, not failover. Slot 0 (and each of its replacements)
+    # dying every N requests measures the real thing: minority capacity
+    # loss under load, survivors absorbing re-dispatched traffic.
+    os.environ["RAY_TPU_FAULT_SEED"] = str(args.seed)
+    os.environ["RAY_TPU_FAULT_SCHEDULE"] = (
+        f"kill_actor:serve-bench-Model-slot0.handle_request:"
+        f"#{args.kill_every}")
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(4, args.replicas + 2),
+                 object_store_memory=128 * 1024 * 1024)
+    import ray_tpu.serve as serve
+
+    try:
+        import numpy as np
+
+        def warmup(handle, n=48):
+            """Warm every replica + batch bucket BEFORE the measured
+            window: deploy-time and first-dispatch costs are startup,
+            not serving latency."""
+            for _ in range(n):
+                handle.remote(np.zeros(args.dim, dtype="float32")).result()
+
+        print("== phase 2: steady-state load (no chaos)")
+        h = build_model(serve, "steady", args.replicas, args.dim)
+        warmup(h)
+        steady = summarize_load(*drive_load(h, args.duration,
+                                            args.threads, args.dim))
+        out["steady"] = steady
+        print(json.dumps(steady, indent=2))
+        # free the steady replicas: the chaos phase must not compete
+        # with idle capacity on a small CPU cgroup
+        serve.delete("steady")
+
+        print("== phase 3: chaos load (seeded replica kills mid-load)")
+        h2 = build_model(serve, "bench", args.replicas, args.dim)
+        # NOTE: warmup calls count toward each replica's kill schedule
+        # position — keep it below --kill-every so the measured window
+        # starts with all replicas alive
+        warmup(h2, n=min(48, max(1, (args.kill_every - 8) // 2)))
+        base_failovers = failover_count("bench#Model")
+        chaos = summarize_load(*drive_load(h2, args.duration,
+                                           args.threads, args.dim))
+        chaos["failovers"] = failover_count("bench#Model") - base_failovers
+        out["chaos"] = chaos
+        print(json.dumps(chaos, indent=2))
+
+        from ray_tpu.experimental.state.api import summarize_serve
+
+        rollup = summarize_serve()
+        out["batching"] = rollup.get("batching", {})
+        replica_deaths = sum(
+            1 for e in rollup.get("events", [])
+            if e.get("kind") == "REPLICA_DIED"
+            and str(e.get("deployment", "")).startswith("bench#"))
+        chaos["replica_deaths_observed"] = replica_deaths
+
+        out["acceptance"] = {
+            "zero_lost_accepted_requests":
+                steady["requests_lost"] == 0 and
+                chaos["requests_lost"] == 0,
+            "kills_landed": chaos["failovers"] >= 1,
+            "recovery_p99_s": chaos["recovery_p99_s"],
+            "recovery_p99_under_1s": chaos["recovery_p99_s"] < 1.0,
+            "bucketed_flat_after_warmup":
+                out["shape_proof"]["bucketed_flat_after_warmup"],
+            "legacy_kept_recompiling":
+                out["shape_proof"]["legacy_misses_total"]
+                > out["shape_proof"]["bucketed_misses_total"],
+        }
+        print("== acceptance")
+        print(json.dumps(out["acceptance"], indent=2))
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_FAULT_SEED", None)
+        os.environ.pop("RAY_TPU_FAULT_SCHEDULE", None)
+
+    import datetime
+
+    out["date"] = datetime.date.today().isoformat()
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print(f"wrote {args.json_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,6 +38,17 @@ Event kinds recorded by the runtime:
 - ``train_group``  — a Train worker gang came up
                      (train/backend_executor.py): per-worker device
                      identities.
+- ``REPLICA_STARTED`` / ``REPLICA_DIED`` / ``REPLICA_DRAINED`` — Serve
+                     replica lifecycle (serve/_private/controller.py):
+                     deployment, replica_id; DIED carries the detection
+                     source (``death_feed`` / ``health`` / ``init``),
+                     DRAINED whether the drain completed gracefully.
+- ``SERVE_SCALED``   — an autoscale decision applied after hysteresis
+                     (controller): deployment, direction, from/to
+                     replica counts, the demand signal.
+- ``REQUEST_SHED``   — Serve admission control rejected a request
+                     (serve/_private/router.py): deployment, queue
+                     occupancy/capacity, the retry-after hint.
 
 Design constraints match the metrics plane: recording is one lock +
 deque append (no allocation beyond the event dict), the ring is bounded

@@ -184,6 +184,74 @@ CATALOG: dict[str, dict] = {
         "description": "Device-mesh construction time "
                        "(kind=mesh|hybrid_mesh)",
     },
+    # --- serve data plane (serve/_private/*, serve/batching.py) ---
+    # deployment names are operator-chosen and bounded (one per deployed
+    # model); fn names likewise — same cardinality class as RPC methods.
+    # Replica ids are NOT used as tags (they contain uuids and churn).
+    "ray_tpu_serve_requests_total": {
+        "kind": "Counter", "tags": ("deployment", "result"),
+        "description": "Serve requests completed at the handle layer "
+                       "(result=ok|error)",
+    },
+    "ray_tpu_serve_request_latency_seconds": {
+        "kind": "Histogram", "tags": ("deployment",),
+        "boundaries": _RPC_BOUNDARIES,
+        "description": "End-to-end handle-observed request latency "
+                       "(router queueing + replica execution)",
+    },
+    "ray_tpu_serve_queue_depth_tasks": {
+        "kind": "Gauge", "tags": ("deployment", "role"),
+        "description": "Router-side demand: callers waiting for a "
+                       "replica slot plus requests in flight (the "
+                       "autoscaler's primary signal). The role tag "
+                       "keeps the driver handle's router and the HTTP "
+                       "proxy's router as separate series — the "
+                       "cross-process gauge merge keeps the last value "
+                       "per tag set, so without it one idle router "
+                       "masks the other's backlog; sum over roles for "
+                       "total demand",
+    },
+    "ray_tpu_serve_shed_total": {
+        "kind": "Counter", "tags": ("deployment",),
+        "description": "Requests shed by admission control "
+                       "(ServeOverloadedError: all replicas at "
+                       "max_ongoing_requests, bounded queue full)",
+    },
+    "ray_tpu_serve_failovers_total": {
+        "kind": "Counter", "tags": ("deployment",),
+        "description": "Requests re-dispatched to a surviving replica "
+                       "after their assigned replica died or started "
+                       "draining mid-request",
+    },
+    "ray_tpu_serve_replicas_tasks": {
+        "kind": "Gauge", "tags": ("deployment", "state"),
+        "description": "Replica FSM occupancy per deployment "
+                       "(state=starting|running|stopping|target)",
+    },
+    "ray_tpu_serve_replica_restarts_total": {
+        "kind": "Counter", "tags": ("deployment", "reason"),
+        "description": "Replicas replaced by the controller "
+                       "(reason=death|health|init)",
+    },
+    "ray_tpu_serve_autoscale_total": {
+        "kind": "Counter", "tags": ("deployment", "direction"),
+        "description": "Autoscale decisions applied after hysteresis "
+                       "(direction=up|down)",
+    },
+    "ray_tpu_serve_batch_size_tasks": {
+        "kind": "Histogram", "tags": ("fn",),
+        "boundaries": [1, 2, 4, 8, 16, 32, 64, 128],
+        "description": "Executed @serve.batch batch sizes (after "
+                       "shape-bucket padding — the batch dimension the "
+                       "jitted program actually compiled for)",
+    },
+    "ray_tpu_serve_batch_pad_waste_tasks": {
+        "kind": "Histogram", "tags": ("fn",),
+        "boundaries": [1, 2, 4, 8, 16, 32, 64],
+        "description": "Padded slots per executed batch (bucket size "
+                       "minus real requests): the compute wasted to "
+                       "keep the pjit cache at a handful of shapes",
+    },
     # --- per-device telemetry (_private/tpu_probe.py) ---
     # node tag is load-bearing: each host's probe subprocess numbers its
     # local devices from 0 (no jax.distributed world), so without it a

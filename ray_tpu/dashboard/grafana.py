@@ -62,6 +62,33 @@ _PANELS = [
      "rate(ray_tpu_collective_groups_poisoned_total[5m])", "ops"),
     ("Stale-epoch traffic rejected",
      "rate(ray_tpu_collective_stale_epoch_total[5m])", "ops"),
+    # --- serve plane (PR 6: inference router / batcher / autoscaler) ---
+    ("Serve QPS",
+     "sum by (deployment) (rate(ray_tpu_serve_requests_total[1m]))",
+     "reqps"),
+    ("Serve p99 latency",
+     "histogram_quantile(0.99, rate(ray_tpu_serve_request_latency_seconds"
+     "_bucket[5m]))", "s"),
+    ("Serve shed rate (admission control)",
+     "sum by (deployment) (rate(ray_tpu_serve_shed_total[5m]))", "reqps"),
+    ("Serve queue depth",
+     "ray_tpu_serve_queue_depth_tasks", "short"),
+    ("Serve batch size p50",
+     "histogram_quantile(0.5, rate(ray_tpu_serve_batch_size_tasks_bucket"
+     "[5m]))", "short"),
+    ("Serve batch pad waste",
+     "rate(ray_tpu_serve_batch_pad_waste_tasks_sum[5m])", "short"),
+    ("Serve replicas (per state)",
+     "ray_tpu_serve_replicas_tasks", "short"),
+    ("Serve replica restarts",
+     "sum by (deployment, reason) "
+     "(rate(ray_tpu_serve_replica_restarts_total[5m]))", "ops"),
+    ("Serve autoscale decisions",
+     "sum by (deployment, direction) "
+     "(rate(ray_tpu_serve_autoscale_total[5m]))", "ops"),
+    ("Serve failovers (replica death/drain re-dispatch)",
+     "sum by (deployment) (rate(ray_tpu_serve_failovers_total[5m]))",
+     "ops"),
 ]
 
 

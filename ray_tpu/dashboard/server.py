@@ -12,6 +12,8 @@ Routes (reference modules in parens — dashboard/modules/*):
     /api/events             structured runtime event log (cluster events)
     /api/collectives        data-plane summary: collective ops,
                             stragglers, compile stats, device gauges
+    /api/serve              serving-plane summary: app/replica status,
+                            request/shed/failover counters, batch stats
     /api/reporter           per-node physical stats (reporter_agent)
     /api/grafana_dashboard  importable Grafana JSON (dashboard factory)
     /api/cluster_status     (`ray status`)
@@ -163,25 +165,17 @@ class DashboardServer:
             return out
 
     def _serve_status(self):
-        """Serve application/deployment status (reference:
-        dashboard/modules/serve). Queries the controller actor if one is
-        running in this cluster."""
-        import ray_tpu
-        from ray_tpu.serve._private.constants import (
-            CONTROLLER_NAME,
-            SERVE_NAMESPACE,
-        )
+        """Serve application/deployment status plus the serving-plane
+        metrics rollup (reference: dashboard/modules/serve). App status
+        queries the controller actor (needs a driver connection); the
+        request/batching/event rollup folds the catalog metrics and works
+        from any connected process (summarize_serve)."""
+        from ray_tpu.experimental.state.api import summarize_serve
 
-        if not ray_tpu.is_initialized():
-            return {"error": "dashboard process is not connected as a "
-                             "driver; serve status needs an actor call"}
-        try:
-            controller = ray_tpu.get_actor(CONTROLLER_NAME,
-                                           namespace=SERVE_NAMESPACE)
-        except ValueError:
-            return {"applications": {}}
-        return {"applications":
-                ray_tpu.get(controller.get_app_status.remote(), timeout=10)}
+        # no is_initialized guard: the metrics/event rollup works from
+        # any connected process; summarize_serve itself degrades
+        # applications to {} when there is no driver connection
+        return summarize_serve(address=self.address)
 
     def _timeline(self):
         from ray_tpu._private import profiling

@@ -160,6 +160,56 @@ class TrainWorkerGroupError(RayTpuError):
         return (type(self), (errs, self.dead_ranks, str(self)))
 
 
+class ServeConfigError(RayTpuError, ValueError):
+    """A Serve DeploymentConfig / AutoscalingConfig carried an invalid
+    value (num_replicas <= 0, min_replicas > max_replicas, negative
+    timeouts/periods, ...). Raised at CONSTRUCTION — a bad config must
+    fail where the operator wrote it, not as a deep runtime failure
+    three actors later. Subclasses ValueError so generic config-
+    validation handlers keep working."""
+
+
+class ServeOverloadedError(RayTpuError):
+    """Admission control shed this request: every replica of the
+    deployment is at ``max_ongoing_requests`` and the router's bounded
+    queue (``max_queued_requests`` per replica) is full. The request was
+    REJECTED, not queued — callers should back off ``retry_after_s``
+    and retry; the HTTP proxy maps this to 503 + a Retry-After header.
+    Shedding with a typed error is the production-serve contract: an
+    unbounded queue converts overload into unbounded latency for every
+    caller instead of fast feedback for the marginal one."""
+
+    def __init__(self, deployment_id: str = "", queued: int = 0,
+                 retry_after_s: float = 1.0):
+        self.deployment_id = deployment_id
+        self.queued = queued
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"deployment {deployment_id!r} is overloaded: all replicas at "
+            f"max_ongoing_requests and {queued} requests already queued; "
+            f"retry after {retry_after_s:.2f}s")
+
+    def __reduce__(self):
+        return (type(self), (self.deployment_id, self.queued,
+                             self.retry_after_s))
+
+
+class ReplicaDrainingError(RayTpuError):
+    """A Serve replica refused a request because it is draining (the
+    controller told it to shut down gracefully). Raised replica-side and
+    caught by the handle layer, which transparently re-dispatches the
+    request to a surviving replica — a scale-down or rolling update must
+    not lose accepted requests that raced the routing-table update."""
+
+    def __init__(self, replica_id: str = ""):
+        self.replica_id = replica_id
+        super().__init__(f"replica {replica_id!r} is draining; "
+                         f"re-dispatch to another replica")
+
+    def __reduce__(self):
+        return (type(self), (self.replica_id,))
+
+
 class RaySystemError(RayTpuError):
     """An internal framework component failed (narrow subclass — catching it
     must NOT swallow user-code TaskErrors, matching reference semantics)."""

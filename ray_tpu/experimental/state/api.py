@@ -563,6 +563,106 @@ def summarize_collectives(*, address: str | None = None) -> dict:
     }
 
 
+def summarize_serve(*, address: str | None = None) -> dict:
+    """Serving-plane rollup (reference tier: `serve status` + the serve
+    dashboard page — but folded from this framework's metric catalog and
+    event stream, like ``summarize_collectives``):
+
+    - ``applications``  controller-reported app/deployment/replica FSM
+                        status (empty when Serve isn't running);
+    - ``requests``      per-deployment completed/error counts, latency
+                        totals, sheds, failovers, live queue depth;
+    - ``batching``      per-batch-fn executed batch count, mean batch
+                        size, mean padded slots (shape-bucket waste);
+    - ``events``        replica lifecycle + scaling + shed events
+                        (REPLICA_STARTED/DIED/DRAINED, SERVE_SCALED,
+                        REQUEST_SHED).
+    """
+    applications: dict = {}
+    try:
+        import ray_tpu
+        from ray_tpu.serve._private.constants import (
+            CONTROLLER_NAME,
+            SERVE_NAMESPACE,
+        )
+
+        if ray_tpu.is_initialized():
+            controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                           namespace=SERVE_NAMESPACE)
+            applications = ray_tpu.get(
+                controller.get_app_status.remote(), timeout=10)
+    except Exception:
+        applications = {}
+
+    snaps = {m["name"]: m for m in metrics_summary(address=address)}
+
+    def _sums(name):
+        fam = snaps.get(name)
+        if not fam:
+            return {}
+        return {tuple(sorted(v["tags"].items())): v["value"]
+                for v in fam.get("values", [])}
+
+    def _counts(name):
+        fam = snaps.get(name)
+        if not fam:
+            return {}
+        return {tuple(sorted(row["tags"].items())): sum(row["counts"])
+                for row in fam.get("counts", [])}
+
+    requests: dict[str, dict] = {}
+
+    def _dep_row(dep):
+        return requests.setdefault(dep, {
+            "ok": 0, "error": 0, "latency_total_s": 0.0, "mean_latency_s":
+            0.0, "shed": 0, "failovers": 0, "queue_depth": 0.0})
+
+    for key, value in _sums("ray_tpu_serve_requests_total").items():
+        tags = dict(key)
+        row = _dep_row(tags.get("deployment") or "?")
+        if tags.get("result") in ("ok", "error"):
+            row[tags["result"]] = int(value)
+    lat_sums = _sums("ray_tpu_serve_request_latency_seconds")
+    for key, count in _counts("ray_tpu_serve_request_latency_seconds"
+                              ).items():
+        row = _dep_row(dict(key).get("deployment") or "?")
+        total = lat_sums.get(key, 0.0)
+        row["latency_total_s"] = total
+        row["mean_latency_s"] = (total / count) if count else 0.0
+    for key, value in _sums("ray_tpu_serve_shed_total").items():
+        _dep_row(dict(key).get("deployment") or "?")["shed"] = int(value)
+    for key, value in _sums("ray_tpu_serve_failovers_total").items():
+        _dep_row(dict(key).get("deployment") or "?")["failovers"] = \
+            int(value)
+    for key, value in _sums("ray_tpu_serve_queue_depth_tasks").items():
+        # one series per (deployment, role): sum roles for total demand
+        _dep_row(dict(key).get("deployment") or "?")["queue_depth"] += value
+
+    batching: dict[str, dict] = {}
+    size_sums = _sums("ray_tpu_serve_batch_size_tasks")
+    for key, count in _counts("ray_tpu_serve_batch_size_tasks").items():
+        fn = dict(key).get("fn") or "?"
+        total = size_sums.get(key, 0.0)
+        batching[fn] = {"batches": int(count),
+                        "mean_batch_size": (total / count) if count else 0.0,
+                        "mean_pad_waste": 0.0}
+    pad_sums = _sums("ray_tpu_serve_batch_pad_waste_tasks")
+    for key, count in _counts("ray_tpu_serve_batch_pad_waste_tasks").items():
+        fn = dict(key).get("fn") or "?"
+        row = batching.setdefault(fn, {"batches": int(count),
+                                       "mean_batch_size": 0.0,
+                                       "mean_pad_waste": 0.0})
+        total = pad_sums.get(key, 0.0)
+        row["mean_pad_waste"] = (total / count) if count else 0.0
+
+    serve_kinds = {"REPLICA_STARTED", "REPLICA_DIED", "REPLICA_DRAINED",
+                   "SERVE_SCALED", "REQUEST_SHED"}
+    events = [e for e in list_cluster_events(address=address)
+              if e.get("kind") in serve_kinds]
+    return {"applications": applications, "requests": requests,
+            "batching": batching, "events": events}
+
+
 def metrics_summary(*, address: str | None = None,
                     prometheus: bool = False):
     """Aggregate metrics (user Counter/Gauge/Histogram plus the runtime's

@@ -188,6 +188,14 @@ def cmd_serve(args):
         except ValueError:
             raise SystemExit("Serve is not running on this cluster")
         return 0
+    if args.action == "summary":
+        # serving-plane rollup: app status + request/shed/failover
+        # counters, batch-size/pad-waste stats, replica lifecycle events
+        from ray_tpu.experimental.state.api import summarize_serve
+
+        print(json.dumps(summarize_serve(address=args.address),
+                         default=str, indent=2))
+        return 0
     serve.shutdown()
     print('{"status": "shutdown"}')
     return 0
@@ -383,7 +391,8 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_microbenchmark)
 
     sp = sub.add_parser("serve", help="deploy / inspect Serve apps")
-    sp.add_argument("action", choices=["run", "status", "shutdown"])
+    sp.add_argument("action",
+                    choices=["run", "status", "summary", "shutdown"])
     sp.add_argument("target", nargs="?", default=None,
                     help="module:attr of a bound Application (run)")
     sp.add_argument("--address", default=None)

@@ -8,7 +8,16 @@ in-flight-capped routing with power-of-two-choices, per-node HTTP proxies,
 long-poll config push, replica autoscaling, graceful drain, and
 model-composition deployment graphs via ``.bind()`` + handle passing.
 """
+from ray_tpu.exceptions import (
+    ReplicaDrainingError,
+    ServeConfigError,
+    ServeOverloadedError,
+)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve._private.weights import (
+    release_shared_weights,
+    shared_weights,
+)
 from ray_tpu.serve.api import (
     Application,
     Deployment,
@@ -35,12 +44,17 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "HTTPOptions",
+    "ReplicaDrainingError",
     "Request",
     "Response",
+    "ServeConfigError",
+    "ServeOverloadedError",
     "StreamingResponse",
     "batch",
     "ingress",
     "delete",
+    "release_shared_weights",
+    "shared_weights",
     "deployment",
     "get_app_handle",
     "get_deployment_handle",

@@ -13,6 +13,20 @@ background thread runs the reconcile loop:
 Replica FSM: STARTING ─ready──▶ RUNNING ─drain──▶ STOPPING ─▶ gone; a
 failed health check or dead actor re-enters through STARTING via a fresh
 replica (replicas are cattle — same as the reference).
+
+Fast failure detection: besides per-replica health checks (period
+``health_check_period_s``), the controller subscribes to the GCS
+actor-death feed (PR 5's ``watch_actor_deaths``). A dead replica is
+dropped and re-broadcast within the feed's publish latency — routers
+stop routing to it in milliseconds, and the scale loop starts the
+replacement on the next tick instead of a health-check period later.
+
+Observability: replica lifecycle lands in the cluster event log
+(``REPLICA_STARTED`` / ``REPLICA_DIED`` / ``REPLICA_DRAINED``), autoscale
+decisions as ``SERVE_SCALED``; the metric catalog carries the FSM
+occupancy gauge (``ray_tpu_serve_replicas_tasks``), replacement counters
+(``ray_tpu_serve_replica_restarts_total{reason}``) and autoscale
+decisions (``ray_tpu_serve_autoscale_total{direction}``).
 """
 from __future__ import annotations
 
@@ -20,6 +34,8 @@ import threading
 import time
 import uuid
 
+from ray_tpu._private import events as _events
+from ray_tpu._private import telemetry as _tm
 from ray_tpu.serve._private.constants import (
     ROUTE_TABLE_KEY,
     deployment_id as make_dep_id,
@@ -33,10 +49,13 @@ RECONCILE_PERIOD_S = 0.1
 
 
 class _Replica:
-    def __init__(self, replica_id, actor_name, handle, ready_ref):
+    def __init__(self, replica_id, actor_name, handle, ready_ref,
+                 slot: int = 0):
         self.replica_id = replica_id
         self.actor_name = actor_name
         self.handle = handle
+        self.slot = slot
+        self.actor_id_hex = getattr(handle, "_actor_id", b"").hex()
         self.state = STARTING
         self.ready_ref = ready_ref
         self.drain_ref = None
@@ -120,9 +139,12 @@ class _DeploymentState:
                     try:
                         ray_tpu.get(r.ready_ref)   # surface init errors
                         r.state = RUNNING
+                        _events.record("REPLICA_STARTED",
+                                       deployment=self.dep_id,
+                                       replica_id=r.replica_id)
                         changed = True
                     except Exception:
-                        self._drop(r)
+                        self._drop(r, reason="init")
                         changed = True
         # 2. reap STOPPING
         for r in list(self.replicas):
@@ -135,10 +157,17 @@ class _DeploymentState:
                     except Exception:
                         drained = True
                 if drained or time.monotonic() > r.drain_deadline:
+                    _events.record("REPLICA_DRAINED",
+                                   deployment=self.dep_id,
+                                   replica_id=r.replica_id,
+                                   graceful=drained)
                     self._kill(r)
                     changed = True
         if self.deleting:
-            return not self.replicas
+            if not self.replicas:
+                self._set_replica_gauges()
+                return True
+            return False
         # 3. health checks on RUNNING
         changed |= self._health_checks()
         # 4. autoscaling metrics + decision
@@ -161,6 +190,29 @@ class _DeploymentState:
             changed = True
         if changed:
             self.broadcast()
+            self._set_replica_gauges()
+        return False
+
+    def on_actor_death(self, actor_id_hex: str) -> bool:
+        """GCS death-feed fast path: drop the dead replica NOW and
+        re-broadcast, so routers shed its traffic in milliseconds. The
+        scale loop replaces the capacity on its next tick. Returns True
+        when the actor was one of this deployment's replicas."""
+        for r in list(self.replicas):
+            if r.actor_id_hex and r.actor_id_hex == actor_id_hex:
+                was_stopping = r.state == STOPPING
+                if r in self.replicas:
+                    self.replicas.remove(r)
+                if not was_stopping:
+                    _events.record("REPLICA_DIED", deployment=self.dep_id,
+                                   replica_id=r.replica_id,
+                                   source="death_feed")
+                    _tm.counter_inc(
+                        "ray_tpu_serve_replica_restarts_total",
+                        tags={"deployment": self.dep_id, "reason": "death"})
+                self.broadcast()
+                self._set_replica_gauges()
+                return True
         return False
 
     def _health_checks(self) -> bool:
@@ -183,10 +235,10 @@ class _DeploymentState:
                         r.last_health_check = now
                     except Exception:
                         # failed health check → replace
-                        self._drop(r)
+                        self._drop(r, reason="health")
                         changed = True
                 elif now > r.health_deadline:
-                    self._drop(r)
+                    self._drop(r, reason="health")
                     changed = True
             elif (now - r.last_health_check
                     >= self.config.health_check_period_s):
@@ -195,43 +247,34 @@ class _DeploymentState:
                     r.health_deadline = (
                         now + self.config.health_check_timeout_s)
                 except Exception:
-                    self._drop(r)
+                    self._drop(r, reason="death")
                     changed = True
         return changed
 
     def _autoscale(self):
-        import ray_tpu
-
         ac = self.config.autoscaling_config
         if ac is None:
             return
         now = time.monotonic()
         if now - self._last_metrics_poll >= ac.metrics_interval_s:
             self._last_metrics_poll = now
-            for r in self.replicas:
-                if r.state != RUNNING:
-                    continue
-                if r.metrics_ref is not None:
-                    try:
-                        done, _ = ray_tpu.wait([r.metrics_ref], timeout=0)
-                        if done:
-                            m = ray_tpu.get(r.metrics_ref)
-                            r.num_ongoing = m["num_ongoing_requests"]
-                            r.metrics_ref = None
-                    except Exception:
-                        r.metrics_ref = None
-                if r.metrics_ref is None:
-                    try:
-                        r.metrics_ref = r.handle.get_metrics.remote()
-                    except Exception:
-                        pass
+            self._poll_replica_metrics()
         running = [r for r in self.replicas if r.state == RUNNING]
         if not running:
             return
         # Handle-side metrics (queued + in-flight at routers) capture demand
         # the replicas never see when the router caps in-flight; fall back
         # to replica-side ongoing when no router has reported recently.
+        # This is the telemetry plane's queue-depth signal — the same
+        # number the routers export as ray_tpu_serve_queue_depth_tasks.
         fresh_cutoff = now - 2.0
+        # evict long-stale routers (exited drivers/proxies): the
+        # controller is detached and outlives them, and each minted a
+        # fresh uuid router_id — without pruning this dict grows with
+        # every driver that ever touched the deployment
+        for rid in [r for r, (_, ts) in self.handle_metrics.items()
+                    if ts < now - 30.0]:
+            del self.handle_metrics[rid]
         handle_total = sum(v for v, ts in self.handle_metrics.values()
                            if ts >= fresh_cutoff)
         has_fresh = any(ts >= fresh_cutoff
@@ -246,11 +289,43 @@ class _DeploymentState:
                  else ac.downscale_delay_s)
         prop = self._scale_proposal_since
         if prop is None or prop[0] != desired:
+            # hysteresis: a proposal must SUSTAIN for the configured
+            # delay before it moves the target (blips don't scale)
             self._scale_proposal_since = (desired, now)
             return
         if now - prop[1] >= delay:
+            direction = "up" if desired > self.target_num else "down"
+            _events.record("SERVE_SCALED", deployment=self.dep_id,
+                           direction=direction,
+                           from_replicas=self.target_num,
+                           to_replicas=desired,
+                           total_ongoing=total_ongoing)
+            _tm.counter_inc("ray_tpu_serve_autoscale_total",
+                            tags={"deployment": self.dep_id,
+                                  "direction": direction})
             self.target_num = desired
             self._scale_proposal_since = None
+
+    def _poll_replica_metrics(self):
+        import ray_tpu
+
+        for r in self.replicas:
+            if r.state != RUNNING:
+                continue
+            if r.metrics_ref is not None:
+                try:
+                    done, _ = ray_tpu.wait([r.metrics_ref], timeout=0)
+                    if done:
+                        m = ray_tpu.get(r.metrics_ref)
+                        r.num_ongoing = m["num_ongoing_requests"]
+                        r.metrics_ref = None
+                except Exception:
+                    r.metrics_ref = None
+            if r.metrics_ref is None:
+                try:
+                    r.metrics_ref = r.handle.get_metrics.remote()
+                except Exception:
+                    pass
 
     # ------------------------------------------------------------- actions
     def _start_replica(self):
@@ -262,6 +337,13 @@ class _DeploymentState:
         opts = dict(self.spec["config"].get("ray_actor_options") or {})
         opts.setdefault("num_cpus", 0)
         cap = int(self.config.max_ongoing_requests)
+        # stable slot ordinal (lowest unused): a replacement replica
+        # inherits the dead one's slot, so seeded chaos schedules can
+        # target one slot's lineage (`serve-<dep>-slot0`) and kill a
+        # minority of capacity instead of every replica in lockstep
+        used = {r.slot for r in self.replicas}
+        slot = next(i for i in range(len(self.replicas) + 1)
+                    if i not in used)
         handle = ray_tpu.remote(ReplicaActor).options(
             name=actor_name, namespace="serve",
             max_concurrency=cap + 8,    # headroom for health/metrics calls
@@ -270,9 +352,10 @@ class _DeploymentState:
         ).remote(self.dep_id, rid, self.spec["user_callable"],
                  self.spec.get("init_args") or (),
                  self.spec.get("init_kwargs") or {},
-                 self.config.user_config)
+                 self.config.user_config, slot)
         ready_ref = handle.ready.remote()
-        self.replicas.append(_Replica(rid, actor_name, handle, ready_ref))
+        self.replicas.append(_Replica(rid, actor_name, handle, ready_ref,
+                                      slot))
 
     def _begin_stop(self, r: _Replica):
         r.state = STOPPING
@@ -284,8 +367,12 @@ class _DeploymentState:
         r.drain_deadline = (time.monotonic()
                             + self.config.graceful_shutdown_timeout_s + 1.0)
 
-    def _drop(self, r: _Replica):
+    def _drop(self, r: _Replica, reason: str = "death"):
         """Immediate removal (failed init / failed health check)."""
+        _events.record("REPLICA_DIED", deployment=self.dep_id,
+                       replica_id=r.replica_id, source=reason)
+        _tm.counter_inc("ray_tpu_serve_replica_restarts_total",
+                        tags={"deployment": self.dep_id, "reason": reason})
         self._kill(r)
 
     def _kill(self, r: _Replica):
@@ -300,12 +387,26 @@ class _DeploymentState:
 
     # ------------------------------------------------------------ broadcast
     def broadcast(self):
-        entries = [{"replica_id": r.replica_id, "actor_name": r.actor_name}
+        entries = [{"replica_id": r.replica_id, "actor_name": r.actor_name,
+                    "actor_id": r.actor_id_hex}
                    for r in self.replicas if r.state == RUNNING]
         self.host.notify_changed(
             replicas_key(self.dep_id),
             {"replicas": entries,
-             "max_ongoing_requests": self.config.max_ongoing_requests})
+             "max_ongoing_requests": self.config.max_ongoing_requests,
+             "max_queued_requests": self.config.max_queued_requests})
+
+    def _set_replica_gauges(self):
+        counts = {s: 0 for s in (STARTING, RUNNING, STOPPING)}
+        for r in self.replicas:
+            counts[r.state] = counts.get(r.state, 0) + 1
+        for state, n in counts.items():
+            _tm.gauge_set("ray_tpu_serve_replicas_tasks", n,
+                          tags={"deployment": self.dep_id,
+                                "state": state.lower()})
+        _tm.gauge_set("ray_tpu_serve_replicas_tasks",
+                      0 if self.deleting else self.target_num,
+                      tags={"deployment": self.dep_id, "state": "target"})
 
     def status(self) -> dict:
         return {
@@ -333,9 +434,29 @@ class ServeController:
         self._apps: dict[str, dict] = {}      # name → {route_prefix, ingress}
         self._http_options = http_options or {}
         self._shutdown = threading.Event()
+        self._death_watch = self._start_death_watch()
         self._loop = threading.Thread(target=self._run_control_loop,
                                       daemon=True, name="serve-controller")
         self._loop.start()
+
+    def _start_death_watch(self):
+        """GCS actor-death subscription: replica death reaches the FSM in
+        the feed's publish latency, not a health-check period. Best-effort
+        (None without a worker runtime — the health checks still catch
+        everything, just slower)."""
+        try:
+            from ray_tpu._private.pubsub import watch_actor_deaths
+
+            return watch_actor_deaths(self._on_actor_death)
+        except Exception:
+            return None
+
+    def _on_actor_death(self, actor_id, reason: str):
+        hex_id = actor_id.hex() if isinstance(actor_id, bytes) else actor_id
+        with self._lock:
+            for ds in self._deployments.values():
+                if ds.on_actor_death(hex_id):
+                    return
 
     # ------------------------------------------------------------- RPC API
     def listen_for_change(self, snapshot_ids: dict):
@@ -429,6 +550,8 @@ class ServeController:
                 return None
             return {"max_ongoing_requests":
                         ds.config.max_ongoing_requests,
+                    "max_queued_requests":
+                        ds.config.max_queued_requests,
                     "status": ds.status()}
 
     def graceful_shutdown(self):
@@ -443,6 +566,12 @@ class ServeController:
                     break
             time.sleep(0.05)
         self._shutdown.set()
+        watch, self._death_watch = self._death_watch, None
+        if watch is not None:
+            try:
+                watch.stop()
+            except Exception:
+                pass
         return True
 
     # ------------------------------------------------------------ internals

@@ -188,6 +188,23 @@ class HTTPProxyActor:
             status, raw, ctype, headers = _encode_response(result)
             self._send(h, status, raw, ctype, headers)
         except Exception as e:
+            from ray_tpu.exceptions import ServeOverloadedError
+
+            if isinstance(e, ServeOverloadedError):
+                # admission control shed the request: 503 + Retry-After,
+                # the standard backpressure contract for HTTP callers
+                try:
+                    self._send(
+                        h, 503,
+                        json.dumps({"error": str(e),
+                                    "retry_after_s": e.retry_after_s}
+                                   ).encode(),
+                        "application/json",
+                        {"Retry-After":
+                         str(max(1, int(round(e.retry_after_s))))})
+                except Exception:
+                    pass
+                return
             tb = traceback.format_exc()
             try:
                 self._send(h, 500,

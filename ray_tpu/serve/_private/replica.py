@@ -22,7 +22,8 @@ class ReplicaActor:
     """
 
     def __init__(self, deployment_id: str, replica_id: str,
-                 user_callable, init_args, init_kwargs, user_config=None):
+                 user_callable, init_args, init_kwargs, user_config=None,
+                 slot: int | None = None):
         self._deployment_id = deployment_id
         self._replica_id = replica_id
         self._lock = threading.Lock()
@@ -31,6 +32,26 @@ class ReplicaActor:
         self._shutdown = False
         # live streaming responses: stream_id -> (iterator, last_pull_ts)
         self._streams: dict[str, tuple] = {}
+        # tag this process for the seeded fault plane so chaos schedules
+        # can target one deployment's replicas deterministically, e.g.
+        # `kill_actor:serve-default-Model.handle_request:#3` (same
+        # mechanism as train workers' rank<N> tags; '#'/':'/'.' are
+        # schedule-grammar characters, hence the sanitization). The slot
+        # ordinal (stable per replica position, reused by replacements)
+        # gets its own tag so a schedule can kill a MINORITY of capacity
+        # — identical processes share the injector's hash stream, so a
+        # deployment-wide rule kills every replica in synchronized waves
+        try:
+            from ray_tpu._private import fault_injection as _fi
+
+            tag = "serve-" + "".join(
+                c if c.isalnum() or c in "-_" else "-"
+                for c in deployment_id)
+            _fi.add_tag(tag)
+            if slot is not None:
+                _fi.add_tag(f"{tag}-slot{slot}")
+        except Exception:
+            pass
         if isinstance(user_callable, type):
             self._user = user_callable(*init_args, **(init_kwargs or {}))
         else:
@@ -65,8 +86,11 @@ class ReplicaActor:
         kwargs = {k: _resolve(v) for k, v in (kwargs or {}).items()}
         with self._lock:
             if self._shutdown:
-                raise RuntimeError(
-                    f"replica {self._replica_id} is shutting down")
+                # typed: the handle layer re-dispatches to a survivor, so
+                # a request racing the drain broadcast is not lost
+                from ray_tpu.exceptions import ReplicaDrainingError
+
+                raise ReplicaDrainingError(self._replica_id)
             self._num_ongoing += 1
             self._num_total += 1
         try:

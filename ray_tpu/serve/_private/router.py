@@ -2,9 +2,28 @@
 
 Reference: python/ray/serve/_private/router.py — Router at :261,
 ReplicaSet._try_assign_replica (in-flight-capped selection) at :134. Ours
-uses power-of-two-choices over the in-flight counts (the reference's newer
-replica scheduler does the same); when every replica is at its cap the
-request queues on a condition variable until a slot frees.
+uses power-of-two-choices over the live per-replica queue depth (in-flight
+count — the reference's newer replica scheduler does the same); when every
+replica is at its cap the request queues on a condition variable until a
+slot frees.
+
+**Admission control:** the wait queue is BOUNDED at
+``max_queued_requests`` per replica. A request arriving with every replica
+saturated and the queue full is shed immediately with a typed
+``ServeOverloadedError`` carrying a retry-after hint (plus a
+``REQUEST_SHED`` cluster event and ``ray_tpu_serve_shed_total``) — the
+production contract is fast feedback for the marginal caller, not
+unbounded latency for every caller.
+
+**Millisecond failover:** besides the long-poll replica-set updates (the
+slow path: controller notices → broadcasts), the router subscribes
+directly to the GCS actor-death feed (``watch_actor_deaths``, the PR 5
+machinery that poisons collective groups in ~tens of ms). A dead
+replica's slot is dropped the moment the GCS publishes the death: new
+requests never pick it, queued callers re-pick a survivor, and in-flight
+requests on it are flagged so their ``DeploymentResponse.result()``
+re-dispatches without waiting for the object layer to surface
+``ActorDiedError``.
 
 Completion tracking: one monitor thread per Router waits on outstanding
 ObjectRefs (batched ``wait``) and releases slots as tasks finish — the
@@ -16,6 +35,9 @@ import random
 import threading
 import uuid
 
+from ray_tpu._private import events as _events
+from ray_tpu._private import telemetry as _tm
+from ray_tpu.exceptions import ServeOverloadedError
 from ray_tpu.serve._private.constants import replicas_key
 from ray_tpu.serve._private.long_poll import LongPollClient
 
@@ -31,19 +53,29 @@ class _ReplicaSlot:
 
 class Router:
     def __init__(self, controller_handle, deployment_id: str,
-                 max_ongoing_requests: int = 8):
+                 max_ongoing_requests: int = 8,
+                 max_queued_requests: int = 32):
         self._controller = controller_handle
         self._deployment_id = deployment_id
         self._max_ongoing = max_ongoing_requests
+        self._max_queued = max_queued_requests
         self._lock = threading.Condition()
         self._replicas: dict[str, _ReplicaSlot] = {}
+        self._actor_to_replica: dict[str, str] = {}   # actor_id hex → rid
         self._outstanding: dict = {}   # ObjectRef -> replica_id
         self._num_queued = 0           # callers blocked waiting for a slot
+        # replicas observed dead (death feed / caller-observed) whose
+        # in-flight requests must fail over; an insertion-ordered dict
+        # used as a set so the overflow trim drops the OLDEST ids (ids
+        # never recur, so old entries are safe to forget)
+        self._dead: dict[str, None] = {}
         # stable identity for controller-side demand bookkeeping: id(self)
         # collides across processes (proxy vs driver handles)
         self._router_id = uuid.uuid4().hex
         self._last_metrics_push = 0.0
         self._stopped = threading.Event()
+        self._death_watch = None
+        self._death_watch_tried = False
         self._long_poll = LongPollClient(
             controller_handle,
             {replicas_key(deployment_id): self._update_replicas})
@@ -54,20 +86,26 @@ class Router:
 
     # ------------------------------------------------------------ callbacks
     def _update_replicas(self, info):
-        """Long-poll callback: (replica list, max_ongoing) snapshot."""
+        """Long-poll callback: (replica list, caps) snapshot."""
         import ray_tpu
 
         if info is None:
-            entries, cap = [], self._max_ongoing
+            entries, cap, queued_cap = [], self._max_ongoing, self._max_queued
         else:
-            entries, cap = info["replicas"], info["max_ongoing_requests"]
+            entries = info["replicas"]
+            cap = info["max_ongoing_requests"]
+            queued_cap = info.get("max_queued_requests", self._max_queued)
         with self._lock:
             self._max_ongoing = cap
+            self._max_queued = queued_cap
             seen = set()
+            actor_map = {}
             for entry in entries:
                 rid, name = entry["replica_id"], entry["actor_name"]
                 seen.add(rid)
-                if rid not in self._replicas:
+                if entry.get("actor_id"):
+                    actor_map[entry["actor_id"]] = rid
+                if rid not in self._replicas and rid not in self._dead:
                     try:
                         handle = ray_tpu.get_actor(
                             name, namespace="serve")
@@ -77,34 +115,81 @@ class Router:
             for rid in list(self._replicas):
                 if rid not in seen:
                     del self._replicas[rid]
+            self._actor_to_replica = actor_map
             self._lock.notify_all()
+        self._ensure_death_watch()
+
+    # ----------------------------------------------------------- death feed
+    def _ensure_death_watch(self):
+        """Subscribe (once) to the GCS actor-death feed so a dead replica
+        sheds traffic in milliseconds instead of a health-check period.
+        Best-effort: with no worker runtime attached (bare unit tests)
+        the router degrades to long-poll-only updates."""
+        if self._death_watch_tried:
+            return
+        self._death_watch_tried = True
+        try:
+            from ray_tpu._private.pubsub import watch_actor_deaths
+
+            self._death_watch = watch_actor_deaths(self._on_actor_death)
+        except Exception:
+            self._death_watch = None
+
+    def _on_actor_death(self, actor_id, reason: str):
+        hex_id = actor_id.hex() if isinstance(actor_id, bytes) else actor_id
+        with self._lock:
+            rid = self._actor_to_replica.get(hex_id)
+            if rid is None:
+                return
+        self.mark_replica_dead(rid)
+
+    def has_death_watch(self) -> bool:
+        return self._death_watch is not None
+
+    def replica_dead(self, replica_id: str) -> bool:
+        with self._lock:
+            return replica_id in self._dead
 
     # ------------------------------------------------------------- requests
     def assign_request(self, method_name: str, args, kwargs,
                        timeout_s: float = 30.0):
-        """Pick a replica (p2c by in-flight, capped) and submit. Returns
-        (ObjectRef, replica_id) of the replica call."""
+        """Pick a replica (p2c by queue depth, capped) and submit. Returns
+        (ObjectRef, replica_id) of the replica call. Sheds with
+        ``ServeOverloadedError`` when saturated AND the bounded queue is
+        full — admission control, not unbounded queueing."""
         import time
 
         deadline = time.monotonic() + timeout_s
         with self._lock:
-            self._num_queued += 1
-            try:
-                while True:
-                    slot = self._pick_slot()
-                    if slot is not None:
-                        slot.in_flight += 1
-                        break
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        raise TimeoutError(
-                            f"no replica of {self._deployment_id} available "
-                            f"within {timeout_s}s "
-                            f"({len(self._replicas)} replicas, all at "
-                            f"max_ongoing_requests={self._max_ongoing})")
-                    self._lock.wait(min(remaining, 0.5))
-            finally:
-                self._num_queued -= 1
+            slot = self._pick_slot()
+            if slot is None:
+                cap = self._queue_capacity()
+                # Shed only when we KNOW the capacity is saturated: with
+                # an empty replica view (cold start before the first
+                # long-poll snapshot, or every replica momentarily dead
+                # awaiting replacement) there is no capacity denominator
+                # to judge overload against — queue until the deadline
+                # instead of shedding traffic the deployment could serve
+                # a few ms later.
+                if self._replicas and self._num_queued >= cap:
+                    self._shed(cap)
+                self._num_queued += 1
+                try:
+                    while True:
+                        slot = self._pick_slot()
+                        if slot is not None:
+                            break
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise TimeoutError(
+                                f"no replica of {self._deployment_id} "
+                                f"available within {timeout_s}s "
+                                f"({len(self._replicas)} replicas, all at "
+                                f"max_ongoing_requests={self._max_ongoing})")
+                        self._lock.wait(min(remaining, 0.5))
+                finally:
+                    self._num_queued -= 1
+            slot.in_flight += 1
         try:
             ref = slot.handle.handle_request.remote(
                 method_name, args, kwargs)
@@ -118,15 +203,67 @@ class Router:
             self._lock.notify_all()   # wake monitor
         return ref, slot.replica_id
 
+    def _queue_capacity(self) -> int:
+        """Bounded-queue size: ``max_queued_requests`` PER replica.
+        (Shedding is additionally gated on a non-empty replica view —
+        see assign_request — so cold-start traffic queues instead of
+        being shed against a capacity of zero.)"""
+        return self._max_queued * max(1, len(self._replicas))
+
+    def _shed(self, cap: int):
+        """Reject one request at admission (caller holds the lock)."""
+        queued = self._num_queued
+        # retry-after: half a max_ongoing drain at ~10 rps per replica is
+        # a crude but bounded hint; clients with real latency knowledge
+        # should use their own backoff
+        retry_after = max(0.1, min(5.0, 0.05 * (1 + queued)))
+        _tm.counter_inc("ray_tpu_serve_shed_total",
+                        tags={"deployment": self._deployment_id})
+        _events.record("REQUEST_SHED", deployment=self._deployment_id,
+                       queued=queued, queue_capacity=cap,
+                       retry_after_s=retry_after)
+        raise ServeOverloadedError(self._deployment_id, queued, retry_after)
+
     def mark_replica_dead(self, replica_id: str):
-        """Drop a replica observed dead by a caller (ActorDiedError on its
-        result). The long-poll will also remove it once the controller
-        notices — this is the fast path so retries don't re-pick it."""
+        """Drop a replica observed dead (GCS death feed, or a caller's
+        ActorDiedError on its result). The long-poll will also remove it
+        once the controller notices — this is the fast path so queued
+        callers and retries never re-pick it, and in-flight requests on
+        it fail over immediately (``replica_dead`` flag polled by
+        DeploymentResponse)."""
         with self._lock:
+            if replica_id in self._dead:
+                return
+            self._dead[replica_id] = None
+            if len(self._dead) > 512:   # bounded: evict the oldest half
+                for rid in list(self._dead)[:256]:
+                    del self._dead[rid]
             self._replicas.pop(replica_id, None)
+            failing_over = 0
             for ref, rid in list(self._outstanding.items()):
                 if rid == replica_id:
                     del self._outstanding[ref]
+                    failing_over += 1
+            self._lock.notify_all()
+        if failing_over:
+            _tm.counter_inc("ray_tpu_serve_failovers_total", failing_over,
+                            tags={"deployment": self._deployment_id})
+
+    def mark_replica_draining(self, replica_id: str):
+        """Drop a replica that refused a request with
+        ``ReplicaDrainingError`` from the selection set WITHOUT flagging
+        it dead: its other in-flight requests were accepted before the
+        drain and will complete (flagging dead would re-dispatch them —
+        double execution). Needed because a draining replica rejects
+        instantly, so its in_flight stays ~0 and power-of-two-choices
+        would otherwise RE-PICK it for every retry until the
+        controller's post-drain broadcast lands, burning the whole
+        retry budget on one drainer while healthy survivors sit busy.
+        (A stale pre-drain broadcast may briefly re-add it; the next
+        rejection removes it again — bounded, and the post-drain
+        broadcast ends the cycle.)"""
+        with self._lock:
+            self._replicas.pop(replica_id, None)
             self._lock.notify_all()
 
     def _pick_slot(self):
@@ -156,6 +293,10 @@ class Router:
                     queued = self._num_queued
                     in_flight = sum(s.in_flight
                                     for s in self._replicas.values())
+                _tm.gauge_set("ray_tpu_serve_queue_depth_tasks",
+                              queued + in_flight,
+                              tags={"deployment": self._deployment_id,
+                                    "role": _tm.role()})
                 try:
                     self._controller.record_handle_metrics.remote(
                         self._deployment_id, self._router_id,
@@ -189,3 +330,9 @@ class Router:
     def stop(self):
         self._stopped.set()
         self._long_poll.stop()
+        watch, self._death_watch = self._death_watch, None
+        if watch is not None:
+            try:
+                watch.stop()
+            except Exception:
+                pass

@@ -1,0 +1,273 @@
+"""Zero-copy same-node model-weight sharing for Serve replicas.
+
+N replicas of one model on one node would naively hold N host copies of
+the weights (before device transfer). This module keeps ONE copy in the
+node's shared-memory object store: the first replica to ask runs the
+loader and publishes the arrays through the store's ``put_ephemeral``
+path (the PR 4 primitive: no spill probe, never hits disk); every later
+replica maps the sealed segment zero-copy (``StoreClient.get`` returns a
+pinned view) and rebuilds its arrays as read-only ``np.frombuffer`` views
+over the shared bytes — load time and N-1 copies both disappear.
+
+Keying: the object id is content-addressed from the caller's key (use
+``f"{deployment}:{version}"`` so a redeploy with new weights mints a new
+segment). A leftover segment from a crashed prior run with the same key
+therefore holds the SAME bytes and is safe to reuse — which is exactly
+why ``get``-before-``load`` is correct here where it wouldn't be for the
+collective plane's per-message ids.
+
+Lifetime: mapped views pin the segment; ``release_shared_weights``
+drops this process's pin and (best-effort) deletes the store object.
+Replicas that exit simply drop their pins with the process. The store is
+node-local and dies with the node, so an unreleased segment is bounded
+by (models served on the node), not by traffic.
+
+No worker runtime / store full → the loader's private copy is returned
+(correct, just not shared); sharing is an optimization, never a
+requirement.
+"""
+from __future__ import annotations
+
+import hashlib
+import pickle
+import struct
+import threading
+
+_ALIGN = 64            # buffer offsets aligned for vectorized consumers
+# _lock guards the two dicts ONLY — never held across loader()/store IO:
+# a weights load can take minutes, replicas serve on many threads, and a
+# loader that composes another shared_weights(key2) call must not
+# deadlock on a process-global lock
+_lock = threading.Lock()
+# key → (value, pin|None): keeps the pinned mapping (and its views) alive
+# for this process and makes repeat calls O(1)
+_cache: dict[str, tuple] = {}
+# key → Event: de-dups concurrent same-key loads within this process
+_inflight: dict[str, threading.Event] = {}
+
+
+class _ArrayRef:
+    """Skeleton placeholder for one stripped array (picklable)."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __reduce__(self):
+        return (_ArrayRef, (self.index,))
+
+
+def _object_id(key: str) -> bytes:
+    return hashlib.sha256(b"serve-weights:" + key.encode()).digest()[:16]
+
+
+def _strip_arrays(obj, specs: list, buffers: list):
+    """Replace every ndarray in a dict/list/tuple pytree with an
+    _ArrayRef; record (shape, dtype) and the contiguous buffer."""
+    import numpy as np
+
+    if isinstance(obj, np.ndarray):
+        arr = np.ascontiguousarray(obj)
+        specs.append((arr.shape, arr.dtype.str))
+        buffers.append(arr)
+        return _ArrayRef(len(specs) - 1)
+    if isinstance(obj, dict):
+        return {k: _strip_arrays(v, specs, buffers) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_strip_arrays(v, specs, buffers) for v in obj)
+    return obj
+
+
+def _fill_arrays(obj, arrays: list):
+    if isinstance(obj, _ArrayRef):
+        return arrays[obj.index]
+    if isinstance(obj, dict):
+        return {k: _fill_arrays(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_fill_arrays(v, arrays) for v in obj)
+    return obj
+
+
+def _serialize(value) -> list:
+    """[u64 header_len][pickle(skeleton, specs)][pad][buf0][pad][buf1]...
+    Buffers are appended as views (scatter-gather into the store's
+    create()d segment — no intermediate concatenation)."""
+    import numpy as np
+
+    specs: list = []
+    buffers: list = []
+    skeleton = _strip_arrays(value, specs, buffers)
+    header = pickle.dumps((skeleton, specs), protocol=5)
+    parts: list = [struct.pack("<Q", len(header)), header]
+    offset = 8 + len(header)
+    for arr in buffers:
+        pad = (-offset) % _ALIGN
+        if pad:
+            parts.append(b"\x00" * pad)
+            offset += pad
+        view = memoryview(np.ascontiguousarray(arr)).cast("B")
+        parts.append(view)
+        offset += len(view)
+    return parts
+
+
+def _deserialize(mv: memoryview):
+    """Rebuild the value with arrays as READ-ONLY views over ``mv`` (the
+    pinned shm segment) — this is the zero-copy step."""
+    import numpy as np
+
+    mv = mv.toreadonly()
+    (header_len,) = struct.unpack("<Q", mv[:8])
+    skeleton, specs = pickle.loads(mv[8:8 + header_len])
+    arrays = []
+    offset = 8 + header_len
+    for shape, dtype_str in specs:
+        offset += (-offset) % _ALIGN
+        dtype = np.dtype(dtype_str)
+        count = 1
+        for d in shape:
+            count *= d
+        arr = np.frombuffer(mv, dtype=dtype, count=count,
+                            offset=offset).reshape(shape)
+        arrays.append(arr)
+        offset += count * dtype.itemsize
+    return _fill_arrays(skeleton, arrays)
+
+
+def shared_weights(key: str, loader):
+    """Load-once-per-node weights. ``loader()`` must return a pytree
+    (dict/list/tuple nesting) of numpy arrays plus picklable scalars;
+    the returned arrays are READ-ONLY views over node-shared memory
+    (copy before mutating — serving weights shouldn't be mutated).
+
+    Typical replica usage::
+
+        class Model:
+            def __init__(self):
+                w = serve.shared_weights("mymodel:v3", load_from_disk)
+                self.params = jax.device_put(w)   # shm → HBM, no 2nd
+                #                                   host copy ever existed
+    """
+    while True:
+        with _lock:
+            hit = _cache.get(key)
+            if hit is not None:
+                return hit[0]
+            ev = _inflight.get(key)
+            if ev is None:
+                _inflight[key] = threading.Event()
+                break           # this thread owns the load
+        ev.wait()               # another thread is loading this key
+    try:
+        entry = _load_entry(key, loader)
+        with _lock:
+            _cache[key] = entry
+        return entry[0]
+    finally:
+        with _lock:
+            ev = _inflight.pop(key, None)
+        if ev is not None:
+            ev.set()
+
+
+def _load_entry(key: str, loader) -> tuple:
+    """One (value, pin|None) load — runs WITHOUT the module lock."""
+    worker = _current_worker()
+    store = getattr(worker, "store", None) if worker else None
+    if store is None:
+        return (loader(), None)
+    oid = _object_id(key)
+    pin = _safe_get(store, oid)
+    if pin is None:
+        value = loader()
+        try:
+            pin = _publish_or_adopt(store, oid, _serialize(value))
+        except Exception:
+            pin = None   # store full / unpicklable → private copy
+        if pin is None:
+            return (value, None)
+    try:
+        value = _deserialize(pin.memoryview())
+    except Exception:
+        # stranded segment with a garbage layout (e.g. key collision
+        # with foreign bytes): fall back to a private load
+        pin.release()
+        return (loader(), None)
+    return (value, pin)
+
+
+def release_shared_weights(key: str, delete: bool = False):
+    """Drop this process's pin (views into the segment become invalid —
+    only call once the model is done with them). ``delete=True`` also
+    removes the store object so the node reclaims the memory once every
+    other pin is gone."""
+    with _lock:
+        entry = _cache.pop(key, None)
+    if entry is None:
+        return False
+    pin = entry[1]
+    if pin is not None:
+        try:
+            pin.release()
+        except Exception:
+            pass
+    if delete:
+        worker = _current_worker()
+        store = getattr(worker, "store", None) if worker else None
+        if store is not None:
+            try:
+                store.delete_ephemeral(_object_id(key))
+            except Exception:
+                pass
+    return True
+
+
+def _publish_or_adopt(store, oid: bytes, parts: list):
+    """Create-if-absent publish. NOT ``put_ephemeral``: that primitive's
+    EXISTS handling deletes the existing object and recreates it —
+    correct for the collective plane's per-message ids (an existing id
+    is always a stranded leftover) but wrong here, where ids are stable
+    and content-addressed: with N replicas starting concurrently, the
+    loser of the publish race would delete the winner's LIVE segment out
+    from under its pinned zero-copy views. Same key = same bytes, so the
+    loser simply ADOPTS the winner's segment instead."""
+    views = [memoryview(p).cast("B") for p in parts]
+    total = sum(len(v) for v in views)
+    buf = store.create(oid, total)
+    if buf is None:
+        # lost the race (or a same-key leftover from a prior run —
+        # identical bytes either way): map the existing segment. A None
+        # get here means the winner hasn't sealed yet; the caller falls
+        # back to its private copy rather than spin.
+        return _safe_get(store, oid)
+    try:
+        dst = memoryview(buf).cast("B")
+        off = 0
+        for v in views:
+            dst[off:off + len(v)] = v
+            off += len(v)
+        store.seal(oid)
+    except BaseException:
+        try:
+            store.abort(oid)
+        except Exception:
+            pass
+        raise
+    return _safe_get(store, oid)
+
+
+def _current_worker():
+    try:
+        from ray_tpu._private.worker_runtime import current_worker
+
+        return current_worker()
+    except Exception:
+        return None
+
+
+def _safe_get(store, oid: bytes):
+    try:
+        return store.get(oid)
+    except Exception:
+        return None

@@ -53,11 +53,24 @@ class Deployment:
         self.version = version
 
     def options(self, *, name=None, num_replicas=None, user_config=None,
-                max_ongoing_requests=None, autoscaling_config=None,
+                max_ongoing_requests=None, max_queued_requests=None,
+                autoscaling_config=None,
                 ray_actor_options=None, health_check_period_s=None,
                 health_check_timeout_s=None, graceful_shutdown_timeout_s=None,
                 version=None):
-        cfg = DeploymentConfig.from_dict(self.config.to_dict())
+        from dataclasses import replace
+
+        # replace(), not to_dict()/from_dict(): asdict would deep-convert
+        # a dataclass user_config into a plain dict (and deep-copy every
+        # value), mangling the object the replica's reconfigure expects.
+        # The two MUTABLE config fields are copied explicitly so editing
+        # the derived deployment never writes through to the original.
+        cfg = replace(
+            self.config,
+            ray_actor_options=dict(self.config.ray_actor_options),
+            autoscaling_config=(replace(self.config.autoscaling_config)
+                                if self.config.autoscaling_config
+                                else None))
         if num_replicas is not None:
             if num_replicas == "auto":
                 cfg.autoscaling_config = (cfg.autoscaling_config
@@ -68,6 +81,8 @@ class Deployment:
             cfg.user_config = user_config
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = int(max_ongoing_requests)
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = int(max_queued_requests)
         if autoscaling_config is not None:
             if isinstance(autoscaling_config, dict):
                 autoscaling_config = AutoscalingConfig(**autoscaling_config)
@@ -80,6 +95,10 @@ class Deployment:
             cfg.health_check_timeout_s = health_check_timeout_s
         if graceful_shutdown_timeout_s is not None:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        # field assignments above bypass validation — re-run it so a bad
+        # .options(...) value raises ServeConfigError HERE, not as a
+        # deep controller-side failure after deploy
+        cfg.__post_init__()
         return Deployment(self._func_or_class, name or self.name, cfg,
                           version or self.version)
 
@@ -89,6 +108,7 @@ class Deployment:
 
 def deployment(func_or_class=None, *, name=None, num_replicas=None,
                user_config=None, max_ongoing_requests=None,
+               max_queued_requests=None,
                autoscaling_config=None, ray_actor_options=None,
                health_check_period_s=None, health_check_timeout_s=None,
                graceful_shutdown_timeout_s=None, version=None):
@@ -100,6 +120,7 @@ def deployment(func_or_class=None, *, name=None, num_replicas=None,
         return dep.options(
             num_replicas=num_replicas, user_config=user_config,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
             autoscaling_config=autoscaling_config,
             ray_actor_options=ray_actor_options,
             health_check_period_s=health_check_period_s,

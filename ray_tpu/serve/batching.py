@@ -1,4 +1,4 @@
-"""Adaptive request batching for Serve deployments.
+"""Adaptive, shape-aware request batching for Serve deployments.
 
 Reference: python/ray/serve/batching.py (``@serve.batch`` — an asyncio
 queue that coalesces concurrent single requests into one call of the
@@ -8,19 +8,82 @@ MXU wants large batch dimensions, so serving throughput hinges on running
 one compiled program over many queued requests instead of one program per
 request.
 
+**Shape awareness** is the part the reference doesn't need: jit/pjit
+compile one program PER INPUT SHAPE, so a naive dynamic batcher that cuts
+batches at whatever size the queue happened to hold (3, then 5, then 7,
+then 4, ...) recompiles the model once per distinct batch size — exactly
+the pjit-cache thrash ``parallel/compile_watch.py`` exists to expose. The
+batcher therefore pads every batch up to a small fixed set of bucket
+sizes (powers of two up to ``max_batch_size`` by default), so a mixed
+traffic stream converges to ZERO recompiles once each bucket has compiled
+— at the cost of the padded slots, which are measured
+(``ray_tpu_serve_batch_pad_waste_tasks``) rather than hidden. Padding
+replicates the last real request, so the wrapped function never sees a
+sentinel value; padded outputs are dropped before fan-out. The kill
+switch ``RAY_TPU_SERVE_SHAPE_BUCKETS=0`` restores the reference's
+pad-free behavior (for CPU-only deployments where recompiles are cheap).
+
+Every batch call is classified against ``compile_watch``'s per-signature
+compile cache (``ray_tpu_pjit_cache_total{fn="serve_batch::...", result}``)
+— the same instrumentation the training step uses — so "the batcher
+stopped recompiling after warmup" is a metric, not a hope. Classification
+works at jit's abstraction level: array items classify by (shape, dtype),
+so bucketed batches of arrays converge to one signature per bucket.
+
 Replica actors in this runtime execute requests on threads
 (``max_concurrency`` > 1, see serve/_private/controller.py), so the
 batcher is thread-based: callers enqueue their item and block; a single
 lazily-started batcher thread drains the queue into lists bounded by
 ``max_batch_size``, waiting at most ``batch_wait_timeout_s`` after the
 first item arrives, then invokes the wrapped function once per batch and
-distributes results (or the raised exception) back to the callers.
+distributes results back to the callers. On failure each caller gets ITS
+OWN clone of the raised exception — a shared exception object mutated by
+one caller's handler (``raise ... from``, ``__traceback__`` rewrites)
+would corrupt what the other callers observe.
 """
 from __future__ import annotations
 
+import copy
+import os
 import threading
 import time
 from typing import Callable
+
+from ray_tpu._private import telemetry as _tm
+
+
+def shape_buckets_enabled() -> bool:
+    """Kill switch, read at batcher construction: ``0`` restores the
+    legacy pad-free batcher (every queue cut is its own batch size)."""
+    return os.environ.get("RAY_TPU_SERVE_SHAPE_BUCKETS", "1") != "0"
+
+
+def default_bucket_sizes(max_batch_size: int) -> tuple[int, ...]:
+    """Powers of two up to (and always including) ``max_batch_size`` —
+    log2(max) compiled programs cover every possible batch, and no batch
+    pads to more than 2x its real size."""
+    sizes, s = [], 1
+    while s < max_batch_size:
+        sizes.append(s)
+        s *= 2
+    sizes.append(max_batch_size)
+    return tuple(sorted(set(sizes)))
+
+
+def _clone_exception(exc: BaseException) -> BaseException:
+    """A per-caller copy of one batch failure. Clones share the original
+    traceback/cause but are DISTINCT objects, so one caller re-raising
+    with ``raise e from other`` (which mutates ``__cause__`` and
+    ``__context__``) cannot corrupt what the batch's other callers see."""
+    try:
+        clone = copy.copy(exc)
+        if clone is exc or type(clone) is not type(exc):
+            return exc
+        clone.__traceback__ = exc.__traceback__
+        clone.__cause__ = exc.__cause__
+        return clone
+    except Exception:
+        return exc   # unclonable exotic exception: shared beats lost
 
 
 class _Pending:
@@ -37,14 +100,40 @@ class _Batcher:
     """Queue + single worker thread for one bound batch function."""
 
     def __init__(self, fn: Callable, max_batch_size: int,
-                 batch_wait_timeout_s: float):
-        self._fn = fn
+                 batch_wait_timeout_s: float,
+                 bucket_sizes: tuple[int, ...] | None = None,
+                 name: str | None = None):
+        self._name = name or getattr(fn, "__name__", "batched")
+        self._fn = self._instrument(fn)
         self.max_batch_size = max_batch_size
         self.batch_wait_timeout_s = batch_wait_timeout_s
+        if shape_buckets_enabled():
+            self.bucket_sizes = tuple(sorted(
+                set(bucket_sizes or default_bucket_sizes(max_batch_size))))
+            if self.bucket_sizes[-1] < max_batch_size:
+                self.bucket_sizes += (max_batch_size,)
+        else:
+            self.bucket_sizes = None
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: list[_Pending] = []
         self._thread: threading.Thread | None = None
+
+    def _instrument(self, fn: Callable):
+        """Classify every batch call against the pjit-style compile
+        cache (parallel/compile_watch.py): array items make the batch
+        signature (batch_size, item shape, dtype), so
+        ``ray_tpu_pjit_cache_total{fn="serve_batch::<name>"}`` misses
+        count exactly the batch shapes the model compiled for — the
+        proof metric that bucketing converges to zero recompiles."""
+        if not _tm.ENABLED:
+            return fn
+        try:
+            from ray_tpu.parallel.compile_watch import CompiledFunction
+
+            return CompiledFunction(fn, name=f"serve_batch::{self._name}")
+        except Exception:
+            return fn
 
     def submit(self, item):
         pending = _Pending(item)
@@ -82,6 +171,20 @@ class _Batcher:
             del self._queue[: len(batch)]
             return batch
 
+    def _pad_to_bucket(self, items: list) -> tuple[list, int]:
+        """Pad ``items`` up to the smallest bucket that fits by
+        replicating the last real item (never a sentinel — the wrapped
+        function must not need a null-request concept). Returns the
+        padded list and the pad count; a no-op when bucketing is off."""
+        if self.bucket_sizes is None:
+            return items, 0
+        n = len(items)
+        bucket = next(b for b in self.bucket_sizes if b >= n)
+        pad = bucket - n
+        if pad:
+            items = items + [items[-1]] * pad
+        return items, pad
+
     def _loop(self):
         while True:
             batch = self._take_batch()
@@ -92,21 +195,43 @@ class _Batcher:
                         continue
                     self._thread = None
                     return
+            items, pad = self._pad_to_bucket([p.item for p in batch])
             try:
-                results = self._fn([p.item for p in batch])
-                if results is None or len(results) != len(batch):
+                results = self._fn(items)
+                if results is None or len(results) != len(items):
                     raise TypeError(
                         f"@serve.batch function must return a list with one "
-                        f"result per input ({len(batch)} expected, got "
+                        f"result per input ({len(items)} expected"
+                        f"{f', incl. {pad} padded' if pad else ''}, got "
                         f"{None if results is None else len(results)})")
                 for pending, result in zip(batch, results):
-                    pending.result = result
+                    pending.result = result   # padded tail dropped by zip
+                _tm.observe("ray_tpu_serve_batch_size_tasks", len(items),
+                            tags={"fn": self._name})
+                _tm.observe("ray_tpu_serve_batch_pad_waste_tasks", pad,
+                            tags={"fn": self._name})
             except BaseException as exc:  # noqa: BLE001 — fan the error out
                 for pending in batch:
-                    pending.error = exc
+                    pending.error = _clone_exception(exc)
             finally:
                 for pending in batch:
                     pending.event.set()
+
+
+def _reject_bad_call(args: tuple, kwargs: dict, name: str):
+    """One clear error for the two call-shape mistakes, instead of a bare
+    arity TypeError from deep inside the batcher."""
+    if kwargs:
+        raise TypeError(
+            f"@serve.batch function {name!r} takes a single positional "
+            f"request argument; unexpected keyword arguments "
+            f"{sorted(kwargs)} — pack request fields into the one request "
+            f"object (the wrapped function receives a LIST of them)")
+    if len(args) != 1:
+        raise TypeError(
+            f"@serve.batch function {name!r} takes exactly one request "
+            f"argument per call (got {len(args)}); it is invoked once per "
+            f"REQUEST, and the wrapped function receives the batched list")
 
 
 class _BatchWrapper:
@@ -116,52 +241,97 @@ class _BatchWrapper:
     queue)."""
 
     def __init__(self, fn: Callable, max_batch_size: int,
-                 batch_wait_timeout_s: float):
+                 batch_wait_timeout_s: float,
+                 batch_size_buckets: tuple[int, ...] | None = None):
+        if max_batch_size < 1:
+            raise ValueError(
+                f"max_batch_size must be >= 1, got {max_batch_size}")
+        if batch_wait_timeout_s < 0:
+            raise ValueError(f"batch_wait_timeout_s must be >= 0, got "
+                             f"{batch_wait_timeout_s}")
+        if batch_size_buckets:
+            bad = [b for b in batch_size_buckets
+                   if not isinstance(b, int) or b < 1 or b > max_batch_size]
+            if bad:
+                # a bucket above max_batch_size would PAD batches past
+                # the bound the wrapped function was sized for
+                raise ValueError(
+                    f"batch_size_buckets must be integers in "
+                    f"[1, max_batch_size={max_batch_size}], got {bad}")
         self._fn = fn
         self._max_batch_size = max_batch_size
         self._batch_wait_timeout_s = batch_wait_timeout_s
+        self._batch_size_buckets = (tuple(batch_size_buckets)
+                                    if batch_size_buckets else None)
         self._batcher: _Batcher | None = None
+        # guards batcher creation: the FIRST _make_batcher triggers the
+        # (slow) compile_watch import, and concurrent first callers that
+        # each saw None would otherwise every one build a private
+        # batcher — 8 queues of 1 item each, i.e. no coalescing at all
+        self._creation_lock = threading.Lock()
         self._instance_attr = f"__serve_batcher_{id(self)}"
         self.__name__ = getattr(fn, "__name__", "batched")
         self.__doc__ = getattr(fn, "__doc__", None)
 
+    # The wrapper rides inside deployment specs (a class attribute of
+    # the user class, cloudpickled to the controller/replicas): ship
+    # only the recipe — the creation lock is unpicklable and a live
+    # batcher (thread + queue) is meaningless in another process.
+    def __getstate__(self):
+        return {"fn": self._fn, "max_batch_size": self._max_batch_size,
+                "batch_wait_timeout_s": self._batch_wait_timeout_s,
+                "batch_size_buckets": self._batch_size_buckets}
+
+    def __setstate__(self, state):
+        self.__init__(state["fn"], state["max_batch_size"],
+                      state["batch_wait_timeout_s"],
+                      state["batch_size_buckets"])
+
+    def _make_batcher(self, fn) -> _Batcher:
+        return _Batcher(fn, self._max_batch_size,
+                        self._batch_wait_timeout_s,
+                        bucket_sizes=self._batch_size_buckets,
+                        name=self.__name__)
+
     def _get_batcher(self, instance=None) -> _Batcher:
         if instance is None:
             if self._batcher is None:
-                self._batcher = _Batcher(
-                    self._fn, self._max_batch_size,
-                    self._batch_wait_timeout_s)
+                with self._creation_lock:
+                    if self._batcher is None:
+                        self._batcher = self._make_batcher(self._fn)
             return self._batcher
         batcher = getattr(instance, self._instance_attr, None)
         if batcher is None:
-            bound = self._fn.__get__(instance, type(instance))
-            batcher = _Batcher(bound, self._max_batch_size,
-                               self._batch_wait_timeout_s)
-            setattr(instance, self._instance_attr, batcher)
+            with self._creation_lock:
+                batcher = getattr(instance, self._instance_attr, None)
+                if batcher is None:
+                    bound = self._fn.__get__(instance, type(instance))
+                    batcher = self._make_batcher(bound)
+                    setattr(instance, self._instance_attr, batcher)
         return batcher
 
-    def __call__(self, *args):
-        if len(args) != 1:
-            raise TypeError(
-                "@serve.batch functions take exactly one request argument "
-                f"per call (got {len(args)})")
+    def __call__(self, *args, **kwargs):
+        _reject_bad_call(args, kwargs, self.__name__)
         return self._get_batcher().submit(args[0])
 
     def __get__(self, instance, owner=None):
         if instance is None:
             return self
         batcher = self._get_batcher(instance)
+        name = self.__name__
 
-        def bound(item):
-            return batcher.submit(item)
+        def bound(*args, **kwargs):
+            _reject_bad_call(args, kwargs, name)
+            return batcher.submit(args[0])
 
-        bound.__name__ = self.__name__
+        bound.__name__ = name
         bound._serve_batcher = batcher
         return bound
 
 
 def batch(fn=None, *, max_batch_size: int = 8,
-          batch_wait_timeout_s: float = 0.01):
+          batch_wait_timeout_s: float = 0.01,
+          batch_size_buckets: list[int] | tuple[int, ...] | None = None):
     """Coalesce concurrent single-item calls into one list-in/list-out call.
 
     Usage (method or free function)::
@@ -177,11 +347,20 @@ def batch(fn=None, *, max_batch_size: int = 8,
 
     Each caller passes ONE item and receives ONE result; the wrapped
     function always receives a list and must return an equal-length list.
+
+    Shape awareness: batches are padded up to a small set of bucket sizes
+    (powers of two up to ``max_batch_size``, or an explicit
+    ``batch_size_buckets``) so a jitted wrapped function compiles a
+    handful of programs instead of one per observed batch size. Padded
+    slots replicate the last real request and their outputs are dropped.
+    ``RAY_TPU_SERVE_SHAPE_BUCKETS=0`` disables padding (legacy behavior).
     """
     if fn is not None:
-        return _BatchWrapper(fn, max_batch_size, batch_wait_timeout_s)
+        return _BatchWrapper(fn, max_batch_size, batch_wait_timeout_s,
+                             batch_size_buckets)
 
     def decorate(inner):
-        return _BatchWrapper(inner, max_batch_size, batch_wait_timeout_s)
+        return _BatchWrapper(inner, max_batch_size, batch_wait_timeout_s,
+                             batch_size_buckets)
 
     return decorate

@@ -4,10 +4,20 @@ Reference parity: python/ray/serve/config.py (DeploymentConfig,
 AutoscalingConfig, HTTPOptions). Plain dataclasses here — the reference uses
 pydantic for REST-facing validation; our REST surface is the JSON status
 endpoint only, so stdlib dataclasses keep the dependency surface zero.
+Validation happens in ``__post_init__`` instead (the pydantic analog):
+bad values raise a named ``ServeConfigError`` at construction, where the
+operator wrote them, not as a deep runtime failure three actors later.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+
+from ray_tpu.exceptions import ServeConfigError
+
+
+def _require(cond: bool, message: str):
+    if not cond:
+        raise ServeConfigError(message)
 
 
 @dataclass
@@ -29,6 +39,25 @@ class AutoscalingConfig:
     # decision (1.0 = jump straight to desired).
     smoothing_factor: float = 1.0
 
+    def __post_init__(self):
+        _require(self.min_replicas >= 0,
+                 f"min_replicas must be >= 0, got {self.min_replicas}")
+        _require(self.max_replicas >= 1,
+                 f"max_replicas must be >= 1, got {self.max_replicas}")
+        _require(self.min_replicas <= self.max_replicas,
+                 f"min_replicas ({self.min_replicas}) must not exceed "
+                 f"max_replicas ({self.max_replicas})")
+        _require(self.target_ongoing_requests > 0,
+                 f"target_ongoing_requests must be > 0, got "
+                 f"{self.target_ongoing_requests}")
+        for name in ("upscale_delay_s", "downscale_delay_s",
+                     "metrics_interval_s"):
+            _require(getattr(self, name) >= 0,
+                     f"{name} must be >= 0, got {getattr(self, name)}")
+        _require(self.smoothing_factor > 0,
+                 f"smoothing_factor must be > 0, got "
+                 f"{self.smoothing_factor}")
+
     def desired_replicas(self, current: int, total_ongoing: float) -> int:
         if current == 0:
             return self.min_replicas
@@ -48,10 +77,15 @@ class DeploymentConfig:
 
     Reference: serve/config.py DeploymentConfig (num_replicas,
     max_ongoing_requests nee max_concurrent_queries, user_config,
-    graceful_shutdown, health checks).
+    graceful_shutdown, health checks). ``max_queued_requests`` bounds the
+    router-side wait queue PER REPLICA: once every replica is at
+    ``max_ongoing_requests`` and ``max_queued_requests * num_replicas``
+    callers are already waiting, further requests are shed with
+    ``ServeOverloadedError`` instead of queuing without bound.
     """
     num_replicas: int = 1
     max_ongoing_requests: int = 8
+    max_queued_requests: int = 32
     user_config: object = None
     graceful_shutdown_timeout_s: float = 5.0
     health_check_period_s: float = 2.0
@@ -59,13 +93,42 @@ class DeploymentConfig:
     autoscaling_config: AutoscalingConfig | None = None
     ray_actor_options: dict = field(default_factory=dict)
 
+    def __post_init__(self):
+        _require(self.num_replicas >= 1,
+                 f"num_replicas must be >= 1, got {self.num_replicas}")
+        _require(self.max_ongoing_requests >= 1,
+                 f"max_ongoing_requests must be >= 1, got "
+                 f"{self.max_ongoing_requests}")
+        _require(self.max_queued_requests >= 0,
+                 f"max_queued_requests must be >= 0, got "
+                 f"{self.max_queued_requests}")
+        for name in ("graceful_shutdown_timeout_s", "health_check_period_s",
+                     "health_check_timeout_s"):
+            _require(getattr(self, name) >= 0,
+                     f"{name} must be >= 0, got {getattr(self, name)}")
+
     def to_dict(self) -> dict:
         from dataclasses import asdict
 
-        d = asdict(self)
-        if self.autoscaling_config is not None:
-            d["autoscaling_config"] = asdict(self.autoscaling_config)
-        return d
+        # field-by-field, NOT asdict(self): user_config is OPAQUE user
+        # data — asdict would recursively convert any dataclass inside
+        # it to a plain dict and deep-copy every value (crashing on
+        # un-deepcopy-able values like locks/handles, paying a full copy
+        # of large weight pytrees), mangling what the replica's
+        # reconfigure receives
+        return {
+            "num_replicas": self.num_replicas,
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "max_queued_requests": self.max_queued_requests,
+            "user_config": self.user_config,
+            "graceful_shutdown_timeout_s": self.graceful_shutdown_timeout_s,
+            "health_check_period_s": self.health_check_period_s,
+            "health_check_timeout_s": self.health_check_timeout_s,
+            "autoscaling_config": (asdict(self.autoscaling_config)
+                                   if self.autoscaling_config is not None
+                                   else None),
+            "ray_actor_options": dict(self.ray_actor_options),
+        }
 
     @classmethod
     def from_dict(cls, d: dict) -> "DeploymentConfig":

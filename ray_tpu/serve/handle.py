@@ -38,8 +38,10 @@ def _get_router(deployment_id: str):
             info = ray_tpu.get(
                 controller.get_deployment_info.remote(deployment_id))
             cap = (info or {}).get("max_ongoing_requests", 8)
+            queued_cap = (info or {}).get("max_queued_requests", 32)
             router = Router(controller, deployment_id,
-                            max_ongoing_requests=cap)
+                            max_ongoing_requests=cap,
+                            max_queued_requests=queued_cap)
             _routers[deployment_id] = router
         return router
 
@@ -54,8 +56,15 @@ def _shutdown_routers():
 class DeploymentResponse:
     """Future-like result of a handle call (reference: handle.py
     DeploymentResponse). Submits eagerly; ``result()`` transparently
-    retries on another replica if the chosen one died (the reference's
-    replica scheduler does the same for actor-died failures)."""
+    retries on another replica if the chosen one died or started
+    draining (the reference's replica scheduler does the same for
+    actor-died failures).
+
+    Failover latency: when the router has a GCS death watch, ``result()``
+    waits in short slices and checks the router's death flag between
+    them, so a replica killed mid-request fails over within ~the death
+    feed's publish latency (milliseconds-to-sub-second) instead of
+    waiting for the object layer to surface ``ActorDiedError``."""
 
     MAX_REPLICA_RETRIES = 3
 
@@ -64,14 +73,92 @@ class DeploymentResponse:
         self._method_name = method_name
         self._args = args
         self._kwargs = kwargs
+        import time
+
+        # stamped BEFORE assign_request: the router's bounded-queue wait
+        # happens inside it, and the latency histogram is cataloged as
+        # router queueing + execution — exactly the overload signal
+        self._start = time.monotonic()
+        # public: how many times this request was re-dispatched to
+        # another replica (death/drain failover) — 0 on the happy path.
+        # Lets callers and benches attribute tail latency to failover.
+        self.num_failovers = 0
+        # settled outcome, replayed by repeat result() calls (metrics
+        # and retries must run once per REQUEST, not once per call)
+        self._done = False
+        self._value = None
+        self._error: BaseException | None = None
         self._ref, self._replica_id = router.assign_request(
             method_name, args, kwargs)
 
+    def _get(self, remaining):
+        """One attempt against the currently-assigned replica. Raises
+        ActorDiedError as soon as the router's death feed flags the
+        replica — without this, a killed replica's in-flight request
+        waits on the object layer's own (slower) death propagation.
+
+        The get is attempted BEFORE the death flag is consulted: a
+        replica that died just after completing the request leaves a
+        perfectly good result in the object store, and re-executing it
+        on a survivor would double the side effects and the latency.
+
+        The short-timeout re-entry loop is a deliberate tradeoff vs the
+        WorkerGroup waiter-thread pattern (PR 5): serve requests are
+        typically short (one-few polls total), a thread per request is
+        worse at serving QPS, and for a long-running request the ≲1 Hz
+        re-entries cost milliseconds against its multi-second body."""
+        import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError, GetTimeoutError
+
+        if not self._router.has_death_watch():
+            return ray_tpu.get(self._ref, timeout=remaining())
+        poll = 0.05
+        while True:
+            left = remaining()
+            try:
+                return ray_tpu.get(self._ref,
+                                   timeout=(poll if left is None
+                                            else min(poll, left)))
+            except GetTimeoutError:
+                if self._router.replica_dead(self._replica_id):
+                    raise ActorDiedError(
+                        "", f"replica {self._replica_id} flagged dead by "
+                            f"the router death feed") from None
+                if left is not None and left <= poll:
+                    raise
+                poll = min(poll * 2, 1.0)   # escalate: cheap for short
+                #                             requests, low overhead for long
+
     def result(self, timeout_s: float | None = None):
+        if self._done:
+            # replay the settled outcome: metrics/retries ran once
+            if self._error is not None:
+                raise self._error
+            return self._value
+        try:
+            self._value = self._result_once(timeout_s)
+            self._done = True
+            return self._value
+        except BaseException as e:
+            # timeouts are NOT settled (the caller may retry with more
+            # budget); terminal errors are
+            from ray_tpu.exceptions import GetTimeoutError
+
+            if not isinstance(e, (GetTimeoutError, TimeoutError)):
+                self._error = e
+                self._done = True
+            raise
+
+    def _result_once(self, timeout_s: float | None):
         import time
 
-        import ray_tpu
-        from ray_tpu.exceptions import ActorDiedError
+        import ray_tpu  # noqa: F401  (runtime must be initialized)
+        from ray_tpu._private import telemetry as _tm
+        from ray_tpu.exceptions import (
+            ActorDiedError,
+            ReplicaDrainingError,
+            TaskError,
+        )
 
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
@@ -80,9 +167,15 @@ class DeploymentResponse:
             return (None if deadline is None
                     else max(0.0, deadline - time.monotonic()))
 
+        dep = self._router._deployment_id
         for attempt in range(self.MAX_REPLICA_RETRIES + 1):
             try:
-                result = ray_tpu.get(self._ref, timeout=remaining())
+                result = self._get(remaining)
+                _tm.observe("ray_tpu_serve_request_latency_seconds",
+                            time.monotonic() - self._start,
+                            tags={"deployment": dep})
+                _tm.counter_inc("ray_tpu_serve_requests_total",
+                                tags={"deployment": dep, "result": "ok"})
                 if isinstance(result, dict) and "__serve_stream__" in result:
                     # streaming deployment: hand back an iterator pulling
                     # chunks from the replica (HTTP callers get chunked
@@ -92,11 +185,36 @@ class DeploymentResponse:
             except ActorDiedError:
                 self._router.mark_replica_dead(self._replica_id)
                 if attempt == self.MAX_REPLICA_RETRIES:
+                    _tm.counter_inc("ray_tpu_serve_requests_total",
+                                    tags={"deployment": dep,
+                                          "result": "error"})
                     raise
-                left = remaining()   # re-read: the failed get consumed time
-                self._ref, self._replica_id = self._router.assign_request(
-                    self._method_name, self._args, self._kwargs,
-                    timeout_s=left if left is not None else 30.0)
+            except (ReplicaDrainingError, TaskError) as e:
+                # a draining replica refuses the request with a typed
+                # error: re-dispatch to a survivor (scale-down must not
+                # lose accepted requests that raced the routing update).
+                # RayError subclasses ship UNWRAPPED (serialize_error),
+                # so the drain error arrives as itself — the TaskError
+                # arm only covers transports that wrap it anyway.
+                draining = isinstance(e, ReplicaDrainingError) or \
+                    getattr(e, "cause_cls_name", None) == \
+                    "ReplicaDrainingError"
+                if not draining or attempt == self.MAX_REPLICA_RETRIES:
+                    _tm.counter_inc("ray_tpu_serve_requests_total",
+                                    tags={"deployment": dep,
+                                          "result": "error"})
+                    raise
+                # drop the drainer from selection: it rejects instantly
+                # (in_flight ~0), so p2c would re-pick it every retry
+                # until the controller's broadcast lands
+                self._router.mark_replica_draining(self._replica_id)
+                _tm.counter_inc("ray_tpu_serve_failovers_total",
+                                tags={"deployment": dep})
+            left = remaining()   # re-read: the failed get consumed time
+            self.num_failovers += 1
+            self._ref, self._replica_id = self._router.assign_request(
+                self._method_name, self._args, self._kwargs,
+                timeout_s=left if left is not None else 30.0)
 
     def _to_object_ref(self):
         return self._ref
