@@ -1,0 +1,26 @@
+"""raylint: repo-wide invariant lint + lock-discipline analysis plane.
+
+Four pass families over ``ray_tpu/`` (and the native sources they must
+stay consistent with):
+
+- ``lock-discipline`` (RTL1xx) — blocking calls / user callbacks under
+  locks, timeout-less polls, lock-order cycles, lock-free writes to
+  guarded attributes;
+- ``knob-registry`` (RTK2xx) — every ``RAY_TPU_*`` env read declared in
+  ``_private/knobs.KNOBS``, catalog/README drift both directions;
+- ``wire-format`` (RTW3xx) — PROTOCOL_VERSION / frame kinds / shm oid
+  layout consistent across ``protocol.py`` and ``src/rpc/rpc_core.cc``;
+- ``metric-catalog`` + ``event-catalog`` (RTC4xx) — metric and event
+  names declared in their single-source-of-truth catalogs.
+
+Run it: ``ray-tpu lint`` (or ``python -m ray_tpu.scripts.cli lint``).
+Gate suite: ``tests/test_zz_lint.py``. Suppress one line with
+``# raylint: disable=<CODE>``; document a by-design finding in
+``baseline.txt`` (with a justification comment).
+"""
+from ray_tpu._private.analysis.core import (AnalysisContext, Finding,
+                                            format_baseline, load_baseline,
+                                            partition, run_all)
+
+__all__ = ["AnalysisContext", "Finding", "format_baseline",
+           "load_baseline", "partition", "run_all"]

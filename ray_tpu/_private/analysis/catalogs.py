@@ -1,0 +1,160 @@
+"""Catalog-consistency passes (the ``RTC4xx`` family).
+
+Unifies the metric-catalog lint that previously lived inside
+``tests/test_telemetry_metrics.py`` (the test now calls this pass) and
+adds the analogous event-name lint against ``_private/events.py``'s
+docstring catalog:
+
+- **RTC401 — undeclared metric literal.** Any ``ray_tpu_*<unit>``
+  string in the tree must be declared in ``telemetry.CATALOG``.
+- **RTC402 — malformed catalog entry.** Catalog names need the
+  ``ray_tpu_`` prefix, a unit suffix, a known kind; counters must end
+  ``_total``.
+- **RTC403 — grafana panel charts a phantom metric.** Dashboard
+  exprs may only reference cataloged names.
+- **RTC404 — unregistered event kind.** ``events.record("<kind>")``
+  with a kind the events.py module docstring doesn't document.
+- **RTC405 — dead event catalog entry.** A documented kind nothing
+  records any more.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu._private.analysis.core import (AnalysisContext, Finding,
+                                            dotted, register)
+
+EVENTS_PY = "ray_tpu/_private/events.py"
+TELEMETRY_PY = "ray_tpu/_private/telemetry.py"
+
+_EVENT_SECTION_START = "Event kinds recorded by the runtime:"
+_EVENT_ENTRY_RE = re.compile(r"``([A-Za-z_]+)``")
+
+
+# ------------------------------------------------------------ event kinds
+
+def documented_event_kinds(ctx: AnalysisContext) -> set[str] | None:
+    """Kinds cataloged in events.py's module docstring (the ``- ``x````
+    entries under "Event kinds recorded by the runtime:"). None when the
+    docstring/section is missing entirely."""
+    mod = ctx.module(EVENTS_PY)
+    if mod is None:
+        return None
+    doc = ast.get_docstring(mod.tree) or ""
+    if _EVENT_SECTION_START not in doc:
+        return None
+    section = doc.split(_EVENT_SECTION_START, 1)[1]
+    kinds: set[str] = set()
+    for line in section.splitlines():
+        if line.strip().startswith("- ``"):
+            head = line.split("—", 1)[0]
+            kinds.update(_EVENT_ENTRY_RE.findall(head))
+    return kinds
+
+
+def recorded_event_kinds(ctx: AnalysisContext):
+    """Yield (kind, path, node) for every literal-kind record() call."""
+    for mod in ctx.package_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name == "record" and mod.path == EVENTS_PY:
+                pass   # events.py's own helpers call record() bare
+            elif not name.endswith(".record"):
+                continue
+            else:
+                recv = name.rsplit(".", 1)[0].rsplit(".", 1)[-1]
+                if recv not in ("events", "_events"):
+                    continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                yield node.args[0].value, mod.path, node
+
+
+@register("event-catalog")
+def event_catalog_pass(ctx: AnalysisContext):
+    documented = documented_event_kinds(ctx)
+    if documented is None:
+        yield Finding(
+            "RTC404", EVENTS_PY, 1, "<docstring>",
+            "events.py module docstring lost its \"Event kinds recorded "
+            "by the runtime:\" catalog section")
+        return
+    recorded: set[str] = set()
+    for kind, path, node in recorded_event_kinds(ctx):
+        recorded.add(kind)
+        if kind not in documented:
+            yield Finding(
+                "RTC404", path, node.lineno, kind,
+                f"event kind {kind!r} is recorded but not documented in "
+                f"events.py's docstring catalog — consumers discover "
+                f"kinds there (and `ray-tpu events --kind`)")
+    for kind in sorted(documented - recorded):
+        yield Finding(
+            "RTC405", EVENTS_PY, 1, kind,
+            f"event kind {kind!r} is documented in the catalog but "
+            f"nothing records it — dead entry, or its producer was "
+            f"dropped by mistake")
+
+
+# ---------------------------------------------------------------- metrics
+
+@register("metric-catalog")
+def metric_catalog_pass(ctx: AnalysisContext):
+    from ray_tpu._private.telemetry import ALLOWED_SUFFIXES, CATALOG
+
+    for name, spec in CATALOG.items():
+        problems = []
+        if not name.startswith("ray_tpu_"):
+            problems.append("missing the ray_tpu_ prefix")
+        if not name.endswith(ALLOWED_SUFFIXES):
+            problems.append(f"lacks a unit suffix {ALLOWED_SUFFIXES}")
+        if spec.get("kind") not in ("Counter", "Gauge", "Histogram"):
+            problems.append(f"unknown kind {spec.get('kind')!r}")
+        elif spec["kind"] == "Counter" and not name.endswith("_total"):
+            problems.append("counters must end in _total")
+        if problems:
+            yield Finding("RTC402", TELEMETRY_PY, 1, name,
+                          f"catalog entry {name}: " + "; ".join(problems))
+
+    suffix_re = "|".join(s.lstrip("_") for s in ALLOWED_SUFFIXES)
+    pat = re.compile(r"""["'](ray_tpu_[a-z0-9_]+_(?:%s))["']"""
+                     % suffix_re)
+    for mod in ctx.package_modules():
+        if mod.path == TELEMETRY_PY:
+            continue
+        for i, line in enumerate(mod.source.splitlines(), start=1):
+            for m in pat.finditer(line):
+                if m.group(1) not in CATALOG:
+                    yield Finding(
+                        "RTC401", mod.path, i, m.group(1),
+                        f"internal metric {m.group(1)!r} is not "
+                        f"declared in _private/telemetry.py CATALOG")
+
+    # grafana: the default dashboard may only chart cataloged metrics
+    try:
+        from ray_tpu.dashboard.grafana import generate_default_dashboard
+
+        dash = generate_default_dashboard()
+    except Exception as e:   # import/runtime break = a finding, not a skip
+        yield Finding("RTC403", "ray_tpu/dashboard/grafana.py", 1,
+                      "generate_default_dashboard",
+                      f"default dashboard generation failed: {e!r}")
+        return
+    if not dash.get("panels"):
+        yield Finding("RTC403", "ray_tpu/dashboard/grafana.py", 1,
+                      "generate_default_dashboard",
+                      "default dashboard lost its panels")
+    for panel in dash.get("panels", []):
+        for target in panel.get("targets", []):
+            for name in re.findall(r"ray_tpu_[a-z0-9_]+",
+                                   target.get("expr", "")):
+                base = re.sub(r"_(?:bucket|sum|count)$", "", name)
+                if base not in CATALOG and name not in CATALOG:
+                    yield Finding(
+                        "RTC403", "ray_tpu/dashboard/grafana.py", 1,
+                        f"{panel.get('title', '?')}:{name}",
+                        f"grafana panel {panel.get('title')!r} charts "
+                        f"{name!r}, which the runtime never emits")

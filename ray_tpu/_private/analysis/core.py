@@ -1,0 +1,290 @@
+"""raylint core: AST pass registry, findings, suppressions, baseline.
+
+The repo-wide invariant checks (metric catalog, event catalog, knob
+registry, lock discipline, wire-format consistency) started life as
+ad-hoc asserts inside test files; this package makes them a subsystem
+with one contract, the shape of the reference's sanitizer-tagged test
+configs (python/ray/tests/BUILD asan tags) applied to *static*
+invariants:
+
+- every check is a registered **pass** producing typed ``Finding``s
+  (stable code + file:line + a stable context key);
+- a finding is silenced either **inline** (``# raylint: disable=CODE``
+  on the offending line or the line above) or via the checked-in
+  **baseline** (``baseline.txt`` next to this file — one line per
+  documented-by-design finding, each with a justification comment);
+- anything not silenced fails ``ray-tpu lint`` and the late-alphabet
+  gate suite ``tests/test_zz_lint.py``.
+
+Passes are pure functions over an ``AnalysisContext`` (parsed-once ASTs
+plus raw text access with override hooks so tests can tamper with a
+file's content without touching disk).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Callable, Iterable
+
+SUPPRESS_RE = re.compile(r"#\s*raylint:\s*disable=([A-Z0-9,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str       # e.g. "RTL101" — stable, documented in README
+    path: str       # repo-relative posix path
+    line: int       # 1-indexed; NOT part of the baseline key
+    context: str    # stable anchor, e.g. "Router._update_replicas"
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: line numbers drift with unrelated edits,
+        so the key is (code, file, enclosing def/class) instead."""
+        return f"{self.code} {self.path} {self.context}"
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: {self.code} [{self.context}] "
+                f"{self.message}")
+
+
+class Module:
+    """One parsed source file."""
+
+    __slots__ = ("path", "source", "tree", "_suppressions")
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source)
+        self._suppressions: dict[int, set[str]] | None = None
+
+    @property
+    def suppressions(self) -> dict[int, set[str]]:
+        """{lineno: {codes}} for every ``# raylint: disable=...``."""
+        if self._suppressions is None:
+            sup: dict[int, set[str]] = {}
+            for i, line in enumerate(self.source.splitlines(), start=1):
+                m = SUPPRESS_RE.search(line)
+                if m:
+                    sup[i] = {c.strip() for c in m.group(1).split(",")
+                              if c.strip()}
+            self._suppressions = sup
+        return self._suppressions
+
+    def suppressed(self, finding: Finding) -> bool:
+        """The comment silences the reported line; the line above also
+        counts, for expressions too long to share a line with it."""
+        for ln in (finding.line, finding.line - 1):
+            if finding.code in self.suppressions.get(ln, set()):
+                return True
+        return False
+
+
+class AnalysisContext:
+    """Lazily loads and caches the repo's sources for the passes.
+
+    ``overrides`` maps repo-relative paths to replacement text (or None
+    to simulate a deleted file) — the tamper hook the wire-format tests
+    use to prove that e.g. a dropped PROTOCOL_VERSION line fails the
+    lint without editing the real file.
+    """
+
+    def __init__(self, root: str | Path | None = None,
+                 overrides: dict[str, str | None] | None = None):
+        if root is None:
+            import ray_tpu
+
+            root = Path(ray_tpu.__file__).resolve().parent.parent
+        self.root = Path(root)
+        self.overrides = dict(overrides or {})
+        self._modules: dict[str, Module | None] = {}
+
+    # ----------------------------------------------------------- file io
+    def read_text(self, relpath: str) -> str | None:
+        """Raw text of a repo file (None when absent/overridden away)."""
+        if relpath in self.overrides:
+            return self.overrides[relpath]
+        p = self.root / relpath
+        try:
+            return p.read_text()
+        except OSError:
+            return None
+
+    def module(self, relpath: str) -> Module | None:
+        """Parsed module for one .py file (None when missing or
+        syntactically broken — the latter surfaces loudly elsewhere)."""
+        if relpath not in self._modules:
+            src = self.read_text(relpath)
+            try:
+                self._modules[relpath] = (Module(relpath, src)
+                                          if src is not None else None)
+            except SyntaxError:
+                self._modules[relpath] = None
+        return self._modules[relpath]
+
+    def package_files(self, package: str = "ray_tpu") -> list[str]:
+        names = set()
+        for p in sorted((self.root / package).rglob("*.py")):
+            names.add(p.relative_to(self.root).as_posix())
+        for rel in self.overrides:
+            if rel.startswith(package + "/") and rel.endswith(".py") \
+                    and self.overrides[rel] is not None:
+                names.add(rel)
+        return sorted(n for n in names
+                      if self.overrides.get(n, "") is not None)
+
+    def package_modules(self, package: str = "ray_tpu"):
+        for rel in self.package_files(package):
+            mod = self.module(rel)
+            if mod is not None:
+                yield mod
+
+
+# --------------------------------------------------------------- registry
+
+PassFn = Callable[[AnalysisContext], Iterable[Finding]]
+PASSES: dict[str, PassFn] = {}
+
+
+def register(name: str):
+    def deco(fn: PassFn) -> PassFn:
+        PASSES[name] = fn
+        return fn
+    return deco
+
+
+def _load_passes():
+    """Import the pass modules (registration is import-time)."""
+    from ray_tpu._private.analysis import (  # noqa: F401
+        catalogs, knobs_pass, lock_discipline, wire_format)
+
+
+def run_all(ctx: AnalysisContext | None = None,
+            passes: Iterable[str] | None = None) -> list[Finding]:
+    """Run the requested passes (default: all) and return every finding
+    that is NOT inline-suppressed. Baseline filtering is the caller's
+    (``partition``) — callers usually want to see both sets."""
+    _load_passes()
+    if ctx is None:
+        ctx = AnalysisContext()
+    names = list(passes) if passes is not None else sorted(PASSES)
+    unknown = [n for n in names if n not in PASSES]
+    if unknown:
+        raise ValueError(
+            f"unknown pass name(s) {unknown}; valid passes: "
+            f"{sorted(PASSES)}")
+    findings: list[Finding] = []
+    for name in names:
+        for f in PASSES[name](ctx):
+            mod = ctx.module(f.path) if f.path.endswith(".py") else None
+            if mod is not None and mod.suppressed(f):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+# --------------------------------------------------------------- baseline
+
+BASELINE_PATH = Path(__file__).with_name("baseline.txt")
+
+
+def load_baseline(path: str | Path | None = None) -> dict[str, str]:
+    """{finding key: justification}. Format, one finding per line::
+
+        CODE path context  # why this is by-design
+
+    Blank lines and full-line comments are ignored. The justification
+    comment is REQUIRED by the gate suite — an unexplained baseline
+    entry is itself a finding of the process, not the code."""
+    p = Path(path) if path is not None else BASELINE_PATH
+    entries: dict[str, str] = {}
+    try:
+        text = p.read_text()
+    except OSError:
+        return entries
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        body, _, comment = stripped.partition("#")
+        parts = body.split()
+        if len(parts) >= 3:
+            entries[" ".join(parts[:3])] = comment.strip()
+    return entries
+
+
+# finding-code prefixes each pass family owns — staleness judgements
+# only apply to families that actually ran (a `--passes wire-format`
+# run must not condemn the lock-discipline baseline as stale)
+PASS_CODES = {
+    "lock-discipline": ("RTL",),
+    "knob-registry": ("RTK",),
+    "wire-format": ("RTW",),
+    "metric-catalog": ("RTC401", "RTC402", "RTC403"),
+    "event-catalog": ("RTC404", "RTC405"),
+}
+
+
+def partition(findings: Iterable[Finding],
+              baseline: dict[str, str] | None = None,
+              passes: Iterable[str] | None = None):
+    """(new, baselined, stale_keys): findings not covered by the
+    baseline, findings the baseline documents, and baseline keys no
+    pass produced any more (candidates for deletion). ``passes``
+    restricts the staleness check to those families' codes (default:
+    all)."""
+    if baseline is None:
+        baseline = load_baseline()
+    prefixes = None
+    if passes is not None:
+        prefixes = tuple(p for name in passes
+                         for p in PASS_CODES.get(name, ()))
+    new, known = [], []
+    seen = set()
+    for f in findings:
+        seen.add(f.key)
+        (known if f.key in baseline else new).append(f)
+    stale = sorted(
+        k for k in baseline if k not in seen
+        and (prefixes is None or k.startswith(prefixes)))
+    return new, known, stale
+
+
+def format_baseline(findings: Iterable[Finding]) -> str:
+    """Render findings as baseline lines (justifications left TODO —
+    the gate suite requires a human to fill them in)."""
+    lines = []
+    for f in sorted(set(f.key for f in findings)):
+        lines.append(f"{f}  # TODO: justify or fix")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ------------------------------------------------------------ AST helpers
+# shared by the pass modules
+
+
+def qualname_of(stack: list[ast.AST]) -> str:
+    """Stable context key from the enclosing class/function stack."""
+    names = [n.name for n in stack
+             if isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                               ast.AsyncFunctionDef))]
+    return ".".join(names) if names else "<module>"
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted best-effort name of a call target: ``time.sleep``,
+    ``self._lock.acquire``, ``loader``..."""
+    return dotted(node.func)
+
+
+def dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""

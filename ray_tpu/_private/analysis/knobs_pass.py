@@ -1,0 +1,113 @@
+"""Knob-registry passes (the ``RTK2xx`` family).
+
+- **RTK201 — undeclared knob read.** Every explicit
+  ``os.environ``/``getenv`` read of a ``RAY_TPU_*`` name inside
+  ``ray_tpu/`` must be declared in ``_private/knobs.KNOBS`` (or be a
+  config-table-derived ``RAY_TPU_<CONFIG_KEY>``). A typo'd read
+  otherwise silently returns the default forever.
+- **RTK202 — knob missing from README.** Every cataloged knob must
+  appear in README (its tables are generated from the catalog, so this
+  only fires when someone adds a knob and forgets to regenerate).
+- **RTK203 — dead catalog entry.** A cataloged knob no source file
+  reads any more: delete it (or the code that should read it got
+  dropped by mistake).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu._private.analysis.core import (AnalysisContext, Finding,
+                                            dotted, register)
+
+_ENV_CALLS = {"os.environ.get", "environ.get", "os.getenv", "getenv",
+              "os.environ.setdefault", "environ.setdefault",
+              "os.environ.pop", "environ.pop"}
+_KNOB_RE = re.compile(r"^RAY_TPU_[A-Z0-9_]+$")
+
+
+def _env_reads(tree: ast.Module):
+    """Yield (name, node) for every RAY_TPU_* env access by literal."""
+    for node in ast.walk(tree):
+        literal = None
+        if isinstance(node, ast.Call) and dotted(node.func) in _ENV_CALLS:
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                literal = node.args[0].value
+        elif isinstance(node, ast.Subscript) and \
+                dotted(node.value) in ("os.environ", "environ"):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                literal = sl.value
+        if literal is not None and _KNOB_RE.match(literal):
+            yield literal, node
+
+
+def _undeclared_read_findings(reads, path: str):
+    from ray_tpu._private.knobs import is_declared
+
+    out = []
+    for name, node in reads:
+        if not is_declared(name):
+            out.append(Finding(
+                "RTK201", path, node.lineno, name,
+                f"env read of undeclared knob {name} — declare it in "
+                f"_private/knobs.KNOBS (default/type/doc) and "
+                f"regenerate the README table"))
+    return out
+
+
+def analyze_module_source(source: str, path: str = "<string>",
+                          tree: ast.Module | None = None):
+    """RTK201 over one source text (fixture-test entry point; the
+    repo-wide pass hands in the context's cached ``tree``)."""
+    if tree is None:
+        tree = ast.parse(source)
+    return _undeclared_read_findings(_env_reads(tree), path)
+
+
+def _literal_knob_names(tree: ast.Module):
+    """Every RAY_TPU_* string constant ASSIGNED in the module — knobs
+    read through a named constant (``_MARKER = "RAY_TPU_ENV_OK"``)
+    count as live even though the env access itself is dynamic."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and \
+                isinstance(getattr(node, "value", None), ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and _KNOB_RE.match(node.value.value):
+            yield node.value.value
+
+
+@register("knob-registry")
+def knob_registry_pass(ctx: AnalysisContext):
+    from ray_tpu._private.knobs import KNOBS, config_knob_names
+
+    # knobs read through the config table (RAY_TPU_<CONFIG_KEY>) never
+    # appear as env-access literals — they are live by construction
+    used: set[str] = set(config_knob_names())
+    for mod in ctx.package_modules():
+        used.update(_literal_knob_names(mod.tree))
+        reads = list(_env_reads(mod.tree))
+        used.update(name for name, _node in reads)
+        yield from _undeclared_read_findings(reads, mod.path)
+    # liveness (RTK203) also counts harness/bench readers outside the
+    # package — undeclared-read enforcement (RTK201) stays ray_tpu/-only
+    for extra_pkg in ("tests", "benchmarks", "scripts"):
+        for mod in ctx.package_modules(extra_pkg):
+            for name, _node in _env_reads(mod.tree):
+                used.add(name)
+
+    readme = ctx.read_text("README.md") or ""
+    for name, knob in sorted(KNOBS.items()):
+        if name not in readme:
+            yield Finding(
+                "RTK202", "README.md", 1, name,
+                f"cataloged knob {name} is not mentioned in README — "
+                f"regenerate the knob table "
+                f"(`ray-tpu lint --knob-table`)")
+        if name not in used:
+            yield Finding(
+                "RTK203", "ray_tpu/_private/knobs.py", 1, name,
+                f"cataloged knob {name} has no explicit env read left "
+                f"in ray_tpu/ — dead entry, or its consumer was "
+                f"dropped by mistake")

@@ -1,0 +1,501 @@
+"""Lock-discipline passes (the ``RTL1xx`` family).
+
+The defect classes that burned review rounds across PRs 4-6, made
+mechanically checkable:
+
+- **RTL101 — blocking call under a lock.** Socket/file IO,
+  ``time.sleep``, RPC round trips, ``ray.get`` and timeout-less
+  ``.get()/.join()/.result()/.wait()`` executed while a ``threading``
+  lock is held stall every other thread contending for that lock (the
+  PR 6 ``shared_weights``-held-across-``loader()`` class).
+- **RTL102 — timeout-less blocking poll.** A zero-arg ``.get()``/
+  ``.join()``/``.result()``/``.wait()`` anywhere, or a timeout-less
+  ``ray_tpu.get``/``.wait`` inside an internal plane (``_private``
+  subtrees — daemon threads and control loops where a hang is a
+  silent stall), turns a lost wakeup into a hang instead of a named
+  failure. Public API surfaces deliberately keep the reference's
+  blocking-``get`` semantics and are out of scope.
+- **RTL103 — user callback invoked under a lock.** Calling a function
+  that arrived as a parameter (``loader()``, ``cb()``) while holding a
+  lock hands YOUR lock to arbitrary user code — the composed-loader
+  deadlock class.
+- **RTL104 — lock-order cycle.** Two locks acquired in both nesting
+  orders across a class's methods (directly or one ``self.method()``
+  hop away) can deadlock under concurrency.
+- **RTL105 — guarded attribute written outside its lock.** An
+  attribute both read and written under a class's lock somewhere, but
+  assigned lock-free in another method (the PR 5/6 unlocked
+  double-checked-init / poison-check race class).
+
+Heuristics are deliberately shallow (single file, one ``self.method()``
+propagation hop, name-based lock identity) — precision comes from the
+inline-suppression and baseline mechanisms, not from a points-to
+analysis this codebase doesn't need.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from ray_tpu._private.analysis.core import (AnalysisContext, Finding,
+                                            dotted, register)
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+_LOCK_NAME_HINT = ("lock", "cond", "mutex")
+
+# attribute tails that block regardless of receiver
+_BLOCKING_ATTRS = {"sendall", "recv", "recv_into", "accept", "makefile",
+                   "get_actor", "getaddrinfo"}
+# exact dotted names that block
+_BLOCKING_EXACT = {"time.sleep", "socket.create_connection",
+                   "_time.sleep", "open"}
+_SUBPROCESS = {"run", "call", "check_call", "check_output", "Popen",
+               "communicate"}
+# module-ish receivers whose .get/.wait are the cluster blocking APIs
+_RAY_MODULES = {"ray", "ray_tpu"}
+# zero-arg calls of these attrs park the thread with no deadline
+_PARK_ATTRS = {"get", "join", "result", "wait"}
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _is_lockish(token: str | None) -> bool:
+    return token is not None and any(h in token.rsplit(".", 1)[-1].lower()
+                                     for h in _LOCK_NAME_HINT)
+
+
+@dataclasses.dataclass
+class _Block:
+    """One blocking call observed in a function."""
+    node: ast.Call
+    desc: str
+    held: tuple[str, ...]   # canonical lock tokens held at the call
+
+
+@dataclasses.dataclass
+class _FnReport:
+    name: str
+    qual: str
+    blocks: list = dataclasses.field(default_factory=list)
+    callbacks: list = dataclasses.field(default_factory=list)  # (node, pname, held)
+    edges: list = dataclasses.field(default_factory=list)      # (A, B, node)
+    acquired: set = dataclasses.field(default_factory=set)
+    self_calls: list = dataclasses.field(default_factory=list)  # (method, held, node)
+    attr_reads: list = dataclasses.field(default_factory=list)  # (attr, held)
+    attr_writes: list = dataclasses.field(default_factory=list)  # (attr, held, node)
+
+
+class _Scope:
+    """Lock universe for one class (or the module pseudo-scope)."""
+
+    def __init__(self):
+        self.locks: set[str] = set()       # canonical tokens
+        self.aliases: dict[str, str] = {}  # cond token -> wrapped lock
+        self.ctxvars: set[str] = set()     # ContextVar names: .get() is
+        #                                    a lookup, not a park
+
+    def canon(self, token: str) -> str:
+        return self.aliases.get(token, token)
+
+    def register_assign(self, target_token: str, value: ast.AST):
+        if not isinstance(value, ast.Call):
+            return
+        ctor = dotted(value.func)
+        if ctor in _LOCK_CTORS:
+            self.locks.add(target_token)
+            if ctor.endswith("Condition") and value.args:
+                wrapped = dotted(value.args[0])
+                if wrapped:
+                    self.aliases[target_token] = wrapped
+                    self.locks.add(wrapped)
+        elif ctor in ("contextvars.ContextVar", "ContextVar"):
+            self.ctxvars.add(target_token)
+
+    def lock_token(self, expr: ast.AST) -> str | None:
+        """Canonical token when ``expr`` names a lock of this scope
+        (declared, or named like one)."""
+        tok = dotted(expr)
+        if not tok:
+            return None
+        if tok in self.locks or tok in self.aliases:
+            return self.canon(tok)
+        if _is_lockish(tok) and (tok.startswith("self.")
+                                 or "." not in tok):
+            return self.canon(tok)
+        return None
+
+
+class _FnWalker:
+    """Walks one function's statements in order, tracking held locks."""
+
+    def __init__(self, scope: _Scope, fn: ast.AST, qual: str,
+                 is_async: bool = False):
+        params = []
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+            a = fn.args
+            params = [p.arg for p in (a.posonlyargs + a.args
+                                      + a.kwonlyargs)]
+            if a.vararg:
+                params.append(a.vararg.arg)
+        self.scope = scope
+        self.params = {p for p in params if p not in ("self", "cls")}
+        self.is_async = is_async
+        self.held: list[str] = []
+        self.rep = _FnReport(getattr(fn, "name", "<lambda>"), qual)
+        self.nested: list[tuple[ast.AST, bool]] = []
+
+    # ------------------------------------------------------------ driving
+    def run(self, body: list[ast.stmt]) -> _FnReport:
+        self._stmts(body)
+        return self.rep
+
+    def _stmts(self, stmts: list[ast.stmt]):
+        for s in stmts:
+            self._stmt(s)
+
+    def _stmt(self, s: ast.stmt):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.nested.append((s, isinstance(s, ast.AsyncFunctionDef)))
+            return
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            tokens = []
+            for item in s.items:
+                self._exprs(item.context_expr)
+                tok = self._with_lock_token(item.context_expr)
+                if tok is not None:
+                    self._acquire(tok, item.context_expr)
+                    tokens.append(tok)
+            self._stmts(s.body)
+            for tok in reversed(tokens):
+                self._release(tok)
+            return
+        if isinstance(s, (ast.If,)):
+            self._exprs(s.test)
+            self._stmts(s.body)
+            self._stmts(s.orelse)
+            return
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._exprs(s.iter)
+            self._assign_target(s.target)
+            self._stmts(s.body)
+            self._stmts(s.orelse)
+            return
+        if isinstance(s, ast.While):
+            self._exprs(s.test)
+            self._stmts(s.body)
+            self._stmts(s.orelse)
+            return
+        if isinstance(s, ast.Try):
+            self._stmts(s.body)
+            for h in s.handlers:
+                self._stmts(h.body)
+            self._stmts(s.orelse)
+            self._stmts(s.finalbody)
+            return
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            name = dotted(s.value.func)
+            if name.endswith(".acquire"):
+                tok = self.scope.lock_token(s.value.func.value)
+                if tok is not None:
+                    self._acquire(tok, s.value)
+                    self._exprs_of_call_args(s.value)
+                    return
+            if name.endswith(".release"):
+                tok = self.scope.lock_token(s.value.func.value)
+                if tok is not None:
+                    self._release(tok)
+                    return
+        if isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = getattr(s, "value", None)
+            if value is not None:
+                self._exprs(value)
+            targets = (s.targets if isinstance(s, ast.Assign)
+                       else [s.target])
+            for t in targets:
+                self._assign_target(t)
+            return
+        # any other simple statement: scan its expressions
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._exprs(child)
+
+    # ------------------------------------------------------------- pieces
+    def _with_lock_token(self, expr: ast.AST) -> str | None:
+        return self.scope.lock_token(expr)
+
+    def _acquire(self, tok: str, node: ast.AST):
+        if self.held:
+            self.rep.edges.append((self.held[-1], tok, node))
+        self.held.append(tok)
+        self.rep.acquired.add(tok)
+
+    def _release(self, tok: str):
+        if tok in self.held:
+            self.held.reverse()
+            self.held.remove(tok)
+            self.held.reverse()
+
+    def _assign_target(self, t: ast.AST):
+        if isinstance(t, ast.Attribute) and dotted(t.value) == "self":
+            self.rep.attr_writes.append((t.attr, tuple(self.held), t))
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._assign_target(e)
+        elif isinstance(t, ast.Subscript):
+            self._exprs(t)
+
+    def _exprs_of_call_args(self, call: ast.Call):
+        for a in call.args:
+            self._exprs(a)
+        for kw in call.keywords:
+            self._exprs(kw.value)
+
+    def _exprs(self, expr: ast.AST):
+        """Scan one expression tree for calls / attr access, PRUNING
+        lambda bodies (they run later, lock-free — a plain ast.walk
+        would still descend into them and report their calls as made
+        under the current lock)."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue   # prune: don't push its children
+            if isinstance(node, ast.Call):
+                self._call(node)
+            elif isinstance(node, ast.Attribute) and \
+                    dotted(node.value) == "self" and \
+                    isinstance(node.ctx, ast.Load):
+                self.rep.attr_reads.append((node.attr, tuple(self.held)))
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _call(self, call: ast.Call):
+        name = dotted(call.func)
+        held = tuple(self.held)
+        # user-callback: a bare parameter name invoked directly
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in self.params and held:
+            self.rep.callbacks.append((call, call.func.id, held))
+        desc = self._blocking_reason(call, name)
+        if desc is not None:
+            self.rep.blocks.append(_Block(call, desc, held))
+        if name.startswith("self.") and name.count(".") == 1:
+            self.rep.self_calls.append((name.split(".")[1], held, call))
+
+    def _blocking_reason(self, call: ast.Call, name: str) -> str | None:
+        if self.is_async:
+            return None   # event-loop code has its own discipline
+        tail = name.rsplit(".", 1)[-1]
+        recv = name.rsplit(".", 1)[0] if "." in name else ""
+        if name in _BLOCKING_EXACT:
+            return f"{name}()"
+        if recv == "subprocess" and tail in _SUBPROCESS:
+            return f"{name}()"
+        if tail in _BLOCKING_ATTRS:
+            return f".{tail}()"
+        if recv in _RAY_MODULES and tail == "get" \
+                and not _has_kw(call, "timeout"):
+            return f"{name}() without timeout"
+        if recv in _RAY_MODULES and tail == "wait" \
+                and not _has_kw(call, "timeout"):
+            return f"{name}() without timeout"
+        if tail in _PARK_ATTRS and not call.args and not call.keywords \
+                and isinstance(call.func, ast.Attribute):
+            if tail == "wait" and self.scope.lock_token(
+                    call.func.value) in self.held:
+                return None   # Condition.wait releases the lock
+            if tail == "get" and dotted(call.func.value) in \
+                    self.scope.ctxvars:
+                return None   # ContextVar.get() is a lookup
+            return f".{tail}() with no timeout"
+        return None
+
+
+# --------------------------------------------------------------- analysis
+
+
+def _scope_for_class(cls: ast.ClassDef) -> _Scope:
+    scope = _Scope()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Attribute) and dotted(t.value) == "self":
+                scope.register_assign(dotted(t), node.value)
+    return scope
+
+
+def _scope_for_module(tree: ast.Module) -> _Scope:
+    scope = _Scope()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            scope.register_assign(node.targets[0].id, node.value)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.value is not None:
+            scope.register_assign(node.target.id, node.value)
+    return scope
+
+
+def _walk_functions(scope: _Scope, fns, qual_prefix: str):
+    """Run the walker over each function AND the nested defs it finds
+    (nested defs start with an empty held stack — they run later)."""
+    reports = {}
+    for fn in fns:
+        pending = [(fn, isinstance(fn, ast.AsyncFunctionDef),
+                    f"{qual_prefix}{fn.name}")]
+        collected = []
+        while pending:
+            node, is_async, qual = pending.pop()
+            w = _FnWalker(scope, node, qual, is_async=is_async)
+            if node.name.endswith("_locked"):
+                # convention: *_locked methods run with the caller's
+                # lock held — their writes are guarded (RTL105) and
+                # blocking calls inside them are under a lock (RTL101)
+                w.held.append("<caller's lock>")
+            rep = w.run(node.body)
+            collected.append(rep)
+            for nested, nested_async in w.nested:
+                pending.append(
+                    (nested, nested_async, f"{qual}.{nested.name}"))
+        reports[fn.name] = collected
+    return reports
+
+
+def _findings_for_scope(path: str, scope: _Scope, reports: dict,
+                        class_name: str | None):
+    findings = []
+    flat = [rep for reps in reports.values() for rep in reps]
+
+    # ---- per-method summaries for one-hop propagation
+    blocking_summary = {}
+    for name, reps in reports.items():
+        lockfree = [b for rep in reps for b in rep.blocks if not b.held]
+        if lockfree:
+            blocking_summary[name] = lockfree
+
+    def emit(code, node, qual, msg):
+        findings.append(Finding(code, path, node.lineno, qual, msg))
+
+    for rep in flat:
+        for b in rep.blocks:
+            if b.held:
+                emit("RTL101", b.node, rep.qual,
+                     f"blocking {b.desc} while holding "
+                     f"{', '.join(b.held)}")
+            elif "no timeout" in b.desc or "without timeout" in b.desc:
+                # ray.get-style blocking without timeout is the
+                # DOCUMENTED public-API semantic (data/rllib/util
+                # mirror the reference); only internal planes — where
+                # a hang is a silent daemon stall, not a user's
+                # foreground call — are held to the deadline rule
+                if "without timeout" in b.desc \
+                        and "/_private/" not in path:
+                    continue
+                emit("RTL102", b.node, rep.qual,
+                     f"{b.desc}: a lost wakeup hangs this thread "
+                     f"forever instead of failing")
+        for node, pname, held in rep.callbacks:
+            emit("RTL103", node, rep.qual,
+                 f"user callback {pname}() invoked while holding "
+                 f"{', '.join(held)}")
+        # one-hop: self.m() under a lock where m blocks lock-free
+        for method, held, node in rep.self_calls:
+            if held and method in blocking_summary:
+                b = blocking_summary[method][0]
+                emit("RTL101", node, rep.qual,
+                     f"calls self.{method}() while holding "
+                     f"{', '.join(held)}; it performs blocking "
+                     f"{b.desc} (line {b.node.lineno})")
+
+    # ---- RTL104 lock-order cycles over the class's edge set
+    edges = {}
+    for rep in flat:
+        for a, b, node in rep.edges:
+            if a != b:
+                edges.setdefault((a, b), (node, rep.qual))
+        for method, held, node in rep.self_calls:
+            for other in reports.get(method, []):
+                for tok in other.acquired:
+                    for h in held:
+                        if tok != h and (h, tok) not in edges:
+                            edges[(h, tok)] = (node, rep.qual)
+    graph = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    seen_cycles = set()
+    for start in graph:
+        stack = [(start, [start])]
+        while stack:
+            cur, trail = stack.pop()
+            for nxt in graph.get(cur, ()):
+                if nxt == start and len(trail) > 1:
+                    cyc = frozenset(trail)
+                    if cyc not in seen_cycles:
+                        seen_cycles.add(cyc)
+                        node, qual = edges[(trail[0], trail[1])]
+                        emit("RTL104", node, qual,
+                             "lock-order cycle: "
+                             + " -> ".join(trail + [start]))
+                elif nxt not in trail:
+                    stack.append((nxt, trail + [nxt]))
+
+    # ---- RTL105 guarded attribute written lock-free elsewhere
+    if class_name is not None:
+        guarded_writes = set()
+        guarded_reads = set()
+        for rep in flat:
+            for attr, held, _node in rep.attr_writes:
+                if held:
+                    guarded_writes.add(attr)
+            for attr, held in rep.attr_reads:
+                if held:
+                    guarded_reads.add(attr)
+        guarded = guarded_writes & guarded_reads
+        for rep in flat:
+            if rep.name in ("__init__", "__new__", "__setstate__",
+                            "__getstate__", "__reduce__", "__del__"):
+                continue
+            for attr, held, node in rep.attr_writes:
+                if not held and attr in guarded \
+                        and not _is_lockish(attr):
+                    emit("RTL105", node, rep.qual,
+                         f"self.{attr} is read AND written under a "
+                         f"lock elsewhere in {class_name} but assigned "
+                         f"here with no lock held")
+    return findings
+
+
+def analyze_module_source(source: str, path: str = "<string>",
+                          tree: ast.Module | None = None):
+    """Run the lock-discipline analysis over one source text — the unit
+    the fixture tests drive directly. Pass ``tree`` when the caller
+    already parsed the file (the repo-wide pass reuses the context's
+    cached ASTs instead of re-parsing the whole package)."""
+    if tree is None:
+        tree = ast.parse(source)
+    findings = []
+    mod_scope = _scope_for_module(tree)
+    mod_fns = [n for n in tree.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    reports = _walk_functions(mod_scope, mod_fns, "")
+    findings += _findings_for_scope(path, mod_scope, reports, None)
+    for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
+        scope = _scope_for_class(cls)
+        scope.locks |= mod_scope.locks
+        scope.aliases.update(mod_scope.aliases)
+        fns = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        reports = _walk_functions(scope, fns, f"{cls.name}.")
+        findings += _findings_for_scope(path, scope, reports, cls.name)
+    return findings
+
+
+@register("lock-discipline")
+def lock_discipline_pass(ctx: AnalysisContext):
+    for mod in ctx.package_modules():
+        yield from analyze_module_source(mod.source, mod.path,
+                                         tree=mod.tree)

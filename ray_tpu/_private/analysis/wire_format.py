@@ -1,0 +1,220 @@
+"""Cross-language wire-format consistency (the ``RTW3xx`` family).
+
+The frame protocol has two implementations (``_private/protocol.py`` and
+``src/rpc/rpc_core.cc``) and the collective shm object id is laid out in
+two files (``worker_runtime.py`` mints the prefix/epoch tags,
+``host_backend.py`` appends rank + counter). PR 4 and PR 5 each nearly
+shipped with the sides desynced (the "silent v3-peer desync" class); this
+pass makes that unshippable:
+
+- **RTW301 — constant missing.** ``PROTOCOL_VERSION`` /
+  ``kProtocolVersion`` / a frame-kind constant vanished from either
+  side; deleting the line now fails the lint instead of shipping.
+- **RTW302 — protocol version mismatch** between Python and C++.
+- **RTW303 — frame-kind constant mismatch** (REQUEST/REPLY/PUSH/
+  PUSH_OOB vs kReq/kReply/kPush/kPushOob).
+- **RTW304 — oid layout broken.** group-prefix + epoch + rank +
+  counter widths must sum to the store's ``kIdSize`` exactly (PR 5's
+  20-byte oid silently disabled the whole shm fast path).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu._private.analysis.core import (AnalysisContext, Finding,
+                                            dotted, register)
+
+PROTOCOL_PY = "ray_tpu/_private/protocol.py"
+RPC_CC = "src/rpc/rpc_core.cc"
+STORE_CC = "src/store/store.cc"
+WORKER_PY = "ray_tpu/_private/worker_runtime.py"
+HOSTBK_PY = "ray_tpu/util/collective/host_backend.py"
+
+_CC_CONST_RE = re.compile(
+    r"constexpr\s+(?:unsigned\s+)?(?:int|uint32_t|int32_t)\s+"
+    r"(k[A-Za-z0-9_]+)\s*=\s*(-?\d+)\s*;")
+
+# python name -> C++ name for the kinds that cross the wire
+KIND_PAIRS = [("REQUEST", "kReq"), ("REPLY", "kReply"),
+              ("PUSH", "kPush"), ("PUSH_OOB", "kPushOob")]
+
+
+def _py_int_constants(tree: ast.Module) -> dict[str, int]:
+    """Top-level int assignments, incl. tuple unpacking
+    (``REQUEST, REPLY, PUSH = 0, 1, 2``)."""
+    out: dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, int):
+                out[target.id] = node.value.value
+            elif isinstance(target, ast.Tuple) and \
+                    isinstance(node.value, ast.Tuple) and \
+                    len(target.elts) == len(node.value.elts):
+                for t, v in zip(target.elts, node.value.elts):
+                    if isinstance(t, ast.Name) and \
+                            isinstance(v, ast.Constant) and \
+                            isinstance(v.value, int):
+                        out[t.id] = v.value
+    return out
+
+
+def _cc_constants(text: str) -> dict[str, int]:
+    return {m.group(1): int(m.group(2))
+            for m in _CC_CONST_RE.finditer(text)}
+
+
+def _find_fn(tree: ast.Module, name: str):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _oid_widths(worker_tree: ast.Module, host_tree: ast.Module) -> dict:
+    """Byte widths of each collective shm oid component, read from the
+    code that mints them (None for a component that can't be found —
+    the check treats that as a layout break, not a skip)."""
+    widths = {"prefix": None, "epoch": None, "rank": None,
+              "counter": None}
+
+    fn = _find_fn(worker_tree, "col_oid_prefix")
+    if fn is not None:
+        const_bytes = 0
+        digest = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, bytes):
+                const_bytes += len(node.value)
+            if isinstance(node, ast.keyword) and \
+                    node.arg == "digest_size" and \
+                    isinstance(node.value, ast.Constant):
+                digest = int(node.value.value)
+        if digest is not None:
+            widths["prefix"] = const_bytes + digest
+
+    fn = _find_fn(worker_tree, "col_epoch_tag")
+    if fn is not None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "to_bytes" and \
+                    node.args and isinstance(node.args[0], ast.Constant):
+                widths["epoch"] = int(node.args[0].value)
+
+    for node in ast.walk(host_tree):
+        if isinstance(node, ast.Call) and \
+                dotted(node.func) == "self.rank.to_bytes" and \
+                node.args and isinstance(node.args[0], ast.Constant):
+            widths["rank"] = int(node.args[0].value)
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Call) and \
+                dotted(node.value.func).endswith("._new_id") and \
+                isinstance(node.slice, ast.Slice) and \
+                node.slice.upper is None and \
+                isinstance(node.slice.lower, ast.Constant):
+            # _new_id() mints a full store-id-sized value; the slice
+            # keeps its low (kIdSize - lower) counter bytes
+            widths["counter"] = ("tail", int(node.slice.lower.value))
+    return widths
+
+
+def parse_layout(ctx: AnalysisContext | None = None) -> dict:
+    """The parsed cross-language constants, for tests to pin:
+    {py: {...}, cc: {...}, id_size, oid_widths}. Missing files/constants
+    appear as absent keys / None values."""
+    if ctx is None:
+        ctx = AnalysisContext()
+    out: dict = {"py": {}, "cc": {}, "id_size": None, "oid_widths": {}}
+    mod = ctx.module(PROTOCOL_PY)
+    if mod is not None:
+        out["py"] = _py_int_constants(mod.tree)
+    cc = ctx.read_text(RPC_CC)
+    if cc is not None:
+        out["cc"] = _cc_constants(cc)
+    store = ctx.read_text(STORE_CC)
+    if store is not None:
+        m = re.search(r"kIdSize\s*=\s*(\d+)", store)
+        if m:
+            out["id_size"] = int(m.group(1))
+    worker = ctx.module(WORKER_PY)
+    host = ctx.module(HOSTBK_PY)
+    if worker is not None and host is not None:
+        out["oid_widths"] = _oid_widths(worker.tree, host.tree)
+    return out
+
+
+@register("wire-format")
+def wire_format_pass(ctx: AnalysisContext):
+    layout = parse_layout(ctx)
+    py, cc = layout["py"], layout["cc"]
+
+    if "PROTOCOL_VERSION" not in py:
+        yield Finding("RTW301", PROTOCOL_PY, 1, "PROTOCOL_VERSION",
+                      "PROTOCOL_VERSION constant missing from "
+                      "protocol.py — the Python side no longer pins a "
+                      "wire revision")
+    if "kProtocolVersion" not in cc:
+        yield Finding("RTW301", RPC_CC, 1, "kProtocolVersion",
+                      "kProtocolVersion constant missing from "
+                      "rpc_core.cc — the native side no longer pins a "
+                      "wire revision")
+    if "PROTOCOL_VERSION" in py and "kProtocolVersion" in cc and \
+            py["PROTOCOL_VERSION"] != cc["kProtocolVersion"]:
+        yield Finding(
+            "RTW302", PROTOCOL_PY, 1, "PROTOCOL_VERSION",
+            f"protocol version desync: protocol.py speaks "
+            f"v{py['PROTOCOL_VERSION']} but rpc_core.cc speaks "
+            f"v{cc['kProtocolVersion']} — a mixed build would reject "
+            f"every frame (or worse, misparse)")
+
+    for py_name, cc_name in KIND_PAIRS:
+        if py_name not in py:
+            yield Finding("RTW301", PROTOCOL_PY, 1, py_name,
+                          f"frame-kind constant {py_name} missing from "
+                          f"protocol.py")
+            continue
+        if cc_name not in cc:
+            yield Finding("RTW301", RPC_CC, 1, cc_name,
+                          f"frame-kind constant {cc_name} missing from "
+                          f"rpc_core.cc")
+            continue
+        if py[py_name] != cc[cc_name]:
+            yield Finding(
+                "RTW303", PROTOCOL_PY, 1, py_name,
+                f"frame-kind desync: {py_name}={py[py_name]} in "
+                f"protocol.py but {cc_name}={cc[cc_name]} in "
+                f"rpc_core.cc")
+
+    id_size = layout["id_size"]
+    widths = layout["oid_widths"]
+    if id_size is None:
+        yield Finding("RTW304", STORE_CC, 1, "kIdSize",
+                      "store id size (kIdSize) not found in store.cc")
+    elif widths:
+        missing = [k for k, v in widths.items() if v is None]
+        if missing:
+            yield Finding(
+                "RTW304", WORKER_PY, 1, "col_oid_layout",
+                f"collective shm oid layout: could not locate the "
+                f"{'/'.join(missing)} component width(s) in the "
+                f"minting code — layout check cannot hold")
+        else:
+            counter = widths["counter"]
+            counter_w = (id_size - counter[1]
+                         if isinstance(counter, tuple) else counter)
+            total = (widths["prefix"] + widths["epoch"]
+                     + widths["rank"] + counter_w)
+            if total != id_size:
+                yield Finding(
+                    "RTW304", HOSTBK_PY, 1, "col_oid_layout",
+                    f"collective shm oid layout is {total} bytes "
+                    f"(prefix {widths['prefix']} + epoch "
+                    f"{widths['epoch']} + rank {widths['rank']} + "
+                    f"counter {counter_w}) but the store id is "
+                    f"{id_size} bytes — a mismatched oid silently "
+                    f"disables the whole shm fast path (the PR 5 bug)")

@@ -38,6 +38,14 @@ Event kinds recorded by the runtime:
 - ``train_group``  — a Train worker gang came up
                      (train/backend_executor.py): per-worker device
                      identities.
+- ``GANG_FAILED`` / ``GANG_RESTARTED`` / ``train_gang_retry`` — elastic
+                     gang fault tolerance (train/trainer.py): a gang
+                     attempt failed (dead ranks, failure counts), a
+                     rebuilt gang resumed from checkpoint, and the
+                     per-retry backoff draw.
+- ``COLLECTIVE_GROUP_POISONED`` — a collective group was poisoned on
+                     member death (util/collective/collective.py):
+                     group, dead ranks, reason, incarnation epoch.
 - ``REPLICA_STARTED`` / ``REPLICA_DIED`` / ``REPLICA_DRAINED`` — Serve
                      replica lifecycle (serve/_private/controller.py):
                      deployment, replica_id; DIED carries the detection

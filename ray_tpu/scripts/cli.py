@@ -310,6 +310,51 @@ def cmd_collectives(args):
     return 0
 
 
+def cmd_lint(args):
+    """raylint: the repo-wide invariant lint (ray_tpu/_private/analysis/)
+    — lock discipline, knob registry, wire-format consistency, metric +
+    event catalogs. Exit 0 only when every finding is inline-suppressed
+    or baselined AND the baseline carries no stale entries."""
+    from ray_tpu._private import analysis
+
+    if args.knob_table:
+        from ray_tpu._private.knobs import readme_knob_table
+
+        print(readme_knob_table())
+        print()
+        print(readme_knob_table(internal=True))
+        return 0
+    passes = args.passes.split(",") if args.passes else None
+    try:
+        findings = analysis.run_all(passes=passes)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    new, known, stale = analysis.partition(findings, passes=passes)
+    if args.json:
+        print(json.dumps({
+            "new": [vars(f) for f in new],
+            "baselined": [vars(f) for f in known],
+            "stale_baseline": stale,
+        }, indent=2))
+        return 1 if (new or stale) else 0
+    if args.emit_baseline:
+        sys.stdout.write(analysis.format_baseline(new))
+        return 0
+    for f in new:
+        print(f)
+    if stale:
+        print(f"\n{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (fixed findings — "
+              f"delete these lines from "
+              f"ray_tpu/_private/analysis/baseline.txt):")
+        for key in stale:
+            print(f"  {key}")
+    print(f"\nraylint: {len(new)} finding(s), {len(known)} baselined, "
+          f"{len(stale)} stale baseline entr"
+          f"{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if (new or stale) else 0
+
+
 def cmd_microbenchmark(_args):
     from ray_tpu._private.ray_perf import main as perf_main
 
@@ -440,6 +485,23 @@ def main(argv=None):
                              "stats, device HBM gauges")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_collectives)
+
+    sp = sub.add_parser("lint",
+                        help="repo-wide invariant lint: lock "
+                             "discipline, knob registry, wire-format "
+                             "consistency, metric/event catalogs")
+    sp.add_argument("--passes", default=None,
+                    help="comma-separated pass names (default: all); "
+                         "see ray_tpu/_private/analysis/")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--emit-baseline", action="store_true",
+                    help="print baseline-format lines for the current "
+                         "non-baselined findings (justifications left "
+                         "TODO)")
+    sp.add_argument("--knob-table", action="store_true",
+                    help="print the generated README knob tables and "
+                         "exit")
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("summary",
                         help="aggregated cluster state rollups")

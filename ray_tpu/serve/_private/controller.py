@@ -137,7 +137,12 @@ class _DeploymentState:
                     done = []
                 if done:
                     try:
-                        ray_tpu.get(r.ready_ref)   # surface init errors
+                        # surface init errors; the ref is already done
+                        # (wait above), so the timeout only bounds the
+                        # result fetch — timeout-less, a wedged store
+                        # fetch would stall the whole control loop
+                        # under the controller lock (raylint RTL102)
+                        ray_tpu.get(r.ready_ref, timeout=10.0)
                         r.state = RUNNING
                         _events.record("REPLICA_STARTED",
                                        deployment=self.dep_id,
@@ -230,7 +235,9 @@ class _DeploymentState:
                     done = [r.health_ref]
                 if done:
                     try:
-                        ray_tpu.get(r.health_ref)
+                        # done ref: timeout bounds only the fetch (a
+                        # hang here would freeze every health check)
+                        ray_tpu.get(r.health_ref, timeout=10.0)
                         r.health_ref = None
                         r.last_health_check = now
                     except Exception:
@@ -316,7 +323,7 @@ class _DeploymentState:
                 try:
                     done, _ = ray_tpu.wait([r.metrics_ref], timeout=0)
                     if done:
-                        m = ray_tpu.get(r.metrics_ref)
+                        m = ray_tpu.get(r.metrics_ref, timeout=10.0)
                         r.num_ongoing = m["num_ongoing_requests"]
                         r.metrics_ref = None
                 except Exception:

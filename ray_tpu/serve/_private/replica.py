@@ -73,7 +73,15 @@ class ReplicaActor:
         def _resolve(v):
             if not isinstance(v, ObjectRef):
                 return v
-            out = ray_tpu.get(v)
+            # bounded: an upstream replica that died mid-compose would
+            # otherwise hang this request forever, pinning one of the
+            # replica's concurrency slots (raylint RTL102); the budget
+            # matches the streaming first-chunk allowance (a compile
+            # may be in front of the value)
+            from ray_tpu._private.config import get_config
+
+            out = ray_tpu.get(
+                v, timeout=float(get_config("serve_stream_chunk_timeout_s")))
             if isinstance(out, dict) and "__serve_stream__" in out:
                 # upstream deployment streamed: hand the composing user
                 # code a chunk iterator, not the raw relay marker

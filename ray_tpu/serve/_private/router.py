@@ -86,7 +86,15 @@ class Router:
 
     # ------------------------------------------------------------ callbacks
     def _update_replicas(self, info):
-        """Long-poll callback: (replica list, caps) snapshot."""
+        """Long-poll callback: (replica list, caps) snapshot.
+
+        Handle resolution (``get_actor`` — a GCS round trip per NEW
+        replica) happens OUTSIDE the router lock: with it held, one
+        slow/reconnecting GCS call froze every ``assign_request`` and
+        the monitor loop for its duration (raylint RTL101). Resolved
+        handles are installed under the lock with a re-check, so a
+        replica that died (or was superseded) mid-resolution is never
+        installed over fresher state."""
         import ray_tpu
 
         if info is None:
@@ -96,21 +104,28 @@ class Router:
             cap = info["max_ongoing_requests"]
             queued_cap = info.get("max_queued_requests", self._max_queued)
         with self._lock:
+            missing = [(e["replica_id"], e["actor_name"]) for e in entries
+                       if e["replica_id"] not in self._replicas
+                       and e["replica_id"] not in self._dead]
+        resolved = []
+        for rid, name in missing:
+            try:
+                resolved.append((rid, ray_tpu.get_actor(
+                    name, namespace="serve")))
+            except ValueError:
+                continue   # died between snapshot and now
+        with self._lock:
             self._max_ongoing = cap
             self._max_queued = queued_cap
             seen = set()
             actor_map = {}
             for entry in entries:
-                rid, name = entry["replica_id"], entry["actor_name"]
-                seen.add(rid)
+                seen.add(entry["replica_id"])
                 if entry.get("actor_id"):
-                    actor_map[entry["actor_id"]] = rid
-                if rid not in self._replicas and rid not in self._dead:
-                    try:
-                        handle = ray_tpu.get_actor(
-                            name, namespace="serve")
-                    except ValueError:
-                        continue   # died between snapshot and now
+                    actor_map[entry["actor_id"]] = entry["replica_id"]
+            for rid, handle in resolved:
+                if rid in seen and rid not in self._replicas \
+                        and rid not in self._dead:
                     self._replicas[rid] = _ReplicaSlot(rid, handle)
             for rid in list(self._replicas):
                 if rid not in seen:

@@ -157,11 +157,15 @@ def start(http_options: HTTPOptions | dict | None = None, **kwargs):
             lifetime="detached", max_concurrency=64, num_cpus=0,
             get_if_exists=True,
         ).remote({"host": http_options.host, "port": http_options.port})
-        ray_tpu.get(controller.ready.remote())
+        # start() deliberately serializes under _lock; the gets carry
+        # explicit deadlines so a wedged controller fails this caller
+        # loudly instead of freezing every serve.* entry point forever
+        ray_tpu.get(controller.ready.remote(), timeout=60.0)
         if _proxy_handle is None:
             from ray_tpu.serve._private.proxy import HTTPProxyActor
 
-            opts = ray_tpu.get(controller.get_http_options.remote())
+            opts = ray_tpu.get(controller.get_http_options.remote(),
+                               timeout=30.0)
             host = opts.get("host", http_options.host)
             port = opts.get("port", http_options.port)
             # One proxy per node, fixed name: a second driver on the same
@@ -174,7 +178,8 @@ def start(http_options: HTTPOptions | dict | None = None, **kwargs):
                 namespace=SERVE_NAMESPACE, lifetime="detached",
                 max_concurrency=64, num_cpus=0, get_if_exists=True,
             ).remote(host, port, CONTROLLER_NAME, SERVE_NAMESPACE)
-            _proxy_port = ray_tpu.get(_proxy_handle.ready.remote())
+            _proxy_port = ray_tpu.get(_proxy_handle.ready.remote(),
+                                      timeout=60.0)
         return controller
 
 
@@ -313,7 +318,10 @@ def shutdown():
                             and str(row.get("name", "")).startswith(
                                 PROXY_NAME_PREFIX)):
                         try:
-                            proxies.append(ray_tpu.get_actor(
+                            # shutdown serializes against start() under
+                            # _lock by design; the lookup is bounded by
+                            # the GCS RPC deadline
+                            proxies.append(ray_tpu.get_actor(  # raylint: disable=RTL101
                                 row["name"],
                                 namespace=SERVE_NAMESPACE))
                         except ValueError:

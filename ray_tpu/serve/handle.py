@@ -18,6 +18,9 @@ from ray_tpu.serve._private.constants import (
 
 _routers_lock = threading.Lock()
 _routers: dict[str, object] = {}
+# bumped by _shutdown_routers: an install whose build straddled a sweep
+# must not re-populate the dict with a live (thread-owning) router
+_routers_gen = 0
 
 
 def _get_controller():
@@ -29,25 +32,48 @@ def _get_controller():
 def _get_router(deployment_id: str):
     from ray_tpu.serve._private.router import Router
 
-    with _routers_lock:
-        router = _routers.get(deployment_id)
-        if router is None:
-            import ray_tpu
+    # Build OUTSIDE the lock: construction is a controller lookup + a
+    # GCS round trip, and holding the module lock across it serialized
+    # every first call to every OTHER deployment behind one slow
+    # controller (raylint RTL101 — the shared_weights deadlock class).
+    # The install re-checks under the lock: a racing builder's loser is
+    # stopped, and a build that straddled a _shutdown_routers sweep
+    # (generation changed) is stopped and retried instead of installed
+    # — post-shutdown the retry fails at the controller lookup, which
+    # is the honest error.
+    while True:
+        with _routers_lock:
+            router = _routers.get(deployment_id)
+            gen = _routers_gen
+        if router is not None:
+            return router
+        import ray_tpu
 
-            controller = _get_controller()
-            info = ray_tpu.get(
-                controller.get_deployment_info.remote(deployment_id))
-            cap = (info or {}).get("max_ongoing_requests", 8)
-            queued_cap = (info or {}).get("max_queued_requests", 32)
-            router = Router(controller, deployment_id,
-                            max_ongoing_requests=cap,
-                            max_queued_requests=queued_cap)
-            _routers[deployment_id] = router
-        return router
+        controller = _get_controller()
+        info = ray_tpu.get(
+            controller.get_deployment_info.remote(deployment_id),
+            timeout=30.0)
+        cap = (info or {}).get("max_ongoing_requests", 8)
+        queued_cap = (info or {}).get("max_queued_requests", 32)
+        router = Router(controller, deployment_id,
+                        max_ongoing_requests=cap,
+                        max_queued_requests=queued_cap)
+        with _routers_lock:
+            if _routers_gen == gen:
+                winner = _routers.setdefault(deployment_id, router)
+            else:
+                winner = None   # swept mid-build: don't resurrect
+        if winner is router:
+            return winner
+        router.stop()   # lost the race / swept: ours has threads
+        if winner is not None:
+            return winner
 
 
 def _shutdown_routers():
+    global _routers_gen
     with _routers_lock:
+        _routers_gen += 1
         for r in _routers.values():
             r.stop()
         _routers.clear()

@@ -63,8 +63,39 @@ constexpr int kProtocolVersion = 4;
 constexpr int kReq = 0;
 constexpr int kReply = 1;
 constexpr int kPush = 2;
+// One-way out-of-band frame ([u32 head_len][pickle head][raw body]).
+// This core routes the kind nibble opaquely — the constant exists so
+// the cross-language wire-format lint (ray_tpu/_private/analysis/
+// wire_format.py) can assert both sides agree on the value; keep in
+// sync with PUSH_OOB in ray_tpu/_private/protocol.py.
+constexpr int kPushOob = 3;
+// self-check: the opaque pass-through below must still cover every kind
+static_assert(kPushOob <= 0x0F, "frame kind must fit the low nibble");
 constexpr int kEvDisconnect = -1;
 constexpr int kEvConnect = -2;
+
+// Timed condvar wait that ThreadSanitizer can SEE. libstdc++-10's
+// condition_variable::wait_for rides pthread_cond_clockwait (glibc
+// 2.30+), which this toolchain's libtsan does not intercept — tsan
+// then misses the mutex release/reacquire inside the wait and reports
+// phantom "double lock of a mutex" + data races between two threads
+// that BOTH hold the lock (scripts/sanitize.sh reproduced this with a
+// 25-line textbook producer/consumer). Under tsan, wait against
+// system_clock instead: that path uses pthread_cond_timedwait, which
+// IS intercepted. Production builds keep steady_clock (immune to
+// wall-clock jumps); these are bounded re-checked poll waits either
+// way.
+template <typename Pred>
+bool timed_wait(std::condition_variable& cv, std::unique_lock<std::mutex>& g,
+                int timeout_ms, Pred ready) {
+#if defined(__SANITIZE_THREAD__)
+  return cv.wait_until(
+      g, std::chrono::system_clock::now() + std::chrono::milliseconds(timeout_ms),
+      ready);
+#else
+  return cv.wait_for(g, std::chrono::milliseconds(timeout_ms), ready);
+#endif
+}
 
 struct Frame {
   uint64_t conn_id = 0;
@@ -356,8 +387,7 @@ int rpc_cl_wait(void* h, long long seq, int timeout_ms, char** out,
   auto ready = [&] { return c->sync_done.count(seq) || c->closed; };
   if (timeout_ms < 0) {
     c->cv.wait(g, ready);
-  } else if (!c->cv.wait_for(g, std::chrono::milliseconds(timeout_ms),
-                             ready)) {
+  } else if (!timed_wait(c->cv, g, timeout_ms, ready)) {
     return 1;
   }
   auto it = c->sync_done.find(seq);
@@ -391,8 +421,7 @@ int rpc_cl_poll_async(void* h, int timeout_ms, int* kind, long long* seq,
   auto ready = [&] { return !c->async_q.empty() || c->closed; };
   if (timeout_ms < 0) {
     c->async_cv.wait(g, ready);
-  } else if (!c->async_cv.wait_for(g, std::chrono::milliseconds(timeout_ms),
-                                   ready)) {
+  } else if (!timed_wait(c->async_cv, g, timeout_ms, ready)) {
     return 1;
   }
   if (c->async_q.empty()) return 2;
@@ -481,8 +510,7 @@ int rpc_sv_next(void* h, int timeout_ms, unsigned long long* conn_id,
   auto ready = [&] { return !s->q.empty() || s->stopped; };
   if (timeout_ms < 0) {
     s->cv.wait(g, ready);
-  } else if (!s->cv.wait_for(g, std::chrono::milliseconds(timeout_ms),
-                             ready)) {
+  } else if (!timed_wait(s->cv, g, timeout_ms, ready)) {
     return 1;
   }
   if (s->q.empty()) return 2;

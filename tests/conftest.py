@@ -41,13 +41,32 @@ def _fault_banner() -> str | None:
     return None
 
 
+def _raylint_banner() -> str:
+    """The lint baseline size, printed in every run's header so drift
+    is visible tier-1-wide: the number should only ever SHRINK (fixed
+    findings get their baseline lines deleted) — a session that grew it
+    added a documented-by-design exception and must justify it."""
+    try:
+        from ray_tpu._private.analysis import load_baseline
+
+        entries = load_baseline()
+        return (f"raylint: {len(entries)} baselined finding(s) "
+                f"(ray_tpu/_private/analysis/baseline.txt; gate: "
+                f"tests/test_zz_lint.py, `ray-tpu lint`)")
+    except Exception as e:   # never block the suite on the lint plane
+        return f"raylint: baseline unreadable ({e!r})"
+
+
 def pytest_report_header(config):
+    lines = [_raylint_banner()]
     banner = _fault_banner()
     if banner:
-        return [f"fault injection: ACTIVE — {banner}"]
-    return ["fault injection: disabled "
-            "(RAY_TPU_FAULT_SCHEDULE activates it; see "
-            "ray_tpu/_private/fault_injection.py)"]
+        lines.append(f"fault injection: ACTIVE — {banner}")
+    else:
+        lines.append("fault injection: disabled "
+                     "(RAY_TPU_FAULT_SCHEDULE activates it; see "
+                     "ray_tpu/_private/fault_injection.py)")
+    return lines
 
 
 @pytest.hookimpl(hookwrapper=True)

@@ -123,6 +123,51 @@ def test_native_client_fails_cleanly_on_bad_version_peer():
     listener.close()
 
 
+def test_wire_format_pass_pins_cross_language_constants():
+    """PR 8 satellite: the raylint wire-format pass parses BOTH
+    languages — pin the constants the cluster actually ships so a
+    one-sided bump (the PR 4/5 near-miss class) fails here, by name."""
+    from ray_tpu._private.analysis import wire_format
+
+    layout = wire_format.parse_layout()
+    # v4: collective incarnation epochs (see protocol.py's history)
+    assert layout["py"]["PROTOCOL_VERSION"] == 4
+    assert layout["cc"]["kProtocolVersion"] == 4
+    # PUSH_OOB (kind 3): the one-way out-of-band data-plane frame
+    assert layout["py"]["PUSH_OOB"] == 3
+    assert layout["cc"]["kPushOob"] == 3
+    assert layout["py"]["PUSH_OOB"] == protocol.PUSH_OOB
+    assert (layout["py"]["REQUEST"], layout["py"]["REPLY"],
+            layout["py"]["PUSH"]) == (0, 1, 2)
+    assert (layout["cc"]["kReq"], layout["cc"]["kReply"],
+            layout["cc"]["kPush"]) == (0, 1, 2)
+    # collective shm oid layout sums to the store's 16-byte id
+    assert layout["id_size"] == 16
+    # and the pass itself is clean over the real tree
+    ctx = wire_format.AnalysisContext()
+    assert list(wire_format.wire_format_pass(ctx)) == []
+
+
+def test_wire_format_pass_fails_on_deleted_version_pin():
+    """Acceptance: deleting the PROTOCOL_VERSION line from EITHER
+    language makes the wire-format pass fail (exercised through the
+    context's override hook; tests/test_zz_lint.py covers more tamper
+    shapes)."""
+    from ray_tpu._private.analysis import wire_format
+    from ray_tpu._private.analysis.core import AnalysisContext
+
+    for path, needle in ((wire_format.PROTOCOL_PY, "PROTOCOL_VERSION = "),
+                         (wire_format.RPC_CC,
+                          "constexpr int kProtocolVersion")):
+        real = AnalysisContext().read_text(path)
+        tampered = "\n".join(ln for ln in real.splitlines()
+                             if needle not in ln)
+        ctx = AnalysisContext(overrides={path: tampered})
+        codes = {f.code for f in wire_format.wire_format_pass(ctx)}
+        assert "RTW301" in codes, f"deleting {needle!r} from {path} " \
+                                  f"did not fail the pass"
+
+
 def test_spec_validation_always_on(monkeypatch):
     """validate_task_spec runs without any opt-in env var (round-5 fix:
     the schema is a contract, not a test aid)."""

@@ -11,7 +11,6 @@
 
 Late-alphabet on purpose (tier-1 wall-clock budget); keep fast.
 """
-import re
 import time
 
 import pytest
@@ -133,36 +132,17 @@ def test_profiling_timeline_events_carry_node():
 
 
 def test_metric_catalog_lint():
-    """CI satellite: every internal metric literal in the tree must be
-    declared in the telemetry catalog (single source of truth), and every
-    catalog name must be ray_tpu_-prefixed with a unit suffix."""
-    import pathlib
+    """The catalog lint now LIVES in the analysis framework (PR 8:
+    ray_tpu/_private/analysis/catalogs.py, codes RTC401/RTC402) — this
+    test drives that pass, so the telemetry suite still gates it even
+    when the full raylint gate (tests/test_zz_lint.py) is filtered out
+    of a targeted run."""
+    from ray_tpu._private.analysis.catalogs import metric_catalog_pass
+    from ray_tpu._private.analysis.core import AnalysisContext
 
-    import ray_tpu
-    from ray_tpu._private.telemetry import ALLOWED_SUFFIXES, CATALOG
-
-    for name, spec in CATALOG.items():
-        assert name.startswith("ray_tpu_"), name
-        assert name.endswith(ALLOWED_SUFFIXES), \
-            f"{name} lacks a unit suffix {ALLOWED_SUFFIXES}"
-        assert spec["kind"] in ("Counter", "Gauge", "Histogram"), name
-        if spec["kind"] == "Counter":
-            assert name.endswith("_total"), \
-                f"counter {name} must end in _total"
-    suffix_re = "|".join(s.lstrip("_") for s in ALLOWED_SUFFIXES)
-    pat = re.compile(
-        r"""["'](ray_tpu_[a-z0-9_]+_(?:%s))["']""" % suffix_re)
-    root = pathlib.Path(ray_tpu.__file__).parent
-    undeclared = {}
-    for path in root.rglob("*.py"):
-        if path.name == "telemetry.py":
-            continue
-        for m in pat.finditer(path.read_text()):
-            if m.group(1) not in CATALOG:
-                undeclared.setdefault(m.group(1), []).append(str(path))
-    assert not undeclared, (
-        f"internal metric names not declared in "
-        f"_private/telemetry.py CATALOG: {undeclared}")
+    findings = [f for f in metric_catalog_pass(AnalysisContext())
+                if f.code in ("RTC401", "RTC402")]
+    assert not findings, "\n".join(str(f) for f in findings)
 
 
 def test_undeclared_collective_metric_fails_fast():
@@ -181,25 +161,26 @@ def test_undeclared_collective_metric_fails_fast():
 
 
 def test_grafana_panels_reference_cataloged_metrics():
-    """PR 3 satellite: the default Grafana dashboard may only chart
-    metrics the runtime actually emits — every ray_tpu_* name in a
-    panel expr (minus Prometheus histogram sub-series suffixes) must be
-    declared in the telemetry CATALOG."""
-    from ray_tpu._private.telemetry import CATALOG
-    from ray_tpu.dashboard.grafana import generate_default_dashboard
+    """PR 3 satellite, PR 8 unified into the framework: the default
+    Grafana dashboard may only chart metrics the runtime actually emits
+    (analysis/catalogs.py, code RTC403)."""
+    from ray_tpu._private.analysis.catalogs import metric_catalog_pass
+    from ray_tpu._private.analysis.core import AnalysisContext
 
-    dash = generate_default_dashboard()
-    assert dash["panels"], "default dashboard lost its panels"
-    unknown = {}
-    for panel in dash["panels"]:
-        for target in panel["targets"]:
-            for name in re.findall(r"ray_tpu_[a-z0-9_]+", target["expr"]):
-                base = re.sub(r"_(?:bucket|sum|count)$", "", name)
-                if base not in CATALOG and name not in CATALOG:
-                    unknown.setdefault(panel["title"], []).append(name)
-    assert not unknown, (
-        f"grafana panels chart metrics the runtime never emits: "
-        f"{unknown}")
+    findings = [f for f in metric_catalog_pass(AnalysisContext())
+                if f.code == "RTC403"]
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_event_kind_catalog_lint():
+    """PR 8: the analogous event-name lint — every recorded kind is
+    documented in events.py's docstring catalog and vice versa
+    (analysis/catalogs.py, codes RTC404/RTC405)."""
+    from ray_tpu._private.analysis.catalogs import event_catalog_pass
+    from ray_tpu._private.analysis.core import AnalysisContext
+
+    findings = list(event_catalog_pass(AnalysisContext()))
+    assert not findings, "\n".join(str(f) for f in findings)
 
 
 # ------------------------------------------------- cluster-level tests

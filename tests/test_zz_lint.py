@@ -1,0 +1,431 @@
+"""raylint gate + framework unit tests (PR 8).
+
+- the GATE: every pass family over the whole package must produce zero
+  non-baselined findings and zero stale baseline entries, inside the
+  acceptance wall-clock budget;
+- framework semantics on synthetic fixture modules: known lock-order
+  cycle, blocking-call-under-lock, user-callback-under-lock, guarded
+  attribute written lock-free, timeout-less park, undeclared knob —
+  asserting EXACT finding codes;
+- suppression (`# raylint: disable=...`) and baseline mechanics;
+- wire-format tamper proofs: deleting PROTOCOL_VERSION from either
+  language (via the context's override hook — the real files are never
+  touched) must fail the pass;
+- the native sanitizer gate: `scripts/sanitize.sh --smoke` (slow,
+  compiler-gated).
+
+Late-alphabet on purpose (tier-1 wall-clock budget); keep fast.
+"""
+from __future__ import annotations
+
+import textwrap
+import time
+
+import pytest
+
+from ray_tpu._private import analysis
+from ray_tpu._private.analysis import core as acore
+from ray_tpu._private.analysis import knobs_pass, lock_discipline, wire_format
+
+pytestmark = pytest.mark.lint
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+def _lock_codes(src: str):
+    return _codes(lock_discipline.analyze_module_source(
+        textwrap.dedent(src), "ray_tpu/_private/_zz_fixture.py"))
+
+
+# ------------------------------------------------------------------- gate
+
+
+def test_whole_package_zero_nonbaselined_findings():
+    """THE acceptance gate: all four pass families over ray_tpu/, every
+    finding either inline-suppressed or baselined, no stale baseline
+    entries, inside the <20s budget."""
+    t0 = time.monotonic()
+    findings = analysis.run_all()
+    elapsed = time.monotonic() - t0
+    new, _known, stale = analysis.partition(findings)
+    assert not new, "non-baselined raylint findings:\n" + "\n".join(
+        f"  {f}" for f in new)
+    assert not stale, (
+        "stale baseline entries (the finding was fixed — delete the "
+        f"line from analysis/baseline.txt): {stale}")
+    assert elapsed < 20.0, f"raylint took {elapsed:.1f}s (budget 20s)"
+
+
+def test_baseline_entries_all_have_justifications():
+    """An unexplained baseline entry defeats the point of a baseline."""
+    text = acore.BASELINE_PATH.read_text()
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        body, sep, comment = stripped.partition("#")
+        assert sep and comment.strip(), \
+            f"baseline entry lacks a justification comment: {stripped!r}"
+        assert len(body.split()) == 3, \
+            f"malformed baseline entry (want CODE path context): {stripped!r}"
+
+
+# -------------------------------------------------- lock-discipline units
+
+
+def test_blocking_call_under_lock_fixture():
+    assert "RTL101" in _lock_codes("""
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """)
+
+
+def test_lock_order_cycle_fixture():
+    codes = _lock_codes("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert "RTL104" in codes
+
+
+def test_no_cycle_for_consistent_order():
+    codes = _lock_codes("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ab2(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    assert "RTL104" not in codes
+
+
+def test_cross_method_lock_cycle_via_self_call():
+    """One self.method() hop: m holds A and calls n, which takes B;
+    p holds B and calls q, which takes A — a cycle no single method
+    shows."""
+    codes = _lock_codes("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m(self):
+                with self._a:
+                    self.n()
+
+            def n(self):
+                with self._b:
+                    pass
+
+            def p(self):
+                with self._b:
+                    self.q()
+
+            def q(self):
+                with self._a:
+                    pass
+    """)
+    assert "RTL104" in codes
+
+
+def test_user_callback_under_lock_fixture():
+    assert "RTL103" in _lock_codes("""
+        import threading
+
+        _lock = threading.Lock()
+
+        def cached(key, loader):
+            with _lock:
+                return loader()
+    """)
+
+
+def test_guarded_attr_written_lockfree_fixture():
+    codes = _lock_codes("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = None
+
+            def update(self, v):
+                with self._lock:
+                    if self._state is None:
+                        self._state = v
+
+            def racy_reset(self):
+                self._state = None
+    """)
+    assert "RTL105" in codes
+
+
+def test_timeout_less_park_fixture():
+    assert "RTL102" in _lock_codes("""
+        class C:
+            def run(self, q):
+                return q.get()
+    """)
+
+
+def test_condition_wait_under_its_own_lock_is_clean():
+    """Condition.wait RELEASES the lock — the canonical pattern must
+    not be flagged as blocking-under-lock."""
+    codes = _lock_codes("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def take(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait(1.0)
+    """)
+    assert "RTL101" not in codes
+
+
+def test_nested_function_runs_lock_free():
+    """A closure defined under a lock runs LATER (its own thread) —
+    its blocking calls are not under-the-lock findings."""
+    codes = _lock_codes("""
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def spawn(self):
+                with self._lock:
+                    def probe():
+                        time.sleep(2.0)
+                    threading.Thread(target=probe).start()
+    """)
+    assert "RTL101" not in codes
+
+
+def test_lambda_body_runs_lock_free():
+    """Same contract as nested defs: a lambda built under a lock runs
+    later — ast.walk would descend into its body and mis-attribute its
+    calls to the held-lock region (regression: the walker now prunes
+    lambda subtrees)."""
+    codes = _lock_codes("""
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def m(self):
+                with self._lock:
+                    self.cb = lambda: time.sleep(5)
+    """)
+    assert "RTL101" not in codes
+
+
+# --------------------------------------------------------- suppressions
+
+
+def test_inline_suppression_silences_exact_code():
+    src = textwrap.dedent("""
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)  # raylint: disable=RTL101
+    """)
+    path = "ray_tpu/_private/_zz_fixture.py"
+    mod = acore.Module(path, src)
+    findings = lock_discipline.analyze_module_source(src, path)
+    assert any(f.code == "RTL101" for f in findings)
+    assert not [f for f in findings if not mod.suppressed(f)]
+    # a different code on the same line stays live
+    other = acore.Finding("RTL104", path, findings[0].line, "C.bad", "x")
+    assert not mod.suppressed(other)
+
+
+def test_baseline_partition_and_staleness():
+    f = acore.Finding("RTL101", "ray_tpu/x.py", 7, "C.m", "boom")
+    baseline = {f.key: "by design", "RTL102 ray_tpu/gone.py D.n": "old"}
+    new, known, stale = acore.partition([f], baseline)
+    assert new == [] and known == [f]
+    assert stale == ["RTL102 ray_tpu/gone.py D.n"]
+    # an unbaselined finding is NEW
+    g = acore.Finding("RTL101", "ray_tpu/x.py", 7, "C.other", "boom")
+    new, _, _ = acore.partition([g], baseline)
+    assert new == [g]
+
+
+def test_baseline_key_is_line_number_stable():
+    a = acore.Finding("RTL101", "ray_tpu/x.py", 7, "C.m", "boom")
+    b = acore.Finding("RTL101", "ray_tpu/x.py", 99, "C.m", "boom")
+    assert a.key == b.key
+
+
+def test_readme_knob_tables_match_generated():
+    """README's knob tables are GENERATED (`ray-tpu lint --knob-table`)
+    — both must appear verbatim, so defaults/docs can't drift from the
+    catalog (RTK202 only checks name presence)."""
+    import pathlib
+
+    from ray_tpu._private.knobs import readme_knob_table
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    readme = (root / "README.md").read_text()
+    for internal in (False, True):
+        table = readme_knob_table(internal=internal)
+        assert table in readme, (
+            f"README's {'internal' if internal else 'user'} knob table "
+            f"is out of date — regenerate with `ray-tpu lint "
+            f"--knob-table` and paste both tables into the Static "
+            f"analysis section")
+
+
+# ------------------------------------------------------------ knob units
+
+
+def test_undeclared_knob_fixture():
+    findings = knobs_pass.analyze_module_source(textwrap.dedent("""
+        import os
+
+        FLAG = os.environ.get("RAY_TPU_TOTALLY_BOGUS_KNOB", "0")
+        OTHER = os.environ["RAY_TPU_ANOTHER_BOGUS_ONE"]
+    """), "ray_tpu/_zz_fixture.py")
+    assert _codes(findings) == {"RTK201"}
+    assert {f.context for f in findings} == {
+        "RAY_TPU_TOTALLY_BOGUS_KNOB", "RAY_TPU_ANOTHER_BOGUS_ONE"}
+
+
+def test_declared_and_config_derived_knobs_are_clean():
+    findings = knobs_pass.analyze_module_source(textwrap.dedent("""
+        import os
+
+        A = os.environ.get("RAY_TPU_INTERNAL_TELEMETRY", "1")
+        B = os.getenv("RAY_TPU_COLLECTIVE_OP_TIMEOUT_S")
+    """), "ray_tpu/_zz_fixture.py")
+    assert findings == []
+
+
+# ------------------------------------------------------ wire-format units
+
+
+def _drop_line(text: str, needle: str) -> str:
+    kept = [ln for ln in text.splitlines() if needle not in ln]
+    assert len(kept) < len(text.splitlines()), f"needle {needle!r} unused"
+    return "\n".join(kept) + "\n"
+
+
+def test_wire_format_clean_on_real_tree():
+    ctx = acore.AnalysisContext()
+    assert list(wire_format.wire_format_pass(ctx)) == []
+
+
+def test_deleting_python_protocol_version_fails_wire_pass():
+    ctx0 = acore.AnalysisContext()
+    real = ctx0.read_text(wire_format.PROTOCOL_PY)
+    ctx = acore.AnalysisContext(overrides={
+        wire_format.PROTOCOL_PY: _drop_line(real, "PROTOCOL_VERSION = ")})
+    codes = _codes(wire_format.wire_format_pass(ctx))
+    assert "RTW301" in codes
+
+
+def test_deleting_cc_protocol_version_fails_wire_pass():
+    ctx0 = acore.AnalysisContext()
+    real = ctx0.read_text(wire_format.RPC_CC)
+    ctx = acore.AnalysisContext(overrides={
+        wire_format.RPC_CC: _drop_line(
+            real, "constexpr int kProtocolVersion")})
+    codes = _codes(wire_format.wire_format_pass(ctx))
+    assert "RTW301" in codes
+
+
+def test_version_desync_fails_wire_pass():
+    ctx0 = acore.AnalysisContext()
+    real = ctx0.read_text(wire_format.PROTOCOL_PY)
+    cur = wire_format.parse_layout(ctx0)["py"]["PROTOCOL_VERSION"]
+    tampered = real.replace(f"PROTOCOL_VERSION = {cur}",
+                            f"PROTOCOL_VERSION = {cur + 1}")
+    assert tampered != real
+    ctx = acore.AnalysisContext(
+        overrides={wire_format.PROTOCOL_PY: tampered})
+    codes = _codes(wire_format.wire_format_pass(ctx))
+    assert "RTW302" in codes
+
+
+def test_oid_layout_tamper_fails_wire_pass():
+    """PR 5 regression class: widening the epoch tag past the store's
+    16-byte id silently disabled the whole shm fast path — now it's a
+    lint failure instead."""
+    ctx0 = acore.AnalysisContext()
+    real = ctx0.read_text(wire_format.WORKER_PY)
+    tampered = real.replace('.to_bytes(4, "big")', '.to_bytes(8, "big")')
+    assert tampered != real
+    ctx = acore.AnalysisContext(
+        overrides={wire_format.WORKER_PY: tampered})
+    codes = _codes(wire_format.wire_format_pass(ctx))
+    assert "RTW304" in codes
+
+
+# --------------------------------------------------- native sanitizer gate
+
+
+@pytest.mark.slow
+def test_sanitize_smoke_gate():
+    """The native race gate, actually exercised: shells out to
+    scripts/sanitize.sh --smoke (tsan-only, small iteration count)
+    whenever a C++ compiler is present."""
+    import pathlib
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in this container")
+    root = pathlib.Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        ["bash", str(root / "scripts" / "sanitize.sh"), "--smoke", "30"],
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, (
+        f"sanitize --smoke failed rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout[-4000:]}\nstderr:\n{proc.stderr[-4000:]}")
+    assert "SANITIZE PASS (smoke)" in proc.stdout
