@@ -58,6 +58,12 @@ def emit(result: dict):
 
 # --------------------------------------------------------------- host backend
 
+# conservative per-format per-hop quantization step, relative to the
+# running partial sum's absmax (bf16: half ULP of an 8-bit mantissa;
+# int8: half a step of a 127-level block scale)
+WIRE_Q = {"bf16": 2.0 ** -8, "int8": 1.0 / 254.0}
+
+
 def _host_bench_actor_cls():
     import numpy as np
 
@@ -66,6 +72,33 @@ def _host_bench_actor_cls():
 
     @ray_tpu.remote
     class BenchRank(CollectiveActorMixin):
+        def wire_error(self, size_bytes: int, fmt: str) -> dict:
+            """Measured allreduce error under the active wire format,
+            against a locally reconstructed exact (float64) oracle.
+            Returns the max-abs error and the DOCUMENTED bound: at most
+            `world` quantized hops (world-1 reduce steps + the final
+            chunk's own encode), each within q_fmt of the running
+            partial's absmax, which is itself bounded by the sum of the
+            ranks' input absmaxes."""
+            from ray_tpu.util import collective as col
+
+            n = col.get_collective_group_size()
+            rank = col.get_rank()
+            elems = max(1, size_bytes // 4)
+            ins = [np.random.RandomState(1000 + r)
+                   .standard_normal(elems).astype(np.float32)
+                   for r in range(n)]
+            got = np.asarray(col.allreduce(ins[rank])).astype(np.float64)
+            exact = np.zeros(elems, np.float64)
+            for x in ins:
+                exact += x
+            err = float(np.abs(got - exact).max())
+            absmax_sum = float(sum(np.abs(x).max() for x in ins))
+            q = WIRE_Q.get(fmt, 0.0)
+            return {"max_abs_err": err,
+                    "err_bound": n * q * absmax_sum,
+                    "absmax_sum": absmax_sum}
+
         def bench(self, op: str, size_bytes: int, repeats: int) -> list:
             """Returns per-op wall times (seconds), one per repeat —
             the caller derives mean (headline, comparable to earlier
@@ -101,7 +134,8 @@ def _host_bench_actor_cls():
 
 
 def run_host(world: int, sizes: list[int], repeats: int,
-             extra: dict | None = None) -> list[dict]:
+             extra: dict | None = None,
+             wire_fmt: str | None = None) -> list[dict]:
     import ray_tpu
     from ray_tpu.util import collective as col
 
@@ -116,6 +150,14 @@ def run_host(world: int, sizes: list[int], repeats: int,
         out = []
         for op in OPS:
             for size in sizes:
+                err_stats = None
+                if wire_fmt is not None and op == "allreduce":
+                    # measured quantization error + documented bound,
+                    # same cluster/knobs as the timed rows (worst rank)
+                    errs = ray_tpu.get(
+                        [a.wire_error.remote(size, wire_fmt)
+                         for a in actors], timeout=600)
+                    err_stats = max(errs, key=lambda e: e["max_abs_err"])
                 per_rank = ray_tpu.get(
                     [a.bench.remote(op, size, repeats) for a in actors],
                     timeout=1800)
@@ -135,6 +177,9 @@ def run_host(world: int, sizes: list[int], repeats: int,
                     "busbw_GBps": round(algbw * bf, 4),
                     "p50_busbw_GBps": round(size / p50 / 1e9 * bf, 4),
                     "best_busbw_GBps": round(size / best / 1e9 * bf, 4),
+                    **({"quant_max_abs_err": err_stats["max_abs_err"],
+                        "quant_err_bound": err_stats["err_bound"]}
+                       if err_stats else {}),
                     **(extra or {}),
                 })
                 emit(out[-1])
@@ -164,6 +209,53 @@ def run_host_sweep(world: int, sizes: list[int], repeats: int,
             "pipeline": pipe_on,
             "segment_bytes": int(get_config("collective_segment_bytes")),
         })
+    return rows
+
+
+def run_wire_sweep(world: int, sizes: list[int], repeats: int,
+                   wire_dtypes: list[str], keep_shm: bool) -> list[dict]:
+    """Host-backend sweep across wire formats, one fresh cluster per
+    format, ALWAYS anchored by a same-run `off` baseline. Unless
+    --wire-shm is passed, the whole sweep (baseline included) runs with
+    the same-node shm transport off: quantization is an INTER-host wire
+    feature — in production the intra-host hierarchy keeps same-host
+    hops exact, so the socket path is the wire a cross-host deployment
+    actually quantizes, and comparing both configs on it is the
+    apples-to-apples measurement. Rows record wire_dtype +
+    collective_shm so the artifact is self-describing, and allreduce
+    rows carry the measured max-abs error against an exact float64
+    oracle plus the documented bound (world * q_fmt * sum of per-rank
+    input absmaxes)."""
+    fmts = list(wire_dtypes)
+    if "off" not in fmts:
+        fmts.insert(0, "off")
+    else:
+        fmts.sort(key=lambda f: f != "off")   # baseline first
+    if not keep_shm:
+        os.environ["RAY_TPU_COLLECTIVE_SHM"] = "0"
+    rows = []
+    for fmt in fmts:
+        os.environ["RAY_TPU_COLLECTIVE_WIRE_DTYPE"] = fmt
+        from ray_tpu._private.config import get_config
+
+        rows += run_host(
+            world, sizes, repeats,
+            extra={
+                "wire_dtype": fmt,
+                "collective_shm": bool(get_config("collective_shm")),
+                "segment_bytes":
+                    int(get_config("collective_segment_bytes")),
+                "quant_block":
+                    int(get_config("collective_quant_block")),
+            },
+            wire_fmt=fmt)
+    baseline = {(r["op"], r["size_bytes"]): r for r in rows
+                if r["wire_dtype"] == "off"}
+    for r in rows:
+        base = baseline.get((r["op"], r["size_bytes"]))
+        if base is not None and r["wire_dtype"] != "off":
+            r["p50_speedup_vs_off"] = round(
+                r["p50_busbw_GBps"] / max(base["p50_busbw_GBps"], 1e-9), 3)
     return rows
 
 
@@ -284,13 +376,28 @@ def main(argv=None):
     ap.add_argument("--pipeline", choices=["on", "off"], default=None,
                     help="host backend: force the pipelined data path "
                          "on/off (default: env/config)")
+    ap.add_argument("--wire-dtype", nargs="+", default=None,
+                    choices=["off", "bf16", "int8"],
+                    help="host backend: sweep block-quantized wire "
+                         "formats (a same-run `off` baseline is always "
+                         "included; runs the socket wire — the path "
+                         "inter-host traffic quantizes — unless "
+                         "--wire-shm) and record measured quantization "
+                         "error vs an exact oracle")
+    ap.add_argument("--wire-shm", action="store_true",
+                    help="with --wire-dtype: keep the same-node shm "
+                         "segment transport on instead of measuring "
+                         "the socket wire")
     ap.add_argument("--json-out", default=None,
                     help="write all rows as one machine-readable JSON "
                          "record (busbw artifact, e.g. BENCH_r06.json)")
     args = ap.parse_args(argv)
     sizes = [int(mb * 2**20) for mb in args.sizes_mb]
 
-    if args.backend == "host":
+    if args.backend == "host" and args.wire_dtype:
+        rows = run_wire_sweep(args.world, sizes, args.repeats,
+                              args.wire_dtype, args.wire_shm)
+    elif args.backend == "host":
         rows = run_host_sweep(args.world, sizes, args.repeats,
                               args.segment_bytes, args.pipeline)
     elif args.backend == "xla-local":

@@ -16,6 +16,13 @@ pass makes that unshippable:
 - **RTW304 — oid layout broken.** group-prefix + epoch + rank +
   counter widths must sum to the store's ``kIdSize`` exactly (PR 5's
   20-byte oid silently disabled the whole shm fast path).
+- **RTW305 — collective wire-dtype tag missing/colliding.** The
+  quantized-segment header tags (``WIRE_OFF``/``WIRE_BF16``/
+  ``WIRE_INT8`` in ``util/collective/wire.py``) must all exist, be
+  distinct, and each selectable format must be wired into
+  ``WIRE_FORMATS`` — every group member parses peers' segment headers
+  by these values, so losing or renumbering one silently turns
+  quantized frames into garbage payloads on the receive side.
 """
 from __future__ import annotations
 
@@ -30,6 +37,10 @@ RPC_CC = "src/rpc/rpc_core.cc"
 STORE_CC = "src/store/store.cc"
 WORKER_PY = "ray_tpu/_private/worker_runtime.py"
 HOSTBK_PY = "ray_tpu/util/collective/host_backend.py"
+WIRE_PY = "ray_tpu/util/collective/wire.py"
+
+# quantized-segment header tags every group member must agree on
+WIRE_TAG_NAMES = ("WIRE_OFF", "WIRE_BF16", "WIRE_INT8")
 
 _CC_CONST_RE = re.compile(
     r"constexpr\s+(?:unsigned\s+)?(?:int|uint32_t|int32_t)\s+"
@@ -123,13 +134,31 @@ def _oid_widths(worker_tree: ast.Module, host_tree: ast.Module) -> dict:
     return widths
 
 
+def _wire_formats_map(tree: ast.Module) -> dict[str, str]:
+    """The ``WIRE_FORMATS`` literal: config value -> tag constant name
+    (``{"bf16": WIRE_BF16, ...}``)."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "WIRE_FORMATS"
+                for t in node.targets) and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str) and \
+                        isinstance(v, ast.Name):
+                    out[k.value] = v.id
+    return out
+
+
 def parse_layout(ctx: AnalysisContext | None = None) -> dict:
     """The parsed cross-language constants, for tests to pin:
-    {py: {...}, cc: {...}, id_size, oid_widths}. Missing files/constants
-    appear as absent keys / None values."""
+    {py: {...}, cc: {...}, id_size, oid_widths, wire_tags,
+    wire_formats}. Missing files/constants appear as absent keys / None
+    values."""
     if ctx is None:
         ctx = AnalysisContext()
-    out: dict = {"py": {}, "cc": {}, "id_size": None, "oid_widths": {}}
+    out: dict = {"py": {}, "cc": {}, "id_size": None, "oid_widths": {},
+                 "wire_tags": {}, "wire_formats": {}}
     mod = ctx.module(PROTOCOL_PY)
     if mod is not None:
         out["py"] = _py_int_constants(mod.tree)
@@ -145,6 +174,11 @@ def parse_layout(ctx: AnalysisContext | None = None) -> dict:
     host = ctx.module(HOSTBK_PY)
     if worker is not None and host is not None:
         out["oid_widths"] = _oid_widths(worker.tree, host.tree)
+    wiremod = ctx.module(WIRE_PY)
+    if wiremod is not None:
+        consts = _py_int_constants(wiremod.tree)
+        out["wire_tags"] = {n: consts.get(n) for n in WIRE_TAG_NAMES}
+        out["wire_formats"] = _wire_formats_map(wiremod.tree)
     return out
 
 
@@ -218,3 +252,32 @@ def wire_format_pass(ctx: AnalysisContext):
                     f"counter {counter_w}) but the store id is "
                     f"{id_size} bytes — a mismatched oid silently "
                     f"disables the whole shm fast path (the PR 5 bug)")
+
+    tags = layout["wire_tags"]
+    if not tags:
+        yield Finding(
+            "RTW305", WIRE_PY, 1, "wire_tags",
+            "util/collective/wire.py is missing or unparseable — the "
+            "quantized-segment wire tags can no longer be pinned")
+    else:
+        for name in WIRE_TAG_NAMES:
+            if tags.get(name) is None:
+                yield Finding(
+                    "RTW305", WIRE_PY, 1, name,
+                    f"wire-dtype tag {name} missing from wire.py — "
+                    f"receivers can no longer identify that segment "
+                    f"header, so a peer still sending it delivers "
+                    f"garbage payloads")
+        values = [v for v in tags.values() if v is not None]
+        if len(set(values)) != len(values):
+            yield Finding(
+                "RTW305", WIRE_PY, 1, "wire_tag_collision",
+                f"wire-dtype tags collide: {tags} — two formats would "
+                f"parse each other's segment headers")
+        fmts = layout["wire_formats"]
+        for fmt, tag_name in sorted(fmts.items()):
+            if tags.get(tag_name) is None:
+                yield Finding(
+                    "RTW305", WIRE_PY, 1, f"WIRE_FORMATS[{fmt}]",
+                    f"WIRE_FORMATS maps {fmt!r} to {tag_name}, which is "
+                    f"not a pinned wire tag")

@@ -87,6 +87,15 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # synchronous request/reply ring exactly.
     "collective_pipeline": True,
     "collective_segment_bytes": 4 * 1024 * 1024,  # ring segment size
+    # Block-quantized wire formats (util/collective/wire.py): "off"
+    # (default, bit-exact), "bf16" (2x smaller wire) or "int8" (per-
+    # block float32 scales, ~4x smaller). Applies to float32 sum
+    # allreduce/reducescatter segments on the pipelined path only;
+    # everything else keeps the exact framing.
+    # RAY_TPU_COLLECTIVE_WIRE_DTYPE mirrors RAY_TPU_COLLECTIVE_PIPELINE
+    # as the per-group env knob.
+    "collective_wire_dtype": "off",
+    "collective_quant_block": 1024,   # int8 scale-block size (elements)
     # Same-node segment transport: ranks sharing a node exchange ring
     # segments as shared-memory store references (one copy in, zero-copy
     # pinned view out; forwarded hops pass the same object id) instead

@@ -56,6 +56,10 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _k("COLLECTIVE_SHM", "1", "bool",
        "0 keeps same-node collective segments off the shm object store "
        "(sockets only)."),
+    _k("COLLECTIVE_WIRE_DTYPE", "off", "str",
+       "wire format for float32 sum ring segments: off = bit-exact "
+       "(default), bf16 = 2x smaller wire, int8 = per-block-scaled "
+       "~4x smaller (bounded error; see README Data plane)."),
     _k("INTERNAL_TELEMETRY", "1", "bool",
        "0 turns off the whole internal metrics + events plane."),
     _k("NATIVE_RPC", "1", "bool",
@@ -75,6 +79,9 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "1 lets the raylet probe for real TPU chips at startup "
        "(subprocess jax.devices())."),
     # --- tuning ----------------------------------------------------------
+    _k("COLLECTIVE_QUANT_BLOCK", "1024", "int",
+       "elements per int8 wire-quantization scale block (one float32 "
+       "scale per block; sub-block tails travel exact)."),
     _k("DEVICE_GAUGE_POLL_S", "0", "float",
        "period of the raylet's per-device HBM gauge poller; 0 = one "
        "probe at raylet start."),

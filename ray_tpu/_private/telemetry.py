@@ -32,8 +32,10 @@ ENABLED = os.environ.get("RAY_TPU_INTERNAL_TELEMETRY", "1") != "0"
 
 # Prometheus-convention unit suffixes internal metric names must end in
 # (counters additionally use `_total` per convention; `_tasks` /
-# `_messages` are the "unit is the thing counted" form for gauges).
-ALLOWED_SUFFIXES = ("_total", "_seconds", "_bytes", "_tasks", "_messages")
+# `_messages` are the "unit is the thing counted" form for gauges;
+# `_ratio` is the Prometheus-convention dimensionless 0..1 form).
+ALLOWED_SUFFIXES = ("_total", "_seconds", "_bytes", "_tasks", "_messages",
+                    "_ratio")
 
 _RPC_BOUNDARIES = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0]
 
@@ -142,6 +144,25 @@ CATALOG: dict[str, dict] = {
         "description": "Ring segments sent by the pipelined host "
                        "collective data path (one-way zero-copy frames; "
                        "0 when RAY_TPU_COLLECTIVE_PIPELINE=0)",
+    },
+    "ray_tpu_collective_wire_bytes_total": {
+        "kind": "Counter", "tags": ("op", "group", "format"),
+        "description": "Actual ring-segment bytes this rank put on the "
+                       "wire (socket or shm), by wire format "
+                       "(format=off|bf16|int8; forwarded frames count "
+                       "under the op's active format). Against "
+                       "ray_tpu_collective_bytes_total's payload bytes "
+                       "this is the live compression ratio",
+    },
+    "ray_tpu_collective_quant_error_ratio": {
+        "kind": "Histogram", "tags": ("op", "format"),
+        "boundaries": [1e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2e-3, 4e-3,
+                       8e-3, 2e-2],
+        "description": "Measured max-abs quantization error of one "
+                       "sampled segment per collective op, normalized "
+                       "by the segment's absmax (bf16 bound: 2^-8 ~ "
+                       "0.0039 of each element; int8 bound: 1/254 ~ "
+                       "0.0039 of the block absmax)",
     },
     # --- gang fault tolerance (train/, util/collective) ---
     "ray_tpu_train_gang_restarts_total": {

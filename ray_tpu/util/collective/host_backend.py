@@ -30,6 +30,24 @@ Data path (PR: pipelined zero-copy host collectives). Two modes:
 - **legacy**: the original synchronous ``col_push`` request/reply ring,
   kept bit-for-bit as the kill-switch fallback and semantic reference.
 
+Wire quantization (PR: block-quantized segmented collectives): with
+``RAY_TPU_COLLECTIVE_WIRE_DTYPE=bf16|int8`` (default ``off`` — the
+bit-exact path), eligible ring segments are quantized just before the
+send (see ``wire.py`` for formats/eligibility/bounds) and
+dequantize-accumulated on the receive, riding the same src/acc split —
+quantization overlaps transfer exactly like the reduce does. The
+allgather phase forwards the already-quantized frame unchanged, so each
+payload is quantized ONCE per hop chain, and whichever rank computed a
+chunk's final reduction decodes its own encoding back into ``acc``
+before broadcasting it — every rank therefore returns byte-identical
+results even though the wire is lossy. Eligibility is float32 ``sum``
+on the pipelined path only; everything else (ints, float64,
+prod/min/max, legacy mode) silently keeps the exact wire format, as do
+individual segments the codec declines (non-finite int8 blocks,
+sub-block tails). The intra-host hierarchy quantizes the INTER-host
+leader ring only — same-host hops are shm/loopback, where the bytes
+are nearly free and exactness is.
+
 All algorithms key messages by (group, op-seq, phase, step[, segment]) so
 concurrent ops and late arrivals never cross wires; collective calls must
 be issued in the same order by every rank (standard collective contract,
@@ -56,6 +74,7 @@ from ray_tpu._private.protocol import (ConnectionLost, PyRpcClient,
                                        RpcClient)
 from ray_tpu._private.worker_runtime import (ColShmRef, col_epoch_tag,
                                              col_oid_prefix, current_worker)
+from ray_tpu.util.collective import wire as _wire
 
 _OPS = {
     "sum": np.add,
@@ -159,6 +178,9 @@ class HostGroup:
                                                    # (None, retry_at)
         self._oid_prefix = col_oid_prefix(name) + col_epoch_tag(self.epoch)
         self._seg_count = 0
+        self._wire_codecs: dict[tuple, _wire.WireCodec] = {}
+        self._wire_bytes: dict[str, int] = {}     # format -> ring bytes
+        self._quant_samples: list[tuple] = []     # (format, err_ratio)
         self._worker = current_worker()
         if self._worker is None:
             raise RuntimeError("collective group requires a ray_tpu worker "
@@ -256,10 +278,48 @@ class HostGroup:
         raise err from e
 
     def _segment_elems(self, itemsize: int) -> int:
+        """Elements per ring segment: floor(collective_segment_bytes /
+        itemsize), never below 1 element even when a single element
+        exceeds the byte budget. Floor division means segments are
+        always WHOLE-element — for itemsizes that don't divide the
+        budget (non-power-of-two dtypes) a segment runs up to
+        itemsize-1 bytes under it, and only the LAST segment of a chunk
+        is ragged (``_segments``). Every rank derives the same element
+        count locally; the int8 block-scale wire layout relies on
+        exactly this (block boundaries are computed in elements, so
+        sender and receiver always agree on where scales apply)."""
         from ray_tpu._private.config import get_config
 
         return max(1, int(get_config("collective_segment_bytes"))
-                   // max(1, itemsize))
+                   // max(1, int(itemsize)))
+
+    def _wire_ctx(self, dtype, op: str) -> _wire.WireCodec | None:
+        """The group's wire-quantization codec for one (dtype, op), or
+        None for the exact path. ``off`` (the default) and the legacy
+        ring always return None; an unknown format name raises rather
+        than silently sending exact. Eligibility beyond the format
+        knob: float32 ``sum`` only — ints and prod/min/max have no
+        bounded-error story, float64 would LOSE precision through a
+        float32-scaled wire."""
+        from ray_tpu._private.config import get_config
+
+        fmt = str(get_config("collective_wire_dtype")).strip().lower()
+        if fmt in ("", "off", "0", "false", "none"):
+            return None
+        if fmt not in _wire.WIRE_FORMATS:
+            raise ValueError(
+                f"collective_wire_dtype={fmt!r}: expected one of off, "
+                f"{', '.join(sorted(_wire.WIRE_FORMATS))}")
+        if not self._pipelined():
+            return None   # legacy kill-switch ring stays bit-exact
+        if np.dtype(dtype) != np.float32 or op != "sum":
+            return None
+        block = int(get_config("collective_quant_block"))
+        key = (fmt, block)
+        codec = self._wire_codecs.get(key)
+        if codec is None:
+            codec = self._wire_codecs[key] = _wire.WireCodec(fmt, block)
+        return codec
 
     def _client(self, rank: int) -> RpcClient:
         # Pipelined mode deliberately uses the pure-Python client even
@@ -375,10 +435,38 @@ class HostGroup:
     # bytes to the socket — tiny segments and barrier tokens stay on TCP
     _SHM_MIN_BYTES = 64 * 1024
 
-    def _push_seg(self, dst: int, key: tuple, seg: np.ndarray):
-        parts = ser.serialize_parts(seg)
-        if ser.parts_size(parts) >= self._SHM_MIN_BYTES \
-                and self._shm_ok(dst):
+    def _push_seg(self, dst: int, key: tuple, seg: np.ndarray,
+                  wire: _wire.WireCodec | None = None,
+                  sync_into: np.ndarray | None = None, slot=None):
+        """Send one ring segment, quantizing it first when `wire` is
+        armed (per-segment: the codec may decline and the segment then
+        travels exact — receivers detect the header tag, no
+        negotiation). `sync_into` is the cross-rank-consistency hook:
+        the DEQUANTIZED values are written there, so the rank that owns
+        a chunk's final reduction keeps exactly the bytes every peer
+        will decode (pass the segment itself to dequantize in place).
+        `slot` pins the encoding to a per-slot arena and RETURNS the
+        wire tuple, letting the pairwise exchange reduce against its
+        own already-encoded send instead of decoding it back."""
+        enc = wire.encode(seg, slot=slot) if wire is not None else None
+        if enc is not None:
+            if _tm.ENABLED and not self._quant_samples:
+                # one sampled (prefix-bounded) segment per op
+                self._quant_samples.append(
+                    (wire.name, wire.sample_error(seg, enc)))
+            if sync_into is not None:
+                wire.decode(enc, out=sync_into)
+            payload = enc
+        else:
+            if sync_into is not None and sync_into is not seg:
+                np.copyto(sync_into, seg)
+            payload = seg
+        parts = ser.serialize_parts(payload)
+        nbytes = ser.parts_size(parts)
+        if _tm.ENABLED:
+            fmt = wire.name if enc is not None else "off"
+            self._wire_bytes[fmt] = self._wire_bytes.get(fmt, 0) + nbytes
+        if nbytes >= self._SHM_MIN_BYTES and self._shm_ok(dst):
             full_key = self._full_key(key, self.rank)
             # group-tag(6) + epoch(4) + rank(2) + process counter(4) —
             # exactly the store's 16-byte id, unique across ranks (rank
@@ -401,14 +489,23 @@ class HostGroup:
                                            oid=oid, nbytes=nbytes)
                 except ConnectionLost as e:
                     self._raise_peer_lost(dst, e, f"send failed: {e}")
-                return
+                return enc
         self._push_frame(dst, key, parts)
+        return enc
 
-    def _forward(self, dst: int, key: tuple, frame):
+    def _forward(self, dst: int, key: tuple, frame,
+                 wire: _wire.WireCodec | None = None):
         """Forward a received frame to the next ring hop without
         re-framing: a same-node shm frame travels as its object id
         (zero copy; the LAST hop deletes the object), anything else
-        re-sends the received bytes. Consumes (releases) the frame."""
+        re-sends the received bytes. Consumes (releases) the frame.
+        Under wire quantization this is the "quantize once per hop
+        chain" guarantee — the already-quantized bytes travel on
+        unchanged (`wire` is accounting-only here)."""
+        if _tm.ENABLED:
+            fmt = wire.name if wire is not None else "off"
+            self._wire_bytes[fmt] = self._wire_bytes.get(fmt, 0) \
+                + int(frame.nbytes)
         if isinstance(frame, _ShmFrame) and self._shm_ok(dst):
             full_key = self._full_key(key, self.rank)
             self._seg_count += 1
@@ -478,9 +575,21 @@ class HostGroup:
 
     def _note_segs(self, op: str):
         n, self._seg_count = self._seg_count, 0
-        if n and _tm.ENABLED:
+        wb, self._wire_bytes = self._wire_bytes, {}
+        qs, self._quant_samples = self._quant_samples, []
+        if not _tm.ENABLED:
+            return
+        if n:
             _tm.counter_inc("ray_tpu_collective_segments_total", float(n),
                             tags={"op": op, "group": self.name})
+        for fmt, nbytes in wb.items():
+            _tm.counter_inc("ray_tpu_collective_wire_bytes_total",
+                            float(nbytes),
+                            tags={"op": op, "group": self.name,
+                                  "format": fmt})
+        for fmt, ratio in qs:
+            _tm.observe("ray_tpu_collective_quant_error_ratio", ratio,
+                        tags={"op": op, "format": fmt})
 
     def _hierarchy_plan(self):
         """(local_ranks_on_my_host, one_leader_per_host) when the
@@ -515,7 +624,7 @@ class HostGroup:
 
     def _ring_allreduce(self, src: np.ndarray, acc: np.ndarray, op: str,
                         seq: int, ring: list[int], tag_r: str,
-                        tag_g: str):
+                        tag_g: str, wire: _wire.WireCodec | None = None):
         """Segmented pipelined ring allreduce over `ring` (a sorted list
         of member ranks; every participant passes the same list),
         reading this rank's contribution from `src` and assembling the
@@ -541,13 +650,14 @@ class HostGroup:
             # pushes its full contribution segment-wise and reduces the
             # peer's locally — same bytes on the wire as the 2-ring,
             # half the notify->wake round trips on the critical path.
-            return self._pair_allreduce(src, acc, fn, seq, ring, tag_r)
+            return self._pair_allreduce(src, acc, fn, seq, ring, tag_r,
+                                        wire)
         right, left = ring[(pos + 1) % m], ring[(pos - 1) % m]
         bounds = _split_bounds(acc.size, m)
         step = self._segment_elems(acc.itemsize)
         lo, hi = bounds[pos]
         for k, (a, b) in enumerate(_segments(lo, hi, step)):
-            self._push_seg(right, (tag_r, seq, 0, k), src[a:b])
+            self._push_seg(right, (tag_r, seq, 0, k), src[a:b], wire)
         # reduce-scatter: after step s this rank holds the running
         # reduction of chunk (pos - s - 1); the final step leaves the
         # FULL reduction of chunk (pos + 1), which doubles as the
@@ -558,66 +668,135 @@ class HostGroup:
             for k, (a, b) in enumerate(_segments(lo, hi, step)):
                 seg = acc[a:b]
                 incoming, frame = self._recv_view(left, (tag_r, seq, s, k))
-                fn(src[a:b], incoming, out=seg)
+                if wire is None:
+                    fn(src[a:b], incoming, out=seg)
+                else:
+                    # fused dequantize-accumulate (wire implies sum)
+                    wire.reduce_into(src[a:b], incoming, seg)
                 if frame is not None:
                     frame.release()
+                # the LAST reduce completes this chunk: decode our own
+                # encoding back into acc (sync_into=seg) so this rank
+                # holds the same post-quantization bytes every peer
+                # will decode — rank-identical results despite the
+                # lossy wire
                 self._push_seg(right,
                                (tag_g, seq, 0, k) if last
-                               else (tag_r, seq, s + 1, k), seg)
+                               else (tag_r, seq, s + 1, k), seg, wire,
+                               sync_into=seg if (last and wire is not None)
+                               else None)
         # allgather the reduced chunks around the ring (store-and-forward
         # per segment; forwarded segments reuse the received frame's
-        # memory or shm object — no re-pickle, no copy)
+        # memory or shm object — no re-pickle, no copy, and under wire
+        # quantization no re-quantization either)
         for s in range(m - 1):
             lo, hi = bounds[(pos - s) % m]
             for k, (a, b) in enumerate(_segments(lo, hi, step)):
                 incoming, frame = self._recv_view(left, (tag_g, seq, s, k))
-                np.copyto(acc[a:b], incoming)
+                if wire is None:
+                    np.copyto(acc[a:b], incoming)
+                else:
+                    wire.copy_into(incoming, acc[a:b])
                 if s < m - 2:
                     if frame is not None:
-                        self._forward(right, (tag_g, seq, s + 1, k), frame)
+                        self._forward(right, (tag_g, seq, s + 1, k), frame,
+                                      wire)
                     else:
+                        # frame-less (local/legacy-shaped) delivery:
+                        # acc already holds DECODED values — forward
+                        # them EXACT (wire=None). Re-quantizing would
+                        # mint a new int8 scale from the decoded data
+                        # and downstream ranks would decode different
+                        # bytes than the finishing rank holds,
+                        # breaking the all-ranks-identical guarantee.
                         self._push_seg(right, (tag_g, seq, s + 1, k),
                                        acc[a:b])
                 elif frame is not None:
                     frame.release()
 
     def _pair_allreduce(self, src: np.ndarray, acc: np.ndarray, fn, seq,
-                        ring: list[int], tag: str):
+                        ring: list[int], tag: str,
+                        wire: _wire.WireCodec | None = None):
         """2-member allreduce as a segmented full exchange. Operand
         order per chunk matches the 2-ring EXACTLY (bit-identical to
         the legacy path even for non-commutative corner cases like
         NaN-payload propagation): the chunk this rank owns in ring
         terms, bounds[pos], arrives pre-reduced as fn(peer, mine); the
-        other chunk is reduced locally as fn(mine, peer)."""
+        other chunk is reduced locally as fn(mine, peer).
+
+        Wire quantization quantizes BOTH contributions: each rank
+        retains its own per-segment encoding (slot arena) and the
+        reduce is one fused acc = deq(mine) + deq(theirs) pass — both
+        ranks add the identical decoded values, keeping the
+        all-ranks-byte-identical property the ring gets from its
+        final-chunk decode-back (finite data; NaN payload bits are not
+        ordered under a lossy wire). Segments where either side's
+        codec declined mix exact and decoded operands — same values,
+        commutative order."""
         pos = ring.index(self.rank)
         peer = ring[1 - pos]
         bounds = _split_bounds(acc.size, 2)
         step = self._segment_elems(acc.itemsize)
         segs = _segments(0, acc.size, step)
+        encs: list = []
         for k, (a, b) in enumerate(segs):
-            self._push_seg(peer, (tag, seq, 0, k), src[a:b])
+            encs.append(self._push_seg(peer, (tag, seq, 0, k), src[a:b],
+                                       wire, slot=k))
         mlo, mhi = bounds[pos]
         for k, (a, b) in enumerate(segs):
             incoming, frame = self._recv_view(peer, (tag, seq, 0, k))
+            if wire is not None:
+                mine_enc = encs[k]
+                inc_wire = _wire.is_wire(incoming)
+                if mine_enc is not None and inc_wire:
+                    wire.add_both(mine_enc, incoming, acc[a:b])
+                elif mine_enc is not None:
+                    # mine rode quantized, theirs exact: exact + deq —
+                    # the peer computes the same two operands
+                    wire.reduce_into(incoming, mine_enc, acc[a:b])
+                elif inc_wire:
+                    wire.reduce_into(src[a:b], incoming, acc[a:b])
+                else:
+                    # both exact (codec declined on both sides): the
+                    # plain pairwise reduce below
+                    self._pair_reduce_exact(src, acc, fn, incoming,
+                                            a, b, bounds, pos, mlo, mhi)
+                if frame is not None:
+                    frame.release()
+                continue
             # split the segment at the chunk boundary so each half gets
             # the ring's operand order
-            for lo, hi, mine_first in (
-                    (*bounds[1 - pos], True), (mlo, mhi, False)):
-                s0, s1 = max(a, lo), min(b, hi)
-                if s0 >= s1:
-                    continue
-                inc = incoming[s0 - a:s1 - a]
-                if mine_first:
-                    fn(src[s0:s1], inc, out=acc[s0:s1])
-                else:
-                    fn(inc, src[s0:s1], out=acc[s0:s1])
+            self._pair_reduce_exact(src, acc, fn, incoming, a, b,
+                                    bounds, pos, mlo, mhi)
             if frame is not None:
                 frame.release()
 
+    @staticmethod
+    def _pair_reduce_exact(src, acc, fn, incoming, a, b, bounds, pos,
+                           mlo, mhi):
+        """Exact pairwise reduce of one received segment, with the
+        2-ring's operand order per chunk half (bit-identical to the
+        legacy path, NaN corners included)."""
+        for lo, hi, mine_first in (
+                (*bounds[1 - pos], True), (mlo, mhi, False)):
+            s0, s1 = max(a, lo), min(b, hi)
+            if s0 >= s1:
+                continue
+            inc = incoming[s0 - a:s1 - a]
+            if mine_first:
+                fn(src[s0:s1], inc, out=acc[s0:s1])
+            else:
+                fn(inc, src[s0:s1], out=acc[s0:s1])
+
     def _allreduce_hier(self, src: np.ndarray, acc: np.ndarray, op: str,
-                        seq: int, locals_: list[int], leaders: list[int]):
+                        seq: int, locals_: list[int], leaders: list[int],
+                        wire: _wire.WireCodec | None = None):
         """Intra-host reduce to the host leader, inter-host ring among
-        leaders, intra-host broadcast back (result lands in acc)."""
+        leaders, intra-host broadcast back (result lands in acc). Wire
+        quantization applies to the INTER-host leader ring only — the
+        hr/hb hops below ride shm or loopback on the same host, where
+        compressing costs more than the bytes are worth and exactness
+        comes free."""
         fn = _OPS[op]
         leader = locals_[0]
         if self.rank != leader:
@@ -633,7 +812,8 @@ class HostGroup:
             fn(acc, incoming, out=acc)
             if frame is not None:
                 frame.release()
-        self._ring_allreduce(acc, acc, op, seq, leaders, "hra", "hga")
+        self._ring_allreduce(acc, acc, op, seq, leaders, "hra", "hga",
+                             wire)
         for r in locals_[1:]:
             self._push_seg(r, ("hb", seq, 0, 0), acc)
 
@@ -648,14 +828,18 @@ class HostGroup:
         if not self._pipelined():
             return self._allreduce_sync(arr, op, seq)
         flat = np.ascontiguousarray(arr).reshape(-1)
-        acc = np.empty_like(flat)   # owned result; src (the input) is
-                                    # only read, never copied up front
+        wire = self._wire_ctx(flat.dtype, op)
+        # owned result; src (the input) is only read, never copied up
+        # front. Wire mode aligns the buffer so the quant kernels'
+        # streaming-store fast path engages.
+        acc = np.empty_like(flat) if wire is None \
+            else _wire.aligned_empty(flat.size, flat.dtype)
         plan = self._hierarchy_plan()
         if plan is not None:
-            self._allreduce_hier(flat, acc, op, seq, *plan)
+            self._allreduce_hier(flat, acc, op, seq, *plan, wire=wire)
         else:
             self._ring_allreduce(flat, acc, op, seq, list(range(n)),
-                                 "ar", "ag")
+                                 "ar", "ag", wire)
         self._note_segs("allreduce")
         return acc.reshape(arr.shape)
 
@@ -699,36 +883,46 @@ class HostGroup:
         pos = self.rank
         bounds = _split_bounds(flat.size, n)
         step = self._segment_elems(flat.itemsize)
+        wire = self._wire_ctx(flat.dtype, op)
         if n == 2:
             # pairwise: each rank sends only the PEER's shard and
             # reduces its own as fn(theirs, mine) — half the traffic of
             # the ring+rotation, one round, and the exact operand order
-            # the legacy path's final rotation delivers.
+            # the legacy path's final rotation delivers. (Each shard's
+            # result lands on exactly one rank, so wire quantization
+            # needs no decode-back for cross-rank consistency here.)
             peer = 1 - pos
             plo, phi = bounds[peer]
             for k, (a, b) in enumerate(_segments(plo, phi, step)):
-                self._push_seg(peer, ("rs", seq, 0, k), flat[a:b])
+                self._push_seg(peer, ("rs", seq, 0, k), flat[a:b], wire)
             mlo, mhi = bounds[pos]
-            out = np.empty(mhi - mlo, dtype=flat.dtype)
+            out = np.empty(mhi - mlo, dtype=flat.dtype) if wire is None \
+                else _wire.aligned_empty(mhi - mlo, flat.dtype)
             for k, (a, b) in enumerate(_segments(mlo, mhi, step)):
                 incoming, frame = self._recv_view(peer, ("rs", seq, 0, k))
+                if wire is not None:
+                    incoming = wire.maybe_decode(incoming)
                 fn(incoming, flat[a:b], out=out[a - mlo:b - mlo])
                 if frame is not None:
                     frame.release()
             self._note_segs("reducescatter")
             return out
-        acc = np.empty_like(flat)
+        acc = np.empty_like(flat) if wire is None \
+            else _wire.aligned_empty(flat.size, flat.dtype)
         right, left = (pos + 1) % n, (pos - 1) % n
         lo, hi = bounds[pos]
         for k, (a, b) in enumerate(_segments(lo, hi, step)):
-            self._push_seg(right, ("rs", seq, 0, k), flat[a:b])
+            self._push_seg(right, ("rs", seq, 0, k), flat[a:b], wire)
         for s in range(n - 1):
             lo, hi = bounds[(pos - s - 1) % n]
             last = s == n - 2
             for k, (a, b) in enumerate(_segments(lo, hi, step)):
                 seg = acc[a:b]
                 incoming, frame = self._recv_view(left, ("rs", seq, s, k))
-                fn(flat[a:b], incoming, out=seg)
+                if wire is None:
+                    fn(flat[a:b], incoming, out=seg)
+                else:
+                    wire.reduce_into(flat[a:b], incoming, seg)
                 if frame is not None:
                     frame.release()
                 # after the last reduce this segment is fully reduced
@@ -736,12 +930,16 @@ class HostGroup:
                 # everywhere (same "rsf" hop as the legacy path)
                 self._push_seg(right,
                                ("rsf", seq, 0, k) if last
-                               else ("rs", seq, s + 1, k), seg)
+                               else ("rs", seq, s + 1, k), seg, wire)
         lo, hi = bounds[pos]
-        out = np.empty(hi - lo, dtype=acc.dtype)
+        out = np.empty(hi - lo, dtype=acc.dtype) if wire is None \
+            else _wire.aligned_empty(hi - lo, acc.dtype)
         for k, (a, b) in enumerate(_segments(lo, hi, step)):
             incoming, frame = self._recv_view(left, ("rsf", seq, 0, k))
-            np.copyto(out[a - lo:b - lo], incoming)
+            if wire is None:
+                np.copyto(out[a - lo:b - lo], incoming)
+            else:
+                wire.copy_into(incoming, out[a - lo:b - lo])
             if frame is not None:
                 frame.release()
         self._note_segs("reducescatter")

@@ -1,0 +1,384 @@
+"""Block-quantized wire formats for the pipelined host collectives.
+
+The transport/user-dtype split (the object-store/transport boundary of
+the original Ray paper) means the bytes a ring segment puts ON THE WIRE
+don't have to be the bytes the caller handed in: EQuARX-style block
+quantization sends each float32 segment as bf16 (2x smaller) or as int8
+with per-block float32 scales (~4x smaller), recovering most of that
+factor as effective bus bandwidth on the socket/shm hop. Selection is
+per group op via ``collective_wire_dtype`` (env
+``RAY_TPU_COLLECTIVE_WIRE_DTYPE=off|bf16|int8``, default ``off`` =
+bit-exact legacy framing).
+
+Wire frame: an eligible segment is replaced by a tagged tuple
+
+    (_MAGIC, tag, nelems, *payload)          # tag: WIRE_BF16|WIRE_INT8
+      bf16 payload: (q_uint16,)
+      int8 payload: (block, scales_f32, q_int8, tail_f32)
+
+serialized through the existing ``serialize_parts`` framing (the big
+``q`` array rides an out-of-band buffer, zero-copy on both ends; the
+header tag is what the ``wire-format`` raylint pass pins). Receivers
+detect the magic per segment, so a sender may fall back to the exact
+format for individual segments (non-finite int8 blocks, sub-block
+tails) without any negotiation.
+
+Numerics (pinned by tests/test_zz_quant_collectives.py, mirrored by
+``src/quant/quant.cc``):
+
+- **bf16**: round-to-nearest-even of the top 16 bits; NaN is truncated
+  with the quiet bit forced (rounding a NaN mantissa could carry into
+  the exponent and turn it into +-Inf), Inf is exact. Per element
+  ``|deq(x) - x| <= 2**-8 * |x|``.
+- **int8**: per-block ``scale = absmax/127``; ``|deq(x) - x| <=
+  absmax_block/254`` (half a step; the native kernel rounds half away
+  from zero, the numpy fallback half to even — both within the bound).
+  Blocks with ``absmax < 1.2e-36`` (subnormal territory, where
+  ``1/scale`` overflows) encode as zeros; a block containing Inf/NaN
+  makes the WHOLE segment fall back to the exact format. The sub-block
+  tail (``nelems % block``) always travels as exact float32.
+
+Fast path: ``librayquant.so`` (built on demand like the store/rpc
+cores) fuses each direction into one vectorized pass, including a
+dequantize-ACCUMULATE used by the ring's reduce step. The numpy
+fallback is semantically identical, just slower.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+# Wire-format tags, one per segment header. Pinned by the wire-format
+# raylint pass (RTW305) and tests/test_protocol.py: every group member
+# parses peers' segment headers by these values, so renumbering them is
+# a wire-protocol change (bump PROTOCOL_VERSION if you ever must).
+WIRE_OFF, WIRE_BF16, WIRE_INT8 = 0, 1, 2
+
+# config value -> tag (``off`` deliberately absent: it means "no wire
+# codec at all", not a codec that tags frames WIRE_OFF)
+WIRE_FORMATS = {"bf16": WIRE_BF16, "int8": WIRE_INT8}
+
+# header sentinel: first element of every quantized-segment tuple
+_MAGIC = "rtqw1"
+
+# int8 blocks whose absmax sits below this encode as zeros: the
+# reciprocal scale would overflow float32 (absmax/127 < ~1/FLT_MAX) and
+# the absolute error of flushing is < 1.2e-36 — unobservable next to
+# either format's quantization step
+_I8_TINY = 1.2e-36
+
+_lib = None
+_lib_failed = False
+_lib_lock = threading.Lock()
+_force_numpy = False    # test hook: exercises the fallback kernels
+
+
+def _native():
+    """librayquant.so, lazily built/loaded; None -> numpy fallback."""
+    global _lib, _lib_failed
+    if _force_numpy or _lib_failed:
+        return None
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return None if _lib_failed else _lib
+        try:
+            from ray_tpu._private.native_build import ensure_lib
+
+            lib = ctypes.CDLL(ensure_lib("rayquant"))
+            I64, P = ctypes.c_int64, ctypes.c_void_p
+            lib.rq_enc_i8.restype = ctypes.c_int
+            lib.rq_enc_i8.argtypes = [P, I64, I64, P, P]
+            lib.rq_dec_i8.restype = None
+            lib.rq_dec_i8.argtypes = [P, P, I64, P, I64]
+            lib.rq_dec_add_i8.restype = None
+            lib.rq_dec_add_i8.argtypes = [P, P, I64, P, P, I64]
+            lib.rq_enc_bf16.restype = None
+            lib.rq_enc_bf16.argtypes = [P, I64, P]
+            lib.rq_dec_bf16.restype = None
+            lib.rq_dec_bf16.argtypes = [P, I64, P]
+            lib.rq_dec_add_bf16.restype = None
+            lib.rq_dec_add_bf16.argtypes = [P, P, P, I64]
+            lib.rq_add_qq_i8.restype = None
+            lib.rq_add_qq_i8.argtypes = [P, P, P, P, I64, P, I64]
+            lib.rq_add_qq_bf16.restype = None
+            lib.rq_add_qq_bf16.argtypes = [P, P, P, I64]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+            return None
+    return _lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def is_wire(val) -> bool:
+    """Is `val` a quantized-segment wire tuple?"""
+    return isinstance(val, tuple) and len(val) >= 3 and val[0] == _MAGIC
+
+
+def aligned_empty(n: int, dtype, align: int = 64) -> np.ndarray:
+    """Uninitialized 1-D array whose data pointer is `align`-byte
+    aligned. numpy only guarantees 16; the quant kernels' non-temporal
+    store paths need 32 for the destination (they quietly fall back to
+    regular stores otherwise), so wire-mode result buffers come from
+    here."""
+    itemsize = np.dtype(dtype).itemsize
+    buf = np.empty(n * itemsize + align, np.uint8)
+    off = (-buf.ctypes.data) % align
+    return buf[off:off + n * itemsize].view(dtype)
+
+
+class WireCodec:
+    """One (format, block) quantization context for a HostGroup.
+
+    Holds the reusable scratch buffers (encode output, decode output),
+    so steady-state rings allocate nothing per segment; safe because a
+    group's ops are serial (the collective contract) and every send
+    completes before the next encode reuses the buffer. NOT thread-safe
+    across concurrent ops on the same group — neither is the ring.
+    """
+
+    def __init__(self, fmt: str, block: int):
+        if fmt not in WIRE_FORMATS:
+            raise ValueError(
+                f"unknown collective wire dtype {fmt!r}: expected one of "
+                f"off, {', '.join(sorted(WIRE_FORMATS))}")
+        self.name = fmt
+        self.tag = WIRE_FORMATS[fmt]
+        self.block = max(1, int(block))
+        self._enc_scratch: dict[tuple, np.ndarray] = {}
+        self._dec_scratch: dict[int, np.ndarray] = {}
+
+    def _scratch(self, kind: str, shape: int, dtype) -> np.ndarray:
+        key = (kind, shape, np.dtype(dtype).str)
+        arr = self._enc_scratch.get(key)
+        if arr is None:
+            arr = self._enc_scratch[key] = np.empty(shape, dtype)
+        return arr
+
+    # ------------------------------------------------------------ encode
+
+    def encode(self, seg: np.ndarray, slot=None):
+        """Quantize one contiguous float32 segment; returns the wire
+        tuple, or None when this segment must travel exact (int8 with
+        non-finite data, or nothing to gain: all-tail int8 segments,
+        sub-element sizes). The returned tuple aliases codec scratch and
+        is valid until the next encode of the same size — UNLESS `slot`
+        is given, which pins it to a per-slot arena so a caller can
+        retain one encoding per ring segment (the pairwise exchange
+        keeps its own sends alive to feed the fused add_both)."""
+        n = seg.size
+        if n == 0:
+            return None
+        if self.tag == WIRE_BF16:
+            return self._enc_bf16(seg, n, slot)
+        return self._enc_i8(seg, n, slot)
+
+    def _enc_bf16(self, seg, n, slot=None):
+        q = self._scratch(("q16", slot), n, np.uint16)
+        lib = _native()
+        if lib is not None:
+            lib.rq_enc_bf16(_ptr(seg), n, _ptr(q))
+        else:
+            u = seg.view(np.uint32)
+            rounded = (u + (((u >> 16) & np.uint32(1)) + np.uint32(0x7FFF))
+                       ) >> np.uint32(16)
+            np.copyto(q, rounded.astype(np.uint16))
+            naninf = (u & np.uint32(0x7F800000)) == np.uint32(0x7F800000)
+            if naninf.any():
+                trunc = (u >> np.uint32(16)).astype(np.uint16)
+                hasmant = (u & np.uint32(0x007FFFFF)) != 0
+                trunc |= (naninf & hasmant).astype(np.uint16) << 6
+                np.copyto(q, trunc, where=naninf)
+        return (_MAGIC, WIRE_BF16, n, q)
+
+    def _enc_i8(self, seg, n, slot=None):
+        nb = n // self.block
+        if nb == 0:
+            return None   # all tail: exact fallback, nothing to gain
+        nq = nb * self.block
+        scales = self._scratch(("sc", slot), nb, np.float32)
+        q = self._scratch(("q8", slot), nq, np.int8)
+        lib = _native()
+        if lib is not None:
+            if lib.rq_enc_i8(_ptr(seg), nq, self.block, _ptr(scales),
+                             _ptr(q)):
+                return None   # inf/nan in a block: whole segment exact
+        else:
+            body = seg[:nq].reshape(nb, self.block)
+            absmax = np.abs(body).max(axis=1)
+            if not np.isfinite(absmax).all():
+                return None
+            np.divide(absmax, 127.0, out=scales)
+            scales[absmax < _I8_TINY] = 0.0
+            inv = np.zeros_like(scales)
+            np.divide(np.float32(1.0), scales, out=inv, where=scales > 0)
+            f = self._scratch("f32", nq, np.float32).reshape(nb, self.block)
+            np.multiply(body, inv[:, None], out=f)
+            np.rint(f, out=f)
+            np.copyto(q.reshape(nb, self.block), f, casting="unsafe")
+        # the sub-block tail rides exact float32 (block-scale layout
+        # only covers whole blocks; the copy pins it so the scratch
+        # tuple never aliases caller memory)
+        tail = seg[nq:].copy()
+        return (_MAGIC, WIRE_INT8, n, self.block, scales, q, tail)
+
+    # ------------------------------------------------------------ decode
+
+    def _dec(self, val, out: np.ndarray):
+        """Dequantize wire tuple `val` into float32 array `out`."""
+        lib = _native()
+        if val[1] == WIRE_BF16:
+            q = np.ascontiguousarray(val[3], dtype=np.uint16)
+            if lib is not None:
+                lib.rq_dec_bf16(_ptr(q), q.size, _ptr(out))
+            else:
+                np.left_shift(q.astype(np.uint32), 16,
+                              out=out.view(np.uint32))
+            return
+        _, _, n, block, scales, q, tail = val
+        scales = np.ascontiguousarray(scales, dtype=np.float32)
+        q = np.ascontiguousarray(q, dtype=np.int8)
+        nq = q.size
+        if lib is not None:
+            lib.rq_dec_i8(_ptr(q), _ptr(scales), block, _ptr(out), nq)
+        else:
+            nb = nq // block
+            np.multiply(q.reshape(nb, block), scales[:, None],
+                        out=out[:nq].reshape(nb, block))
+        if n > nq:
+            np.copyto(out[nq:], tail)
+
+    def decode(self, val, out: np.ndarray | None = None) -> np.ndarray:
+        """Dequantized float32 array for wire tuple `val` — into `out`
+        when given, else into a reusable scratch buffer (valid until the
+        next decode of the same size)."""
+        n = val[2]
+        if out is None:
+            out = self._dec_scratch.get(n)
+            if out is None:
+                out = self._dec_scratch[n] = np.empty(n, np.float32)
+        self._dec(val, out)
+        return out
+
+    def maybe_decode(self, val, out: np.ndarray | None = None):
+        """decode() for wire tuples; pass anything else through (a peer
+        may have fallen back to exact for this segment)."""
+        if is_wire(val):
+            return self.decode(val, out)
+        if out is not None:
+            np.copyto(out, val)
+            return out
+        return val
+
+    def copy_into(self, val, out: np.ndarray):
+        """out[:] = value of `val` (wire tuple or plain array) — the
+        ring's allgather-phase write."""
+        if is_wire(val):
+            self._dec(val, out)
+        else:
+            np.copyto(out, val)
+
+    def reduce_into(self, src: np.ndarray, val, acc: np.ndarray):
+        """acc = src + value of `val` — the ring's reduce step, fused
+        with the dequantize when the native kernels are present (one
+        pass instead of decode-then-add). Only ``sum`` groups are
+        eligible for quantization, so the op is fixed."""
+        if not is_wire(val):
+            np.add(src, val, out=acc)
+            return
+        lib = _native()
+        if lib is None:
+            np.add(src, self.decode(val), out=acc)
+            return
+        if val[1] == WIRE_BF16:
+            q = np.ascontiguousarray(val[3], dtype=np.uint16)
+            lib.rq_dec_add_bf16(_ptr(q), _ptr(src), _ptr(acc), q.size)
+            return
+        _, _, n, block, scales, q, tail = val
+        scales = np.ascontiguousarray(scales, dtype=np.float32)
+        q = np.ascontiguousarray(q, dtype=np.int8)
+        nq = q.size
+        lib.rq_dec_add_i8(_ptr(q), _ptr(scales), block, _ptr(src),
+                          _ptr(acc), nq)
+        if n > nq:
+            np.add(src[nq:], tail, out=acc[nq:])
+
+    def add_both(self, val_a, val_b, acc: np.ndarray):
+        """acc = deq(val_a) + deq(val_b), both wire tuples of the SAME
+        format and length — one fused pass. This is the pairwise
+        exchange's reduce: both contributions ride the wire quantized,
+        so every rank adds identical decoded values (and float add is
+        commutative bit-for-bit on finite values, so operand order
+        doesn't break the rank-identical-results property)."""
+        if val_a[1] != val_b[1] or val_a[2] != val_b[2] or \
+                (val_a[1] == WIRE_INT8 and val_a[3] != val_b[3]):
+            # mismatched peer framing (e.g. ranks configured different
+            # block sizes): decode-then-add, slow but safe
+            self._dec(val_a, acc)
+            np.add(acc, self.decode(val_b), out=acc)
+            return
+        lib = _native()
+        if lib is None:
+            # two decodes + one add; the second decode uses the shared
+            # size-keyed scratch, so decode A straight into acc first
+            self._dec(val_a, acc)
+            np.add(acc, self.decode(val_b), out=acc)
+            return
+        if val_a[1] == WIRE_BF16:
+            qa = np.ascontiguousarray(val_a[3], dtype=np.uint16)
+            qb = np.ascontiguousarray(val_b[3], dtype=np.uint16)
+            lib.rq_add_qq_bf16(_ptr(qa), _ptr(qb), _ptr(acc), qa.size)
+            return
+        _, _, n, block, sa, qa, ta = val_a
+        _, _, _n2, _b2, sb, qb, tb = val_b
+        qa = np.ascontiguousarray(qa, dtype=np.int8)
+        qb = np.ascontiguousarray(qb, dtype=np.int8)
+        sa = np.ascontiguousarray(sa, dtype=np.float32)
+        sb = np.ascontiguousarray(sb, dtype=np.float32)
+        nq = qa.size
+        lib.rq_add_qq_i8(_ptr(qa), _ptr(sa), _ptr(qb), _ptr(sb), block,
+                         _ptr(acc), nq)
+        if n > nq:
+            np.add(ta, tb, out=acc[nq:])
+
+    # --------------------------------------------------------- telemetry
+
+    def sample_error(self, seg: np.ndarray, enc: tuple,
+                     max_elems: int = 16384) -> float:
+        """Measured max-abs quantization error of (a prefix of) one
+        just-encoded segment, normalized by the prefix's absmax — the
+        scale-free number the quant-error histogram records. Sampled
+        (one segment per op, bounded prefix) so telemetry never doubles
+        the encode cost."""
+        n = min(int(seg.size), max_elems)
+        if self.tag == WIRE_INT8:
+            n = min(n, int(enc[5].size))   # stay inside quantized blocks
+        if n == 0:
+            return 0.0
+        trimmed = _trim(enc, n)
+        n = trimmed[2]                     # _trim may round up to a block
+        ref = seg[:n]
+        deq = self.decode(trimmed, out=None)
+        denom = float(np.abs(ref).max())
+        if denom == 0.0 or not np.isfinite(denom):
+            return 0.0
+        return float(np.abs(deq[:n] - ref).max()) / denom
+
+
+def _trim(enc: tuple, n: int) -> tuple:
+    """A view of wire tuple `enc` covering only its first `n` elements
+    (n must stay within the quantized body for int8)."""
+    if enc[1] == WIRE_BF16:
+        return (_MAGIC, WIRE_BF16, n, enc[3][:n])
+    _, _, _total, block, scales, q, _tail = enc
+    nb = max(1, n // block)
+    n = nb * block
+    return (_MAGIC, WIRE_INT8, n, block, scales[:nb], q[:n],
+            np.empty(0, np.float32))

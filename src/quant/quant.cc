@@ -1,0 +1,266 @@
+// Block-quantized wire kernels for the pipelined host collectives
+// (ray_tpu/util/collective/wire.py loads this as librayquant.so; every
+// entry point has a numpy fallback there, so a missing compiler only
+// costs speed, never correctness).
+//
+// Why C: the quantize/dequantize passes sit INSIDE the ring's
+// per-segment budget — at 4 MiB segments the whole point of sending
+// 1/4 of the bytes dies if the encode costs more than the bytes it
+// saves. numpy needs one full temporary pass per step (abs, max,
+// multiply, round, cast: ~0.4 ms/MB); these fused single-pass loops
+// auto-vectorize to ~0.07 ms/MB.
+//
+// Numerics contract (mirrored by the numpy fallback and pinned by
+// tests/test_zz_quant_collectives.py):
+//   int8:  per-block scale = absmax/127, round half away from zero;
+//          |deq(x) - x| <= absmax_block/254 (half a quantization step).
+//          Any non-finite value in the input returns 1 and the caller
+//          falls back to the exact wire format for the whole segment.
+//   bf16:  round-to-nearest-even on the high 16 bits; NaN payloads are
+//          truncated with the quiet bit forced (a rounded NaN mantissa
+//          could carry into the exponent and come back +-inf), Inf is
+//          preserved exactly; |deq(x) - x| <= 2^-8 * |x|.
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+// The decode-accumulate family writes a fresh result buffer the size of
+// the PAYLOAD (4x the wire bytes for int8) — under two ranks contending
+// for memory bandwidth those read-for-ownership fills are a third of
+// the traffic. When the destination is 32-byte aligned (host_backend
+// allocates acc that way in wire mode) the AVX2 paths use non-temporal
+// streaming stores instead. Every vector path computes mul+mul+add /
+// mul+add EXACTLY like the scalar loops (no FMA — see the
+// -ffp-contract=off note in native_build.py), so results stay
+// bit-identical whichever path runs.
+
+#if defined(__AVX2__)
+static inline __m256 dq8(const int8_t* p, __m256 scale) {
+  return _mm256_mul_ps(
+      _mm256_cvtepi32_ps(_mm256_cvtepi8_epi32(
+          _mm_loadl_epi64((const __m128i*)p))),
+      scale);
+}
+
+static inline __m256 dqbf16(const uint16_t* p) {
+  __m128i h = _mm_loadu_si128((const __m128i*)p);
+  return _mm256_castsi256_ps(
+      _mm256_slli_epi32(_mm256_cvtepu16_epi32(h), 16));
+}
+#endif
+
+static inline int aligned32(const void* p) {
+  return (((uintptr_t)p) & 31u) == 0;
+}
+
+extern "C" {
+
+// ---------------------------------------------------------------- int8
+
+// absmax runs over the uint32 domain: for IEEE-754 floats,
+// |a| <= |b|  iff  (bits(a) & 0x7FFFFFFF) <= (bits(b) & 0x7FFFFFFF),
+// and NaN/Inf sort above every finite value — one integer max both
+// finds the block scale and detects non-finite input. Integer max
+// reductions vectorize without -ffast-math (no FP reassociation), the
+// float version does not.
+int rq_enc_i8(const float* x, int64_t n, int64_t block,
+              float* scales, int8_t* q) {
+  int64_t nb = n / block;
+  for (int64_t b = 0; b < nb; ++b) {
+    const float* xb = x + b * block;
+    uint32_t um = 0;
+    for (int64_t i = 0; i < block; ++i) {
+      uint32_t u;
+      std::memcpy(&u, &xb[i], 4);
+      u &= 0x7FFFFFFFu;
+      um = u > um ? u : um;
+    }
+    if (um >= 0x7F800000u) return 1;  // inf or nan in this block
+    float m;
+    std::memcpy(&m, &um, 4);
+    // subnormal-absmax blocks flush to zero (mirrors wire.py's
+    // _I8_TINY): below this, 1/scale overflows to +inf and the
+    // float->int cast of x*inf would be UNDEFINED BEHAVIOR (and for
+    // deep subnormals where inv stays finite, scale's own rounding
+    // can push |x*inv| past 127). The flush error is < 1.2e-36 —
+    // unobservable against either format's quantization step.
+    float scale = m < 1.2e-36f ? 0.0f : m * (1.0f / 127.0f);
+    float inv = scale > 0.0f ? 1.0f / scale : 0.0f;
+    scales[b] = scale;
+    int8_t* qb = q + b * block;
+    for (int64_t i = 0; i < block; ++i) {
+      // round half away from zero: add sign-matched 0.5, truncate.
+      // |x*inv| <= 127 by construction, so the int cast never wraps.
+      float v = xb[i] * inv;
+      uint32_t uv;
+      std::memcpy(&uv, &v, 4);
+      uint32_t uh = 0x3F000000u | (uv & 0x80000000u);
+      float h;
+      std::memcpy(&h, &uh, 4);
+      qb[i] = (int8_t)(int32_t)(v + h);
+    }
+  }
+  return 0;
+}
+
+void rq_dec_i8(const int8_t* q, const float* scales, int64_t block,
+               float* out, int64_t n) {
+  int64_t nb = n / block;
+#if defined(__AVX2__)
+  if (aligned32(out) && block % 8 == 0) {
+    for (int64_t b = 0; b < nb; ++b) {
+      __m256 s = _mm256_set1_ps(scales[b]);
+      const int8_t* qb = q + b * block;
+      float* ob = out + b * block;
+      for (int64_t i = 0; i < block; i += 8)
+        _mm256_stream_ps(ob + i, dq8(qb + i, s));
+    }
+    _mm_sfence();
+    return;
+  }
+#endif
+  for (int64_t b = 0; b < nb; ++b) {
+    float s = scales[b];
+    const int8_t* qb = q + b * block;
+    float* ob = out + b * block;
+    for (int64_t i = 0; i < block; ++i) ob[i] = (float)qb[i] * s;
+  }
+}
+
+// fused dequantize-accumulate: acc = src + deq(q) in one pass (the
+// ring's reduce step; saves a full 4x-sized temporary write+read over
+// decode-then-add)
+void rq_dec_add_i8(const int8_t* q, const float* scales, int64_t block,
+                   const float* src, float* acc, int64_t n) {
+  int64_t nb = n / block;
+#if defined(__AVX2__)
+  if (aligned32(acc) && block % 8 == 0) {
+    for (int64_t b = 0; b < nb; ++b) {
+      __m256 s = _mm256_set1_ps(scales[b]);
+      const int8_t* qb = q + b * block;
+      const float* sb = src + b * block;
+      float* ab = acc + b * block;
+      for (int64_t i = 0; i < block; i += 8)
+        _mm256_stream_ps(
+            ab + i, _mm256_add_ps(_mm256_loadu_ps(sb + i),
+                                  dq8(qb + i, s)));
+    }
+    _mm_sfence();
+    return;
+  }
+#endif
+  for (int64_t b = 0; b < nb; ++b) {
+    float s = scales[b];
+    const int8_t* qb = q + b * block;
+    const float* sb = src + b * block;
+    float* ab = acc + b * block;
+    for (int64_t i = 0; i < block; ++i)
+      ab[i] = sb[i] + (float)qb[i] * s;
+  }
+}
+
+// fused both-quantized add: acc = deq(qa) + deq(qb) in one pass (the
+// 2-member pairwise exchange, where BOTH contributions ride the wire
+// quantized so every rank decodes identical bytes)
+void rq_add_qq_i8(const int8_t* qa, const float* sa,
+                  const int8_t* qb, const float* sb, int64_t block,
+                  float* acc, int64_t n) {
+  int64_t nb = n / block;
+#if defined(__AVX2__)
+  if (aligned32(acc) && block % 8 == 0) {
+    for (int64_t b = 0; b < nb; ++b) {
+      __m256 fa = _mm256_set1_ps(sa[b]);
+      __m256 fb = _mm256_set1_ps(sb[b]);
+      const int8_t* ab = qa + b * block;
+      const int8_t* bb = qb + b * block;
+      float* ob = acc + b * block;
+      for (int64_t i = 0; i < block; i += 8)
+        _mm256_stream_ps(ob + i,
+                         _mm256_add_ps(dq8(ab + i, fa), dq8(bb + i, fb)));
+    }
+    _mm_sfence();
+    return;
+  }
+#endif
+  for (int64_t b = 0; b < nb; ++b) {
+    float fa = sa[b], fb = sb[b];
+    const int8_t* ab = qa + b * block;
+    const int8_t* bb = qb + b * block;
+    float* ob = acc + b * block;
+    for (int64_t i = 0; i < block; ++i)
+      ob[i] = (float)ab[i] * fa + (float)bb[i] * fb;
+  }
+}
+
+// ---------------------------------------------------------------- bf16
+
+void rq_enc_bf16(const uint32_t* u, int64_t n, uint16_t* q) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t v = u[i];
+    uint32_t naninf = (uint32_t)((v & 0x7F800000u) == 0x7F800000u);
+    uint32_t hasmant = (uint32_t)((v & 0x007FFFFFu) != 0u);
+    uint32_t rounded = (v + (((v >> 16) & 1u) + 0x7FFFu)) >> 16;
+    uint32_t trunc = (v >> 16) | ((naninf & hasmant) << 6);
+    q[i] = (uint16_t)(naninf ? trunc : rounded);
+  }
+}
+
+void rq_dec_bf16(const uint16_t* q, int64_t n, uint32_t* out) {
+  int64_t i = 0;
+#if defined(__AVX2__)
+  if (aligned32(out)) {
+    for (; i + 8 <= n; i += 8)
+      _mm256_stream_ps((float*)(out + i),
+                       dqbf16(q + i));
+    _mm_sfence();
+  }
+#endif
+  for (; i < n; ++i) out[i] = ((uint32_t)q[i]) << 16;
+}
+
+void rq_dec_add_bf16(const uint16_t* q, const float* src, float* acc,
+                     int64_t n) {
+  int64_t i = 0;
+#if defined(__AVX2__)
+  if (aligned32(acc)) {
+    for (; i + 8 <= n; i += 8)
+      _mm256_stream_ps(
+          acc + i, _mm256_add_ps(_mm256_loadu_ps(src + i),
+                                 dqbf16(q + i)));
+    _mm_sfence();
+  }
+#endif
+  for (; i < n; ++i) {
+    uint32_t u = ((uint32_t)q[i]) << 16;
+    float f;
+    std::memcpy(&f, &u, 4);
+    acc[i] = src[i] + f;
+  }
+}
+
+void rq_add_qq_bf16(const uint16_t* qa, const uint16_t* qb, float* acc,
+                    int64_t n) {
+  int64_t i = 0;
+#if defined(__AVX2__)
+  if (aligned32(acc)) {
+    for (; i + 8 <= n; i += 8)
+      _mm256_stream_ps(acc + i,
+                       _mm256_add_ps(dqbf16(qa + i), dqbf16(qb + i)));
+    _mm_sfence();
+  }
+#endif
+  for (; i < n; ++i) {
+    uint32_t ua = ((uint32_t)qa[i]) << 16;
+    uint32_t ub = ((uint32_t)qb[i]) << 16;
+    float fa, fb;
+    std::memcpy(&fa, &ua, 4);
+    std::memcpy(&fb, &ub, 4);
+    acc[i] = fa + fb;
+  }
+}
+
+}  // extern "C"

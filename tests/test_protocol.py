@@ -143,6 +143,17 @@ def test_wire_format_pass_pins_cross_language_constants():
             layout["cc"]["kPush"]) == (0, 1, 2)
     # collective shm oid layout sums to the store's 16-byte id
     assert layout["id_size"] == 16
+    # quantized-segment wire-dtype tags (PR 9): pinned values every
+    # group member parses peers' segment headers by — renumbering is a
+    # wire-protocol change
+    assert layout["wire_tags"] == {"WIRE_OFF": 0, "WIRE_BF16": 1,
+                                   "WIRE_INT8": 2}
+    assert layout["wire_formats"] == {"bf16": "WIRE_BF16",
+                                      "int8": "WIRE_INT8"}
+    from ray_tpu.util.collective import wire as wire_mod
+
+    assert (wire_mod.WIRE_OFF, wire_mod.WIRE_BF16,
+            wire_mod.WIRE_INT8) == (0, 1, 2)
     # and the pass itself is clean over the real tree
     ctx = wire_format.AnalysisContext()
     assert list(wire_format.wire_format_pass(ctx)) == []
@@ -166,6 +177,29 @@ def test_wire_format_pass_fails_on_deleted_version_pin():
         codes = {f.code for f in wire_format.wire_format_pass(ctx)}
         assert "RTW301" in codes, f"deleting {needle!r} from {path} " \
                                   f"did not fail the pass"
+
+
+def test_wire_format_pass_fails_on_deleted_wire_tag():
+    """PR 9: deleting (or colliding) a quantized-segment wire-dtype tag
+    in util/collective/wire.py fails the pass with RTW305."""
+    from ray_tpu._private.analysis import wire_format
+    from ray_tpu._private.analysis.core import AnalysisContext
+
+    real = AnalysisContext().read_text(wire_format.WIRE_PY)
+    tag_line = next(ln for ln in real.splitlines()
+                    if ln.startswith("WIRE_OFF"))
+    # deleted tags
+    ctx = AnalysisContext(overrides={
+        wire_format.WIRE_PY: real.replace(tag_line, "")})
+    codes = {f.code for f in wire_format.wire_format_pass(ctx)}
+    assert "RTW305" in codes
+    # colliding tags (two formats would parse each other's headers)
+    ctx = AnalysisContext(overrides={
+        wire_format.WIRE_PY: real.replace(
+            tag_line, "WIRE_OFF, WIRE_BF16, WIRE_INT8 = 0, 1, 1")})
+    findings = [f for f in wire_format.wire_format_pass(ctx)
+                if f.code == "RTW305"]
+    assert any("collide" in f.message for f in findings)
 
 
 def test_spec_validation_always_on(monkeypatch):

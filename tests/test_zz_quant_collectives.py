@@ -1,0 +1,527 @@
+"""Block-quantized collective wire formats (late-alphabet; sequenced
+after the tier-1 timeout horizon by design).
+
+Covers the PR's tentpole: the bf16 / int8-with-block-scales wire
+codecs (`util/collective/wire.py` + `src/quant/quant.cc`), their
+per-segment eligibility fallback, the documented error bounds, the
+`off` kill switch being bit-exact, rank-identical results under a
+lossy wire, hierarchy/shm composition, wire telemetry, and chaos
+parity (a dropped or duplicated quantized segment behaves exactly like
+an exact one: timeout-not-hang, no double dequantize-accumulate).
+
+Knob plumbing mirrors tests/test_zz_host_pipeline.py: members read the
+collective config live from env, so actors get a `configure` method.
+"""
+import numpy as np
+import pytest
+
+SEG = 1024       # segment bytes under test: 256 f32 elements
+BLOCK = 64       # int8 scale block (elements)
+
+BASE_ENV = {
+    "RAY_TPU_COLLECTIVE_SEGMENT_BYTES": SEG,
+    "RAY_TPU_COLLECTIVE_QUANT_BLOCK": BLOCK,
+    "RAY_TPU_COLLECTIVE_PIPELINE": "1",
+}
+
+# documented per-hop quantization step, relative to the running
+# partial's absmax (see util/collective/wire.py docstring)
+Q = {"bf16": 2.0 ** -8, "int8": 1.0 / 254.0}
+
+
+def _bound(fmt: str, world: int, ins) -> float:
+    """world quantized hops x q x (sum of per-rank input absmax) —
+    the bound PERF.md documents and the bench records."""
+    return world * Q[fmt] * sum(float(np.abs(x).max()) for x in ins)
+
+
+def _rank_cls(ray):
+    @ray.remote
+    class Rank:
+        def configure(self, env):
+            import os
+
+            os.environ.update({k: str(v) for k, v in env.items()})
+            return True
+
+        def join(self, world, rank, name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, "host", name)
+            return rank
+
+        def allreduce(self, arr, name, op="sum"):
+            from ray_tpu.util import collective as col
+
+            return col.allreduce(arr, name, op=op)
+
+        def reducescatter(self, arr, name, op="sum"):
+            from ray_tpu.util import collective as col
+
+            return col.reducescatter(arr, name, op=op)
+
+        def chaos(self, seed, schedule):
+            from ray_tpu._private import fault_injection as fi
+
+            fi.install(seed, schedule)
+            return True
+
+        def chaos_off(self):
+            from ray_tpu._private import fault_injection as fi
+
+            fi.uninstall()
+            return True
+
+        def destroy(self, name):
+            from ray_tpu.util import collective as col
+
+            col.destroy_collective_group(name)
+            return True
+
+    return Rank
+
+
+def _make_world(ray, world, name, env=None):
+    Rank = _rank_cls(ray)
+    actors = [Rank.options(num_cpus=0).remote() for _ in range(world)]
+    merged = dict(BASE_ENV)
+    merged.update(env or {})
+    ray.get([a.configure.remote(merged) for a in actors])
+    ray.get([a.join.remote(world, i, name)
+             for i, a in enumerate(actors)], timeout=120)
+    return actors
+
+
+def _teardown(ray, actors, name):
+    try:
+        ray.get([a.destroy.remote(name) for a in actors], timeout=30)
+    except Exception:
+        pass
+    for a in actors:
+        try:
+            ray.kill(a)
+        except Exception:
+            pass
+
+
+def _mk(rank, size, dtype="float32", scale=3.0):
+    rng = np.random.RandomState(1000 * rank + size)
+    return (rng.standard_normal(size) * scale).astype(dtype)
+
+
+# ------------------------------------------------------------ codec units
+
+def test_codec_bounds_and_corners():
+    """Encode/decode roundtrip honors the documented per-format bound,
+    NaN/Inf corners, zero and subnormal blocks, sub-block tails — on
+    the native kernels AND the numpy fallback."""
+    from ray_tpu.util.collective import wire
+
+    for force in (False, True):
+        wire._force_numpy = force
+        try:
+            c8 = wire.WireCodec("int8", BLOCK)
+            cb = wire.WireCodec("bf16", BLOCK)
+            x = _mk(1, 1000) * 7
+            e = c8.encode(x)
+            assert wire.is_wire(e)
+            d = c8.decode(e, out=np.empty(1000, np.float32))
+            nq = 1000 // BLOCK * BLOCK
+            bmax = np.abs(x[:nq].reshape(-1, BLOCK)).max(axis=1)
+            err = np.abs(d[:nq] - x[:nq]).reshape(-1, BLOCK).max(axis=1)
+            assert (err <= bmax / 254 + 1e-12).all()
+            assert np.array_equal(d[nq:], x[nq:])   # tail exact
+            eb = cb.encode(x)
+            db = cb.decode(eb, out=np.empty(1000, np.float32))
+            rel = np.abs(db - x) / np.maximum(np.abs(x), 1e-30)
+            assert rel.max() <= 2 ** -8 + 1e-9
+            # non-finite: int8 declines the whole segment; bf16 keeps
+            # NaN as (quiet) NaN and Inf exact
+            xn = x.copy()
+            xn[3], xn[400], xn[500] = np.nan, np.inf, -np.inf
+            assert c8.encode(xn) is None
+            dn = cb.decode(cb.encode(xn),
+                           out=np.empty(1000, np.float32))
+            assert np.isnan(dn[3]) and dn[400] == np.inf \
+                and dn[500] == -np.inf
+            # zero/subnormal blocks flush to zero, bounded by 1.2e-36
+            # (below the flush threshold 1/scale would overflow — the
+            # deep-subnormal 3e-43 case was UB in the first native cut)
+            xz = np.zeros(3 * BLOCK, np.float32)
+            xz[BLOCK + 2] = 1e-38
+            xz[2 * BLOCK + 5] = 3e-43
+            dz = c8.decode(c8.encode(xz),
+                           out=np.empty(3 * BLOCK, np.float32))
+            assert np.abs(dz).max() <= 1.2e-36
+            # all-tail / empty segments decline (exact fallback)
+            assert c8.encode(np.ones(BLOCK - 1, np.float32)) is None
+            assert c8.encode(np.empty(0, np.float32)) is None
+        finally:
+            wire._force_numpy = False
+
+
+def test_codec_fused_paths_match_and_commute():
+    """The fused kernels (reduce_into / add_both, native NT + scalar
+    paths and the numpy fallback) produce bit-identical results from
+    the same wire bytes, and add_both commutes — the property
+    rank-identical pairwise results rest on."""
+    from ray_tpu.util.collective import wire
+
+    for fmt in ("int8", "bf16"):
+        wire._force_numpy = False
+        c = wire.WireCodec(fmt, BLOCK)
+        n = 997
+        x, y = _mk(1, n) * 9, _mk(2, n) * 9
+        src = _mk(3, n)
+        ea = tuple(v.copy() if isinstance(v, np.ndarray) else v
+                   for v in c.encode(x, slot=0))
+        eb = tuple(v.copy() if isinstance(v, np.ndarray) else v
+                   for v in c.encode(y, slot=1))
+        results = {}
+        for force in (False, True):
+            wire._force_numpy = force
+            try:
+                c2 = wire.WireCodec(fmt, BLOCK)
+                acc = wire.aligned_empty(n, np.float32)      # NT path
+                c2.add_both(ea, eb, acc)
+                rev = wire.aligned_empty(n, np.float32)
+                c2.add_both(eb, ea, rev)
+                assert np.array_equal(acc, rev), (fmt, force)
+                red = wire.aligned_empty(n, np.float32)
+                c2.reduce_into(src, ea, red)
+                dec = wire.aligned_empty(n, np.float32)
+                c2.copy_into(ea, dec)
+                unal = np.empty(n + 1, np.float32)[1:]       # scalar path
+                c2.add_both(ea, eb, unal)
+                results[force] = (acc.copy(), red.copy(), dec.copy(),
+                                  unal.copy())
+            finally:
+                wire._force_numpy = False
+        for a, b in zip(results[False], results[True]):
+            assert np.array_equal(a, b), fmt
+        # fused reduce == decode-then-add, and aligned == unaligned
+        f = results[False]
+        assert np.array_equal(f[1], src + f[2])
+        assert np.array_equal(f[0], f[3])
+
+
+def test_segment_elems_non_power_of_two_itemsize(ray_start_regular):
+    """Satellite regression: _segment_elems floor-divides, so segments
+    are always whole-element for itemsizes that don't divide
+    collective_segment_bytes (the int8 block layout relies on both
+    ends agreeing on element boundaries), and never drop below one
+    element."""
+    import os
+
+    from ray_tpu.util.collective.host_backend import HostGroup
+
+    g = HostGroup("segelems", 2, 0,
+                  {0: ("h", 1), 1: ("h", 2)})
+    os.environ["RAY_TPU_COLLECTIVE_SEGMENT_BYTES"] = "4096"
+    try:
+        for itemsize in (1, 2, 3, 4, 5, 8, 12, 16, 100):
+            elems = g._segment_elems(itemsize)
+            assert elems == max(1, 4096 // itemsize)
+            assert elems * itemsize <= 4096 or elems == 1
+        # an element larger than the whole budget still makes progress
+        assert g._segment_elems(10_000) == 1
+        assert g._segment_elems(0) >= 1   # guarded, not ZeroDivision
+    finally:
+        os.environ.pop("RAY_TPU_COLLECTIVE_SEGMENT_BYTES", None)
+        g.close()
+
+
+def test_unknown_wire_dtype_raises(ray_start_regular):
+    import os
+
+    from ray_tpu.util.collective.host_backend import HostGroup
+
+    g = HostGroup("badfmt", 2, 0, {0: ("h", 1), 1: ("h", 2)})
+    os.environ["RAY_TPU_COLLECTIVE_WIRE_DTYPE"] = "fp4"
+    try:
+        with pytest.raises(ValueError, match="fp4"):
+            g._wire_ctx(np.float32, "sum")
+    finally:
+        os.environ.pop("RAY_TPU_COLLECTIVE_WIRE_DTYPE", None)
+        g.close()
+
+
+# --------------------------------------------------------------- oracles
+
+def test_quantized_oracle_worlds_1_to_4(ray_start_regular):
+    """float32 sum allreduce/reducescatter under bf16 and int8 across
+    odd sizes and worlds 1-4: within the documented bound, and every
+    rank returns BYTE-IDENTICAL results despite the lossy wire."""
+    ray = ray_start_regular
+    sizes = (1, 63, 64, 257, 1000)   # tail-only, block, odd, multi-seg
+    for fmt in ("bf16", "int8"):
+        for world in (1, 2, 3, 4):
+            name = f"q_{fmt}_{world}"
+            actors = _make_world(
+                ray, world, name,
+                env={"RAY_TPU_COLLECTIVE_WIRE_DTYPE": fmt})
+            try:
+                for size in sizes:
+                    ins = [_mk(r, size) for r in range(world)]
+                    exact = np.zeros(size, np.float64)
+                    for x in ins:
+                        exact += x
+                    out = ray.get(
+                        [a.allreduce.remote(ins[r], name)
+                         for r, a in enumerate(actors)], timeout=60)
+                    outs = [np.asarray(o) for o in out]
+                    for o in outs[1:]:
+                        assert o.tobytes() == outs[0].tobytes(), \
+                            (fmt, world, size, "rank divergence")
+                    got = outs[0].astype(np.float64)
+                    assert got.dtype == np.float64
+                    assert outs[0].shape == (size,)
+                    err = np.abs(got - exact).max()
+                    assert err <= _bound(fmt, world, ins) + 1e-6, \
+                        (fmt, world, size, err)
+                    rs = ray.get(
+                        [a.reducescatter.remote(ins[r], name)
+                         for r, a in enumerate(actors)], timeout=60)
+                    shards = np.array_split(exact, world)
+                    for r, got in enumerate(rs):
+                        if shards[r].size == 0:
+                            continue   # size < world: empty shard
+                        rerr = np.abs(np.asarray(got).astype(np.float64)
+                                      - shards[r]).max()
+                        assert rerr <= _bound(fmt, world, ins) + 1e-6, \
+                            (fmt, world, size, rerr)
+            finally:
+                _teardown(ray, actors, name)
+
+
+def test_eligibility_fallback_matrix(ray_start_regular):
+    """With a wire format armed, everything OUTSIDE float32-sum must be
+    bit-exact: integer dtypes, float64, non-sum ops, and segments whose
+    data is non-finite (int8 declines per segment)."""
+    ray = ray_start_regular
+    world, name = 2, "q_elig"
+    actors = _make_world(ray, world, name,
+                         env={"RAY_TPU_COLLECTIVE_WIRE_DTYPE": "int8"})
+    try:
+        size = 300
+        cases = [
+            ("int32", "sum"), ("int32", "max"),
+            ("float64", "sum"), ("float32", "max"),
+            ("float32", "product"), ("float32", "min"),
+        ]
+        for dtype, op in cases:
+            ins = [_mk(r, size, dtype) if np.dtype(dtype).kind == "f"
+                   else np.arange(size, dtype=dtype) + r
+                   for r in range(world)]
+            import functools
+
+            fn = {"sum": np.add, "max": np.maximum, "min": np.minimum,
+                  "product": np.multiply}[op]
+            expect = functools.reduce(fn, ins[1:], ins[0])
+            out = ray.get([a.allreduce.remote(ins[r], name, op)
+                           for r, a in enumerate(actors)], timeout=60)
+            for got in out:
+                got = np.asarray(got)
+                assert got.dtype == np.dtype(dtype), (dtype, op)
+                assert got.tobytes() == expect.tobytes(), (dtype, op)
+        # float32 sum with non-finite data: int8 declines every
+        # poisoned segment; Inf/NaN propagate exactly like np.add
+        bad = [_mk(r, size) for r in range(world)]
+        bad[0][7] = np.inf
+        bad[1][9] = np.nan
+        expect = np.add(bad[0], bad[1])
+        out = [np.asarray(o) for o in ray.get(
+            [a.allreduce.remote(bad[r], name)
+             for r, a in enumerate(actors)], timeout=60)]
+        assert np.isinf(out[0][7]) and np.isnan(out[0][9])
+        # the NaN/Inf-free remainder still reduces within bound (the
+        # bound computed over the finite values only — absmax of data
+        # containing Inf/NaN is not a number)
+        mask = np.isfinite(expect)
+        err = np.abs(out[0][mask] - expect[mask]).max()
+        finite_bound = world * Q["int8"] * sum(
+            float(np.abs(x[np.isfinite(x)]).max()) for x in bad)
+        assert err <= finite_bound + 1e-6
+    finally:
+        _teardown(ray, actors, name)
+
+
+def test_off_is_bit_identical_including_nan(ray_start_regular):
+    """RAY_TPU_COLLECTIVE_WIRE_DTYPE=off (the default) is byte-for-byte
+    the pre-quantization pipeline: pipelined-off results equal the
+    legacy kill-switch ring bit-for-bit, NaN payload corners included,
+    and `off` equals the knob being UNSET."""
+    ray = ray_start_regular
+    world, name = 3, "q_off"
+    actors = _make_world(ray, world, name)
+    try:
+        rng = np.random.RandomState(11)
+        ins = [rng.standard_normal(517).astype(np.float32)
+               for _ in range(world)]
+        for r in range(world):
+            ins[r][r * 7] = np.nan    # NaN corners, distinct per rank
+        results = {}
+        for mode, env in (
+                ("unset", {"RAY_TPU_COLLECTIVE_WIRE_DTYPE": "",
+                           "RAY_TPU_COLLECTIVE_PIPELINE": "1"}),
+                ("off", {"RAY_TPU_COLLECTIVE_WIRE_DTYPE": "off",
+                         "RAY_TPU_COLLECTIVE_PIPELINE": "1"}),
+                ("legacy", {"RAY_TPU_COLLECTIVE_WIRE_DTYPE": "off",
+                            "RAY_TPU_COLLECTIVE_PIPELINE": "0"})):
+            ray.get([a.configure.remote(env) for a in actors])
+            ar = ray.get([a.allreduce.remote(ins[r], name)
+                          for r, a in enumerate(actors)], timeout=60)
+            rs = ray.get([a.reducescatter.remote(ins[r], name)
+                          for r, a in enumerate(actors)], timeout=60)
+            results[mode] = ([np.asarray(x).tobytes() for x in ar],
+                             [np.asarray(x).tobytes() for x in rs])
+        assert results["off"] == results["unset"]
+        assert results["off"] == results["legacy"]
+    finally:
+        _teardown(ray, actors, name)
+
+
+def test_hierarchy_and_shm_compose_with_quantization(ray_start_regular):
+    """Forced intra-host hierarchy + the shm same-node transport with
+    quantization armed: the inter-host (leader) ring quantizes, local
+    hops stay exact, results land within bound and rank-identical.
+    Large segments so the >=64KB shm gate engages for the quantized
+    frames too."""
+    ray = ray_start_regular
+    world, name = 4, "q_hier"
+    actors = _make_world(
+        ray, world, name,
+        env={"RAY_TPU_COLLECTIVE_WIRE_DTYPE": "int8",
+             "RAY_TPU_COLLECTIVE_HIERARCHY": "1",
+             "RAY_TPU_COLLECTIVE_SEGMENT_BYTES": 128 * 1024,
+             "RAY_TPU_COLLECTIVE_QUANT_BLOCK": 1024})
+    try:
+        ins = [_mk(r, 100_000) for r in range(world)]
+        exact = np.zeros(100_000, np.float64)
+        for x in ins:
+            exact += x
+        out = [np.asarray(o) for o in ray.get(
+            [a.allreduce.remote(ins[r], name)
+             for r, a in enumerate(actors)], timeout=60)]
+        for o in out[1:]:
+            assert o.tobytes() == out[0].tobytes()
+        err = np.abs(out[0].astype(np.float64) - exact).max()
+        assert err <= _bound("int8", world, ins) + 1e-6
+        # flat ring over shm too (hierarchy back to auto = off on one
+        # host): same gate, forwarded quantized shm frames
+        ray.get([a.configure.remote(
+            {"RAY_TPU_COLLECTIVE_HIERARCHY": "0"}) for a in actors])
+        out2 = [np.asarray(o) for o in ray.get(
+            [a.allreduce.remote(ins[r], name)
+             for r, a in enumerate(actors)], timeout=60)]
+        for o in out2[1:]:
+            assert o.tobytes() == out2[0].tobytes()
+        err2 = np.abs(out2[0].astype(np.float64) - exact).max()
+        assert err2 <= _bound("int8", world, ins) + 1e-6
+    finally:
+        _teardown(ray, actors, name)
+
+
+def test_wire_telemetry_compression_ratio(ray_start_regular):
+    """ray_tpu_collective_wire_bytes_total records the ACTUAL wire
+    bytes by format: the int8 series for an op must be well under the
+    payload bytes (compression visible), and the quant-error histogram
+    records a sampled sub-bound ratio."""
+    ray = ray_start_regular
+    from ray_tpu.experimental.state.api import metrics_summary
+
+    world, name = 2, "q_tm"
+    actors = _make_world(ray, world, name,
+                         env={"RAY_TPU_COLLECTIVE_WIRE_DTYPE": "int8",
+                              "RAY_TPU_COLLECTIVE_QUANT_BLOCK": 256,
+                              # realistic segments: with the tiny
+                              # BASE_ENV segment size, per-segment
+                              # framing would swamp the wire bytes
+                              "RAY_TPU_COLLECTIVE_SEGMENT_BYTES":
+                                  128 * 1024})
+    try:
+        size = 200_000   # 800KB payload per rank
+        ins = [_mk(r, size) for r in range(world)]
+        ray.get([a.allreduce.remote(ins[r], name)
+                 for r, a in enumerate(actors)], timeout=60)
+        import time as _time
+
+        deadline = _time.time() + 30
+        while True:
+            snaps = {m["name"]: m for m in metrics_summary()}
+            wb = snaps.get("ray_tpu_collective_wire_bytes_total")
+            rows = [v for v in (wb or {}).get("values", ())
+                    if v["tags"].get("group") == name
+                    and v["tags"].get("format") == "int8"]
+            if rows:
+                break
+            assert _time.time() < deadline, "wire bytes metric late"
+            _time.sleep(0.5)
+        wire_bytes = sum(v["value"] for v in rows)
+        payload = size * 4 * world   # both ranks' full sends
+        # int8 + scales + framing: must sit well under half the payload
+        assert 0 < wire_bytes < payload / 2, (wire_bytes, payload)
+        err = snaps.get("ray_tpu_collective_quant_error_ratio")
+        samples = [r for r in (err or {}).get("counts", ())
+                   if r["tags"].get("format") == "int8"]
+        assert samples, "quant error histogram missing"
+    finally:
+        _teardown(ray, actors, name)
+
+
+# ----------------------------------------------------------------- chaos
+
+def test_dropped_quantized_segment_raises_timeout(ray_start_regular):
+    """Chaos parity: a deterministically dropped QUANTIZED segment
+    surfaces as the op timeout, never a hang (same failure detector as
+    the exact path)."""
+    ray = ray_start_regular
+    world, name = 2, "q_chaos_drop"
+    actors = _make_world(ray, world, name,
+                         env={"RAY_TPU_COLLECTIVE_WIRE_DTYPE": "int8",
+                              "RAY_TPU_COLLECTIVE_OP_TIMEOUT_S": "3"})
+    try:
+        ins = [_mk(r, 1000) for r in range(world)]
+        ray.get([a.allreduce.remote(ins[r], name)
+                 for r, a in enumerate(actors)], timeout=60)
+        ray.get([a.chaos.remote(0, "drop:*.col_push_frame:#2")
+                 for a in actors])
+        refs = [a.allreduce.remote(ins[r], name)
+                for r, a in enumerate(actors)]
+        with pytest.raises(Exception) as ei:
+            ray.get(refs, timeout=60)
+        assert "timed out" in str(ei.value).lower()
+        ray.get([a.chaos_off.remote() for a in actors])
+    finally:
+        _teardown(ray, actors, name)
+
+
+def test_duplicated_quantized_segment_no_double_accumulate(
+        ray_start_regular):
+    """Chaos parity: a dup-delivered quantized segment must NOT be
+    dequantize-accumulated twice — the mailbox overwrites the
+    unconsumed duplicate, so results are identical to a clean run of
+    the same inputs, repeatedly."""
+    ray = ray_start_regular
+    world, name = 2, "q_chaos_dup"
+    actors = _make_world(ray, world, name,
+                         env={"RAY_TPU_COLLECTIVE_WIRE_DTYPE": "int8"})
+    try:
+        ins = [_mk(r, 1000) for r in range(world)]
+        clean = [np.asarray(o) for o in ray.get(
+            [a.allreduce.remote(ins[r], name)
+             for r, a in enumerate(actors)], timeout=60)]
+        ray.get([a.chaos.remote(0, "dup:*.col_push_frame:p1")
+                 for a in actors])
+        for _ in range(2):
+            out = [np.asarray(o) for o in ray.get(
+                [a.allreduce.remote(ins[r], name)
+                 for r, a in enumerate(actors)], timeout=60)]
+            for got in out:
+                # bit-identical to the clean quantized run: a double
+                # accumulate would shift the sum by a whole
+                # contribution, far outside equality
+                assert got.tobytes() == clean[0].tobytes()
+        ray.get([a.chaos_off.remote() for a in actors])
+    finally:
+        _teardown(ray, actors, name)
