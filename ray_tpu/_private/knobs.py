@@ -56,6 +56,13 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _k("COLLECTIVE_SHM", "1", "bool",
        "0 keeps same-node collective segments off the shm object store "
        "(sockets only)."),
+    _k("DATA_STREAMING", "1", "bool",
+       "0 restores the legacy materialize-then-iterate dataset path "
+       "(bit-identical kill switch for the streaming data plane)."),
+    _k("DATA_SHUFFLE_COLLECTIVE", "0", "bool",
+       "1 routes random_shuffle's partition all-to-all over the "
+       "pipelined host-collective plane (actor gang exchange) instead "
+       "of object-store reduce tasks; identical rows per seed."),
     _k("COLLECTIVE_WIRE_DTYPE", "off", "str",
        "wire format for float32 sum ring segments: off = bit-exact "
        "(default), bf16 = 2x smaller wire, int8 = per-block-scaled "
@@ -79,6 +86,10 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "1 lets the raylet probe for real TPU chips at startup "
        "(subprocess jax.devices())."),
     # --- tuning ----------------------------------------------------------
+    _k("DATA_PREFETCH_BLOCKS", "4", "int",
+       "streaming data plane: blocks a consumer may have buffered or "
+       "in flight at once (the bounded-memory prefetch budget; "
+       "producers park when the buffer is full)."),
     _k("COLLECTIVE_QUANT_BLOCK", "1024", "int",
        "elements per int8 wire-quantization scale block (one float32 "
        "scale per block; sub-block tails travel exact)."),

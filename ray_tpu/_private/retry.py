@@ -70,6 +70,9 @@ RETRY_SAFE_RPCS = frozenset({
     "fetch_object", "fetch_object_chunk", "get_owned_value",
     "locate_object", "store_stats", "node_info", "ping", "task_state",
     "report_resources", "drain_node",
+    # streaming data plane: a block fetch is a pure read of an immutable
+    # sealed object (data/_internal/streaming/executor.py)
+    "data_block_fetch",
     # telemetry plane: pure reads (per-process metric/event/span rings)
     "metrics_snapshot", "events_snapshot", "profile_events",
     "trace_spans",

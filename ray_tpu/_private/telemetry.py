@@ -35,7 +35,7 @@ ENABLED = os.environ.get("RAY_TPU_INTERNAL_TELEMETRY", "1") != "0"
 # `_messages` are the "unit is the thing counted" form for gauges;
 # `_ratio` is the Prometheus-convention dimensionless 0..1 form).
 ALLOWED_SUFFIXES = ("_total", "_seconds", "_bytes", "_tasks", "_messages",
-                    "_ratio")
+                    "_ratio", "_blocks")
 
 _RPC_BOUNDARIES = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0]
 
@@ -183,6 +183,34 @@ CATALOG: dict[str, dict] = {
                        "ingest because they carried a previous group "
                        "incarnation's epoch (plus dead-epoch mailbox "
                        "entries swept at group rejoin)",
+    },
+    # --- streaming data plane (data/_internal/streaming/) ---
+    # consumer names are bounded: "default", bench harness labels, or
+    # train/<dataset>/rank<k> (one per gang member) — same cardinality
+    # class as collective group names
+    "ray_tpu_data_wait_seconds": {
+        "kind": "Histogram", "tags": ("consumer",),
+        "boundaries": [0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                       0.5, 1.0, 5.0],
+        "description": "Wall time a dataset consumer was blocked "
+                       "waiting for its next batch (fetch + slice + "
+                       "device transfer not yet overlapped) — the "
+                       "input-gates-the-step signal; per-step data "
+                       "wait / step time is the ingest health ratio",
+    },
+    "ray_tpu_data_prefetch_depth_blocks": {
+        "kind": "Gauge", "tags": ("consumer",),
+        "description": "Blocks currently buffered ahead of a streaming "
+                       "dataset consumer (bounded by "
+                       "RAY_TPU_DATA_PREFETCH_BLOCKS; pinned in the shm "
+                       "store, not heap copies)",
+    },
+    "ray_tpu_data_blocks_total": {
+        "kind": "Counter", "tags": ("consumer", "source"),
+        "description": "Blocks fed to streaming dataset consumers by "
+                       "origin (source=local|remote): locality-aware "
+                       "pull ordering should keep remote pulls a "
+                       "minority when blocks were produced on this node",
     },
     # --- pjit compile path (parallel/compile_watch.py) ---
     "ray_tpu_pjit_compile_seconds": {

@@ -12,6 +12,8 @@ Routes (reference modules in parens — dashboard/modules/*):
     /api/events             structured runtime event log (cluster events)
     /api/collectives        data-plane summary: collective ops,
                             stragglers, compile stats, device gauges
+    /api/data               streaming-data-plane summary: per-consumer
+                            data wait, prefetch depth, block locality
     /api/serve              serving-plane summary: app/replica status,
                             request/shed/failover counters, batch stats
     /api/reporter           per-node physical stats (reporter_agent)
@@ -98,6 +100,8 @@ class DashboardServer:
                 payload = state.list_cluster_events(address=self.address)
             elif path == "/api/collectives":
                 payload = state.summarize_collectives(address=self.address)
+            elif path == "/api/data":
+                payload = state.summarize_data(address=self.address)
             elif path == "/api/reporter":
                 payload = self._reporter()
             elif path == "/api/grafana_dashboard":

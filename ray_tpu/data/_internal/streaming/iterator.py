@@ -1,0 +1,251 @@
+"""Batch assembly over a block stream + the device-put double buffer.
+
+One batching loop serves every path — legacy (``RAY_TPU_DATA_STREAMING=0``)
+and streaming, Dataset and DatasetPipeline — so streaming output is
+bit-identical to the legacy path by construction, and a pipeline carries
+its batch remainder across window boundaries (only the final batch may be
+short, honoring ``drop_last``).
+
+With ``device_put=True`` the streaming path double-buffers: a producer
+thread assembles batch k+1 (block fetch is already overlapped by the
+executor) and dispatches its ``jax.device_put`` while the caller consumes
+batch k, so the host→HBM transfer rides under the train step.
+
+Every yielded batch stamps ``ray_tpu_data_wait_seconds{consumer}`` — the
+wall time the consumer was blocked waiting for that batch, the "input
+gates the step" signal the ROADMAP's <5% data-wait acceptance is measured
+by. Off under ``RAY_TPU_INTERNAL_TELEMETRY=0``.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+
+from ray_tpu._private import telemetry as _tm
+from ray_tpu.data import block as B
+from ray_tpu.data._internal.streaming.executor import (
+    StreamingExecutor,
+    streaming_enabled,
+)
+
+
+def iter_batch_blocks(blocks, batch_size: int, drop_last: bool):
+    """Slice a block stream into batch-sized blocks: numpy views + one
+    concat per batch, zero per-row Python for columnar blocks (the exact
+    assembly the legacy ``iter_batches`` loop used — kept verbatim so
+    both paths produce identical bytes)."""
+    pending: list = []       # partial blocks carried across block refs
+    pending_n = 0
+    for blk in blocks:
+        pending.append(blk)
+        pending_n += B.num_rows(blk)
+        while pending_n >= batch_size:
+            take, taken = [], 0
+            while taken < batch_size:
+                head = pending[0]
+                hn = B.num_rows(head)
+                need = batch_size - taken
+                if hn <= need:
+                    take.append(head)
+                    taken += hn
+                    pending.pop(0)
+                else:
+                    take.append(B.slice_block(head, 0, need))
+                    pending[0] = B.slice_block(head, need, hn)
+                    taken += need
+            pending_n -= batch_size
+            yield (B.concat_blocks(take) if len(take) > 1 else take[0])
+    if pending_n and not drop_last:
+        yield B.concat_blocks(pending)
+
+
+def make_to_batch(batch_format: str, device_put: bool):
+    def to_batch(blk):
+        if batch_format == "numpy":
+            batch = B.to_numpy_batch(blk)
+        else:
+            batch = B.to_rows(blk)
+        if device_put:
+            import jax
+
+            batch = jax.device_put(batch)
+        return batch
+
+    return to_batch
+
+
+def stamp_wait(gen, consumer: str):
+    """Wrap a batch generator, observing the consumer-blocked time per
+    batch (production time of each __next__)."""
+    while True:
+        t0 = time.perf_counter()
+        try:
+            batch = next(gen)
+        except StopIteration:
+            return
+        _tm.observe("ray_tpu_data_wait_seconds",
+                    time.perf_counter() - t0, tags={"consumer": consumer})
+        yield batch
+
+
+def _double_buffered(batch_blocks, to_batch):
+    """Producer thread converts (slice + device_put dispatch) batch k+1
+    while the caller consumes batch k. Queue depth 2 = one batch in the
+    caller's hands, one converted and waiting, one being converted."""
+    q: _queue.Queue = _queue.Queue(maxsize=2)
+    stop = threading.Event()
+
+    def produce():
+        try:
+            for bb in batch_blocks:
+                item = ("ok", to_batch(bb))
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except _queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            while not stop.is_set():
+                try:
+                    q.put(("end", None), timeout=0.2)
+                    return
+                except _queue.Full:
+                    continue
+        except BaseException as e:  # noqa: BLE001 — re-raised by consumer
+            while not stop.is_set():
+                try:
+                    q.put(("err", e), timeout=0.2)
+                    return
+                except _queue.Full:
+                    continue
+
+    t = threading.Thread(target=produce, daemon=True,
+                         name="data-stream-device-put")
+    t.start()
+    try:
+        while True:
+            try:
+                kind, payload = q.get(timeout=1.0)
+            except _queue.Empty:
+                if not t.is_alive():
+                    return   # producer died without a sentinel
+                continue
+            if kind == "end":
+                return
+            if kind == "err":
+                raise payload
+            yield payload
+    finally:
+        # The producer OWNS batch_blocks (closing a generator that is
+        # executing in another thread raises); stop just flips the flag —
+        # the producer exits at its next put, and the caller's executor
+        # close unblocks a producer parked inside a block wait.
+        stop.set()
+
+
+def _one_batch_lookahead(batch_blocks, to_batch):
+    """The legacy device-feed overlap: convert (and dispatch the device
+    transfer of) batch k+1 before yielding batch k. Order and content
+    are unchanged — only the conversion timing moves."""
+    prev = None
+    for bb in batch_blocks:
+        batch = to_batch(bb)
+        if prev is not None:
+            yield prev
+        prev = batch
+    if prev is not None:
+        yield prev
+
+
+def stream_items(ds):
+    """(stages, ref) sources for one Dataset, drawn lazily so the
+    executor submits map-stage tasks on demand. ActorPoolStrategy
+    datasets keep their eager pool materialization (the pool is sized
+    from the block count up front) and stream the resulting refs."""
+    from ray_tpu.data.dataset import _ActorPoolStrategy
+
+    compute = getattr(ds, "_compute", None)
+    if ds._stages and isinstance(compute, _ActorPoolStrategy):
+        for ref in ds._materialized_refs():
+            yield (None, ref)
+        return
+    stages = ds._stages
+    for ref in ds._block_refs:
+        yield (stages, ref)
+
+
+def _make_submit():
+    from ray_tpu.data.dataset import _get_chain_task
+
+    def submit(item):
+        stages, ref = item
+        if stages:
+            return _get_chain_task().remote(stages, ref)
+        return ref
+
+    return submit
+
+
+def dataset_iter_batches(ds, *, batch_size: int, batch_format: str,
+                         device_put: bool, drop_last: bool):
+    """The streaming implementation behind ``Dataset.iter_batches``."""
+    consumer = getattr(ds, "_consumer", None) or "default"
+    to_batch = make_to_batch(batch_format, device_put)
+    ex = StreamingExecutor(stream_items(ds), _make_submit(),
+                           consumer=consumer)
+    batch_blocks = iter_batch_blocks(ex.iter_blocks(), batch_size,
+                                     drop_last)
+    if device_put:
+        gen = _double_buffered(batch_blocks, to_batch)
+    else:
+        gen = (to_batch(bb) for bb in batch_blocks)
+    try:
+        yield from stamp_wait(gen, consumer)
+    finally:
+        ex.close()
+
+
+def pipeline_iter_batches(pipe, *, batch_size: int, batch_format: str,
+                          device_put: bool, drop_last: bool):
+    """``DatasetPipeline.iter_batches``: one batch stream over ALL
+    windows, carrying the remainder across window boundaries. Streaming
+    mode runs one executor over the concatenated window sources (window
+    i+1's tasks submit while window i is consumed, bounded by the same
+    budget); the kill-switch path fetches window blocks with the legacy
+    one-window lookahead — both feed the same batcher, so their batches
+    are identical."""
+    consumer = getattr(pipe, "_consumer", None) or "default"
+    to_batch = make_to_batch(batch_format, device_put)
+    ex = None
+    if streaming_enabled():
+        def items():
+            for w in pipe._window_iter():
+                yield from stream_items(w)
+
+        ex = StreamingExecutor(items(), _make_submit(), consumer=consumer)
+        blocks = ex.iter_blocks()
+    else:
+        def legacy_blocks():
+            import ray_tpu
+
+            for ds in pipe.iter_datasets():
+                for ref in ds._materialized_refs():
+                    yield ray_tpu.get(ref)
+
+        blocks = legacy_blocks()
+    batch_blocks = iter_batch_blocks(blocks, batch_size, drop_last)
+    if device_put and ex is not None:
+        gen = _double_buffered(batch_blocks, to_batch)
+    elif device_put:
+        # kill-switch path keeps the legacy one-batch device lookahead
+        gen = _one_batch_lookahead(batch_blocks, to_batch)
+    else:
+        gen = (to_batch(bb) for bb in batch_blocks)
+    try:
+        yield from stamp_wait(gen, consumer)
+    finally:
+        if ex is not None:
+            ex.close()

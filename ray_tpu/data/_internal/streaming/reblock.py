@@ -1,0 +1,181 @@
+"""Task-side re-blocking: repartition / zip / uneven split without
+driver materialization.
+
+The legacy implementations pulled every row onto the driver with
+``take_all()`` and re-created blocks with ``from_items`` — O(dataset) on
+the driver for ops whose output lives in the object store anyway. Here
+the driver only sees per-block ROW COUNTS (a handful of ints); slicing
+and concatenation run as remote tasks over block refs, and the output
+blocks never leave the store (reference: ray.data's split_at_indices /
+zip over block lists, _internal/split.py).
+
+Row-range math mirrors ``from_items`` exactly (chunk = ceil(total/n),
+contiguous ranges, possibly-empty tails), and ``_slice_concat`` re-
+columnarizes row-list merges, so output content matches the legacy
+driver path row for row.
+"""
+from __future__ import annotations
+
+import builtins
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+_count_task = None
+_slice_concat_task = None
+_zip_task = None
+
+
+def _exec_chain(stages, blk):
+    for fn in stages:
+        blk = fn(blk)
+    return blk
+
+
+def _count_block(stages, blk) -> int:
+    return B.num_rows(_exec_chain(stages, blk))
+
+
+def _get_count_task():
+    global _count_task
+    if _count_task is None:
+        _count_task = ray_tpu.remote(_count_block)
+    return _count_task
+
+
+def _slice_concat(pieces, *blocks):
+    """pieces: [(block_pos, lo, hi), ...] row ranges over the positional
+    block args; returns one merged block. Row-list merges are re-
+    columnarized for representation parity with the legacy
+    ``columnarize(rows)`` path."""
+    parts = [B.slice_block(blocks[p], lo, hi) for (p, lo, hi) in pieces]
+    if not parts:
+        return []
+    merged = B.concat_blocks(parts) if len(parts) > 1 else parts[0]
+    if not B.is_columnar(merged) and isinstance(merged, list):
+        merged = B.columnarize(merged)
+    return merged
+
+
+def _get_slice_concat_task():
+    global _slice_concat_task
+    if _slice_concat_task is None:
+        _slice_concat_task = ray_tpu.remote(_slice_concat)
+    return _slice_concat_task
+
+
+def _zip_slices(a_pieces, b_pieces, n_a, *blocks):
+    """Zip row ranges of two datasets' blocks into one list block of
+    (row_a, row_b) tuples — the exact row shape the legacy
+    ``list(zip(take_all, take_all))`` path produced. The first ``n_a``
+    positional blocks belong to the left dataset."""
+    rows_a = [row
+              for (p, lo, hi) in a_pieces
+              for row in B.to_rows(B.slice_block(blocks[p], lo, hi))]
+    rows_b = [row
+              for (p, lo, hi) in b_pieces
+              for row in B.to_rows(B.slice_block(blocks[n_a + p], lo, hi))]
+    return B.columnarize(list(zip(rows_a, rows_b)))
+
+
+def _get_zip_task():
+    global _zip_task
+    if _zip_task is None:
+        _zip_task = ray_tpu.remote(_zip_slices)
+    return _zip_task
+
+
+def block_counts(refs) -> list[int]:
+    """Row count per block via remote tasks (ints to the driver, never
+    rows)."""
+    task = _get_count_task()
+    return ray_tpu.get([task.remote([], r) for r in refs])
+
+
+def _ranges_for(start: int, stop: int, offsets: list[int]):
+    """Map a global row range onto per-block (block_idx, lo, hi) pieces.
+    ``offsets`` are the blocks' global start offsets plus a final total."""
+    pieces = []
+    for i in builtins.range(len(offsets) - 1):
+        b_lo, b_hi = offsets[i], offsets[i + 1]
+        lo, hi = max(start, b_lo), min(stop, b_hi)
+        if lo < hi:
+            pieces.append((i, lo - b_lo, hi - b_lo))
+    return pieces
+
+
+def _offsets(counts: list[int]) -> list[int]:
+    out = [0]
+    for c in counts:
+        out.append(out[-1] + c)
+    return out
+
+
+def repartition_refs(refs, num_blocks: int) -> list:
+    """Re-block ``refs`` into ``num_blocks`` output block refs with the
+    ``from_items`` chunking (contiguous, chunk = ceil(total/n))."""
+    counts = block_counts(refs)
+    total = sum(counts)
+    offsets = _offsets(counts)
+    n = max(1, min(num_blocks, total or 1))
+    chunk = (total + n - 1) // n if total else 0
+    task = _get_slice_concat_task()
+    out = []
+    for j in builtins.range(n):
+        start, stop = j * chunk, min((j + 1) * chunk, total)
+        pieces = _ranges_for(start, stop, offsets)
+        needed = sorted({p for (p, _, _) in pieces})
+        remap = {p: k for k, p in enumerate(needed)}
+        local = [(remap[p], lo, hi) for (p, lo, hi) in pieces]
+        out.append(task.remote(local, *[refs[p] for p in needed]))
+    return out
+
+
+def zip_refs(a_refs, b_refs, num_blocks: int) -> list:
+    """Pair rows of two materialized datasets (truncating to the
+    shorter), producing ``num_blocks``-chunked list blocks of tuples —
+    task-side, matching the legacy driver zip row for row."""
+    a_counts, b_counts = block_counts(a_refs), block_counts(b_refs)
+    total = min(sum(a_counts), sum(b_counts))
+    a_off, b_off = _offsets(a_counts), _offsets(b_counts)
+    n = max(1, min(num_blocks, total or 1))
+    chunk = (total + n - 1) // n if total else 0
+    task = _get_zip_task()
+    out = []
+    for j in builtins.range(n):
+        start, stop = j * chunk, min((j + 1) * chunk, total)
+        a_pieces = _ranges_for(start, stop, a_off)
+        b_pieces = _ranges_for(start, stop, b_off)
+        a_need = sorted({p for (p, _, _) in a_pieces})
+        b_need = sorted({p for (p, _, _) in b_pieces})
+        a_map = {p: k for k, p in enumerate(a_need)}
+        b_map = {p: k for k, p in enumerate(b_need)}
+        out.append(task.remote(
+            [(a_map[p], lo, hi) for (p, lo, hi) in a_pieces],
+            [(b_map[p], lo, hi) for (p, lo, hi) in b_pieces],
+            len(a_need),
+            *[a_refs[p] for p in a_need],
+            *[b_refs[p] for p in b_need]))
+    return out
+
+
+def split_refs_uneven(refs, n: int) -> list[list]:
+    """Uneven split: one single-block shard per split, with the legacy
+    row chunking (chunk = ceil(total/n); trailing shards may be empty)."""
+    counts = block_counts(refs)
+    total = sum(counts)
+    offsets = _offsets(counts)
+    chunk = (total + n - 1) // n if total else 0
+    task = _get_slice_concat_task()
+    shards = []
+    for j in builtins.range(n):
+        start, stop = j * chunk, min((j + 1) * chunk, total)
+        if total == 0 or start >= stop:
+            shards.append([ray_tpu.put([])])
+            continue
+        pieces = _ranges_for(start, stop, offsets)
+        needed = sorted({p for (p, _, _) in pieces})
+        remap = {p: k for k, p in enumerate(needed)}
+        local = [(remap[p], lo, hi) for (p, lo, hi) in pieces]
+        shards.append([task.remote(local, *[refs[p] for p in needed])])
+    return shards

@@ -1,0 +1,120 @@
+"""Collective-exchange shuffle: the block-partition all-to-all riding
+the host collective plane.
+
+The default ``random_shuffle`` exchanges its n*n partitions as object-
+store refs (n map tasks emit n partitions each, n reduce tasks each pull
+one partition per block). With ``RAY_TPU_DATA_SHUFFLE_COLLECTIVE=1`` the
+exchange instead runs on a gang of n actors joined into a host
+collective group: each actor partitions its block and sends partition j
+straight to actor j over the PR 4 pipelined one-way segment path (same-
+node peers ride the ``put_ephemeral`` shm frames, cross-node peers the
+segmented zero-copy socket frames) — no per-partition object-store
+round trip, and the exchange shows up in the collective telemetry
+plane like any other op.
+
+Partition/permutation math is IDENTICAL to the task-based path (same
+per-block seed derivation, same merge order, same final permutation),
+so both paths produce the same rows for the same seed — which is also
+the test oracle. Any failure falls back to the task-based shuffle.
+"""
+from __future__ import annotations
+
+import builtins
+import os
+import pickle
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+
+def shuffle_collective_enabled() -> bool:
+    return os.environ.get("RAY_TPU_DATA_SHUFFLE_COLLECTIVE", "0") == "1"
+
+
+class _ExchangeWorker:
+    """Actor body: one rank of the shuffle exchange gang."""
+
+    def setup(self, world: int, rank: int, group: str) -> int:
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world, rank, "host", group)
+        return rank
+
+    def exchange(self, group: str, world: int, rank: int,
+                 seed_base: int, stages, block):
+        from ray_tpu.util import collective as col
+
+        for fn in stages:
+            block = fn(block)
+        rows_n = B.num_rows(block)
+        rng = np.random.default_rng(seed_base + rank)
+        perm = rng.permutation(rows_n)
+        parts = [B.take_indices(block, idx)
+                 for idx in np.array_split(perm, world)]
+        got = {rank: parts[rank]}
+        # cyclic-shift schedule: at offset k every rank sends to rank+k
+        # and receives from rank-k. Sends are one-way pushes (PR 4), so
+        # the whole round is deadlock-free without pairwise ordering.
+        for off in builtins.range(1, world):
+            dst = (rank + off) % world
+            src = (rank - off) % world
+            blob = np.frombuffer(
+                pickle.dumps(parts[dst],
+                             protocol=pickle.HIGHEST_PROTOCOL),
+                dtype=np.uint8)
+            col.send(blob, dst, group)
+            got[src] = pickle.loads(
+                np.asarray(col.recv(src, group)).tobytes())
+        # merge in BLOCK order (not arrival order) — the task-based
+        # reduce concatenates partition i of block 0..n-1 in order, and
+        # matching it keeps the two paths row-identical per seed
+        merged = B.concat_blocks([got[b] for b in builtins.range(world)])
+        rng2 = np.random.default_rng((seed_base ^ 0x5EED) + rank)
+        return B.take_indices(merged,
+                              rng2.permutation(B.num_rows(merged)))
+
+    def teardown(self, group: str):
+        from ray_tpu.util import collective as col
+
+        try:
+            col.destroy_collective_group(group)
+        except Exception:
+            pass
+        return True
+
+
+def shuffle_via_collective(ds, seed_base: int):
+    """Run the all-to-all on a collective actor gang; returns the output
+    block refs, or None when the path does not apply (world < 2)."""
+    n = ds.num_blocks
+    if n < 2:
+        return None
+    group = f"data_shuffle_{os.urandom(4).hex()}"
+    worker_cls = ray_tpu.remote(_ExchangeWorker)
+    actors = [worker_cls.remote() for _ in builtins.range(n)]
+    try:
+        ray_tpu.get([a.setup.remote(n, i, group)
+                     for i, a in enumerate(actors)], timeout=120)
+        out = [actors[i].exchange.remote(group, n, i, seed_base,
+                                         ds._stages, ref)
+               for i, ref in enumerate(ds._block_refs)]
+        # block until every exchange result is SEALED somewhere before
+        # the gang tears down (the data stays in the object store; the
+        # driver never sees rows)
+        _, not_ready = ray_tpu.wait(out, num_returns=n, timeout=300,
+                                    fetch_local=False)
+        if not_ready:
+            raise TimeoutError(
+                f"collective shuffle exchange stalled "
+                f"({len(not_ready)}/{n} ranks pending)")
+        ray_tpu.get([a.teardown.remote(group) for a in actors],
+                    timeout=30)
+        return out
+    finally:
+        for a in actors:
+            try:
+                ray_tpu.kill(a, no_restart=True)
+            except Exception:
+                pass

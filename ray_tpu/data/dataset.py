@@ -244,8 +244,13 @@ class Dataset:
         return self._with_stage(fn, compute=compute)
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        rows = self.take_all()
-        return from_items(rows, parallelism=num_blocks)
+        """Re-block via remote tasks over block refs — the driver only
+        sees per-block row counts, never rows (the old implementation
+        pulled the whole dataset through ``take_all()``)."""
+        from ray_tpu.data._internal.streaming import reblock
+
+        refs = self._materialized_refs()
+        return self._derive(reblock.repartition_refs(refs, num_blocks))
 
     def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
         """Push-based two-stage shuffle (reference:
@@ -253,9 +258,26 @@ class Dataset:
         N random partitions; reduce tasks concatenate partition i of every
         block. All intermediate partitions live in the object store.
         Columnar blocks partition with one numpy permutation + array
-        indexing per block — no per-row Python."""
+        indexing per block — no per-row Python.
+
+        With ``RAY_TPU_DATA_SHUFFLE_COLLECTIVE=1`` the partition
+        exchange instead rides the pipelined host-collective plane (an
+        actor gang doing the all-to-all over one-way segment frames);
+        identical rows per seed, falls back here on any failure."""
+        if not self._block_refs:
+            return self   # zero-block dataset: nothing to permute
         n = max(1, self.num_blocks)
         seed_base = seed if seed is not None else _random.randrange(2**31)
+
+        from ray_tpu.data._internal.streaming import shuffle as _shuf
+
+        if _shuf.shuffle_collective_enabled() and n >= 2:
+            try:
+                refs = _shuf.shuffle_via_collective(self, seed_base)
+                if refs is not None:
+                    return self._derive(refs)
+            except Exception:
+                pass   # gang/exchange failure: task-based path below
 
         @ray_tpu.remote(num_returns=n)
         def shuffle_map(stages, block, block_idx):
@@ -335,22 +357,29 @@ class Dataset:
                             extra_pins=other._keep_alive)
 
     def zip(self, other: "Dataset") -> "Dataset":
-        mine, theirs = self.take_all(), other.take_all()
-        return from_items(list(zip(mine, theirs)),
-                          parallelism=self.num_blocks)
+        """Pair rows of two datasets (truncating to the shorter) via
+        remote zip tasks over both sides' block refs — rows never land
+        on the driver."""
+        from ray_tpu.data._internal.streaming import reblock
+
+        refs = reblock.zip_refs(self._materialized_refs(),
+                                other._materialized_refs(),
+                                self.num_blocks)
+        return self._derive(refs, extra_pins=other._keep_alive)
 
     def split(self, n: int, *, equal: bool = True) -> list["Dataset"]:
         """Shard for per-worker consumption (reference: dataset.py split;
-        used by Train's dataset_spec)."""
+        used by Train's dataset_spec). The uneven case re-blocks with
+        remote slice/concat tasks instead of driver ``take_all()``."""
+        from ray_tpu.data._internal.streaming import reblock
+
         refs = self._materialized_refs()
         if len(refs) >= n and len(refs) % n == 0:
             per = len(refs) // n
             return [self._derive(refs[i * per:(i + 1) * per])
                     for i in builtins.range(n)]
-        rows = self.take_all()
-        chunk = (len(rows) + n - 1) // n
-        return [from_items(rows[i * chunk:(i + 1) * chunk] or [],
-                           parallelism=1) for i in builtins.range(n)]
+        return [self._derive(shard)
+                for shard in reblock.split_refs_uneven(refs, n)]
 
     def groupby(self, key) -> "GroupedDataset":
         return GroupedDataset(self, key)
@@ -418,9 +447,38 @@ class Dataset:
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy",
                      device_put: bool = False, drop_last: bool = False):
-        """Batched iteration with one-batch lookahead; with device_put the
-        next batch is already on its way to the device while the caller
-        consumes the current one (the TPU host→HBM feed pipeline)."""
+        """Batched streaming iteration (data/_internal/streaming/): map
+        tasks run on demand under a bounded prefetch budget
+        (``RAY_TPU_DATA_PREFETCH_BLOCKS``), blocks stage zero-copy in the
+        shm store with per-consumer backpressure, and with device_put a
+        double-buffer thread overlaps fetch + slice + ``jax.device_put``
+        of batch k+1 with the caller consuming batch k (the TPU host→HBM
+        feed pipeline). ``RAY_TPU_DATA_STREAMING=0`` restores the legacy
+        materialize-then-iterate path bit-for-bit. Per-batch consumer
+        wait lands in ``ray_tpu_data_wait_seconds{consumer}``."""
+        from ray_tpu.data._internal.streaming import (
+            executor as _sx,
+            iterator as _si,
+        )
+
+        if _sx.streaming_enabled():
+            yield from _si.dataset_iter_batches(
+                self, batch_size=batch_size, batch_format=batch_format,
+                device_put=device_put, drop_last=drop_last)
+            return
+        yield from _si.stamp_wait(
+            self._iter_batches_legacy(batch_size=batch_size,
+                                      batch_format=batch_format,
+                                      device_put=device_put,
+                                      drop_last=drop_last),
+            getattr(self, "_consumer", None) or "default")
+
+    def _iter_batches_legacy(self, *, batch_size, batch_format,
+                             device_put, drop_last):
+        """The pre-streaming path (``RAY_TPU_DATA_STREAMING=0``): one
+        blocking get per block with one-batch lookahead; with device_put
+        the next batch is already on its way to the device while the
+        caller consumes the current one."""
         def to_batch(blk):
             if batch_format == "numpy":
                 batch = B.to_numpy_batch(blk)

@@ -469,6 +469,27 @@ def memory_summary(*, address: str | None = None) -> str:
     return "\n".join(lines)
 
 
+def _fold_sums(snaps: dict, name: str) -> dict:
+    """{sorted-tag-items: value} for one metric family out of a
+    ``metrics_summary`` snapshot dict (Counter/Gauge values, Histogram
+    observation sums) — the shared fold under every summarize_*."""
+    fam = snaps.get(name)
+    if not fam:
+        return {}
+    return {tuple(sorted(v["tags"].items())): v["value"]
+            for v in fam.get("values", [])}
+
+
+def _fold_counts(snaps: dict, name: str) -> dict:
+    """{sorted-tag-items: total observation count} for one Histogram
+    family out of a ``metrics_summary`` snapshot dict."""
+    fam = snaps.get(name)
+    if not fam:
+        return {}
+    return {tuple(sorted(row["tags"].items())): sum(row["counts"])
+            for row in fam.get("counts", [])}
+
+
 def summarize_collectives(*, address: str | None = None) -> dict:
     """Data-plane rollup (reference tier: `ray summary` — but over the
     collective/compile/device telemetry this framework's PR 3 adds).
@@ -487,18 +508,10 @@ def summarize_collectives(*, address: str | None = None) -> dict:
     snaps = {m["name"]: m for m in metrics_summary(address=address)}
 
     def _sums(name):
-        fam = snaps.get(name)
-        if not fam:
-            return {}
-        return {tuple(sorted(v["tags"].items())): v["value"]
-                for v in fam.get("values", [])}
+        return _fold_sums(snaps, name)
 
     def _counts(name):
-        fam = snaps.get(name)
-        if not fam:
-            return {}
-        return {tuple(sorted(row["tags"].items())): sum(row["counts"])
-                for row in fam.get("counts", [])}
+        return _fold_counts(snaps, name)
 
     ops: dict[tuple, dict] = {}
     lat_sums = _sums("ray_tpu_collective_latency_seconds")
@@ -563,6 +576,50 @@ def summarize_collectives(*, address: str | None = None) -> dict:
     }
 
 
+def summarize_data(*, address: str | None = None) -> dict:
+    """Streaming-data-plane rollup (folded from the metric catalog like
+    ``summarize_collectives``): one row per dataset consumer with its
+    batch count, total/mean data-wait, the live prefetch-buffer depth,
+    and block counts by origin (local vs remote pulls). The headline
+    ingest-health signal is ``mean_wait_s`` against the consumer's step
+    time — the ROADMAP's "data wait per step < 5%" acceptance."""
+    snaps = {m["name"]: m for m in metrics_summary(address=address)}
+
+    def _sums(name):
+        return _fold_sums(snaps, name)
+
+    def _counts(name):
+        return _fold_counts(snaps, name)
+
+    consumers: dict[str, dict] = {}
+
+    def _row(consumer):
+        return consumers.setdefault(consumer, {
+            "consumer": consumer, "batches": 0, "wait_total_s": 0.0,
+            "mean_wait_s": 0.0, "prefetch_depth": 0.0,
+            "blocks_local": 0, "blocks_remote": 0})
+
+    wait_sums = _sums("ray_tpu_data_wait_seconds")
+    for key, count in _counts("ray_tpu_data_wait_seconds").items():
+        row = _row(dict(key).get("consumer") or "?")
+        total = wait_sums.get(key, 0.0)
+        row["batches"] = int(count)
+        row["wait_total_s"] = total
+        row["mean_wait_s"] = (total / count) if count else 0.0
+    for key, value in _sums("ray_tpu_data_prefetch_depth_blocks").items():
+        _row(dict(key).get("consumer") or "?")["prefetch_depth"] = value
+    for key, value in _sums("ray_tpu_data_blocks_total").items():
+        tags = dict(key)
+        row = _row(tags.get("consumer") or "?")
+        if tags.get("source") == "local":
+            row["blocks_local"] = int(value)
+        elif tags.get("source") == "remote":
+            row["blocks_remote"] = int(value)
+
+    return {"consumers": sorted(consumers.values(),
+                                key=lambda r: r["consumer"])}
+
+
 def summarize_serve(*, address: str | None = None) -> dict:
     """Serving-plane rollup (reference tier: `serve status` + the serve
     dashboard page — but folded from this framework's metric catalog and
@@ -597,18 +654,10 @@ def summarize_serve(*, address: str | None = None) -> dict:
     snaps = {m["name"]: m for m in metrics_summary(address=address)}
 
     def _sums(name):
-        fam = snaps.get(name)
-        if not fam:
-            return {}
-        return {tuple(sorted(v["tags"].items())): v["value"]
-                for v in fam.get("values", [])}
+        return _fold_sums(snaps, name)
 
     def _counts(name):
-        fam = snaps.get(name)
-        if not fam:
-            return {}
-        return {tuple(sorted(row["tags"].items())): sum(row["counts"])
-                for row in fam.get("counts", [])}
+        return _fold_counts(snaps, name)
 
     requests: dict[str, dict] = {}
 

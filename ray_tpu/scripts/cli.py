@@ -310,6 +310,17 @@ def cmd_collectives(args):
     return 0
 
 
+def cmd_data(args):
+    """Streaming-data-plane summary — the CLI face of
+    `experimental.state.api.summarize_data`: per-consumer batch counts,
+    data-wait totals, prefetch depth, and local/remote block counts."""
+    from ray_tpu.experimental.state.api import summarize_data
+
+    print(json.dumps(summarize_data(address=args.address),
+                     indent=2, default=str))
+    return 0
+
+
 def cmd_lint(args):
     """raylint: the repo-wide invariant lint (ray_tpu/_private/analysis/)
     — lock discipline, knob registry, wire-format consistency, metric +
@@ -485,6 +496,13 @@ def main(argv=None):
                              "stats, device HBM gauges")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_collectives)
+
+    sp = sub.add_parser("data",
+                        help="streaming data-plane summary "
+                             "(per-consumer data wait / prefetch / "
+                             "block locality)")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_data)
 
     sp = sub.add_parser("lint",
                         help="repo-wide invariant lint: lock "

@@ -73,6 +73,15 @@ class TrainWorker:
         return col.destroy_collective_group(group_name)
 
     def set_dataset_shard(self, name, shard):
+        # Tag the shard with a per-rank consumer label so the streaming
+        # data plane's telemetry (`ray_tpu_data_wait_seconds{consumer}`)
+        # attributes data wait to the gang member it stalls — the
+        # per-step "input gates the train step" signal.
+        if hasattr(shard, "iter_batches"):
+            try:
+                shard._consumer = f"train/{name}/rank{self.world_rank}"
+            except Exception:
+                pass   # exotic shard types (plain lists) have no attrs
         self.session.dataset_shards[name] = shard
 
     def start_training(self, train_fn, config):
