@@ -309,7 +309,9 @@ def timeline(filename=None):
     if getattr(worker, "mode", None) == "client":
         trace = worker._rpc.call("client_timeline")
     else:
-        events = profiling.snapshot()         # this process (driver)
+        # drop markers ride along (ph "M" metadata rows): a ring that
+        # evicted spans must say so in the merged timeline
+        events = profiling.snapshot(with_drop_marker=True)  # driver
         events.extend(_each_raylet(worker.gcs.call, "profile_events"))
         trace = profiling.to_chrome_trace(events)
     if filename:

@@ -111,6 +111,13 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "collective_straggler_multiple": 3.0,   # lag > multiple * median lag
     "collective_straggler_min_lag_s": 0.05,  # floor: ignore µs jitter in
                                              # tight groups (median ~ 0)
+    # --- step anatomy (parallel/step_anatomy.py) ---
+    # Rolling-baseline step-time regression detector: compare p50 of the
+    # last `window` steps against p50 of the window before it; fire a
+    # STEP_REGRESSION event + counter when recent > multiple * baseline.
+    # window=0 disables the detector (anatomy recording stays on).
+    "step_regression_multiple": 2.0,
+    "step_regression_window": 20,
     # --- device telemetry (_private/tpu_probe.py) ---
     "device_gauge_poll_s": 0.0,        # 0 = one probe at raylet start
                                        # (before workers own the chips);

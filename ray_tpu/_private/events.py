@@ -57,6 +57,13 @@ Event kinds recorded by the runtime:
 - ``REQUEST_SHED``   — Serve admission control rejected a request
                      (serve/_private/router.py): deployment, queue
                      occupancy/capacity, the retry-after hint.
+- ``STEP_REGRESSION`` — the step-anatomy rolling-baseline detector
+                     fired (parallel/step_anatomy.py): rank, step_id,
+                     recent/baseline p50 step time, the knobbed
+                     multiple.
+- ``FLIGHT_RECORDER_DUMP`` — a black-box dump directory was written
+                     (_private/flight_recorder.py): trigger reason,
+                     dump path, number of processes captured.
 
 Design constraints match the metrics plane: recording is one lock +
 deque append (no allocation beyond the event dict), the ring is bounded

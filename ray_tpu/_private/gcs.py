@@ -994,6 +994,14 @@ class GcsServer:
 
         return registry_snapshot()
 
+    def rpc_blackbox_snapshot(self, conn):
+        """The GCS process's flight-recorder window (its event ring is
+        where node/actor lifecycle lands) for a cluster black-box dump."""
+        from ray_tpu._private import flight_recorder
+
+        snap = flight_recorder.local_snapshot()
+        return [snap] if snap else []
+
     def rpc_debug_state(self, conn):
         with self._lock:
             return {

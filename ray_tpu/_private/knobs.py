@@ -98,6 +98,13 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "probe at raylet start."),
     _k("EVENT_LOG_SIZE", "4096", "int",
        "bounded structured-event ring size per process (drop-oldest)."),
+    _k("FLIGHT_RECORDER_WINDOW_S", "120", "float",
+       "flight recorder: how far back the per-process black box reaches "
+       "when a dump is cut (spans/events older than this are dropped "
+       "from the dump)."),
+    _k("FLIGHT_RECORDER_DIR", "", "path",
+       "flight recorder: directory dump folders are written under "
+       "(default <tmpdir>/ray_tpu/blackbox)."),
     _k("LEASE_SOFT_CAP", "0", "int",
        "max concurrent worker leases per node; 0 = auto (2x cluster "
        "CPUs)."),

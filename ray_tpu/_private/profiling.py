@@ -17,6 +17,7 @@ _MAX_EVENTS = 10_000
 
 _lock = threading.Lock()
 _events: collections.deque = collections.deque(maxlen=_MAX_EVENTS)
+_dropped = 0
 
 # pids collide across hosts: a merged multi-node timeline needs the
 # producing host on every event (tracing spans already carry `node`)
@@ -34,8 +35,15 @@ _ENABLED = os.environ.get("RAY_TPU_TIMELINE", "1") != "0"
 
 def _append_event(category, name, start_s, dur_s, extra):
     """Single definition of the chrome-event shape — the live context
-    manager and the after-the-fact recorder must never drift apart."""
+    manager and the after-the-fact recorder must never drift apart.
+    Appends into a full ring evict the oldest span, COUNTED (metric +
+    stats + a drop-marker metadata row in timeline merges) so a fused
+    window can flag itself incomplete instead of mis-attributing."""
+    global _dropped
     with _lock:
+        dropped = len(_events) == _events.maxlen
+        if dropped:
+            _dropped += 1
         _events.append({
             "cat": category,
             "name": name,
@@ -47,6 +55,13 @@ def _append_event(category, name, start_s, dur_s, extra):
             "ph": "X",
             "args": extra or {},
         })
+    if dropped:
+        try:
+            from ray_tpu._private import telemetry as _tm
+
+            _tm.counter_inc("ray_tpu_timeline_dropped_total")
+        except Exception:
+            pass
 
 
 class _SpanCM:
@@ -90,16 +105,36 @@ def record_completed_span(category: str, name: str, start_s: float,
     _append_event(category, name, start_s, dur_s, extra)
 
 
-def snapshot() -> list[dict]:
+def snapshot(with_drop_marker: bool = False) -> list[dict]:
+    """This process's events. ``with_drop_marker=True`` (the RPC /
+    timeline-merge path) appends one chrome *metadata* row (``ph: M``)
+    carrying the ring's drop count — chrome/Perfetto ignore unknown
+    metadata names, and merged timelines surface the loss instead of
+    presenting an evicted window as complete."""
     with _lock:
-        return list(_events)
+        out = list(_events)
+        dropped = _dropped
+    if with_drop_marker and dropped:
+        out.append({"ph": "M", "name": "ray_tpu_timeline_dropped",
+                    "pid": _PID, "node": _NODE, "ts": 0,
+                    "args": {"dropped": dropped}})
+    return out
+
+
+def stats() -> dict:
+    with _lock:
+        return {"buffered": len(_events), "dropped": _dropped,
+                "capacity": _events.maxlen}
 
 
 def clear():
+    global _dropped
     with _lock:
         _events.clear()
+        _dropped = 0
 
 
 def to_chrome_trace(events: list[dict]) -> list[dict]:
-    """Already chrome-shaped; kept as a seam for format evolution."""
+    """Already chrome-shaped; kept as a seam for format evolution.
+    Metadata rows (drop markers) sort first — ``ts`` 0."""
     return sorted(events, key=lambda e: e["ts"])

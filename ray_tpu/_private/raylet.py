@@ -1212,6 +1212,22 @@ class Raylet:
 
         return _events.snapshot() + self._fanout_workers("events_snapshot")
 
+    def rpc_step_records(self, conn):
+        """Step-anatomy exports from every registered worker on this
+        node (the raylet itself runs no train loop — its own export
+        would always be empty)."""
+        return self._fanout_workers("step_records")
+
+    def rpc_blackbox_snapshot(self, conn):
+        """Flight-recorder windows: the raylet process's own black box
+        (its event ring and metrics matter in a post-mortem) plus every
+        registered worker's. The dump path dedups by (node, pid)."""
+        from ray_tpu._private import flight_recorder
+
+        snap = flight_recorder.local_snapshot()
+        own = [snap] if snap else []
+        return own + self._fanout_workers("blackbox_snapshot")
+
     def rpc_ping(self, conn):
         return "pong"
 

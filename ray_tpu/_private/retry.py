@@ -75,7 +75,7 @@ RETRY_SAFE_RPCS = frozenset({
     "data_block_fetch",
     # telemetry plane: pure reads (per-process metric/event/span rings)
     "metrics_snapshot", "events_snapshot", "profile_events",
-    "trace_spans",
+    "trace_spans", "step_records", "blackbox_snapshot",
     # ray:// client protocol: the proxy DEDUPS every mutating op by the
     # session-scoped req_id the client attaches (util/client/server.py),
     # so replay across a proxy restart is safe — these were built to

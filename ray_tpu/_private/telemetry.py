@@ -301,6 +301,41 @@ CATALOG: dict[str, dict] = {
                        "minus real requests): the compute wasted to "
                        "keep the pjit cache at a handful of shapes",
     },
+    # --- step anatomy + flight recorder (parallel/step_anatomy.py,
+    # _private/flight_recorder.py) ---
+    "ray_tpu_step_seconds": {
+        "kind": "Histogram", "tags": (),
+        "boundaries": [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                       30.0, 120.0],
+        "description": "Wall time of one train-loop step on one rank "
+                       "(the interval between session.report calls, "
+                       "stamped by the step-anatomy plane)",
+    },
+    "ray_tpu_step_regressions_total": {
+        "kind": "Counter", "tags": (),
+        "description": "STEP_REGRESSION firings: rolling p50 step time "
+                       "drifted beyond step_regression_multiple x the "
+                       "prior window's p50",
+    },
+    "ray_tpu_flight_recorder_dumps_total": {
+        "kind": "Counter", "tags": ("trigger",),
+        "description": "Black-box dump directories written, by trigger "
+                       "(GANG_FAILED/collective_poison/actor_death/"
+                       "manual/...)",
+    },
+    # --- telemetry ring overflow (util/tracing.py, _private/profiling.py) ---
+    "ray_tpu_trace_dropped_total": {
+        "kind": "Counter", "tags": (),
+        "description": "Tracing spans evicted from the bounded "
+                       "per-process span ring (a non-zero rate means "
+                       "fused trace windows are incomplete)",
+    },
+    "ray_tpu_timeline_dropped_total": {
+        "kind": "Counter", "tags": (),
+        "description": "Chrome-timeline spans evicted from the bounded "
+                       "per-process profiling ring (merged timelines "
+                       "carry a drop-marker metadata row)",
+    },
     # --- per-device telemetry (_private/tpu_probe.py) ---
     # node tag is load-bearing: each host's probe subprocess numbers its
     # local devices from 0 (no jax.distributed world), so without it a

@@ -1745,12 +1745,14 @@ class CoreWorker:
     def rpc_profile_events(self, conn):
         from ray_tpu._private import profiling
 
-        return profiling.snapshot()
+        # drop marker included: a merged timeline must surface ring
+        # eviction instead of presenting the window as complete
+        return profiling.snapshot(with_drop_marker=True)
 
     def rpc_trace_spans(self, conn):
         from ray_tpu.util import tracing
 
-        return tracing.local_spans()
+        return tracing.local_spans(with_drop_marker=True)
 
     def rpc_metrics_snapshot(self, conn):
         from ray_tpu.util import metrics
@@ -1759,6 +1761,21 @@ class CoreWorker:
 
     def rpc_events_snapshot(self, conn):
         return _events.snapshot()
+
+    def rpc_step_records(self, conn):
+        """This process's step-anatomy export (steps + activities +
+        drop counts) for summarize_steps()'s cluster fan-out."""
+        from ray_tpu.parallel import step_anatomy
+
+        return [step_anatomy.local_records()]
+
+    def rpc_blackbox_snapshot(self, conn):
+        """This process's flight-recorder window (recent spans/events/
+        steps/metrics) for a cluster black-box dump."""
+        from ray_tpu._private import flight_recorder
+
+        snap = flight_recorder.local_snapshot()
+        return [snap] if snap else []
 
     # ------------------------------------------- owner-based object directory
     # Reference: ownership_based_object_directory.h:1 — the owning worker is

@@ -27,6 +27,16 @@ class _Session:
 
     def report(self, metrics: dict, checkpoint=None):
         self.iteration += 1
+        # step-anatomy boundary: the interval between reports IS the
+        # step, and the report's iteration number its monotonically
+        # increasing step_id. No-op outside an instrumented train loop
+        # (e.g. Tune function trainables reporting on the driver).
+        try:
+            from ray_tpu.parallel import step_anatomy
+
+            step_anatomy.advance(self.iteration)
+        except Exception:
+            pass
         self.results.put({"metrics": dict(metrics),
                           "checkpoint": checkpoint,
                           "iteration": self.iteration,

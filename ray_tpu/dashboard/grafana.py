@@ -62,6 +62,24 @@ _PANELS = [
      "rate(ray_tpu_collective_groups_poisoned_total[5m])", "ops"),
     ("Stale-epoch traffic rejected",
      "rate(ray_tpu_collective_stale_epoch_total[5m])", "ops"),
+    # --- step anatomy + flight recorder (PR 11: observability) ---
+    ("Train step p50",
+     "histogram_quantile(0.5, rate(ray_tpu_step_seconds_bucket[5m]))",
+     "s"),
+    ("Train step p99",
+     "histogram_quantile(0.99, rate(ray_tpu_step_seconds_bucket[5m]))",
+     "s"),
+    ("Step-time regressions",
+     "rate(ray_tpu_step_regressions_total[5m])", "ops"),
+    ("Data wait p50 (per consumer)",
+     "histogram_quantile(0.5, sum by (consumer, le) "
+     "(rate(ray_tpu_data_wait_seconds_bucket[5m])))", "s"),
+    ("Flight-recorder dumps",
+     "sum by (trigger) (rate(ray_tpu_flight_recorder_dumps_total[5m]))",
+     "ops"),
+    ("Telemetry ring drops (trace + timeline)",
+     "rate(ray_tpu_trace_dropped_total[5m]) + "
+     "rate(ray_tpu_timeline_dropped_total[5m])", "ops"),
     # --- serve plane (PR 6: inference router / batcher / autoscaler) ---
     ("Serve QPS",
      "sum by (deployment) (rate(ray_tpu_serve_requests_total[1m]))",

@@ -14,6 +14,8 @@ Routes (reference modules in parens — dashboard/modules/*):
                             stragglers, compile stats, device gauges
     /api/data               streaming-data-plane summary: per-consumer
                             data wait, prefetch depth, block locality
+    /api/steps              step-anatomy summary: per-step/per-rank
+                            breakdown, overlap fraction, critical path
     /api/serve              serving-plane summary: app/replica status,
                             request/shed/failover counters, batch stats
     /api/reporter           per-node physical stats (reporter_agent)
@@ -102,6 +104,8 @@ class DashboardServer:
                 payload = state.summarize_collectives(address=self.address)
             elif path == "/api/data":
                 payload = state.summarize_data(address=self.address)
+            elif path == "/api/steps":
+                payload = state.summarize_steps(address=self.address)
             elif path == "/api/reporter":
                 payload = self._reporter()
             elif path == "/api/grafana_dashboard":

@@ -70,6 +70,7 @@ const TABS = [
   {id:"objects", label:"Objects", api:"/api/objects"},
   {id:"jobs", label:"Jobs", api:"/api/jobs"},
   {id:"events", label:"Events", api:"/api/events"},
+  {id:"steps", label:"Steps", api:"/api/steps"},
   {id:"serve", label:"Serve", api:"/api/serve"},
 ];
 let current = location.hash.slice(1) || "overview";

@@ -24,6 +24,9 @@ import time
 
 from ray_tpu._private import telemetry as _tm
 from ray_tpu.data import block as B
+# jax-free module (parallel/__init__ is empty): the step-anatomy stamps
+# below cost one tuple read per batch when no train step is active
+from ray_tpu.parallel import step_anatomy as _sa
 from ray_tpu.data._internal.streaming.executor import (
     StreamingExecutor,
     streaming_enabled,
@@ -77,15 +80,22 @@ def make_to_batch(batch_format: str, device_put: bool):
 
 def stamp_wait(gen, consumer: str):
     """Wrap a batch generator, observing the consumer-blocked time per
-    batch (production time of each __next__)."""
+    batch (production time of each __next__). When a train step is
+    active, the same interval goes to the step-anatomy ring as an
+    EXPOSED ``data_wait`` activity — the input-gated share of that
+    step, joined by step_id."""
     while True:
         t0 = time.perf_counter()
+        m0 = time.monotonic()
         try:
             batch = next(gen)
         except StopIteration:
             return
-        _tm.observe("ray_tpu_data_wait_seconds",
-                    time.perf_counter() - t0, tags={"consumer": consumer})
+        wait = time.perf_counter() - t0
+        _tm.observe("ray_tpu_data_wait_seconds", wait,
+                    tags={"consumer": consumer})
+        _sa.record_activity("data_wait", m0, m0 + wait, blocking=True,
+                            consumer=consumer)
         yield batch
 
 
@@ -99,7 +109,14 @@ def _double_buffered(batch_blocks, to_batch):
     def produce():
         try:
             for bb in batch_blocks:
+                m0 = time.monotonic()
                 item = ("ok", to_batch(bb))
+                # background by construction: this thread's conversion
+                # + device_put dispatch is the ingest work that HIDES
+                # under the caller's train step — step anatomy reports
+                # it as data_hidden (overlap proof for the data plane)
+                _sa.record_activity("data_produce", m0, time.monotonic(),
+                                    blocking=False)
                 while not stop.is_set():
                     try:
                         q.put(item, timeout=0.2)

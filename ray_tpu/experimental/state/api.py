@@ -620,6 +620,44 @@ def summarize_data(*, address: str | None = None) -> dict:
                                 key=lambda r: r["consumer"])}
 
 
+def summarize_steps(*, address: str | None = None,
+                    last: int | None = None) -> dict:
+    """Step-anatomy rollup: per-step, per-rank wall-clock attribution
+    fused ACROSS the cluster by ``step_id`` (never by wall-clock
+    windows — parallel/step_anatomy.py). Collects every process's step
+    + activity records (driver-local plus a raylet→worker fan-out,
+    like the other telemetry RPCs) and returns::
+
+        {"steps": [{"step_id", "ranks": {rank: {wall_s, compute_s,
+                     comm_exposed_s, comm_hidden_s, data_wait_s,
+                     data_hidden_s, compile_s, other_s,
+                     overlap_fraction}},
+                    "critical_path": {"rank", "phase", "wall_s"},
+                    "overlap_fraction", "complete"}],
+         "ranks": per-rank rollups, "regressions": STEP_REGRESSION
+         events, "incomplete": ring-eviction flag, "dropped": counts}
+
+    ``last`` keeps only the most recent N steps (post-fusion).
+    ``overlap_fraction`` is hidden / (hidden + exposed) auxiliary time —
+    the 2011.03641 metric that says whether pipelining paid off;
+    ``critical_path`` names the rank and phase that bounded each step.
+    """
+    from ray_tpu.parallel import step_anatomy
+
+    exports = [step_anatomy.local_records()]
+    with _gcs(address) as call:
+        exports.extend(_each_raylet(call, "step_records"))
+    fused = step_anatomy.fuse(exports)
+    if last is not None:
+        fused["steps"] = fused["steps"][-last:] if last else []
+    try:
+        fused["regressions"] = list_cluster_events(
+            address=address, filters=[("kind", "=", "STEP_REGRESSION")])
+    except Exception:
+        fused["regressions"] = []
+    return fused
+
+
 def summarize_serve(*, address: str | None = None) -> dict:
     """Serving-plane rollup (reference tier: `serve status` + the serve
     dashboard page — but folded from this framework's metric catalog and

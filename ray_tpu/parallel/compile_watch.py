@@ -158,22 +158,34 @@ class CompiledFunction:
     def _record_miss(self, start: float, dur: float, tags: dict):
         """Metrics + BEGIN/END events + both span planes for one
         compile, materialized after the fact (the cache-size delta is
-        only knowable once the call returned)."""
+        only knowable once the call returned). A compile inside an
+        active train step additionally lands in the step-anatomy ring
+        (and stamps the events) — a recompiling step must show up as a
+        compile-bounded step, not unexplained "compute"."""
+        from ray_tpu.parallel import step_anatomy as _sa
         from ray_tpu.util import tracing
 
+        step_id = _sa.current_step_id()
         _tm.counter_inc("ray_tpu_pjit_cache_total",
                         tags={**tags, "result": "miss"})
         _tm.observe("ray_tpu_pjit_compile_seconds", dur, tags=tags)
-        _events.record("COMPILE_BEGIN", fn=self._name, started_at=start)
+        _events.record("COMPILE_BEGIN", fn=self._name, started_at=start,
+                       step=step_id)
         _events.record("COMPILE_END", fn=self._name, ok=True,
-                       duration_s=dur)
+                       duration_s=dur, step=step_id)
+        if step_id is not None:
+            m1 = time.monotonic()
+            _sa.record_activity("compile", m1 - dur, m1, blocking=True,
+                                fn=self._name)
         start_ns = int(start * 1e9)
         end_ns = start_ns + int(dur * 1e9)
         _prof.record_completed_span("compile", f"compile::{self._name}",
-                                    start, dur, {"fn": self._name})
+                                    start, dur, {"fn": self._name,
+                                                 "step": step_id})
         tracing.record_completed_span(f"compile {self._name}", "INTERNAL",
                                       start_ns, end_ns,
-                                      attributes={"fn": self._name})
+                                      attributes={"fn": self._name,
+                                                  "step": step_id})
 
     def _call_classified_by_signature(self, args, kwargs):
         """Fallback for callables without ``_cache_size``: classify by
@@ -189,12 +201,14 @@ class CompiledFunction:
             _tm.counter_inc("ray_tpu_pjit_cache_total",
                             tags={**tags, "result": "hit"})
             return self._fn(*args, **kwargs)
+        from ray_tpu.parallel import step_anatomy as _sa
         from ray_tpu.util import tracing
 
         _tm.counter_inc("ray_tpu_pjit_cache_total",
                         tags={**tags, "result": "miss"})
         _events.record("COMPILE_BEGIN", fn=self._name)
         t0 = time.perf_counter()
+        m0 = time.monotonic()
         try:
             with _prof.record_span("compile", f"compile::{self._name}"):
                 with tracing.span(f"compile {self._name}", "INTERNAL",
@@ -209,6 +223,8 @@ class CompiledFunction:
                            duration_s=time.perf_counter() - t0)
             raise
         dur = time.perf_counter() - t0
+        _sa.record_activity("compile", m0, time.monotonic(),
+                            blocking=True, fn=self._name)
         _tm.observe("ray_tpu_pjit_compile_seconds", dur, tags=tags)
         _events.record("COMPILE_END", fn=self._name, ok=True,
                        duration_s=dur)

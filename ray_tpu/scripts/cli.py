@@ -321,6 +321,46 @@ def cmd_data(args):
     return 0
 
 
+def cmd_steps(args):
+    """Step-anatomy summary — the CLI face of
+    `experimental.state.api.summarize_steps`: per-step/per-rank
+    compute/comm/data/compile breakdown, overlap fraction, the
+    cross-rank critical path, and STEP_REGRESSION events."""
+    from ray_tpu.experimental.state.api import summarize_steps
+
+    print(json.dumps(summarize_steps(address=args.address,
+                                     last=args.last),
+                     indent=2, default=str))
+    return 0
+
+
+def cmd_blackbox(args):
+    """Flight recorder: `ray-tpu blackbox dump` fans out over every
+    process's black box (bounded rings of recent spans/events/steps/
+    metrics) and writes one timestamped dump dir with per-process JSONL
+    plus a merged chrome-timeline — the same artifact gang failures and
+    collective poisoning produce automatically."""
+    from ray_tpu._private import flight_recorder
+
+    if args.action == "dump":
+        path = flight_recorder.dump("manual", address=args.address,
+                                    out_dir=args.out)
+        if path is None:
+            raise SystemExit(
+                "flight recorder disabled (RAY_TPU_INTERNAL_TELEMETRY=0)")
+        print(json.dumps({"status": "dumped", "path": path,
+                          "timeline": os.path.join(path,
+                                                   "timeline.json")}))
+        return 0
+    # last: where did the most recent automatic/manual dump land?
+    # Scan the base dir — the in-memory last_dump_path is per-process
+    # and this CLI is always a fresh process.
+    print(json.dumps({"last_dump": flight_recorder.find_latest_dump(),
+                      "base_dir": flight_recorder.base_dir(),
+                      "window_s": flight_recorder.window_s()}))
+    return 0
+
+
 def cmd_lint(args):
     """raylint: the repo-wide invariant lint (ray_tpu/_private/analysis/)
     — lock discipline, knob registry, wire-format consistency, metric +
@@ -503,6 +543,25 @@ def main(argv=None):
                              "block locality)")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_data)
+
+    sp = sub.add_parser("steps",
+                        help="step-anatomy summary: per-step/per-rank "
+                             "compute/comm/data breakdown, overlap "
+                             "fraction, critical path, regressions")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--last", type=int, default=None,
+                    help="only the most recent N steps")
+    sp.set_defaults(fn=cmd_steps)
+
+    sp = sub.add_parser("blackbox",
+                        help="flight recorder: dump / locate the "
+                             "cluster black box")
+    sp.add_argument("action", choices=["dump", "last"])
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--out", default=None,
+                    help="dump: parent directory to write the dump "
+                         "under (default RAY_TPU_FLIGHT_RECORDER_DIR)")
+    sp.set_defaults(fn=cmd_blackbox)
 
     sp = sub.add_parser("lint",
                         help="repo-wide invariant lint: lock "

@@ -87,13 +87,20 @@ def _clone_exception(exc: BaseException) -> BaseException:
 
 
 class _Pending:
-    __slots__ = ("item", "event", "result", "error")
+    __slots__ = ("item", "event", "result", "error", "trace_ctx",
+                 "enq_ns")
 
     def __init__(self, item):
         self.item = item
         self.event = threading.Event()
         self.result = None
         self.error = None
+        # captured on the CALLER's thread at enqueue time: the batcher
+        # thread that executes the batch has no caller context, so
+        # without carrying this the span chain of a traced Serve
+        # request breaks at the batching hop
+        self.trace_ctx = None
+        self.enq_ns = 0
 
 
 class _Batcher:
@@ -137,6 +144,15 @@ class _Batcher:
 
     def submit(self, item):
         pending = _Pending(item)
+        if _tm.ENABLED:
+            try:
+                from ray_tpu.util import tracing
+
+                pending.trace_ctx = tracing.inject_context()
+                if pending.trace_ctx is not None:
+                    pending.enq_ns = time.time_ns()
+            except Exception:
+                pending.trace_ctx = None
         with self._cond:
             self._queue.append(pending)
             if self._thread is None or not self._thread.is_alive():
@@ -196,6 +212,7 @@ class _Batcher:
                     self._thread = None
                     return
             items, pad = self._pad_to_bucket([p.item for p in batch])
+            exec_start_ns = time.time_ns()
             try:
                 results = self._fn(items)
                 if results is None or len(results) != len(items):
@@ -214,8 +231,47 @@ class _Batcher:
                 for pending in batch:
                     pending.error = _clone_exception(exc)
             finally:
+                self._link_traces(batch, exec_start_ns, len(items), pad)
                 for pending in batch:
                     pending.event.set()
+
+    def _link_traces(self, batch, exec_start_ns: int, batch_size: int,
+                     pad: int):
+        """Re-link each traced caller's span chain across the batching
+        hop: one batch-execution span (recorded under the first traced
+        item's context), plus one per-item span under the ITEM's own
+        caller context covering enqueue → done, carrying the batching
+        wait and a ``batch_span`` attribute pointing at the shared
+        execution span. A traced Serve request thus shows how long it
+        queued and which batch executed it."""
+        traced = [p for p in batch if p.trace_ctx is not None]
+        if not traced:
+            return
+        try:
+            from ray_tpu.util import tracing
+
+            end_ns = time.time_ns()
+            exec_span = tracing.record_completed_span(
+                f"serve.batch_execute {self._name}", "INTERNAL",
+                exec_start_ns, end_ns,
+                attributes={"fn": self._name, "batch_size": batch_size,
+                            "pad": pad, "requests": len(batch)},
+                ctx=traced[0].trace_ctx)
+            batch_span_id = exec_span["span_id"] if exec_span else None
+            for p in traced:
+                tracing.record_completed_span(
+                    f"serve.batch {self._name}", "INTERNAL",
+                    p.enq_ns, end_ns,
+                    attributes={
+                        "fn": self._name,
+                        "batch_wait_s":
+                            max(0, exec_start_ns - p.enq_ns) / 1e9,
+                        "batch_size": batch_size,
+                        "batch_span": batch_span_id,
+                    },
+                    ctx=p.trace_ctx)
+        except Exception:
+            pass   # tracing must never fail the serving data plane
 
 
 def _reject_bad_call(args: tuple, kwargs: dict, name: str):

@@ -50,6 +50,15 @@ class _GangDeathMonitor:
             return
         with self._lock:
             self._dead.setdefault(rank, reason)
+        # black box while the body is warm: the dump fan-out runs off
+        # the pubsub callback thread (background), debounced so a
+        # multi-rank death burst produces one dump
+        try:
+            from ray_tpu._private import flight_recorder as _fr
+
+            _fr.trigger_dump("actor_death", background=True)
+        except Exception:
+            pass
 
     def dead_ranks(self) -> dict[int, str]:
         with self._lock:

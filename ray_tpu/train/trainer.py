@@ -113,18 +113,20 @@ class DataParallelTrainer(BaseTrainer):
         # attempt cap
         policy = RetryPolicy(base_backoff_s=0.5, max_backoff_s=10.0)
         attempt = 0
+        self._group = group
         self._resume_ckpt = self.resume_from_checkpoint
         self._latest_checkpoint = None
         self._latest_iteration = None
         while True:
+            self._attempt = attempt + 1
             try:
                 return self._fit_once()
-            except Exception as e:
+            except Exception:
+                # GANG_FAILED event + flight-recorder dump were recorded
+                # inside _fit_once, BEFORE its finally tore the gang
+                # down — a post-teardown dump would capture only idle
+                # pool workers, not the survivors' final spans
                 attempt += 1
-                dead = sorted(getattr(e, "dead_ranks", ()) or ())
-                _events.record("GANG_FAILED", group=group,
-                               attempt=attempt, dead_ranks=list(dead),
-                               error=f"{type(e).__name__}: {e}")
                 if max_failures != -1 and attempt > max_failures:
                     raise
                 if getattr(fc, "restore_from_latest_checkpoint", True) \
@@ -148,9 +150,12 @@ class DataParallelTrainer(BaseTrainer):
                                resume_iteration=self._latest_iteration)
 
     def _fit_once(self) -> Result:
-        executor = BackendExecutor(self.backend_config,
-                                   self.scaling_config).start()
+        from ray_tpu._private import events as _events
+
+        executor = None
         try:
+            executor = BackendExecutor(self.backend_config,
+                                       self.scaling_config).start()
             self._setup_datasets(executor)
             config = dict(self.train_loop_config)
             resume = getattr(self, "_resume_ckpt", None) \
@@ -159,8 +164,30 @@ class DataParallelTrainer(BaseTrainer):
                 config["_resume_checkpoint"] = resume
             executor.start_training(self.train_loop_per_worker, config)
             return self._drive(executor)
+        except Exception as e:
+            # The gang's surviving workers are STILL ALIVE here (the
+            # finally below is what tears them down): record the
+            # failure and cut the cluster black box now, so the dump
+            # captures the survivors' final collective spans and step
+            # records instead of post-teardown idle pool workers.
+            # force ONLY on the first attempt: the death monitor's own
+            # trigger may have fired moments earlier, BEFORE this
+            # GANG_FAILED event existed, and the flagship dump must not
+            # be debounced into missing it — but a crash-looping gang
+            # retrying every backoff must not write one full cluster
+            # dump per attempt (later attempts ride the 15s debounce).
+            dead = sorted(getattr(e, "dead_ranks", ()) or ())
+            attempt = getattr(self, "_attempt", 1)
+            _events.record("GANG_FAILED", group=self._group,
+                           attempt=attempt, dead_ranks=list(dead),
+                           error=f"{type(e).__name__}: {e}")
+            from ray_tpu._private import flight_recorder as _fr
+
+            _fr.trigger_dump("GANG_FAILED", force=attempt == 1)
+            raise
         finally:
-            executor.shutdown()
+            if executor is not None:
+                executor.shutdown()
 
     def _setup_datasets(self, executor):
         for name, ds in self.datasets.items():

@@ -95,11 +95,19 @@ class TrainWorker:
         _session._set_session(self.session)
 
         def _run():
+            from ray_tpu.parallel import step_anatomy
+
+            # step 1 opens when the train function starts; each
+            # session.report advances it (iteration == step_id), so
+            # every collective/data/compile interval recorded by this
+            # gang member fuses by step, not by wall-clock windows
+            step_anatomy.start(rank=self.world_rank)
             try:
                 train_fn(config) if config is not None else train_fn()
             except BaseException as e:  # noqa: BLE001
                 self.session.error = e
             finally:
+                step_anatomy.finish()
                 self.session.finished.set()
 
         self._thread = threading.Thread(target=_run, daemon=True,

@@ -154,6 +154,15 @@ class _Rendezvous:
         _events.record("COLLECTIVE_GROUP_POISONED",
                        group=self.group_name,
                        dead_ranks=list(dead_set), reason=reason)
+        # black box: capture the cluster's final collective spans while
+        # survivors still buffer them (background — the poison pushes
+        # below must not wait on a dump fan-out; debounced per process)
+        try:
+            from ray_tpu._private import flight_recorder as _fr
+
+            _fr.trigger_dump("collective_poison", background=True)
+        except Exception:
+            pass
         survivors = []
 
         def _push(addr):

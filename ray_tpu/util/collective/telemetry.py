@@ -76,20 +76,38 @@ def run_op(g, op: str, seq, body, payload=None,
     the kill-switch check, so a disabled op pays only the bool."""
     if not _tm.ENABLED:
         return body()
+    from ray_tpu.parallel import step_anatomy as _sa
     from ray_tpu.util import tracing
 
     nbytes = payload_nbytes(payload) if payload is not None else 0
     tags = {"op": op, "backend": g.backend, "group": g.name}
+    # the active train step (if any): stamped into both span planes and
+    # the rank-timing record, and an activity interval goes to the
+    # step-anatomy ring so per-step comm attribution fuses by step_id
+    # instead of wall-clock windows. One tuple read when inactive.
+    step = _sa.current()
+    step_id = step[0] if step is not None else None
     start = time.time()
     t0 = time.perf_counter()
+    mono0 = time.monotonic()
     with _prof.record_span("collective", f"collective::{op}",
                            {"group": g.name, "backend": g.backend,
-                            "seq": seq, "bytes": nbytes}):
+                            "seq": seq, "bytes": nbytes,
+                            "step": step_id}):
         with tracing.span(f"collective {op}", "INTERNAL",
                           attributes={"group": g.name,
-                                      "backend": g.backend, "seq": seq}):
+                                      "backend": g.backend, "seq": seq,
+                                      "step": step_id}):
             result = body()
     dur = time.perf_counter() - t0
+    if step is not None:
+        # blocking iff the op ran on the thread driving the step loop
+        # (today's synchronous collectives always do; a future async
+        # bucketed-DDP flusher records background comm here)
+        _sa.record_activity(
+            "collective", mono0, mono0 + dur,
+            blocking=threading.get_ident() == _sa._cur_thread,
+            op=op, group=g.name)
     if measure_result:
         nbytes = payload_nbytes(result)
     _tm.observe("ray_tpu_collective_latency_seconds", dur, tags=tags)
@@ -100,7 +118,7 @@ def run_op(g, op: str, seq, body, payload=None,
         _reporter.add({"group": g.name, "op": op, "seq": int(seq),
                        "rank": g.rank, "world_size": g.world_size,
                        "start": start, "end": start + dur,
-                       "bytes": nbytes})
+                       "bytes": nbytes, "step": step_id})
     return result
 
 

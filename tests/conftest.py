@@ -69,11 +69,31 @@ def pytest_report_header(config):
     return lines
 
 
+def _flight_recorder_hint() -> str:
+    """Where this failure's black box is (or would be): the last dump
+    this process wrote, else the base dir cluster processes dump into —
+    post-mortems of seeded-kill tests start from the black box, not
+    from scrollback."""
+    try:
+        from ray_tpu._private import flight_recorder as fr
+
+        path = fr.last_dump_path() or fr.find_latest_dump()
+        if path:
+            return f"dump: {path}"
+        return (f"no dump written yet; auto-dumps land under "
+                f"{fr.base_dir()} (ray-tpu blackbox dump for a "
+                f"manual one)")
+    except Exception as e:
+        return f"flight recorder unavailable ({e!r})"
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
     """Stamp failures with the seed+schedule that reproduces the exact
     injected-fault sequence (the injector is deterministic per call
-    index, so this one line replays the failure)."""
+    index, so this one line replays the failure), and — for chaos /
+    fault_injection-marked tests — with the flight-recorder dump path,
+    so the post-mortem starts from the black box."""
     outcome = yield
     rep = outcome.get_result()
     if rep.when == "call" and rep.failed:
@@ -81,6 +101,10 @@ def pytest_runtest_makereport(item, call):
         if banner:
             rep.sections.append(
                 ("fault injection", f"reproduce with: {banner}"))
+        if item.get_closest_marker("chaos") is not None or \
+                item.get_closest_marker("fault_injection") is not None:
+            rep.sections.append(
+                ("flight recorder", _flight_recorder_hint()))
 
 
 # ---------------------------------------------------------------------------
