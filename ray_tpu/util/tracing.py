@@ -41,6 +41,7 @@ _NODE = os.uname().nodename
 
 _lock = threading.Lock()
 _spans: collections.deque = collections.deque(maxlen=_MAX_SPANS)
+_dropped = 0
 _enabled = False
 
 # the active span for THIS logical execution context (task body, driver
@@ -65,6 +66,26 @@ def is_enabled() -> bool:
 
 def _new_id(nbytes: int) -> str:
     return os.urandom(nbytes).hex()
+
+
+def _append_span(span: dict):
+    """Sole writer to the ring: a span pushed into a FULL ring evicts
+    the oldest one, and that loss is COUNTED (metric + stats) — fused
+    consumers (step anatomy, flight recorder) must be able to flag an
+    incomplete window instead of silently reporting wrong attribution."""
+    global _dropped
+    with _lock:
+        dropped = len(_spans) == _spans.maxlen
+        if dropped:
+            _dropped += 1
+        _spans.append(span)
+    if dropped:
+        try:
+            from ray_tpu._private import telemetry as _tm
+
+            _tm.counter_inc("ray_tpu_trace_dropped_total")
+        except Exception:
+            pass
 
 
 def current_context() -> dict | None:
@@ -109,51 +130,56 @@ def span(name: str, kind: str, ctx: dict | None = None,
     finally:
         end = time.time_ns()
         _current.reset(token)
-        with _lock:
-            _spans.append({
-                "traceId": trace_id,
-                "spanId": span_id,
-                "parentSpanId": parent,
-                "name": name,
-                "kind": kind,                # "PRODUCER"/"CONSUMER"/...
-                "startTimeUnixNano": start,
-                "endTimeUnixNano": end,
-                "pid": _PID,
-                # pids collide across hosts; (node, pid) identifies the
-                # producing process cluster-wide
-                "node": _NODE,
-                "attributes": attributes or {},
-            })
-
-
-def record_completed_span(name: str, kind: str, start_ns: int,
-                          end_ns: int, attributes: dict | None = None):
-    """Append an already-timed span linked under the CURRENT context
-    (same linkage rule as span(); no-op when tracing is inactive).
-    For observers that only learn a span happened after the fact —
-    e.g. a compile-cache miss detected by cache-size delta — so the
-    span can't wrap the work as a context manager."""
-    inherited = _current.get()
-    if inherited is None:
-        if not _enabled:
-            return None
-        trace_id, parent = _new_id(16), None
-    else:
-        trace_id, parent = inherited["trace_id"], inherited["span_id"]
-    span_id = _new_id(8)
-    with _lock:
-        _spans.append({
+        _append_span({
             "traceId": trace_id,
             "spanId": span_id,
             "parentSpanId": parent,
             "name": name,
-            "kind": kind,
-            "startTimeUnixNano": int(start_ns),
-            "endTimeUnixNano": int(end_ns),
+            "kind": kind,                # "PRODUCER"/"CONSUMER"/...
+            "startTimeUnixNano": start,
+            "endTimeUnixNano": end,
             "pid": _PID,
+            # pids collide across hosts; (node, pid) identifies the
+            # producing process cluster-wide
             "node": _NODE,
             "attributes": attributes or {},
         })
+
+
+def record_completed_span(name: str, kind: str, start_ns: int,
+                          end_ns: int, attributes: dict | None = None,
+                          ctx: dict | None = None):
+    """Append an already-timed span linked under the CURRENT context
+    (same linkage rule as span(); no-op when tracing is inactive).
+    For observers that only learn a span happened after the fact —
+    e.g. a compile-cache miss detected by cache-size delta — so the
+    span can't wrap the work as a context manager. An explicit ``ctx``
+    (an injected context, e.g. captured at @serve.batch enqueue time on
+    the CALLER's thread) overrides the current-context linkage — the
+    recording thread's own context is usually the wrong trace there."""
+    if ctx is not None:
+        trace_id, parent = ctx["trace_id"], ctx.get("parent_span_id")
+    else:
+        inherited = _current.get()
+        if inherited is None:
+            if not _enabled:
+                return None
+            trace_id, parent = _new_id(16), None
+        else:
+            trace_id, parent = inherited["trace_id"], inherited["span_id"]
+    span_id = _new_id(8)
+    _append_span({
+        "traceId": trace_id,
+        "spanId": span_id,
+        "parentSpanId": parent,
+        "name": name,
+        "kind": kind,
+        "startTimeUnixNano": int(start_ns),
+        "endTimeUnixNano": int(end_ns),
+        "pid": _PID,
+        "node": _NODE,
+        "attributes": attributes or {},
+    })
     return {"trace_id": trace_id, "span_id": span_id}
 
 
@@ -179,20 +205,54 @@ def submit_span(spec: dict, name: str):
     return _cm()
 
 
-def local_spans() -> list[dict]:
+def local_spans(with_drop_marker: bool = False) -> list[dict]:
+    """This process's spans. ``with_drop_marker=True`` (the RPC path)
+    appends one marker entry carrying this process's drop count so
+    cluster collection can surface ring overflow; ``get_spans`` strips
+    markers back out of the span list."""
     with _lock:
-        return list(_spans)
+        out = list(_spans)
+        dropped = _dropped
+    if with_drop_marker and dropped:
+        out.append({"spanId": f"__drops__:{_NODE}:{_PID}",
+                    "__drops__": dropped, "node": _NODE, "pid": _PID})
+    return out
+
+
+def stats() -> dict:
+    with _lock:
+        return {"buffered": len(_spans), "dropped": _dropped,
+                "capacity": _spans.maxlen}
 
 
 def clear():
+    global _dropped
     with _lock:
         _spans.clear()
+        _dropped = 0
 
 
-def get_spans(address: str | None = None) -> list[dict]:
+class SpanList(list):
+    """``get_spans``'s return type: a plain span list, plus ``dropped``
+    — {(node, pid): count} of spans each process's ring evicted before
+    collection. A non-empty ``dropped`` means the trace window is
+    incomplete and fused attribution over it should say so."""
+
+    def __init__(self, spans, dropped):
+        super().__init__(spans)
+        self.dropped: dict[tuple, int] = dropped
+
+    @property
+    def complete(self) -> bool:
+        return not self.dropped
+
+
+def get_spans(address: str | None = None) -> "SpanList":
     """Cluster-wide span collection: driver-local spans plus a fan-out
-    over every raylet's workers (the same plumbing as `timeline()`)."""
-    out = local_spans()
+    over every raylet's workers (the same plumbing as `timeline()`).
+    Returns a list subclass whose ``dropped`` maps (node, pid) to the
+    spans that process's ring evicted (incomplete-window signal)."""
+    out = local_spans(with_drop_marker=True)
     try:
         from ray_tpu.experimental.state.api import _each_raylet, _gcs
 
@@ -207,12 +267,16 @@ def get_spans(address: str | None = None) -> list[dict]:
             "only", exc_info=True)
     # the driver's own worker also answers the fan-out — dedup by span id
     seen, deduped = set(), []
+    drops: dict[tuple, int] = {}
     for s in out:
         if s["spanId"] in seen:
             continue
         seen.add(s["spanId"])
+        if "__drops__" in s:
+            drops[(s.get("node"), s.get("pid"))] = s["__drops__"]
+            continue
         deduped.append(s)
-    return deduped
+    return SpanList(deduped, drops)
 
 
 def export_otlp_json(spans: list[dict], path: str) -> str:
