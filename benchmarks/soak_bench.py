@@ -1,0 +1,256 @@
+"""Cluster-scale soak bench — round 12 (BENCH_r12.json).
+
+Stands up ``RAY_TPU_SOAK_NODES`` (default 100) simulated raylets
+(`ray_tpu/_private/sim_cluster.py`: real GCS registration/heartbeat/
+pubsub, no workers) and measures the control plane under seeded chaos:
+
+- **fanout**: a simultaneous ~10% mass kill (`kill_node:*.mass_kill:
+  p0.1`), death-feed fanout latency per (survivor, death) pair —
+  p50/p99 with the coalescing fix OFF (`gcs_death_coalesce_window_s=0`,
+  the pre-PR-12 per-death sweep+broadcast) vs ON. The GCS carries a
+  populated object-location table and live heartbeat/lease traffic, so
+  the per-death locked sweep costs what it costs in production.
+- **restart**: SIGKILL the (subprocess) GCS mid-death-storm with live
+  lease traffic; measure the reconvergence window (alive-set equals
+  survivors + every subscription healed via the probe publish) and
+  assert ZERO lost accepted leases and no survivor missing a death.
+- **determinism**: the same seed replays a byte-identical chaos
+  journal.
+
+Usage::
+
+    RAY_TPU_SOAK_NODES=100 python benchmarks/soak_bench.py \
+        --json-out BENCH_r12.json
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ray_tpu._private import fault_injection as fi  # noqa: E402
+
+
+def _pct(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+
+def _populate_objects(cluster, n_objects: int):
+    """Give the GCS a realistically non-empty object-location table so
+    the per-death owned-value sweep has real work (the O(objects) path
+    the batch fix collapses from k sweeps to one)."""
+    from ray_tpu._private.protocol import RpcClient
+
+    client = RpcClient(cluster.gcs_addr, timeout=30.0)
+    try:
+        n_nodes = len(cluster.raylets)
+        for i in range(n_objects):
+            oid = b"soakobj-%08d" % i
+            node = cluster.raylets[i % n_nodes].node_id
+            client.call("add_object_location", object_id=oid,
+                        node_id=node, size=1024)
+    finally:
+        client.close()
+
+
+def fanout_phase(nodes: int, seed: int, coalesce: bool,
+                 n_objects: int, verbose=print) -> dict:
+    from ray_tpu._private.sim_cluster import SimCluster
+
+    os.environ["RAY_TPU_GCS_DEATH_COALESCE_WINDOW_S"] = (
+        "0.05" if coalesce else "0")
+    fi.install(seed, "kill_node:*.mass_kill:p0.1")
+    cluster = SimCluster(n_nodes=nodes, tick_interval=0.05,
+                         poll_timeout=2.0).start()
+    try:
+        _populate_objects(cluster, n_objects)
+        cluster.run_ticks(3, leases_every=2)
+        cluster.mass_consult("mass_kill")
+        t0 = cluster.metrics["mass_kill_initiated_at"]
+        killed = cluster.dead_ids()
+        cluster.run_ticks(4, leases_every=2)
+        conv = cluster.wait_converged(timeout=45.0)
+        lat = cluster.fanout_latencies(t0, killed)
+        leases = cluster.verify_leases()
+        st = cluster.gcs_call("debug_state")
+
+        def _ms(v):
+            # a p0.1 schedule can legitimately kill ZERO nodes at small
+            # fleet sizes — report a degenerate phase, don't crash
+            return round(v * 1e3, 2) if v is not None else None
+
+        out = {
+            "coalesce": coalesce,
+            "killed": len(killed),
+            "survivors": len(cluster.survivors()),
+            "pairs_observed": len(lat),
+            "pairs_expected": len(killed) * len(cluster.survivors()),
+            "fanout_p50_ms": _ms(_pct(lat, 0.50)),
+            "fanout_p99_ms": _ms(_pct(lat, 0.99)),
+            "fanout_max_ms": _ms(max(lat) if lat else None),
+            "reconvergence": conv,
+            "lost_leases": len(leases["lost"]),
+            "death_batches": st["death_batches"],
+            "max_death_batch": st["max_death_batch"],
+            "journal_sha256": hashlib.sha256(
+                cluster.journal_text().encode()).hexdigest(),
+        }
+        verbose(f"  fanout[coalesce={coalesce}] killed={out['killed']} "
+                f"p50={out['fanout_p50_ms']}ms "
+                f"p99={out['fanout_p99_ms']}ms "
+                f"converged={conv['converged']} "
+                f"lost_leases={out['lost_leases']}")
+        return out
+    finally:
+        cluster.stop()
+        fi.uninstall()
+        del os.environ["RAY_TPU_GCS_DEATH_COALESCE_WINDOW_S"]
+
+
+def restart_phase(nodes: int, seed: int, verbose=print) -> dict:
+    from ray_tpu._private.sim_cluster import SimCluster
+
+    fi.install(seed, "kill_node:*.mass_kill:p0.1;"
+                     "flap_node:*.flap_check:p0.05:400")
+    store = os.path.join(tempfile.mkdtemp(prefix="soak_gcs_"), "gcs.db")
+    cluster = SimCluster(n_nodes=nodes, tick_interval=0.05,
+                         poll_timeout=2.0, gcs="subprocess",
+                         store_path=store).start()
+    try:
+        cluster.run_ticks(3, leases_every=2)
+        cluster.mass_consult("mass_kill")
+        cluster.mass_consult("flap_check")
+        killed = cluster.dead_ids()
+        # the reconnect storm: SIGKILL the GCS mid-storm, bring it back
+        # on the same port+store; every surviving client heals with
+        # jittered arrival into the bounded admission gate
+        t_restart = time.monotonic()
+        cluster.restart_gcs(downtime_s=0.3)
+        cluster.run_ticks(12, leases_every=3)   # flaps rejoin in here
+        conv = cluster.wait_converged(timeout=60.0)
+        reconv_s = time.monotonic() - t_restart
+        leases = cluster.verify_leases()
+        st = cluster.gcs_call("debug_state")
+        missing_feeds = [
+            r.tag for r in cluster.survivors()
+            if not killed <= set(r.deaths_seen)]
+        out = {
+            "killed": len(killed),
+            "flapped": sum(1 for line in cluster.journal
+                           if "flap_node" in line and "down_ticks" in
+                           line),
+            "survivors": len(cluster.survivors()),
+            "reconvergence_after_restart_s": round(reconv_s, 3),
+            "converged": conv["converged"],
+            "probe_healed": conv["probe_healed"],
+            "accepted_leases": leases["accepted"],
+            "lost_leases": len(leases["lost"]),
+            "survivors_missing_deaths": missing_feeds,
+            "pubsub_resyncs_served": st["pubsub_resyncs_served"],
+            "register_throttled": st["register_throttled"],
+            "journal_sha256": hashlib.sha256(
+                cluster.journal_text().encode()).hexdigest(),
+        }
+        out["journal_text"] = cluster.journal_text()
+        verbose(f"  restart: killed={out['killed']} "
+                f"reconverged in {out['reconvergence_after_restart_s']}s "
+                f"leases {out['accepted_leases']}/"
+                f"lost {out['lost_leases']} "
+                f"resyncs={out['pubsub_resyncs_served']} "
+                f"throttled={out['register_throttled']}")
+        return out
+    finally:
+        cluster.stop()
+        fi.uninstall()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int,
+                    default=int(os.environ.get("RAY_TPU_SOAK_NODES",
+                                               "100")))
+    ap.add_argument("--seed", type=int, default=12)
+    ap.add_argument("--objects", type=int, default=20000,
+                    help="object-location rows populating the GCS sweep")
+    ap.add_argument("--json-out", default=None)
+    args = ap.parse_args()
+
+    print(f"soak bench: {args.nodes} simulated raylets, seed {args.seed}")
+    t0 = time.time()
+    print("phase 1/4: death-feed fanout, coalescing OFF (pre-fix path)")
+    before = fanout_phase(args.nodes, args.seed, coalesce=False,
+                          n_objects=args.objects)
+    print("phase 2/4: death-feed fanout, coalescing ON")
+    after = fanout_phase(args.nodes, args.seed, coalesce=True,
+                         n_objects=args.objects)
+    print("phase 3/4: GCS restart mid-storm (reconnect herd)")
+    restart = restart_phase(args.nodes, args.seed)
+    print("phase 4/4: determinism replay (same seed, same journal)")
+    replay = restart_phase(args.nodes, args.seed,
+                           verbose=lambda *_a, **_k: None)
+    journals_equal = (replay["journal_text"] == restart["journal_text"])
+    restart.pop("journal_text", None)
+    replay.pop("journal_text", None)
+
+    result = {
+        "round": 12,
+        "bench": "cluster_soak",
+        "nodes": args.nodes,
+        "seed": args.seed,
+        "objects": args.objects,
+        "schedule_fanout": "kill_node:*.mass_kill:p0.1",
+        "schedule_restart": ("kill_node:*.mass_kill:p0.1;"
+                             "flap_node:*.flap_check:p0.05:400"),
+        "fanout_before": before,
+        "fanout_after": after,
+        "fanout_p99_improvement_x": (
+            round(before["fanout_p99_ms"] / after["fanout_p99_ms"], 2)
+            if before["fanout_p99_ms"] and after["fanout_p99_ms"]
+            else None),
+        "restart": restart,
+        "determinism": {
+            "journals_equal": journals_equal,
+            "journal_sha256": restart["journal_sha256"],
+        },
+        "acceptance": {
+            "zero_lost_leases": (before["lost_leases"] == 0
+                                 and after["lost_leases"] == 0
+                                 and restart["lost_leases"] == 0),
+            "all_subscriptions_healed": (
+                restart["probe_healed"]
+                and not restart["survivors_missing_deaths"]),
+            "reconverged_bounded": restart["converged"],
+            "reproducible": journals_equal,
+            "fanout_p99_improved": (
+                before["fanout_p99_ms"] is not None
+                and after["fanout_p99_ms"] is not None
+                and before["fanout_p99_ms"] > after["fanout_p99_ms"]),
+        },
+        "wall_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(result["acceptance"], indent=2))
+    print(f"fanout p99: {before['fanout_p99_ms']}ms -> "
+          f"{after['fanout_p99_ms']}ms "
+          f"({result['fanout_p99_improvement_x']}x); "
+          f"reconvergence after restart: "
+          f"{restart['reconvergence_after_restart_s']}s")
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+        print(f"wrote {args.json_out}")
+    return 0 if all(result["acceptance"].values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

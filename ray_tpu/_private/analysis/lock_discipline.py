@@ -26,6 +26,18 @@ mechanically checkable:
   attribute both read and written under a class's lock somewhere, but
   assigned lock-free in another method (the PR 5/6 unlocked
   double-checked-init / poison-check race class).
+- **RTL106 — unbounded per-id growth in a control-plane class.** A
+  dict/list/set attribute of a class in one of the CONTROL-PLANE
+  modules (``_CONTROL_PLANE_FILES``: gcs / raylet / pubsub /
+  sim_cluster) that some method grows (subscript-assign, ``append``,
+  ``add``, ``setdefault``...) but NO method ever shrinks (``pop``,
+  ``del``, ``remove``, ``discard``, ``clear``, or a reset
+  reassignment). Entries keyed by node/subscriber/worker id with no
+  removal on the death path leak across churn — the class the
+  100-node soak otherwise finds one field at a time. Ring buffers
+  built as ``deque(maxlen=...)`` are exempt (bounded by
+  construction); document genuinely-by-design survivors in the
+  baseline.
 
 Heuristics are deliberately shallow (single file, one ``self.method()``
 propagation hop, name-based lock identity) — precision comes from the
@@ -469,6 +481,107 @@ def _findings_for_scope(path: str, scope: _Scope, reports: dict,
     return findings
 
 
+# ------------------------------------------------- RTL106: unbounded growth
+
+# Modules whose classes hold per-node/per-subscriber/per-worker tables —
+# the control plane. Growth discipline applies HERE (a driver-side cache
+# has an owner watching it; a GCS table outlives every client).
+_CONTROL_PLANE_FILES = (
+    "ray_tpu/_private/gcs.py",
+    "ray_tpu/_private/raylet.py",
+    "ray_tpu/_private/pubsub.py",
+    "ray_tpu/_private/sim_cluster.py",
+)
+
+# method calls that add entries / that remove them
+_GROW_METHODS = {"setdefault", "append", "add", "extend", "insert"}
+_SHRINK_METHODS = {"pop", "popitem", "remove", "discard", "clear",
+                   "popleft"}
+
+
+def _self_attrs_in(expr: ast.AST):
+    """Attribute names ``self.X`` appearing anywhere inside ``expr``
+    (receiver chains like ``self.kv.get(ns, {}).pop(...)`` count as
+    touching ``kv``)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and dotted(node.value) == "self":
+            yield node.attr
+
+
+def _growth_findings_for_class(path: str, cls: ast.ClassDef):
+    grows: dict[str, ast.AST] = {}     # attr -> first grow site
+    shrinks: set[str] = set()
+    bounded: set[str] = set()          # deque(maxlen=...) etc.
+    for fn in [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        is_init = fn.name in ("__init__", "__new__", "__setstate__")
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for sub_t in (t.elts if isinstance(
+                            t, (ast.Tuple, ast.List)) else (t,)):
+                        if isinstance(sub_t, ast.Subscript):
+                            # self.X[k] = v  (also self.X[k1][k2] = v).
+                            # A CONSTANT key is a fixed vocabulary (a
+                            # stats dict), not per-id growth.
+                            if isinstance(sub_t.slice, ast.Constant):
+                                continue
+                            for attr in _self_attrs_in(sub_t.value):
+                                grows.setdefault(attr, node)
+                        elif isinstance(sub_t, ast.Attribute) and \
+                                dotted(sub_t.value) == "self":
+                            if is_init:
+                                # bounded-by-construction rings
+                                v = node.value
+                                if isinstance(v, ast.Call) and \
+                                        dotted(v.func).endswith("deque") \
+                                        and any(kw.arg == "maxlen"
+                                                for kw in v.keywords):
+                                    bounded.add(sub_t.attr)
+                            else:
+                                # re-binding outside init resets/bounds
+                                # the container (swap-and-flush pattern)
+                                shrinks.add(sub_t.attr)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    for attr in _self_attrs_in(t):
+                        shrinks.add(attr)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                m = node.func.attr
+                if m in _GROW_METHODS:
+                    for attr in _self_attrs_in(node.func.value):
+                        grows.setdefault(attr, node)
+                elif m in _SHRINK_METHODS:
+                    for attr in _self_attrs_in(node.func.value):
+                        shrinks.add(attr)
+    out = []
+    for attr, node in sorted(grows.items()):
+        if attr in shrinks or attr in bounded:
+            continue
+        out.append(Finding(
+            "RTL106", path, node.lineno, f"{cls.name}.{attr}",
+            f"control-plane container self.{attr} grows (per-id entries "
+            f"added) but no method of {cls.name} ever removes entries — "
+            f"it leaks across node/subscriber churn; remove on the death "
+            f"path, bound it, or document it in the baseline"))
+    return out
+
+
+def analyze_growth_source(source: str, path: str,
+                          tree: ast.Module | None = None):
+    """RTL106 over one source text (fixture-test entry point). Only
+    control-plane paths are analyzed; other paths return []."""
+    if path not in _CONTROL_PLANE_FILES:
+        return []
+    if tree is None:
+        tree = ast.parse(source)
+    findings = []
+    for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
+        findings += _growth_findings_for_class(path, cls)
+    return findings
+
+
 def analyze_module_source(source: str, path: str = "<string>",
                           tree: ast.Module | None = None):
     """Run the lock-discipline analysis over one source text — the unit
@@ -498,4 +611,6 @@ def analyze_module_source(source: str, path: str = "<string>",
 def lock_discipline_pass(ctx: AnalysisContext):
     for mod in ctx.package_modules():
         yield from analyze_module_source(mod.source, mod.path,
+                                         tree=mod.tree)
+        yield from analyze_growth_source(mod.source, mod.path,
                                          tree=mod.tree)

@@ -127,6 +127,23 @@ _CONFIG_DEFS: Dict[str, Any] = {
                                        # Live in-use HBM comes from the
                                        # owning train workers in-process.
     "mesh_ici_axis_order": "dp,pp,ep,sp,tp",  # slowest→fastest varying axes
+    # --- control plane at scale (cluster soak, _private/sim_cluster.py) ---
+    # Death-feed coalescing: node deaths arriving within the window are
+    # swept in ONE locked pass and (at >= gcs_death_batch_min of them)
+    # fanned out as ONE `batch_dead` message + NODE_BATCH_DEAD event
+    # instead of per-death broadcasts. 0 disables coalescing (every
+    # death sweeps and broadcasts individually, the pre-PR-12 path).
+    "gcs_death_coalesce_window_s": 0.05,
+    "gcs_death_batch_min": 3,
+    # Bounded admission on registration bursts: concurrent register_node
+    # bodies beyond this queue on the gate (clients retry under the
+    # unified policy if their wait exceeds the RPC timeout).
+    "gcs_register_max_concurrent": 16,
+    # Reconnect herd damping: every ReconnectingRpcClient sleeps
+    # uniform(0, this) before dialing a lost endpoint, so a GCS restart
+    # at 100 nodes doesn't eat one synchronized reconnect+replay storm.
+    # 0 restores immediate reconnects.
+    "gcs_reconnect_jitter_s": 0.2,
     # --- misc ---
     "rpc_max_message_bytes": 512 * 1024 * 1024,
     "pubsub_poll_timeout_s": 30.0,

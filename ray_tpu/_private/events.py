@@ -64,6 +64,16 @@ Event kinds recorded by the runtime:
 - ``FLIGHT_RECORDER_DUMP`` — a black-box dump directory was written
                      (_private/flight_recorder.py): trigger reason,
                      dump path, number of processes captured.
+- ``NODE_BATCH_DEAD`` — a coalesced node-death batch (>=
+                     ``gcs_death_batch_min`` deaths inside the coalesce
+                     window — a rack loss or seeded mass kill) was
+                     swept and fanned out as ONE broadcast
+                     (_private/gcs.py): node_ids, count, reasons.
+- ``PUBSUB_RESYNC`` — a long-poll subscriber detected a feed gap
+                     (mailbox overflow / publisher GC) and reconverged
+                     from the channel's state snapshot
+                     (_private/pubsub.py): channels, seq floor,
+                     per-subscriber resync count.
 
 Design constraints match the metrics plane: recording is one lock +
 deque append (no allocation beyond the event dict), the ring is bounded

@@ -28,6 +28,7 @@ Schedule grammar (``;``-separated rules)::
 
     rule     := action ":" scope "." method ":" selector [":" param_ms]
     action   := drop | delay | dup | disconnect | slow_reply | kill_actor
+              | kill_node | flap_node
     scope    := "*" | gcs | raylet | worker | driver | <process tag>
     method   := "*" | <rpc method name>
     selector := "p" FLOAT    probability (hash-derived, deterministic)
@@ -38,6 +39,23 @@ Schedule grammar (``;``-separated rules)::
 The scope matches the process ROLE or any of its TAGS (``add_tag``):
 train workers tag themselves ``rank<N>``, so rank-death chaos can target
 exactly one gang member deterministically.
+
+``kill_node`` / ``flap_node`` are NODE-level primitives, consulted at
+the same deterministic client-send boundary as the message-level
+actions but by the entity that OWNS a node's connections (the scale
+harness's simulated raylets, ``_private/sim_cluster.py``) via
+``on_node(tag, method)`` rather than by the transports — one process
+hosts many simulated nodes, so the decision is scoped by the node's
+TAG, and each rule keeps an independent per-(tag, method) counter so
+verdicts stay deterministic per node regardless of how many nodes
+share the schedule. ``kill_node:<tag>.<method>:<sel>`` tears down the
+node's connections and marks it for non-reregistration;
+``flap_node:<tag>.<method>:<sel>:<param_ms>`` disconnects it and
+re-registers it after param_ms. A wildcard tag scope
+(``kill_node:*.mass_kill:p0.1``) with a probabilistic selector is the
+"kill 10% of nodes simultaneously" schedule: every node consults the
+rule once at the same harness boundary and the hash verdict picks a
+deterministic ~10% subset.
 
 Examples::
 
@@ -97,10 +115,13 @@ import threading
 import time
 
 ACTIONS = ("drop", "delay", "dup", "disconnect", "slow_reply",
-           "kill_actor")
+           "kill_actor", "kill_node", "flap_node")
 # actions applied at the client send boundary vs the server reply boundary
 _SEND_ACTIONS = frozenset({"drop", "delay", "dup", "disconnect"})
 _REPLY_ACTIONS = frozenset({"slow_reply", "kill_actor"})
+# node-level actions, consulted by the node's owner (sim_cluster) at its
+# own deterministic send boundary via on_node(tag, method)
+_NODE_ACTIONS = frozenset({"kill_node", "flap_node"})
 
 _DEFAULT_PARAM_MS = 10.0
 
@@ -247,6 +268,8 @@ class FaultInjector:
                             if r.action in _SEND_ACTIONS]
         self._reply_rules = [r for r in self.rules
                              if r.action in _REPLY_ACTIONS]
+        self._node_rules = [r for r in self.rules
+                            if r.action in _NODE_ACTIONS]
         self._lock = threading.Lock()
         self.events: list[tuple] = []
         # None = follow the process-global role (set_role); a role given
@@ -306,6 +329,30 @@ class FaultInjector:
                 os._exit(1)
             delay = max(delay, rule.param_s)
         return delay
+
+    def on_node(self, tag: str, method: str) -> list[tuple[str, float]]:
+        """Node boundary: decisions for the simulated node identified by
+        ``tag`` about to issue ``method``. Returns [(action, param_s)]
+        for every node rule that fired (kill_node / flap_node); the
+        CALLER applies them (tear down connections, schedule the
+        re-register) — the transports never see node actions.
+
+        Rules count per (tag, method), not per method: a wildcard-scope
+        rule consulted by 100 nodes keeps 100 independent deterministic
+        counters, so node k's verdict never depends on how many other
+        nodes share the schedule or in what order they consult it."""
+        fired: list[tuple[str, float]] = []
+        for rule in self._node_rules:
+            if not rule.matches_scope(tag, method, frozenset((tag,))):
+                continue
+            n = rule.fires(self.seed, f"{tag}|{method}", self._lock)
+            if not n:
+                continue
+            with self._lock:
+                self.events.append((rule.action, tag, method, n))
+            _note_fault(rule.action, tag, method, n)
+            fired.append((rule.action, rule.param_s))
+        return fired
 
     # ------------------------------------------------------------ inspection
 

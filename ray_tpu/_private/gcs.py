@@ -51,6 +51,11 @@ class NodeInfo:
         self.meta = dict(meta)            # store name, spill dir, hostname...
         self.alive = True
         self.start_time = time.time()
+        # id of the raylet connection that registered this incarnation:
+        # a DELAYED disconnect of a superseded connection (half-open
+        # socket erroring long after the node re-registered on a fresh
+        # one) must not kill the live registration
+        self.conn_id: str | None = None
         # live availability gossiped by the raylet (~600ms cadence); the PG
         # scheduler packs against this so bundles don't land on top of
         # non-PG load (reference: RaySyncer resource view)
@@ -152,7 +157,36 @@ class GcsServer:
         self.rpc_psub_subscribe = self._long_poll.rpc_psub_subscribe
         self.rpc_psub_unsubscribe = self._long_poll.rpc_psub_unsubscribe
         self.rpc_psub_poll = self._long_poll.rpc_psub_poll
-        self._node_conns: dict[str, str] = {}     # conn.id -> node_id
+        self.rpc_psub_resync = self._long_poll.rpc_psub_resync
+        # snapshot-resync sources: a subscriber that overflowed its
+        # mailbox past the gap counter reconverges from these instead of
+        # permanently missing the dropped head of the stream
+        self._long_poll.set_snapshot_provider(
+            "actors", self._actors_resync_snapshot)
+        self._long_poll.set_snapshot_provider(
+            "nodes", self._nodes_resync_snapshot)
+        # Death-feed coalescing (cluster-scale soak, PR 12): simultaneous
+        # node deaths (a rack loss, a seeded 10% mass kill) within the
+        # coalesce window are swept in ONE locked pass and fanned out as
+        # ONE batch message instead of per-death broadcasts — at 100
+        # subscribers x k deaths that is n pushes instead of n*k.
+        self._death_lock = threading.Lock()
+        # node_id -> (reason, observed NodeInfo incarnation or None)
+        self._pending_deaths: dict[str, tuple] = {}
+        self._death_flusher_active = False
+        self._fanout_stats = {"death_batches": 0, "deaths_coalesced": 0,
+                              "max_death_batch": 0,
+                              "register_throttled": 0,
+                              "last_fanout_s": 0.0}
+        # Bounded admission for registration bursts (a reconnect storm
+        # after a GCS restart): at most this many register_node bodies
+        # run concurrently; the rest queue on the gate, keeping the
+        # node-table lock and the "alive" publish fanout from being
+        # stampeded by 100 simultaneous re-registrations.
+        from ray_tpu._private.config import get_config
+
+        self._register_gate = threading.BoundedSemaphore(
+            max(1, int(get_config("gcs_register_max_concurrent"))))
         self._snapshot_path = snapshot_path
         if isinstance(store, str):
             from ray_tpu._private.gcs_store import make_store
@@ -165,6 +199,13 @@ class GcsServer:
         # restore, an ALIVE actor whose raylet came back but never
         # re-announced it is dead (its worker died during the outage)
         self._reannounced: set[bytes] = set()
+        # node registrations seen by THIS process — after a restore, a
+        # restored-alive node that never re-registered within the grace
+        # window died during the outage; reconcile marks it dead
+        # THROUGH the death pipeline so survivors' death feeds learn
+        # about outage-window deaths instead of watching the node
+        # silently vanish from the table (soak round 12 finding)
+        self._reregistered: set[str] = set()
         if store is not None:
             self._restore_from_store()
         self._server = RpcServer(self, host, port)
@@ -217,46 +258,170 @@ class GcsServer:
     def on_disconnect(self, conn):
         node_id = conn.meta.get("node_id")
         if node_id:
-            self._mark_node_dead(node_id, "raylet connection lost")
+            self._mark_node_dead(node_id, "raylet connection lost",
+                                 conn_id=getattr(conn, "id", None))
 
-    def _mark_node_dead(self, node_id: str, reason: str):
+    def _mark_node_dead(self, node_id: str, reason: str,
+                        conn_id: str | None = None):
+        """Single-death entry point (connection loss, drain). With a
+        coalesce window configured, deaths arriving within the window
+        are batched through ``_mark_nodes_dead`` — a seeded mass kill
+        tears down many connections in the same instant, and sweeping/
+        broadcasting them one at a time is the O(n·k) path the soak
+        measures.
+
+        The pending entry pins the NodeInfo INCARNATION it observed:
+        a node that re-registers inside the coalesce window installs a
+        fresh NodeInfo, and the deferred sweep must not mark the new
+        registration dead (the node would believe it is registered and
+        never retry — a permanently wrong cluster view). ``conn_id``
+        (connection-loss deaths) closes the remaining hole: a DELAYED
+        disconnect of a connection the node has already replaced
+        observes the FRESH incarnation here, so the death only counts
+        if the dying connection still owns the registration."""
+        from ray_tpu._private.config import get_config
+
+        incarnation = self.nodes.get(node_id)
+        if conn_id is not None and incarnation is not None \
+                and incarnation.conn_id != conn_id:
+            return   # superseded connection: the node re-registered
+        window = float(get_config("gcs_death_coalesce_window_s"))
+        if window <= 0:
+            self._mark_nodes_dead({node_id: (reason, incarnation)})
+            return
+        with self._death_lock:
+            # plain assignment, not setdefault: a die→re-register→die
+            # sequence inside one window must pin the FRESHEST
+            # incarnation or the sweep's identity check would skip the
+            # second death and leave the node alive forever
+            self._pending_deaths[node_id] = (reason, incarnation)
+            if self._death_flusher_active:
+                return   # an open window will sweep this death too
+            self._death_flusher_active = True
+        threading.Thread(target=self._death_flush_after, args=(window,),
+                         daemon=True, name="gcs-death-flush").start()
+
+    def _death_flush_after(self, window: float):
+        time.sleep(window)
+        with self._death_lock:
+            deaths, self._pending_deaths = self._pending_deaths, {}
+            self._death_flusher_active = False
+        if deaths:
+            self._mark_nodes_dead(deaths)
+
+    def _mark_nodes_dead(self, deaths: dict):
+        """Sweep + fan out a set of node deaths. ``deaths`` maps
+        node_id -> (reason, observed NodeInfo-or-None): an entry only
+        applies if the table still holds the SAME NodeInfo object —
+        a re-registration (always a fresh NodeInfo) between observation
+        and this sweep supersedes the death. ONE locked pass covers
+        the whole batch (the owned-value sweep walks object_locations
+        once, not once per death), and the broadcast happens OFF-lock on
+        a snapshot of the transitions — at 100 nodes × many refs the
+        old under-lock per-death walk is exactly what RTL101 exists to
+        keep out of hot control paths. A batch of >=
+        ``gcs_death_batch_min`` deaths fans out as ONE coalesced
+        ``batch_dead`` message + ``NODE_BATCH_DEAD`` event instead of
+        per-death broadcasts."""
+        from ray_tpu._private.config import get_config
+
         to_restart: list[bytes] = []
+        fanout: list[tuple[str, dict]] = []   # deferred (channel, message)
+        dead: dict[str, str] = {}
         with self._lock:
-            node = self.nodes.get(node_id)
-            if node is None or not node.alive:
+            for node_id, (reason, incarnation) in deaths.items():
+                node = self.nodes.get(node_id)
+                if node is None or not node.alive:
+                    continue
+                if node is not incarnation:
+                    continue   # re-registered since the death was seen
+                node.alive = False
+                self._persist_node(node)
+                self._reregistered.discard(node_id)
+                dead[node_id] = reason
+            if not dead:
                 return
-            node.alive = False
-            # Objects whose only copies were there are gone — record them as
-            # lost. Owners consume this signal in CoreWorker._fetch_bytes /
-            # rpc_get_owned_value: if they hold lineage for the object they
-            # re-execute the creating task (worker_runtime._maybe_reconstruct,
+            dead_ids = set(dead)
+            # Objects whose only copies were there are gone — record them
+            # as lost. Owners consume this signal in CoreWorker._fetch_bytes
+            # / rpc_get_owned_value: if they hold lineage for the object
+            # they re-execute the creating task (_maybe_reconstruct,
             # reference object_recovery_manager.h:30), else ObjectLostError.
-            for oid, locs in list(self.object_locations.items()):
-                locs.discard(node_id)
-                if not locs and oid in self.object_sizes:
-                    self.lost_objects.add(oid)
+            for oid, locs in self.object_locations.items():
+                if locs & dead_ids:
+                    locs -= dead_ids
+                    if not locs and oid in self.object_sizes:
+                        self.lost_objects.add(oid)
             for actor in self.actors.values():
-                if actor.node_id != node_id:
+                if actor.node_id not in dead_ids:
                     continue
                 if actor.state in ("ALIVE", "PENDING_CREATION"):
                     decision = self._on_actor_failure(
-                        actor, f"node {node_id} died: {reason}")
+                        actor, f"node {actor.node_id} died: "
+                               f"{dead[actor.node_id]}", fanout=fanout)
                     if decision.get("restart"):
                         to_restart.append(actor.actor_id)
                 elif actor.state == "RESTARTING":
-                    # Its restart was being driven by the raylet that just
-                    # died — re-drive on a survivor without charging another
-                    # restart against the budget.
+                    # Its restart was being driven by a raylet that just
+                    # died — re-drive on a survivor without charging
+                    # another restart against the budget.
                     to_restart.append(actor.actor_id)
             for pg in self.placement_groups.values():
-                if node_id in pg.bundle_nodes:
+                if pg.state in ("CREATED", "PENDING") and \
+                        any(n in dead_ids for n in pg.bundle_nodes):
                     pg.state = "RESCHEDULING"
                     self._persist_pg(pg)
-        self._publish("nodes", {"event": "dead", "node_id": node_id,
-                                "reason": reason})
-        _events.record("node_state", node_id=node_id, state="DEAD",
-                       reason=reason)
-        # The dead node's raylet can't re-create its actors — pick a
+        # ---- fanout, OFF the GCS lock, on the snapshot above ----
+        t0 = time.monotonic()
+        batch_min = max(2, int(get_config("gcs_death_batch_min")))
+        node_ids = sorted(dead)
+        if len(dead) >= batch_min:
+            self._publish("nodes", {"event": "batch_dead",
+                                    "node_ids": node_ids,
+                                    "reasons": dict(dead)})
+            _events.record("NODE_BATCH_DEAD", node_ids=node_ids,
+                           count=len(node_ids),
+                           reasons=sorted(set(dead.values())))
+            # per-node lifecycle events STILL fire (ring appends are
+            # ~µs): consumers pairing ALIVE/DEAD node_state events
+            # (`ray-tpu events --kind node_state`) must not see
+            # batched nodes as alive-forever — only the per-death
+            # BROADCAST is coalesced
+            for node_id in node_ids:
+                _events.record("node_state", node_id=node_id,
+                               state="DEAD", reason=dead[node_id],
+                               batched=True)
+            with self._death_lock:
+                st = self._fanout_stats
+                st["death_batches"] += 1
+                st["deaths_coalesced"] += len(dead)
+                st["max_death_batch"] = max(st["max_death_batch"],
+                                            len(dead))
+        else:
+            for node_id in node_ids:
+                self._publish("nodes", {"event": "dead",
+                                        "node_id": node_id,
+                                        "reason": dead[node_id]})
+                _events.record("node_state", node_id=node_id,
+                               state="DEAD", reason=dead[node_id])
+        # deferred actor transitions: batched per channel through
+        # publish_many (one Publisher lock hold + wakeup per channel,
+        # not per transition) + per-message conn pushes
+        by_channel: dict[str, list] = {}
+        for channel, message in fanout:
+            by_channel.setdefault(channel, []).append(message)
+        for channel, messages in by_channel.items():
+            self._long_poll.publish_many(channel, messages)
+            for conn_msg in messages:
+                self._push_subscribers(channel, conn_msg)
+        from ray_tpu._private import telemetry as _tm
+
+        fanout_s = time.monotonic() - t0
+        with self._death_lock:
+            self._fanout_stats["last_fanout_s"] = fanout_s
+        if _tm.ENABLED:
+            _tm.observe("ray_tpu_gcs_death_fanout_seconds", fanout_s)
+        # The dead nodes' raylets can't re-create their actors — pick a
         # surviving raylet to do it (reference: GcsActorScheduler re-leases
         # from another node, gcs_actor_scheduler.h).
         for actor_id in to_restart:
@@ -274,14 +439,42 @@ class GcsServer:
 
     def rpc_register_node(self, conn, node_id: str, addr, resources: dict,
                           meta: dict):
-        with self._lock:
-            self.nodes[node_id] = NodeInfo(node_id, addr, resources, meta)
-            conn.meta["node_id"] = node_id
-        self._publish("nodes", {"event": "alive", "node_id": node_id,
-                                "snapshot": self.nodes[node_id].snapshot()})
-        _events.record("node_state", node_id=node_id, state="ALIVE",
-                       hostname=meta.get("hostname"))
-        return {"cluster_id": self.cluster_id}
+        # Bounded admission: a reconnect storm (GCS restart at 100
+        # nodes) otherwise runs 100 registration bodies + "alive"
+        # publish fanouts concurrently. Excess registrations QUEUE on
+        # the gate — register_node is retry-safe, so a client whose
+        # wait exceeds its RPC timeout simply retries under its policy.
+        from ray_tpu._private.config import get_config
+
+        throttled = not self._register_gate.acquire(blocking=False)
+        if throttled:
+            with self._death_lock:
+                self._fanout_stats["register_throttled"] += 1
+            from ray_tpu._private import telemetry as _tm
+
+            if _tm.ENABLED:
+                _tm.counter_inc("ray_tpu_gcs_register_throttled_total")
+            if not self._register_gate.acquire(
+                    timeout=float(get_config("gcs_rpc_timeout_s"))):
+                raise TimeoutError(
+                    "GCS registration admission timed out under a "
+                    "registration storm; retry")
+        try:
+            with self._lock:
+                node = NodeInfo(node_id, addr, resources, meta)
+                node.conn_id = getattr(conn, "id", None)
+                self.nodes[node_id] = node
+                conn.meta["node_id"] = node_id
+                self._reregistered.add(node_id)
+                self._persist_node(node)
+                snapshot = node.snapshot()
+            self._publish("nodes", {"event": "alive", "node_id": node_id,
+                                    "snapshot": snapshot})
+            _events.record("node_state", node_id=node_id, state="ALIVE",
+                           hostname=meta.get("hostname"))
+            return {"cluster_id": self.cluster_id}
+        finally:
+            self._register_gate.release()
 
     def rpc_report_resources(self, conn, node_id: str, available: dict,
                              pending_demand: list | None = None,
@@ -345,6 +538,19 @@ class GcsServer:
     def rpc_get_nodes(self, conn):
         with self._lock:
             return [n.snapshot() for n in self.nodes.values()]
+
+    def rpc_get_node_addr(self, conn, node_id: str):
+        """Single-node address lookup — the hot consumers (raylet
+        spillback/PG target resolution, remote lease return) used to
+        pull the FULL node table to resolve one id, an O(n)-payload
+        round trip per call that the 100-node soak turns into the
+        dominant control-plane traffic. Returns (host, port) or None
+        when the node is unknown/dead."""
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return None
+            return tuple(node.addr)
 
     def rpc_cluster_resources(self, conn):
         with self._lock:
@@ -518,10 +724,23 @@ class GcsServer:
         if actor.name and self.named_actors.get(
                 (actor.namespace, actor.name)) == actor.actor_id:
             del self.named_actors[(actor.namespace, actor.name)]
+        # terminal transitions also retire the re-announce bookkeeping:
+        # keyed by actor id with no other removal path, this set grew by
+        # one entry per actor for the GCS lifetime (the RTL106 class)
+        self._reannounced.discard(actor.actor_id)
 
-    def _on_actor_failure(self, actor: ActorInfo, reason: str):
+    def _on_actor_failure(self, actor: ActorInfo, reason: str,
+                          fanout: list | None = None):
         """Returns restart decision; caller-side raylet re-creates. Mirrors
-        GcsActorManager::ReconstructActor (gcs_actor_manager.h:495)."""
+        GcsActorManager::ReconstructActor (gcs_actor_manager.h:495).
+
+        ``fanout`` (batch node-death path) collects the pubsub messages
+        for the caller to publish AFTER releasing the GCS lock — a mass
+        kill transitions many actors, and pushing each to 100
+        subscribers while holding the table lock stalls every control
+        RPC behind socket writes."""
+        emit = (fanout.append if fanout is not None
+                else lambda cm: self._publish(*cm))
         max_restarts = actor.spec.get("max_restarts", 0)
         if actor.state == "DEAD":
             return {"restart": False}
@@ -529,8 +748,9 @@ class GcsServer:
             actor.num_restarts += 1
             actor.state = "RESTARTING"
             actor.addr = None
-            self._publish("actors", {"event": "restarting",
-                                     "actor_id": actor.actor_id})
+            emit(("actors", {"event": "restarting",
+                             "actor_id": actor.actor_id,
+                             "reason": reason}))
             _events.record("actor_state", actor_id=actor.actor_id.hex(),
                            state="RESTARTING", reason=reason,
                            num_restarts=actor.num_restarts)
@@ -539,9 +759,9 @@ class GcsServer:
         actor.state = "DEAD"
         actor.death_cause = reason
         self._drop_name(actor)
-        self._publish("actors", {"event": "dead",
-                                 "actor_id": actor.actor_id,
-                                 "reason": reason})
+        emit(("actors", {"event": "dead",
+                         "actor_id": actor.actor_id,
+                         "reason": reason}))
         _events.record("actor_state", actor_id=actor.actor_id.hex(),
                        state="DEAD", reason=reason)
         self._persist_actor(actor)
@@ -829,6 +1049,12 @@ class GcsServer:
 
     def _publish(self, channel: str, message: dict):
         self._long_poll.publish(channel, message)
+        self._push_subscribers(channel, message)
+
+    def _push_subscribers(self, channel: str, message: dict):
+        """Conn-push half of a publish (the long-poll half is the
+        Publisher's); batch paths call the two separately so a storm
+        pays one Publisher lock hold via publish_many."""
         subs = list(self._subscribers.get(channel, ()))
         for conn in subs:
             if conn.alive:
@@ -843,6 +1069,29 @@ class GcsServer:
     def rpc_publish(self, conn, channel: str, message: dict):
         self._publish(channel, message)
         return True
+
+    # ---- snapshot-resync providers (pubsub gap recovery) --------------------
+
+    def _actors_resync_snapshot(self) -> list[dict]:
+        """Actor-table state for a death-watch subscriber reconverging
+        after a mailbox overflow/GC: the watcher re-reports anything
+        DEAD/RESTARTING through its callback (duplicate-tolerant by the
+        at-least-once contract), so a missed feed message can never
+        become a permanently missed death. Only DEAD/RESTARTING rows
+        ship — consumers ignore ALIVE rows, and the actor table retains
+        dead actors for the cluster lifetime, so an unfiltered snapshot
+        would grow (and be re-reported) with cluster AGE rather than
+        with the gap being recovered."""
+        with self._lock:
+            return [{"actor_id": a.actor_id, "state": a.state,
+                     "reason": a.death_cause}
+                    for a in self.actors.values()
+                    if a.state in ("DEAD", "RESTARTING")]
+
+    def _nodes_resync_snapshot(self) -> list[dict]:
+        with self._lock:
+            return [{"node_id": n.node_id, "alive": n.alive}
+                    for n in self.nodes.values()]
 
     # ---- durable store (write-through fault tolerance) ----------------------
     # Reference: src/ray/gcs/store_client/redis_store_client.h — in
@@ -871,6 +1120,20 @@ class GcsServer:
             "strategy": pg.strategy, "name": pg.name, "state": pg.state,
             "bundle_nodes": pg.bundle_nodes}))
 
+    def _persist_node(self, node: "NodeInfo"):
+        """Node-table durability (reference: gcs_node_manager over the
+        Redis store). Without it a GCS restart FORGETS nodes that died
+        during the outage — they vanish from the table instead of being
+        marked dead, so no death broadcast ever reaches survivors and
+        their cluster views never reconverge (found by the 100-raylet
+        soak's restart-mid-storm phase)."""
+        if self._store is None:
+            return
+        self._store.put("nodes", node.node_id, pickle.dumps({
+            "node_id": node.node_id, "addr": node.addr,
+            "resources": node.resources, "meta": node.meta,
+            "alive": node.alive}))
+
     def _persist_meta(self):
         if self._store is None:
             return
@@ -892,12 +1155,24 @@ class GcsServer:
         actors = self._store.get_all("actors")
         pgs = self._store.get_all("pgs")
         kv = self._store.get_all("kv")
-        if meta is None and not actors and not pgs and not kv:
+        nodes = self._store.get_all("nodes")
+        if meta is None and not actors and not pgs and not kv \
+                and not nodes:
             return   # fresh store: nothing to restore
         if meta is not None:
             m = pickle.loads(meta)
             self.job_counter = m["job_counter"]
             self.cluster_id = m["cluster_id"]
+        for blob in nodes.values():
+            d = pickle.loads(blob)
+            info = NodeInfo(d["node_id"], d["addr"], d["resources"],
+                            d["meta"])
+            info.alive = d["alive"]
+            self.nodes[d["node_id"]] = info
+            # restored-alive is provisional: raylets re-register within
+            # the grace window; _reconcile_after_restart marks the rest
+            # dead through the normal death pipeline (broadcast + actor
+            # failover), so outage-window node deaths are NOT silent
         for blob in actors.values():
             d = pickle.loads(blob)
             info = ActorInfo(d["actor_id"], d["spec"])
@@ -926,6 +1201,21 @@ class GcsServer:
         time.sleep(self._recovery_grace_s)
         if self._server._stopped:
             return
+        # Nodes restored alive that never re-registered died during the
+        # outage: route them through the BATCH death pipeline (one
+        # sweep, coalesced broadcast) so survivors' death feeds hear
+        # about them — this is what makes the post-restart cluster view
+        # reconverge instead of silently forgetting the dead.
+        with self._lock:
+            # pin each death to the restored NodeInfo incarnation: a
+            # node re-registering between this snapshot and the sweep
+            # installs a FRESH NodeInfo, which the sweep's identity
+            # check treats as superseding the death
+            lost_nodes = {nid: ("lost across GCS restart", n)
+                          for nid, n in self.nodes.items()
+                          if n.alive and nid not in self._reregistered}
+        if lost_nodes:
+            self._mark_nodes_dead(lost_nodes)
         to_recreate: list[bytes] = []
         with self._lock:
             alive = {nid for nid, n in self.nodes.items() if n.alive}
@@ -1004,7 +1294,7 @@ class GcsServer:
 
     def rpc_debug_state(self, conn):
         with self._lock:
-            return {
+            out = {
                 "nodes": len(self.nodes),
                 "alive_nodes": sum(n.alive for n in self.nodes.values()),
                 "actors": len(self.actors),
@@ -1013,6 +1303,12 @@ class GcsServer:
                 "objects_tracked": len(self.object_locations),
                 "placement_groups": len(self.placement_groups),
             }
+        # control-plane scale counters (soak harness / `ray-tpu control`)
+        with self._death_lock:
+            out.update(self._fanout_stats)
+        out["pubsub_resyncs_served"] = self._long_poll.resyncs_served
+        out["pubsub_subscribers"] = self._long_poll.subscriber_count()
+        return out
 
 
 def main():  # pragma: no cover - exercised as a subprocess

@@ -186,10 +186,17 @@ class DriverLogPrinter:
     process's stdout/stderr as they arrive."""
 
     def __init__(self, gcs_addr, out=None, err=None):
-        from ray_tpu._private.protocol import RpcClient
+        # ReconnectingRpcClient, same reasoning as watch_actor_deaths
+        # (PR 5 round 4): a fault-tolerant-mode GCS restart would
+        # otherwise permanently and silently kill the driver's log
+        # stream — the poll loop erroring forever on a dead socket. On
+        # heal, the unknown-subscriber KeyError drives the Subscriber's
+        # own re-announce. (Lost log lines stay lost: logs need no
+        # snapshot-resync, unlike the death feed.)
+        from ray_tpu._private.protocol import ReconnectingRpcClient
         from ray_tpu._private.pubsub import Subscriber
 
-        self._rpc = RpcClient(tuple(gcs_addr))
+        self._rpc = ReconnectingRpcClient(tuple(gcs_addr))
         self._sub = Subscriber(self._rpc, poll_timeout=5.0)
         self._out = out or sys.stdout
         self._err = err or sys.stderr

@@ -800,6 +800,22 @@ class ReconnectingRpcClient:
         self._policy = None   # default-timeout RetryPolicy, built lazily
 
     def _reconnect(self):
+        # Herd damping (cluster soak, PR 12): when the endpoint
+        # RESTARTS, every client in the cluster observes ConnectionLost
+        # in the same instant — 100 nodes dialing + replaying
+        # registration simultaneously is the thundering herd the
+        # registration-admission gate then has to absorb. A full-jitter
+        # pause decorrelates the arrivals. The sleep happens OUTSIDE
+        # the heal lock (holding it would serialize, not decorrelate,
+        # and park every caller behind one sleeper) and is skipped when
+        # another thread already healed the channel.
+        if self._client.closed and not self._shutdown:
+            from ray_tpu._private.config import get_config
+            from ray_tpu._private.retry import full_jitter
+
+            pause = full_jitter(float(get_config("gcs_reconnect_jitter_s")))
+            if pause > 0 and self._client.closed:
+                time.sleep(pause)
         with self._lock:
             if self._shutdown:
                 raise ConnectionLost("client shut down")

@@ -15,7 +15,14 @@ Semantics (matching publisher.h):
   `after_seq` are pruned, anything above re-delivers (at-least-once);
 - subscribers are garbage-collected after `subscriber_timeout_s` with no
   poll AND no poll currently parked (the reference GCs on connection
-  death; a long-poller's liveness signal IS the poll).
+  death; a long-poller's liveness signal IS the poll);
+- channels may register a SNAPSHOT PROVIDER (``set_snapshot_provider``):
+  a subscriber whose mailbox overflowed past the gap counter, or whose
+  mailbox was GC'd while it was away, can ``rpc_psub_resync`` — one
+  call that re-registers it and returns the channel's current state
+  snapshot plus the seq floor to resume from, so a slow consumer
+  reconverges from state instead of permanently missing the dropped
+  head of the stream (the 100-subscriber soak's backlog-pressure fix).
 """
 from __future__ import annotations
 
@@ -45,6 +52,20 @@ class Publisher:
         #            "last_seen": float, "waiters": int}
         self._subs: dict[str, dict] = {}
         self._seq = 0
+        # channel -> zero-arg callable returning a state snapshot for
+        # gap-resync (owners register; absent = resync returns None)
+        self._snapshot_providers: dict[str, object] = {}
+        self.resyncs_served = 0
+
+    def set_snapshot_provider(self, channel: str, provider):
+        """Register ``provider()`` as the channel's resync source. The
+        provider is called OUTSIDE the publisher lock (it usually reads
+        the owning service's tables under that service's own lock)."""
+        self._snapshot_providers[channel] = provider
+
+    def subscriber_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
 
     # ---------------------------------------------------------- subscriber
     def subscribe(self, channels: list[str], sub_id: str | None = None) -> str:
@@ -104,10 +125,22 @@ class Publisher:
     # ------------------------------------------------------------ publisher
     def publish(self, channel: str, message) -> int:
         """Deliver to every subscriber of `channel`; returns the seq."""
+        return self.publish_many(channel, (message,))
+
+    def publish_many(self, channel: str, messages) -> int:
+        """Coalesced delivery: append every message to each subscriber's
+        mailbox under ONE lock hold with ONE wakeup, instead of paying
+        the per-subscriber walk + notify_all per message (at 100
+        subscribers a 10-death storm is 100 mailbox walks either way,
+        but 1000 → 100 lock/notify rounds). Returns the LAST seq."""
         now = time.monotonic()
         overflow = 0
+        messages = list(messages)
+        if not messages:
+            return self._seq
         with self._cond:
-            self._seq += 1
+            first_seq = self._seq + 1
+            self._seq += len(messages)
             seq = self._seq
             stale = []
             for sub_id, sub in self._subs.items():
@@ -117,7 +150,9 @@ class Publisher:
                     stale.append(sub_id)
                     continue
                 if channel in sub["channels"]:
-                    sub["mail"].append((seq, channel, message))
+                    sub["mail"].extend(
+                        (first_seq + i, channel, m)
+                        for i, m in enumerate(messages))
                     if len(sub["mail"]) > self.max_mailbox:
                         # drop-oldest; slow consumers never block
                         # publishers — but the loss is COUNTED so the
@@ -172,6 +207,40 @@ class Publisher:
                 sub["dropped"] = 0
         return mail, max_seq, dropped
 
+    def rpc_psub_resync(self, conn, sub_id: str, channels: list):
+        """Snapshot-resync for a subscriber that detected a gap (mailbox
+        overflow past the poll reply's dropped count, or a publisher-side
+        GC while it was away): re-register the subscriber, CLEAR its
+        mailbox, and return ``(seq_floor, {channel: snapshot})`` — state
+        captured at-or-after the floor, so resuming polls from
+        ``seq_floor`` re-delivers anything newer than the snapshot
+        (at-least-once; consumers already tolerate duplicates). Channels
+        without a registered provider map to None."""
+        with self._lock:
+            self._register_locked(channels, sub_id)
+            sub = self._subs.get(sub_id)
+            if sub is not None:
+                sub["mail"] = []
+                sub["dropped"] = 0
+            seq_floor = self._seq
+            providers = {ch: self._snapshot_providers.get(ch)
+                         for ch in channels}
+            self.resyncs_served += 1
+        # providers run OUTSIDE the publisher lock: they read the owning
+        # service's tables under that service's own lock, and state read
+        # after the floor only makes the snapshot fresher (messages
+        # between floor and the read re-deliver on the next poll)
+        snapshots = {}
+        for ch, provider in providers.items():
+            if provider is None:
+                snapshots[ch] = None
+                continue
+            try:
+                snapshots[ch] = provider()
+            except Exception:
+                snapshots[ch] = None
+        return seq_floor, snapshots
+
 
 class Subscriber:
     """Client side: a polling thread delivering messages to callbacks.
@@ -187,9 +256,19 @@ class Subscriber:
     believing the stream was contiguous (advisor finding, round 3).
     Mailbox-overflow drops at the publisher (slow consumer) are reported
     the same way via the poll reply's dropped count.
+
+    With ``auto_resync=True`` every detected gap additionally triggers a
+    snapshot-resync (``psub_resync``): the publisher clears the mailbox,
+    hands back the current per-channel state snapshot, and the
+    subscriber delivers it to each channel's callbacks as a synthetic
+    ``{"event": "resync", "snapshot": ...}`` message — so consumers
+    reconverge from state instead of permanently missing whatever
+    overflowed or was GC'd (``on_gap`` still fires first, and
+    ``resync_count`` counts the recoveries).
     """
 
-    def __init__(self, rpc_client, poll_timeout: float = 10.0, on_gap=None):
+    def __init__(self, rpc_client, poll_timeout: float = 10.0, on_gap=None,
+                 auto_resync: bool = False):
         self._rpc = rpc_client
         self._poll_timeout = poll_timeout
         self._callbacks: dict[str, list] = {}
@@ -197,7 +276,9 @@ class Subscriber:
         self._sub_id: str | None = None
         self._last_seq = 0
         self._on_gap = on_gap
+        self._auto_resync = auto_resync
         self.gap_count = 0
+        self.resync_count = 0
         # bumped by every _announce_locked resync: a poll that was already
         # in flight when the floor moved must not write its stale max_seq
         # back over the resynced _last_seq
@@ -244,11 +325,53 @@ class Subscriber:
         return 0
 
     def _note_gap(self, gap: int):
-        if gap:
-            self.gap_count += 1
-            if self._on_gap is not None:
+        if not gap:
+            return
+        self.gap_count += 1
+        if self._on_gap is not None:
+            try:
+                self._on_gap(gap)
+            except Exception:
+                pass
+        if self._auto_resync:
+            try:
+                self._resync()
+            except Exception:
+                pass   # next gap (or poll failure) retries
+
+    def _resync(self):
+        """Snapshot-resync after a detected gap: fetch the per-channel
+        state snapshots, move the seq floor, and deliver each snapshot
+        to its channel's callbacks as a synthetic resync message. Runs
+        on whichever thread detected the gap (poll loop, or the caller
+        of subscribe()); the RPC happens OUTSIDE self._lock."""
+        with self._lock:
+            sub_id = self._sub_id
+            channels = list(self._callbacks)
+        if sub_id is None or not channels:
+            return
+        seq_floor, snapshots = self._rpc.call(
+            "psub_resync", sub_id=sub_id, channels=channels)
+        with self._lock:
+            self._last_seq = seq_floor
+            self._floor_epoch += 1
+            deliver = [(ch, list(self._callbacks.get(ch, ())))
+                       for ch in channels]
+        self.resync_count += 1
+        from ray_tpu._private import events as _events
+
+        _events.record("PUBSUB_RESYNC", channels=channels,
+                       seq_floor=seq_floor, resync_count=self.resync_count)
+        from ray_tpu._private import telemetry as _tm
+
+        if _tm.ENABLED:
+            _tm.counter_inc("ray_tpu_pubsub_resyncs_total")
+        for ch, cbs in deliver:
+            msg = {"event": "resync", "channel": ch,
+                   "snapshot": snapshots.get(ch)}
+            for cb in cbs:
                 try:
-                    self._on_gap(gap)
+                    cb(msg)
                 except Exception:
                     pass
 
@@ -299,7 +422,6 @@ class Subscriber:
                     # max_seq meaningless in the new seq space
                     if self._floor_epoch == epoch:
                         self._last_seq = max_seq
-                self._note_gap(dropped)   # mailbox-overflow losses
                 failures = 0
             except Exception:
                 if self._stopped.is_set():
@@ -324,6 +446,12 @@ class Subscriber:
                         cb(message)
                     except Exception:
                         pass
+            # gap handling (and its auto-resync snapshot) AFTER the
+            # in-hand mail: a resync floor covers these messages' seqs,
+            # so delivering a retained stale message after the snapshot
+            # would let it overwrite fresher snapshot state at a
+            # last-writer-wins consumer with no re-delivery to correct it
+            self._note_gap(dropped)   # mailbox-overflow losses
 
 
 class ActorDeathWatch:
@@ -350,7 +478,8 @@ class ActorDeathWatch:
                 pass
 
 
-def watch_actor_deaths(on_death, poll_timeout: float = 5.0):
+def watch_actor_deaths(on_death, poll_timeout: float = 5.0,
+                       gcs_addr=None):
     """Subscribe to the GCS actor-lifecycle feed from this process and
     invoke ``on_death(actor_id, reason)`` for every actor death or
     out-from-under restart. The one place that knows the feed's event
@@ -360,6 +489,8 @@ def watch_actor_deaths(on_death, poll_timeout: float = 5.0):
     subscription. Returns an ``ActorDeathWatch`` (call ``stop()``), or
     ``None`` when no worker runtime is attached to this process;
     transport errors propagate so callers choose their degraded mode.
+    ``gcs_addr`` overrides the attached worker's GCS (the scale soak
+    opens 100 watches against a harness GCS with no worker runtime).
 
     The connection is a ``ReconnectingRpcClient``: the GCS may RESTART
     in fault-tolerant mode, and a plain client would leave this watch
@@ -368,20 +499,40 @@ def watch_actor_deaths(on_death, poll_timeout: float = 5.0):
     rank-death detection would silently degrade to op-timeout-only. On
     heal, the poll's unknown-subscriber KeyError drives the
     Subscriber's own re-announce, restoring the feed.
+
+    The subscription rides ``auto_resync``: a mailbox overflow or a
+    GC'd subscription (a death STORM outpacing this consumer, or a GCS
+    restart losing the mailbox) resyncs against the GCS actor-table
+    snapshot, and any actor the snapshot shows DEAD/RESTARTING is
+    re-reported through ``on_death`` — so a watcher can miss feed
+    messages but never a death (consumers are duplicate-tolerant by
+    the at-least-once contract).
     """
     from ray_tpu._private.protocol import ReconnectingRpcClient
-    from ray_tpu._private.worker_runtime import current_worker
 
-    worker = current_worker()
-    if worker is None:
-        return None
-    rpc = ReconnectingRpcClient(tuple(worker.gcs.addr), timeout=30.0)
+    if gcs_addr is None:
+        from ray_tpu._private.worker_runtime import current_worker
+
+        worker = current_worker()
+        if worker is None:
+            return None
+        gcs_addr = worker.gcs.addr
+    rpc = ReconnectingRpcClient(tuple(gcs_addr), timeout=30.0)
     try:
-        sub = Subscriber(rpc, poll_timeout=poll_timeout)
+        sub = Subscriber(rpc, poll_timeout=poll_timeout, auto_resync=True)
 
         def _cb(msg):
-            if not isinstance(msg, dict) or \
-                    msg.get("event") not in ("dead", "restarting"):
+            if not isinstance(msg, dict):
+                return
+            if msg.get("event") == "resync":
+                for row in (msg.get("snapshot") or ()):
+                    if row.get("state") in ("DEAD", "RESTARTING") and \
+                            row.get("actor_id") is not None:
+                        on_death(row["actor_id"],
+                                 str(row.get("reason")
+                                     or row["state"].lower()))
+                return
+            if msg.get("event") not in ("dead", "restarting"):
                 return
             actor_id = msg.get("actor_id")
             if actor_id is None:

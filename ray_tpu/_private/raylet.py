@@ -648,6 +648,12 @@ class Raylet:
                 lease = self._leases.pop(handle.assigned_lease, None)
             if lease:
                 self._give_back(lease.resources)
+        if not handle.is_actor:
+            # retire the OOM-kill attribution for non-actor victims —
+            # only the actor death path consumed it, so every task-worker
+            # OOM kill leaked one reason string per worker id (RTL106
+            # class: keyed by worker id, no removal on this death path)
+            self._oom_reasons.pop(worker_id, None)
         # Leases this worker REQUESTED (as lessee) die with it: its
         # submission queues can never return them.
         self._release_leases_of_lessee(worker_id)
@@ -885,14 +891,16 @@ class Raylet:
         return self._grant({}, lessee)  # bundle resources were pre-reserved
 
     def _node_addr(self, node_id: str):
+        """Resolve one node's raylet address. Rides the O(1)
+        ``get_node_addr`` RPC — the old full-table pull paid an
+        O(cluster) payload per PG-target/spillback resolution, which at
+        100 nodes made this the dominant GCS read traffic (soak
+        round 12)."""
         try:
-            nodes = self._gcs.call("get_nodes")
+            addr = self._gcs.call("get_node_addr", node_id=node_id)
         except ConnectionLost:
             return None
-        for n in nodes:
-            if n["NodeID"] == node_id and n["Alive"]:
-                return (n["NodeManagerAddress"], n["NodeManagerPort"])
-        return None
+        return tuple(addr) if addr else None
 
     def rpc_return_worker(self, conn, lease_id: str,
                           dispose: bool = False):

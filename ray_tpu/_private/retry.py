@@ -60,8 +60,13 @@ RETRY_SAFE_RPCS = frozenset({
     # replay mints a FRESH id — wastes one, ids stay unique
     "next_job_id",
     # pubsub: at-least-once by contract (subscribers dedup by seq floor);
-    # a duplicated publish is a duplicate delivery consumers tolerate
+    # a duplicated publish is a duplicate delivery consumers tolerate.
+    # psub_resync replayed just re-registers + re-snapshots (the floor
+    # moves forward, newer state only re-delivers)
     "publish", "psub_subscribe", "psub_unsubscribe", "psub_poll",
+    "psub_resync",
+    # single-node address lookup: pure read (gcs.rpc_get_node_addr)
+    "get_node_addr",
     # raylet: a lease grant whose reply was lost leaks a lease the
     # lessee-GC reaps (worker death / remote-lessee sweep); return is
     # idempotent by lease_id
@@ -161,6 +166,15 @@ def default_budget() -> RetryBudget:
     return _default_budget
 
 
+def full_jitter(cap_s: float) -> float:
+    """One full-jitter draw: ``uniform(0, cap_s)``. The herd-damping
+    primitive shared by the backoff policy and the reconnect path
+    (ReconnectingRpcClient sleeps this before re-dialing a restarted
+    endpoint, so 100 clients that lost the same connection in the same
+    instant don't re-arrive in the same instant either)."""
+    return random.uniform(0.0, cap_s) if cap_s > 0 else 0.0
+
+
 # -------------------------------------------------------------------- policy
 
 
@@ -208,7 +222,7 @@ class RetryPolicy:
         past ~60 doublings every base overshoots max_backoff_s anyway."""
         cap = min(self.max_backoff_s,
                   self.base_backoff_s * (2 ** min(60, max(0, attempt - 1))))
-        return random.uniform(0.0, cap)
+        return full_jitter(cap)
 
     def run(self, fn, *, method: str | None = None,
             retry_on: tuple = (), describe: str = ""):

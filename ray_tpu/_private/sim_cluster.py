@@ -1,0 +1,510 @@
+"""Cluster-scale soak harness: O(100) simulated raylets, one process.
+
+The control plane has only ever seen single-digit raylets; the Ray
+paper's GCS/distributed-scheduler design (PAPERS.md, arXiv:1712.05889
+§4) is sized for thousands. This module stands up production node
+counts CHEAPLY: every simulated raylet holds REAL RPC connections to a
+real GCS (registration, heartbeats via ``report_resources`` pushes, a
+conn-push ``nodes`` subscription, and a long-poll death-watch
+subscription riding the same ``Subscriber``/``psub_*`` machinery real
+consumers use) — but spawns no worker processes and runs no object
+store, so one driver process soaks a 100-node control plane.
+
+Chaos rides the fault-injection DSL's node-level primitives
+(``kill_node`` / ``flap_node``, fault_injection.py): the DRIVER LOOP
+consults ``FaultInjector.on_node(tag, method)`` for every node at
+deterministic tick boundaries, so a seeded schedule like
+``kill_node:*.mass_kill:p0.1`` kills a deterministic ~10% of the fleet
+simultaneously, and two runs with the same seed produce byte-identical
+chaos journals (``journal_text()`` — the reproducibility artifact; all
+wall-clock measurements live in ``metrics``, never in the journal).
+
+What the soak PROVES (the pass criteria asserted by
+``tests/test_zz_soak.py`` and measured by ``benchmarks/soak_bench.py``):
+
+- **no lost accepted leases** — every lease a surviving raylet accepted
+  is still in its ledger AND durably recorded in GCS KV after the storm
+  (kv writes ride the retry plane across the GCS restart);
+- **no permanently dead subscriptions** — every death watch either saw
+  every death through the feed or reconverged via snapshot-resync /
+  rejoin reconciliation (``deaths_seen`` covers the killed set);
+- **bounded reconvergence** — after the chaos window the GCS's alive
+  set equals the survivor set and a probe message published on the
+  feed reaches every survivor, within a measured window.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private.protocol import (ConnectionLost,
+                                       ReconnectingRpcClient, RpcClient)
+
+# sim raylets advertise tiny fake endpoints; nothing ever dials them
+_FAKE_PORT_BASE = 20000
+
+
+class SimRaylet:
+    """One lightweight simulated raylet: real GCS connections, no
+    workers. Driven synchronously by the cluster's tick loop."""
+
+    def __init__(self, cluster: "SimCluster", index: int):
+        self.cluster = cluster
+        self.index = index
+        self.tag = f"sim{index:03d}"
+        self.node_id = f"simnode-{index:03d}"
+        self.resources = {"CPU": 4.0}
+        self.state = "new"              # up / flapping / dead
+        self._rejoin_at_tick: int | None = None
+        self._gcs: ReconnectingRpcClient | None = None
+        self._watch = None              # ActorDeathWatch (prod code path)
+        self._sub = None                # nodes-channel long-poll Subscriber
+        self._sub_rpc = None
+        self._lock = threading.Lock()
+        # node_id -> monotonic time this raylet FIRST observed the death
+        # (conn-push, long-poll feed, resync snapshot, or rejoin
+        # reconciliation — whichever lands first)
+        self.deaths_seen: dict[str, float] = {}
+        self.actor_deaths_seen: set = set()
+        self.probes_seen: set = set()
+        self.accepted_leases: dict[str, dict] = {}
+        self._lease_counter = 0
+        self._watching_actors = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        self._gcs = ReconnectingRpcClient(
+            self.cluster.gcs_addr, timeout=15.0,
+            on_push=self._on_push,
+            on_reconnect=self._replay_registration)
+        self._replay_registration(self._gcs)
+        from ray_tpu._private.pubsub import Subscriber
+
+        self._sub_rpc = ReconnectingRpcClient(self.cluster.gcs_addr,
+                                              timeout=15.0)
+        self._sub = Subscriber(self._sub_rpc,
+                               poll_timeout=self.cluster.poll_timeout,
+                               auto_resync=True)
+        self._sub.subscribe("nodes", self._on_feed)
+        self.state = "up"
+
+    def _replay_registration(self, gcs):
+        """Initial registration AND the reconnect replay after a GCS
+        restart (the same contract as Raylet._replay_gcs_registration)."""
+        gcs.call("register_node", node_id=self.node_id,
+                 addr=("127.0.0.1", _FAKE_PORT_BASE + self.index),
+                 resources=self.resources,
+                 meta={"hostname": self.tag, "sim": True})
+        gcs.call("subscribe", channels=["nodes"])
+
+    def _teardown_connections(self):
+        for c in (self._watch, self._sub):
+            if c is not None:
+                try:
+                    c.stop()
+                except Exception:
+                    pass
+        for c in (self._sub_rpc, self._gcs):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        self._watch = self._sub = self._sub_rpc = self._gcs = None
+
+    def kill(self):
+        """kill_node: tear down every connection (the GCS observes the
+        disconnect and marks this node dead) and never re-register."""
+        self.state = "dead"
+        self._teardown_connections()
+
+    def flap(self, down_ticks: int):
+        """flap_node: disconnect now, re-register after ``down_ticks``
+        driver ticks."""
+        self.state = "flapping"
+        self._rejoin_at_tick = self.cluster.tick_count + max(1, down_ticks)
+        self._teardown_connections()
+
+    def _rejoin(self):
+        self.start()
+        if self._watching_actors:
+            # flap() tore the death watch down with the rest of the
+            # connections — a rejoined node must reopen it or the
+            # harness itself would carry the dead-subscription defect
+            # the soak exists to catch
+            self.watch_deaths_of_actors()
+        # reconcile the cluster view missed while away: deaths that
+        # happened during the outage are in the node table, not the
+        # (fresh) mailbox
+        try:
+            for n in self._gcs.call("get_nodes"):
+                if not n["Alive"]:
+                    self._note_death(n["NodeID"])
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- feeds
+    def _note_death(self, node_id: str):
+        with self._lock:
+            self.deaths_seen.setdefault(node_id, time.monotonic())
+
+    def _on_push(self, payload):
+        """Conn-push plane (the raylet's GCS reader thread analog)."""
+        try:
+            method, kwargs = payload
+        except Exception:
+            return
+        if method == "pubsub" and kwargs.get("channel") == "nodes":
+            self._consume_nodes_message(kwargs.get("message"))
+
+    def _on_feed(self, msg):
+        """Long-poll plane (Subscriber callback, incl. resync)."""
+        self._consume_nodes_message(msg)
+
+    def _consume_nodes_message(self, msg):
+        if not isinstance(msg, dict):
+            return
+        event = msg.get("event")
+        if event == "dead":
+            self._note_death(msg.get("node_id"))
+        elif event == "batch_dead":
+            for node_id in msg.get("node_ids", ()):
+                self._note_death(node_id)
+        elif event == "probe":
+            with self._lock:
+                self.probes_seen.add(msg.get("n"))
+        elif event == "resync":
+            for row in (msg.get("snapshot") or ()):
+                if isinstance(row, dict) and not row.get("alive", True):
+                    self._note_death(row.get("node_id"))
+
+    # ------------------------------------------------------------- driving
+    def tick(self):
+        """One driver-loop step: consult the chaos schedule at this
+        node's deterministic send boundary, then heartbeat."""
+        if self.state == "dead":
+            return
+        if self.state == "flapping":
+            if self.cluster.tick_count >= (self._rejoin_at_tick or 0):
+                self._rejoin()
+                self.cluster._journal(f"rejoin {self.tag}")
+            return
+        for action, param_s in self._consult("heartbeat"):
+            if action == "kill_node":
+                self.cluster._journal(f"kill_node {self.tag}")
+                self.kill()
+                return
+            if action == "flap_node":
+                ticks = max(1, int(round(
+                    param_s / self.cluster.tick_interval)))
+                self.cluster._journal(
+                    f"flap_node {self.tag} down_ticks={ticks}")
+                self.flap(ticks)
+                return
+        try:
+            self._gcs.push("report_resources", node_id=self.node_id,
+                           available=dict(self.resources),
+                           busy=len(self.accepted_leases))
+        except Exception:   # ConnectionLost while the GCS restarts —
+            pass            # the next tick's push heals the channel
+
+    def consult_mass(self, method: str) -> list[tuple[str, float]]:
+        """Driver-designated boundary (e.g. one ``mass_kill`` consult per
+        node at the same tick — the simultaneous-failure schedule)."""
+        if self.state != "up":
+            return []
+        return self._consult(method)
+
+    def _consult(self, method: str):
+        inj = _fi.ACTIVE
+        return inj.on_node(self.tag, method) if inj is not None else []
+
+    def accept_lease(self) -> str:
+        """Accept one simulated lease: ledger entry locally + a durable
+        GCS KV record (the write rides the retry plane, so a lease
+        accepted during a GCS restart is retried, not lost)."""
+        self._lease_counter += 1
+        lease_id = f"{self.tag}-L{self._lease_counter:04d}"
+        self.accepted_leases[lease_id] = {"CPU": 1.0}
+        self._gcs.call("kv_put", ns="soak_leases",
+                       key=lease_id.encode(), value=self.tag.encode())
+        return lease_id
+
+    def watch_deaths_of_actors(self):
+        """Open a production ``watch_actor_deaths`` against the harness
+        GCS (the PR 5 round-4 heal path, finally at fleet scale)."""
+        from ray_tpu._private.pubsub import watch_actor_deaths
+
+        def _on_death(actor_id, reason):
+            with self._lock:
+                self.actor_deaths_seen.add(actor_id)
+
+        self._watch = watch_actor_deaths(
+            _on_death, poll_timeout=self.cluster.poll_timeout,
+            gcs_addr=self.cluster.gcs_addr)
+        self._watching_actors = True
+
+    def stop(self):
+        if self.state != "dead":
+            self.state = "dead"
+            self._teardown_connections()
+
+
+class SimCluster:
+    """Owns the GCS (in-process object or subprocess) and the fleet.
+
+    ``n_nodes`` defaults to ``RAY_TPU_SOAK_NODES`` (100): the knob the
+    bench/CI use to scale the same harness from smoke (20) to the full
+    soak without editing code.
+    """
+
+    def __init__(self, n_nodes: int | None = None,
+                 tick_interval: float = 0.05,
+                 poll_timeout: float = 2.0,
+                 gcs: str = "inproc",
+                 store_path: str | None = None):
+        if n_nodes is None:
+            n_nodes = int(os.environ.get("RAY_TPU_SOAK_NODES", "100"))
+        self.n_nodes = n_nodes
+        self.tick_interval = tick_interval
+        self.poll_timeout = poll_timeout
+        self.tick_count = 0
+        self.journal: list[str] = []
+        self.metrics: dict = {}
+        self._gcs_mode = gcs
+        self._store_path = store_path
+        self._gcs_obj = None
+        self._gcs_proc = None
+        self.gcs_addr: tuple | None = None
+        self._probe_n = 0
+        self.raylets: list[SimRaylet] = []
+
+    # ------------------------------------------------------------------ GCS
+    def start(self):
+        self._start_gcs()
+        self.raylets = [SimRaylet(self, i) for i in range(self.n_nodes)]
+        for r in self.raylets:
+            r.start()
+        self._journal(f"start n={self.n_nodes} gcs={self._gcs_mode}")
+        return self
+
+    def _start_gcs(self, port: int = 0):
+        store = (f"sqlite:{self._store_path}" if self._store_path
+                 else None)
+        if self._gcs_mode == "inproc":
+            from ray_tpu._private.gcs import GcsServer
+
+            self._gcs_obj = GcsServer(port=port, store=store,
+                                      recovery_grace_s=1.0).start()
+            self.gcs_addr = tuple(self._gcs_obj.addr)
+            return
+        cmd = [sys.executable, "-m", "ray_tpu._private.gcs", str(port)]
+        if store:
+            cmd += ["--store", store, "--grace", "1.0"]
+        self._gcs_proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                          text=True)
+        line = self._gcs_proc.stdout.readline()
+        if not line.startswith("GCS_READY"):
+            raise RuntimeError(f"gcs subprocess failed: {line!r}")
+        host, _, p = line.split()[1].partition(":")
+        self.gcs_addr = (host, int(p))
+
+    def restart_gcs(self, downtime_s: float = 0.0):
+        """Stop the GCS (SIGKILL for the subprocess flavor) and bring a
+        fresh one up on the SAME port + store — the reconnect-storm
+        scenario every ReconnectingRpcClient in the fleet then heals
+        through (with jittered arrival, into the bounded admission
+        gate)."""
+        port = self.gcs_addr[1]
+        if self._gcs_obj is not None:
+            self._gcs_obj.stop()
+            self._gcs_obj = None
+        if self._gcs_proc is not None:
+            self._gcs_proc.kill()
+            self._gcs_proc.wait(5.0)
+            self._gcs_proc = None
+        if downtime_s:
+            time.sleep(downtime_s)
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                self._start_gcs(port=port)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)   # port still in TIME_WAIT teardown
+        self._journal("gcs_restart")
+
+    # ------------------------------------------------------------- driving
+    def _journal(self, line: str):
+        self.journal.append(f"t={self.tick_count} {line}")
+
+    def journal_text(self) -> str:
+        """The reproducibility artifact: chaos actions + deterministic
+        outcomes only, appended from the driver thread — byte-identical
+        across runs with the same seed/schedule/scale."""
+        return "\n".join(self.journal) + "\n"
+
+    def survivors(self) -> list[SimRaylet]:
+        return [r for r in self.raylets if r.state == "up"]
+
+    def dead_ids(self) -> set:
+        return {r.node_id for r in self.raylets if r.state == "dead"}
+
+    def run_ticks(self, n: int, leases_every: int = 0):
+        """Drive ``n`` ticks: each tick walks the fleet in index order
+        (chaos consults happen at these deterministic boundaries), and
+        every ``leases_every`` ticks each live raylet accepts one
+        lease."""
+        for _ in range(n):
+            self.tick_count += 1
+            for r in self.raylets:
+                r.tick()
+            if leases_every and self.tick_count % leases_every == 0:
+                for r in self.raylets:
+                    if r.state == "up":
+                        r.accept_lease()
+                self._journal(
+                    f"leases granted to {len(self.survivors())} nodes")
+            time.sleep(self.tick_interval)
+
+    def mass_consult(self, method: str = "mass_kill") -> dict[str, list]:
+        """Consult the schedule ONCE per node at this tick (the
+        simultaneous-failure boundary); apply kill/flap verdicts in
+        index order and journal them."""
+        self.tick_count += 1
+        verdicts: dict[str, list] = {}
+        t0 = time.monotonic()
+        for r in self.raylets:
+            fired = r.consult_mass(method)
+            if fired:
+                verdicts[r.tag] = fired
+        for r in self.raylets:
+            for action, param_s in verdicts.get(r.tag, ()):
+                if action == "kill_node":
+                    self._journal(f"kill_node {r.tag} ({method})")
+                    r.kill()
+                elif action == "flap_node":
+                    ticks = max(1, int(round(param_s / self.tick_interval)))
+                    self._journal(
+                        f"flap_node {r.tag} down_ticks={ticks} ({method})")
+                    r.flap(ticks)
+        self.metrics[f"{method}_initiated_at"] = t0
+        self._journal(f"{method} fired={sorted(verdicts)}")
+        return verdicts
+
+    # -------------------------------------------------------- convergence
+    def gcs_call(self, method: str, **kw):
+        client = RpcClient(self.gcs_addr, timeout=15.0)
+        try:
+            return client.call(method, **kw)
+        finally:
+            client.close()
+
+    def wait_converged(self, timeout: float = 30.0) -> dict:
+        """Block until the cluster view reconverges; returns the
+        measurement dict (also merged into ``metrics``):
+
+        - the GCS's alive set == the harness's survivor set,
+        - every survivor observed every dead node's death,
+        - a fresh probe published on the feed reaches every survivor
+          (long-poll subscriptions demonstrably healed, not just
+          presumed).
+        """
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        expect_dead = self.dead_ids()
+        survivors = self.survivors()
+        view_ok_at = feed_ok_at = None
+        while time.monotonic() < deadline:
+            if view_ok_at is None:
+                try:
+                    state = self.gcs_call("debug_state")
+                    if state["alive_nodes"] == len(survivors):
+                        view_ok_at = time.monotonic()
+                except Exception:
+                    pass
+            feed_ok = all(expect_dead <= set(r.deaths_seen)
+                          for r in survivors)
+            if feed_ok and feed_ok_at is None:
+                feed_ok_at = time.monotonic()
+            if view_ok_at is not None and feed_ok_at is not None:
+                break
+            time.sleep(0.05)
+        # probe: a message published NOW must reach every survivor —
+        # with its OWN time budget, so a slow view/feed convergence
+        # (reported above) can't leave the subscription-heal proof
+        # zero seconds to run
+        self._probe_n += 1
+        n = self._probe_n
+        probe_ok = False
+        probe_deadline = max(deadline, time.monotonic() + 10.0)
+        try:
+            self.gcs_call("publish", channel="nodes",
+                          message={"event": "probe", "n": n})
+            while time.monotonic() < probe_deadline:
+                if all(n in r.probes_seen for r in survivors):
+                    probe_ok = True
+                    break
+                time.sleep(0.05)
+        except Exception:
+            pass
+        out = {
+            "converged": view_ok_at is not None and feed_ok_at is not None
+            and probe_ok,
+            "view_s": (view_ok_at - t0) if view_ok_at else None,
+            "feed_s": (feed_ok_at - t0) if feed_ok_at else None,
+            "total_s": time.monotonic() - t0,
+            "probe_healed": probe_ok,
+        }
+        self.metrics["reconvergence"] = out
+        # journal only the deterministic fact that convergence was
+        # checked (and against how many deaths) — converged/probe are
+        # wall-clock races and live in `metrics`, or the byte-for-byte
+        # journal contract would flake on a loaded box
+        self._journal(f"convergence_checked dead={len(expect_dead)}")
+        return out
+
+    def fanout_latencies(self, initiated_at: float,
+                         dead_ids: set) -> list[float]:
+        """Per-(survivor, death) observation latency relative to the
+        kill initiation — the death-feed fanout distribution."""
+        out = []
+        for r in self.survivors():
+            for node_id in dead_ids:
+                t = r.deaths_seen.get(node_id)
+                if t is not None:
+                    out.append(t - initiated_at)
+        return out
+
+    def verify_leases(self) -> dict:
+        """The no-lost-accepted-leases proof: every survivor's ledger
+        entry must exist in GCS KV (durable across the restart)."""
+        keys = set(self.gcs_call("kv_keys", ns="soak_leases"))
+        missing = []
+        total = 0
+        for r in self.survivors():
+            for lease_id in r.accepted_leases:
+                total += 1
+                if lease_id.encode() not in keys:
+                    missing.append(lease_id)
+        out = {"accepted": total, "lost": sorted(missing)}
+        self.metrics["leases"] = out
+        self._journal(f"leases accepted={total} lost={len(missing)}")
+        return out
+
+    def stop(self):
+        for r in self.raylets:
+            r.stop()
+        if self._gcs_obj is not None:
+            self._gcs_obj.stop()
+            self._gcs_obj = None
+        if self._gcs_proc is not None:
+            self._gcs_proc.kill()
+            self._gcs_proc.wait(5.0)
+            self._gcs_proc = None

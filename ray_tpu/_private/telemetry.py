@@ -111,6 +111,25 @@ CATALOG: dict[str, dict] = {
         "description": "Messages dropped by mailbox overflow "
                        "(slow long-poll consumers)",
     },
+    "ray_tpu_pubsub_resyncs_total": {
+        "kind": "Counter", "tags": (),
+        "description": "Snapshot-resyncs performed by long-poll "
+                       "subscribers after a feed gap (mailbox overflow "
+                       "or publisher-side GC)",
+    },
+    # --- GCS control plane at scale (gcs.py, cluster soak) ---
+    "ray_tpu_gcs_death_fanout_seconds": {
+        "kind": "Histogram", "tags": (),
+        "boundaries": _RPC_BOUNDARIES,
+        "description": "Wall time of the off-lock death-feed broadcast "
+                       "per swept node-death batch (coalesced or "
+                       "single)",
+    },
+    "ray_tpu_gcs_register_throttled_total": {
+        "kind": "Counter", "tags": (),
+        "description": "register_node calls that queued on the bounded "
+                       "admission gate during a registration burst",
+    },
     # --- event log (events.py) ---
     "ray_tpu_events_dropped_total": {
         "kind": "Counter", "tags": (),

@@ -2166,16 +2166,17 @@ class CoreWorker:
             if lw.node_id == self.node_id:
                 self.raylet.push("return_worker", lease_id=lw.lease_id)
             else:
-                nodes = self.gcs.call("get_nodes")
-                for n in nodes:
-                    if n["NodeID"] == lw.node_id and n["Alive"]:
-                        c = RpcClient((n["NodeManagerAddress"],
-                                       n["NodeManagerPort"]), timeout=10.0)
-                        try:
-                            c.push("return_worker", lease_id=lw.lease_id)
-                        finally:
-                            c.close()
-                        break
+                # O(1) single-node lookup: returning one spillback lease
+                # used to pull the WHOLE node table (O(cluster) payload
+                # per return — at 100 nodes, the soak's dominant driver
+                # → GCS traffic)
+                addr = self.gcs.call("get_node_addr", node_id=lw.node_id)
+                if addr is not None:
+                    c = RpcClient(tuple(addr), timeout=10.0)
+                    try:
+                        c.push("return_worker", lease_id=lw.lease_id)
+                    finally:
+                        c.close()
         except (ConnectionLost, Exception):  # noqa: BLE001
             pass
         finally:

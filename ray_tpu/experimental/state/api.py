@@ -408,6 +408,37 @@ def summarize_objects(*, address: str | None = None) -> dict:
             "per_node": per_node}
 
 
+def summarize_control_plane(*, address: str | None = None) -> dict:
+    """Control-plane scale & health rollup (cluster soak, round 12):
+    the GCS's table sizes, death-feed fanout/coalescing counters,
+    registration-admission throttling, and pubsub subscriber/resync
+    state — the numbers `benchmarks/soak_bench.py` soaks and
+    `ray-tpu control` prints."""
+    with _gcs(address) as call:
+        state = call("debug_state")
+    return {
+        "nodes": {"total": state.get("nodes", 0),
+                  "alive": state.get("alive_nodes", 0)},
+        "actors": {"total": state.get("actors", 0),
+                   "alive": state.get("alive_actors", 0)},
+        "placement_groups": state.get("placement_groups", 0),
+        "objects_tracked": state.get("objects_tracked", 0),
+        "death_feed": {
+            "batches": state.get("death_batches", 0),
+            "deaths_coalesced": state.get("deaths_coalesced", 0),
+            "max_batch": state.get("max_death_batch", 0),
+            "last_fanout_s": state.get("last_fanout_s", 0.0),
+        },
+        "registration": {
+            "throttled": state.get("register_throttled", 0),
+        },
+        "pubsub": {
+            "subscribers": state.get("pubsub_subscribers", 0),
+            "resyncs_served": state.get("pubsub_resyncs_served", 0),
+        },
+    }
+
+
 def cluster_status(*, address: str | None = None) -> str:
     """`ray status` analog (reference: scripts.py:1872): node table +
     resource usage summary."""

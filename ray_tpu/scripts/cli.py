@@ -321,6 +321,18 @@ def cmd_data(args):
     return 0
 
 
+def cmd_control(args):
+    """Control-plane scale & health summary — the CLI face of
+    `experimental.state.api.summarize_control_plane`: GCS table sizes,
+    death-feed fanout/coalescing counters, registration-admission
+    throttling, pubsub subscriber/resync state (cluster soak, r12)."""
+    from ray_tpu.experimental.state.api import summarize_control_plane
+
+    print(json.dumps(summarize_control_plane(address=args.address),
+                     indent=2, default=str))
+    return 0
+
+
 def cmd_steps(args):
     """Step-anatomy summary — the CLI face of
     `experimental.state.api.summarize_steps`: per-step/per-rank
@@ -543,6 +555,13 @@ def main(argv=None):
                              "block locality)")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_data)
+
+    sp = sub.add_parser("control",
+                        help="control-plane scale/health summary "
+                             "(death-feed coalescing, registration "
+                             "admission, pubsub resyncs)")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_control)
 
     sp = sub.add_parser("steps",
                         help="step-anatomy summary: per-step/per-rank "

@@ -204,6 +204,97 @@ def test_timeout_less_park_fixture():
     """)
 
 
+def _growth_codes(src: str, path: str = "ray_tpu/_private/gcs.py"):
+    return _codes(lock_discipline.analyze_growth_source(
+        textwrap.dedent(src), path))
+
+
+def test_unbounded_growth_fixture():
+    """RTL106: a per-id table grown on registration with no removal on
+    any path — the leak class the 100-node soak finds one field at a
+    time."""
+    src = """
+        class ControlTable:
+            def __init__(self):
+                self._by_node = {}
+                self._watchers = set()
+
+            def register(self, node_id, info):
+                self._by_node[node_id] = info
+
+            def watch(self, sub_id):
+                self._watchers.add(sub_id)
+    """
+    codes = _growth_codes(src)
+    assert codes == {"RTL106"}
+    contexts = {f.context for f in lock_discipline.analyze_growth_source(
+        textwrap.dedent(src), "ray_tpu/_private/gcs.py")}
+    assert contexts == {"ControlTable._by_node", "ControlTable._watchers"}
+
+
+def test_growth_with_removal_on_death_path_is_clean():
+    assert _growth_codes("""
+        class ControlTable:
+            def __init__(self):
+                self._by_node = {}
+                self._watchers = set()
+
+            def register(self, node_id, info):
+                self._by_node[node_id] = info
+
+            def watch(self, sub_id):
+                self._watchers.add(sub_id)
+
+            def on_node_dead(self, node_id):
+                self._by_node.pop(node_id, None)
+
+            def unwatch(self, sub_id):
+                self._watchers.discard(sub_id)
+    """) == set()
+
+
+def test_growth_exemptions_fixture():
+    """Bounded deques, constant-key stats dicts, swap-and-flush
+    reassignment, and receiver-CHAIN shrinks are all clean; files
+    outside the control-plane set are out of scope entirely."""
+    src = """
+        import collections
+
+        class C:
+            def __init__(self):
+                self._ring = collections.deque(maxlen=64)
+                self._stats = {"a": 0}
+                self._pending = {}
+                self._nested = {}
+
+            def record(self, x):
+                self._ring.append(x)
+                self._stats["a"] = 1
+
+            def enqueue(self, k, v):
+                self._pending[k] = v
+                self._nested.setdefault(k, {})[v] = 1
+
+            def flush(self):
+                out, self._pending = self._pending, {}
+                return out
+
+            def drop(self, k):
+                self._nested.get(k, {}).pop(k, None)
+    """
+    assert _growth_codes(src) == set()
+    # a leaky class OUTSIDE the control-plane module set is not flagged
+    leaky = """
+        class C:
+            def __init__(self):
+                self._t = {}
+            def put(self, k, v):
+                self._t[k] = v
+    """
+    assert _growth_codes(leaky, "ray_tpu/serve/_private/router.py") == set()
+    assert _growth_codes(leaky) == {"RTL106"}
+
+
 def test_condition_wait_under_its_own_lock_is_clean():
     """Condition.wait RELEASES the lock — the canonical pattern must
     not be flagged as blocking-under-lock."""

@@ -1,0 +1,391 @@
+"""Cluster-scale soak — smoke tier (PR 12).
+
+The full 100-node soak lives in ``benchmarks/soak_bench.py`` (slow,
+BENCH_r12.json); this suite proves the same machinery at <=20 simulated
+raylets inside the tier-1 budget:
+
+- the fault-injection DSL's node-level primitives (``kill_node`` /
+  ``flap_node``) fire deterministically PER NODE TAG;
+- a seeded simultaneous mass kill coalesces into ONE batched death-feed
+  fanout (``batch_dead`` + ``NODE_BATCH_DEAD``), survivors keep every
+  accepted lease, every subscription heals, the cluster view
+  reconverges, and the chaos journal is byte-for-byte reproducible;
+- a GCS restart mid-death-storm with 100 live ``watch_actor_deaths``
+  subscriptions (the PR 5 round-4 heal path at fleet scale): every
+  watch heals and no watcher misses a death — pre-restart deaths
+  arrive via the snapshot-resync against the store-restored actor
+  table;
+- registration bursts are admitted through the bounded gate;
+- mailbox overflow past the gap counter triggers a snapshot-resync.
+
+Late-alphabet on purpose (tier-1 wall-clock budget). Keep fast.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import fault_injection as fi
+
+pytestmark = [pytest.mark.chaos, pytest.mark.fault_injection,
+              pytest.mark.soak]
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    fi.uninstall()
+
+
+def _wait(pred, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ------------------------------------------------------- DSL node actions
+
+
+def test_node_action_grammar_and_per_tag_determinism():
+    sched = ("kill_node:*.mass_kill:p0.3;"
+             "flap_node:sim002.heartbeat:#2:400;"
+             "kill_node:sim009.heartbeat:%3")
+    rules = fi.parse_schedule(sched)
+    assert [r.action for r in rules] == ["kill_node", "flap_node",
+                                        "kill_node"]
+
+    def drive(inj):
+        for t in (f"sim{i:03d}" for i in range(12)):
+            inj.on_node(t, "mass_kill")
+        for _ in range(6):
+            inj.on_node("sim002", "heartbeat")
+            inj.on_node("sim009", "heartbeat")
+        return inj.trace()
+
+    a = drive(fi.FaultInjector(42, sched))
+    b = drive(fi.FaultInjector(42, sched))
+    assert a == b, "node-action verdicts are not deterministic"
+    # per-tag counters: sim002 flaps exactly on ITS 2nd heartbeat,
+    # sim009 kills on every 3rd of ITS OWN — other tags never fire
+    flaps = [e for e in a if e[0] == "flap_node"]
+    assert flaps == [("flap_node", "sim002", "heartbeat", 2)]
+    kills9 = [e for e in a if e[0] == "kill_node" and e[1] == "sim009"]
+    assert [n for (_, _, _, n) in kills9] == [3, 6]
+    # a different seed reshuffles the probabilistic subset
+    c = drive(fi.FaultInjector(43, sched))
+    assert {e[1] for e in a if e[2] == "mass_kill"} != \
+        {e[1] for e in c if e[2] == "mass_kill"} or True  # may collide
+    # node actions never leak into the transport boundaries
+    inj = fi.FaultInjector(42, sched)
+    assert inj.on_send("mass_kill") is None
+    assert inj.on_reply("heartbeat") == 0.0
+
+
+def test_bad_node_rule_rejected():
+    with pytest.raises(fi.ScheduleError):
+        fi.parse_schedule("melt_node:*.x:p0.5")
+
+
+# --------------------------------------------------- smoke soak (the gate)
+
+
+def _run_smoke_soak(seed: int):
+    """One deterministic smoke soak: 18 nodes, seeded simultaneous kill
+    + flap, lease traffic throughout. Returns (cluster, killed_tags)."""
+    from ray_tpu._private.sim_cluster import SimCluster
+
+    fi.install(seed, "kill_node:*.mass_kill:p0.2;"
+                     "flap_node:*.flap_check:p0.12:300")
+    cluster = SimCluster(n_nodes=18, tick_interval=0.05,
+                         poll_timeout=1.0).start()
+    try:
+        cluster.run_ticks(3, leases_every=2)
+        cluster.mass_consult("mass_kill")
+        cluster.mass_consult("flap_check")
+        cluster.run_ticks(8, leases_every=3)   # flaps rejoin inside this
+        conv = cluster.wait_converged(timeout=25.0)
+        leases = cluster.verify_leases()
+        return cluster, conv, leases
+    finally:
+        fi.uninstall()
+
+
+def test_smoke_soak_survivors_keep_scheduling_and_reconverge():
+    from ray_tpu._private import events as _events
+
+    cluster, conv, leases = _run_smoke_soak(seed=1205)
+    try:
+        killed = cluster.dead_ids()
+        assert killed, "seed 1205 must kill at least one node"
+        # 1) zero lost accepted leases on survivors
+        assert leases["lost"] == []
+        assert leases["accepted"] > 0
+        # 2) every survivor observed every death (feed, batch, resync or
+        #    rejoin reconciliation) and its subscription demonstrably
+        #    heals (the probe publish inside wait_converged)
+        assert conv["converged"], conv
+        for r in cluster.survivors():
+            assert killed <= set(r.deaths_seen), (r.tag, r.deaths_seen)
+        # 3) >=3 simultaneous deaths coalesced into batched fanout (the
+        #    flap disconnects may share the coalesce window with the
+        #    kills, so batches can be a superset of the killed set)
+        if len(killed) >= 3:
+            batches = [e for e in _events.snapshot()
+                       if e["kind"] == "NODE_BATCH_DEAD"]
+            assert batches, "mass kill did not coalesce"
+            assert any(len(b["node_ids"]) >= 3 for b in batches)
+            st = cluster.gcs_call("debug_state")
+            assert st["death_batches"] >= 1
+            assert st["max_death_batch"] >= 3
+        # 4) flapped nodes re-registered and are alive in the GCS view
+        st = cluster.gcs_call("debug_state")
+        assert st["alive_nodes"] == len(cluster.survivors())
+    finally:
+        cluster.stop()
+
+
+def test_smoke_soak_journal_is_byte_for_byte_reproducible():
+    a, _, _ = _run_smoke_soak(seed=77)
+    ja = a.journal_text()
+    a.stop()
+    b, _, _ = _run_smoke_soak(seed=77)
+    jb = b.journal_text()
+    b.stop()
+    assert ja == jb, "same seed must replay the identical event order"
+    c, _, _ = _run_smoke_soak(seed=78)
+    jc = c.journal_text()
+    c.stop()
+    assert jc != ja, "a different seed should alter the chaos schedule"
+
+
+def test_flap_node_rejoins_and_reconciles_missed_deaths():
+    from ray_tpu._private.sim_cluster import SimCluster
+
+    # sim002 flaps down for ~8 ticks; sim004 dies WHILE sim002 is away —
+    # the rejoin snapshot reconciliation must deliver the missed death
+    fi.install(0, "flap_node:sim002.flap_check:#1:400;"
+                  "kill_node:sim004.late_kill:#1")
+    cluster = SimCluster(n_nodes=6, tick_interval=0.05,
+                         poll_timeout=1.0).start()
+    try:
+        cluster.run_ticks(2)
+        cluster.mass_consult("flap_check")
+        assert cluster.raylets[2].state == "flapping"
+        cluster.mass_consult("late_kill")
+        assert cluster.raylets[4].state == "dead"
+        cluster.run_ticks(10)     # sim002 rejoins in here
+        assert cluster.raylets[2].state == "up"
+        conv = cluster.wait_converged(timeout=20.0)
+        assert conv["converged"], conv
+        assert "simnode-004" in cluster.raylets[2].deaths_seen
+    finally:
+        cluster.stop()
+
+
+# ------------------------------------- GCS restart during a death storm
+
+
+def test_gcs_restart_during_death_storm_100_watches(tmp_path):
+    """The PR 5 round-4 heal path at fleet scale: 100 live
+    ``watch_actor_deaths`` subscriptions, a death storm, a GCS SIGKILL +
+    restart mid-storm, more deaths — every watch must heal and NO
+    watcher may miss a death (pre-restart deaths reach late/healed
+    watchers via the snapshot-resync against the store-restored actor
+    table)."""
+    from ray_tpu._private.protocol import RpcClient
+    from ray_tpu._private.pubsub import watch_actor_deaths
+    from ray_tpu._private.sim_cluster import SimCluster
+
+    cluster = SimCluster(n_nodes=0, gcs="subprocess",
+                         store_path=str(tmp_path / "gcs.db"))
+    cluster._start_gcs()
+    watches, seen = [], []
+    try:
+        gcs = RpcClient(cluster.gcs_addr, timeout=15.0)
+        actor_ids = [b"soak-actor-%03d----" % i for i in range(20)]
+        for aid in actor_ids:
+            gcs.call("register_actor", actor_id=aid,
+                     spec={"class_name": "Soak", "max_restarts": 0})
+            gcs.call("actor_started", actor_id=aid,
+                     addr=("127.0.0.1", 1), node_id="storm-node")
+
+        for i in range(100):
+            acc = set()
+            lock = threading.Lock()
+
+            def _on_death(actor_id, reason, acc=acc, lock=lock):
+                with lock:
+                    acc.add(actor_id)
+
+            w = watch_actor_deaths(_on_death, poll_timeout=1.0,
+                                   gcs_addr=cluster.gcs_addr)
+            assert w is not None
+            watches.append(w)
+            seen.append(acc)
+
+        # storm part 1: 10 deaths, then SIGKILL the GCS mid-storm
+        for aid in actor_ids[:10]:
+            gcs.call("actor_exited", actor_id=aid)
+        gcs.close()
+        cluster.restart_gcs(downtime_s=0.2)
+        gcs = RpcClient(cluster.gcs_addr, timeout=15.0)
+        # storm part 2 against the restarted GCS
+        for aid in actor_ids[10:]:
+            gcs.call("actor_exited", actor_id=aid)
+        gcs.close()
+
+        want = set(actor_ids)
+        ok = _wait(lambda: all(want <= s for s in seen), timeout=60.0,
+                   interval=0.25)
+        missing = [(i, sorted(want - s)[:3], len(want - s))
+                   for i, s in enumerate(seen) if not want <= s]
+        assert ok, (f"{len(missing)} of 100 death watches missed "
+                    f"deaths after the GCS restart: {missing[:5]}")
+    finally:
+        for w in watches:
+            w.stop()
+        cluster.stop()
+
+
+# -------------------------------------------------- registration admission
+
+
+def test_registration_admission_is_bounded():
+    from ray_tpu._private.gcs import GcsServer
+
+    os.environ["RAY_TPU_GCS_REGISTER_MAX_CONCURRENT"] = "2"
+    try:
+        server = GcsServer(port=0)
+        # no .start(): we drive the handler directly — admission is a
+        # handler-level property, not a transport one
+        inflight, peak = [0], [0]
+        gate = threading.Lock()
+        orig_publish = server._publish
+
+        def slow_publish(channel, message):
+            with gate:
+                inflight[0] += 1
+                peak[0] = max(peak[0], inflight[0])
+            time.sleep(0.05)
+            with gate:
+                inflight[0] -= 1
+            orig_publish(channel, message)
+
+        server._publish = slow_publish
+
+        class _Conn:
+            def __init__(self):
+                self.meta = {}
+
+        threads = [threading.Thread(
+            target=server.rpc_register_node,
+            args=(_Conn(), f"burst-{i}", ("h", i), {"CPU": 1}, {}))
+            for i in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20.0)
+        assert peak[0] <= 2, f"admission gate leaked: peak={peak[0]}"
+        with server._lock:
+            assert len(server.nodes) == 10   # everyone got in eventually
+        with server._death_lock:
+            assert server._fanout_stats["register_throttled"] >= 1
+        server.stop()
+    finally:
+        del os.environ["RAY_TPU_GCS_REGISTER_MAX_CONCURRENT"]
+
+
+def test_death_coalesce_window_respects_reregistration():
+    """The coalesce window must not let a stale death observation kill
+    a FRESH registration (blip → re-register inside the window), and a
+    die→re-register→die sequence inside ONE window must still land the
+    second death (last observation pins the freshest incarnation)."""
+    from ray_tpu._private.gcs import GcsServer
+
+    class _Conn:
+        def __init__(self):
+            self.meta = {}
+
+    server = GcsServer(port=0)
+    try:
+        # blip: death observed, node re-registers inside the window
+        server.rpc_register_node(_Conn(), "blip", ("h", 1), {"CPU": 1}, {})
+        server._mark_node_dead("blip", "connection lost")
+        server.rpc_register_node(_Conn(), "blip", ("h", 1), {"CPU": 1}, {})
+        assert _wait(lambda: not server._death_flusher_active, 5.0)
+        assert server.nodes["blip"].alive, \
+            "stale death observation killed a fresh registration"
+        # die -> re-register -> die, all inside one window
+        server.rpc_register_node(_Conn(), "churn", ("h", 2), {"CPU": 1},
+                                 {})
+        server._mark_node_dead("churn", "first death")
+        server.rpc_register_node(_Conn(), "churn", ("h", 2), {"CPU": 1},
+                                 {})
+        server._mark_node_dead("churn", "second death")
+        assert _wait(lambda: not server._death_flusher_active, 5.0)
+        assert _wait(lambda: not server.nodes["churn"].alive, 5.0), \
+            "second death inside the window was lost"
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------ overflow snapshot-resync
+
+
+def test_mailbox_overflow_triggers_snapshot_resync():
+    from ray_tpu._private.pubsub import Publisher, Subscriber
+
+    pub = Publisher(max_mailbox=4)
+    state = {"nodes": ["n1", "n2"]}
+    pub.set_snapshot_provider("ch", lambda: dict(state))
+
+    class _LocalRpc:
+        def call(self, method, **kw):
+            kw.pop("timeout", None)
+            if method == "psub_subscribe":
+                return pub.rpc_psub_subscribe(None, kw["channels"],
+                                              kw.get("sub_id"))
+            if method == "psub_poll":
+                return pub.rpc_psub_poll(None, kw["sub_id"],
+                                         kw["after_seq"],
+                                         kw.get("poll_timeout", 1))
+            if method == "psub_resync":
+                return pub.rpc_psub_resync(None, kw["sub_id"],
+                                           kw["channels"])
+            raise AssertionError(method)
+
+    got, gaps = [], []
+    sub = Subscriber(_LocalRpc(), poll_timeout=0.2, on_gap=gaps.append,
+                     auto_resync=True)
+    sub.subscribe("ch", got.append)
+    assert _wait(lambda: sub._thread is not None, 5.0)
+    # flood well past the mailbox while the subscriber is slow to poll:
+    # the overflow count rides the next poll reply as `dropped`
+    for i in range(40):
+        pub.publish("ch", {"n": i})
+    ok = _wait(lambda: sub.resync_count >= 1, timeout=10.0)
+    assert ok, f"no resync after overflow (gaps={gaps})"
+    resyncs = [m for m in got if isinstance(m, dict)
+               and m.get("event") == "resync"]
+    assert resyncs and resyncs[0]["snapshot"] == {"nodes": ["n1", "n2"]}
+    assert pub.resyncs_served >= 1
+    sub.stop()
+
+
+def test_publish_many_is_one_seq_run_and_coalesced():
+    from ray_tpu._private.pubsub import Publisher
+
+    pub = Publisher()
+    sid = pub.subscribe(["c"])
+    last = pub.publish_many("c", [{"i": i} for i in range(5)])
+    mail, max_seq = pub.poll(sid, after_seq=0, timeout=1)
+    assert [m[2]["i"] for m in mail] == list(range(5))
+    seqs = [m[0] for m in mail]
+    assert seqs == list(range(seqs[0], seqs[0] + 5))
+    assert max_seq == last
