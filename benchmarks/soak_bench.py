@@ -1,4 +1,4 @@
-"""Cluster-scale soak bench — round 12 (BENCH_r12.json).
+"""Cluster-scale soak bench — rounds 12/13 (BENCH_r12/BENCH_r13.json).
 
 Stands up ``RAY_TPU_SOAK_NODES`` (default 100) simulated raylets
 (`ray_tpu/_private/sim_cluster.py`: real GCS registration/heartbeat/
@@ -16,11 +16,18 @@ pubsub, no workers) and measures the control plane under seeded chaos:
   assert ZERO lost accepted leases and no survivor missing a death.
 - **determinism**: the same seed replays a byte-identical chaos
   journal.
+- **multitenant** (round 13): 3 competing jobs (batch pri 0 / train
+  pri 5 / serve pri 10, each quota-capped) creating gangs against the
+  same 100 nodes under seeded ``preempt_job`` storms + node kills —
+  measures high-priority time-to-placement on a full cluster (the
+  preemption path end to end) and asserts zero quota violations in
+  every ``summarize_jobs`` sample plus a byte-identical journal
+  across two runs.
 
 Usage::
 
     RAY_TPU_SOAK_NODES=100 python benchmarks/soak_bench.py \
-        --json-out BENCH_r12.json
+        --json-out BENCH_r13.json
 """
 from __future__ import annotations
 
@@ -175,6 +182,100 @@ def restart_phase(nodes: int, seed: int, verbose=print) -> dict:
         fi.uninstall()
 
 
+MT_SCHEDULE = ("preempt_job:train.job_tick:%3:300;"
+               "preempt_job:batch.job_tick:%4:300;"
+               "kill_node:*.mt_kill:p0.04")
+
+
+def multitenant_phase(nodes: int, seed: int, verbose=print) -> dict:
+    """Round-13 phase: competing quota-capped jobs + seeded preemption
+    storms + node kills on one 100-node control plane."""
+    from ray_tpu._private.sim_cluster import SimCluster
+
+    os.environ["RAY_TPU_GCS_PREEMPT_GRACE_S"] = "0.3"
+    fi.install(seed, MT_SCHEDULE)
+    cluster = SimCluster(n_nodes=nodes, tick_interval=0.05,
+                         poll_timeout=2.0).start()
+    try:
+        cpus = 4.0 * nodes
+        # quotas sum past 100%: batch+train can SATURATE the cluster, so
+        # every serve scale-up must go through the preemption path —
+        # the latency this phase exists to measure
+        cluster.register_job("batch", quota={"CPU": cpus * 0.6},
+                             priority=0)
+        cluster.register_job("train", quota={"CPU": cpus * 0.5},
+                             priority=5)
+        cluster.register_job("serve", quota={"CPU": cpus * 0.1},
+                             priority=10)
+        cluster.run_ticks(2)
+        # fill the cluster: batch + train gangs up to (and past) quota —
+        # the overflow gangs exercise the quota-block path
+        for _ in range(int(cpus * 0.6 / 8) + 2):
+            cluster.create_job_pg("batch", n_bundles=4, cpu=2.0)
+        for _ in range(int(cpus * 0.5 / 8) + 2):
+            cluster.create_job_pg("train", n_bundles=4, cpu=2.0)
+        cluster.run_ticks(4)
+        cluster.sample_jobs()
+        # seeded preemption storm + composed node kills, with serve
+        # scale-ups arriving against a full cluster
+        placement_waits = []
+        for round_n in range(6):
+            cluster.jobs_tick()
+            if round_n == 2:
+                cluster.mass_consult("mt_kill")
+            if round_n % 2 == 0:
+                pg_id = cluster.create_job_pg("serve", n_bundles=2,
+                                              cpu=1.0)
+                t0 = time.monotonic()
+                deadline = t0 + 20.0
+                placed = False
+                while time.monotonic() < deadline:
+                    snap = cluster.gcs_call("get_placement_group",
+                                            pg_id=pg_id)
+                    if snap and snap["State"] == "CREATED":
+                        placed = True
+                        break
+                    time.sleep(0.05)
+                placement_waits.append(
+                    {"placed": placed,
+                     "wait_ms": round((time.monotonic() - t0) * 1e3, 1)})
+            cluster.run_ticks(3)
+            cluster.sample_jobs()
+        conv = cluster.wait_converged(timeout=45.0)
+        st = cluster.gcs_call("debug_state")
+        samples = cluster.metrics.get("job_samples", [])
+        waits = [w["wait_ms"] for w in placement_waits if w["placed"]]
+        out = {
+            "nodes": nodes,
+            "killed": len(cluster.dead_ids()),
+            "preemptions_fired": st.get("preemptions_fired", 0),
+            "quota_rejections": st.get("quota_rejections", 0),
+            "pending_pgs_end": st.get("pending_pgs", 0),
+            "violations_total": sum(len(s["violations"])
+                                    for s in samples),
+            "samples": len(samples),
+            "serve_placements": placement_waits,
+            "serve_placement_p50_ms": _pct(waits, 0.50),
+            "serve_placement_max_ms": _pct(waits, 1.0),
+            "serve_placed_all": all(w["placed"]
+                                    for w in placement_waits),
+            "reconvergence": conv,
+            "journal_sha256": hashlib.sha256(
+                cluster.journal_text().encode()).hexdigest(),
+            "journal_text": cluster.journal_text(),
+        }
+        verbose(f"  multitenant: killed={out['killed']} "
+                f"preemptions={out['preemptions_fired']} "
+                f"violations={out['violations_total']} "
+                f"serve p50 wait={out['serve_placement_p50_ms']}ms "
+                f"(all placed: {out['serve_placed_all']})")
+        return out
+    finally:
+        cluster.stop()
+        fi.uninstall()
+        del os.environ["RAY_TPU_GCS_PREEMPT_GRACE_S"]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int,
@@ -188,23 +289,31 @@ def main():
 
     print(f"soak bench: {args.nodes} simulated raylets, seed {args.seed}")
     t0 = time.time()
-    print("phase 1/4: death-feed fanout, coalescing OFF (pre-fix path)")
+    print("phase 1/6: death-feed fanout, coalescing OFF (pre-fix path)")
     before = fanout_phase(args.nodes, args.seed, coalesce=False,
                           n_objects=args.objects)
-    print("phase 2/4: death-feed fanout, coalescing ON")
+    print("phase 2/6: death-feed fanout, coalescing ON")
     after = fanout_phase(args.nodes, args.seed, coalesce=True,
                          n_objects=args.objects)
-    print("phase 3/4: GCS restart mid-storm (reconnect herd)")
+    print("phase 3/6: GCS restart mid-storm (reconnect herd)")
     restart = restart_phase(args.nodes, args.seed)
-    print("phase 4/4: determinism replay (same seed, same journal)")
+    print("phase 4/6: determinism replay (same seed, same journal)")
     replay = restart_phase(args.nodes, args.seed,
                            verbose=lambda *_a, **_k: None)
     journals_equal = (replay["journal_text"] == restart["journal_text"])
     restart.pop("journal_text", None)
     replay.pop("journal_text", None)
+    print("phase 5/6: multi-tenant (3 jobs, seeded preemptions + kills)")
+    mt = multitenant_phase(args.nodes, args.seed)
+    print("phase 6/6: multi-tenant determinism replay")
+    mt_replay = multitenant_phase(args.nodes, args.seed,
+                                  verbose=lambda *_a, **_k: None)
+    mt_journals_equal = (mt_replay["journal_text"] == mt["journal_text"])
+    mt.pop("journal_text", None)
+    mt_replay.pop("journal_text", None)
 
     result = {
-        "round": 12,
+        "round": 13,
         "bench": "cluster_soak",
         "nodes": args.nodes,
         "seed": args.seed,
@@ -219,11 +328,19 @@ def main():
             if before["fanout_p99_ms"] and after["fanout_p99_ms"]
             else None),
         "restart": restart,
+        "schedule_multitenant": MT_SCHEDULE,
+        "multitenant": mt,
         "determinism": {
             "journals_equal": journals_equal,
             "journal_sha256": restart["journal_sha256"],
+            "multitenant_journals_equal": mt_journals_equal,
+            "multitenant_journal_sha256": mt["journal_sha256"],
         },
         "acceptance": {
+            "zero_quota_violations": mt["violations_total"] == 0,
+            "preemptions_fired": mt["preemptions_fired"] > 0,
+            "high_pri_always_placed": mt["serve_placed_all"],
+            "multitenant_reproducible": mt_journals_equal,
             "zero_lost_leases": (before["lost_leases"] == 0
                                  and after["lost_leases"] == 0
                                  and restart["lost_leases"] == 0),
@@ -244,7 +361,10 @@ def main():
           f"{after['fanout_p99_ms']}ms "
           f"({result['fanout_p99_improvement_x']}x); "
           f"reconvergence after restart: "
-          f"{restart['reconvergence_after_restart_s']}s")
+          f"{restart['reconvergence_after_restart_s']}s; "
+          f"multitenant: {mt['preemptions_fired']} preemptions, "
+          f"{mt['violations_total']} violations, serve placement p50 "
+          f"{mt['serve_placement_p50_ms']}ms")
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump(result, f, indent=2, sort_keys=True)

@@ -144,6 +144,17 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # at 100 nodes doesn't eat one synchronized reconnect+replay storm.
     # 0 restores immediate reconnects.
     "gcs_reconnect_jitter_s": 0.2,
+    # --- multi-tenant control plane (jobs/quotas/preemption, gcs.py) ---
+    # Grace window between the PREEMPTION warning a victim placement
+    # group receives and the GCS reclaiming its bundles: the Train
+    # plane uses it to cut a checkpoint so the victim loses at most the
+    # post-checkpoint steps, not the run.
+    "gcs_preempt_grace_s": 5.0,
+    # PlacementGroup.ready()/wait() ride the `pg_state` pubsub channel;
+    # this is the cadence of the direct-RPC FALLBACK poll kept
+    # underneath it (a missed transition can't hang a waiter past one
+    # fallback period; PR 12's snapshot-resync covers feed gaps).
+    "pg_wait_poll_fallback_s": 2.0,
     # --- misc ---
     "rpc_max_message_bytes": 512 * 1024 * 1024,
     "pubsub_poll_timeout_s": 30.0,

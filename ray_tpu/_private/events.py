@@ -69,6 +69,17 @@ Event kinds recorded by the runtime:
                      window — a rack loss or seeded mass kill) was
                      swept and fanned out as ONE broadcast
                      (_private/gcs.py): node_ids, count, reasons.
+- ``JOB_REGISTERED`` — a named job joined the multi-tenant scheduling
+                     plane (_private/gcs.py): job, priority, quota.
+- ``PREEMPTION_WARNED`` — a higher-priority placement group could not
+                     place and the GCS picked this victim: pg_id, job,
+                     the grace window, the preemptor — the Train plane
+                     cuts a checkpoint inside the window
+                     (_private/gcs.py).
+- ``PREEMPTION_FIRED`` — the grace window elapsed and the victim's
+                     bundles were reclaimed; the victim re-queued
+                     PENDING to resume when capacity returns
+                     (_private/gcs.py): pg_id, job, preemptor.
 - ``PUBSUB_RESYNC`` — a long-poll subscriber detected a feed gap
                      (mailbox overflow / publisher GC) and reconverged
                      from the channel's state snapshot

@@ -40,6 +40,17 @@ The scope matches the process ROLE or any of its TAGS (``add_tag``):
 train workers tag themselves ``rank<N>``, so rank-death chaos can target
 exactly one gang member deterministically.
 
+``preempt_job`` is a JOB-level primitive: the driver of a named job
+(the multi-tenant soak harness, a chaos test loop) consults
+``on_job(job, method)`` at its own deterministic boundaries, and a
+fired rule means "force-preempt this job's newest running gang now"
+(the caller issues the GCS ``preempt_job`` RPC — warning + grace +
+reclaim, exactly the organic can't-place path). Counters are
+per-(job, method) like the node primitives, so
+``preempt_job:train.job_tick:%5`` preempts the ``train`` job on every
+5th consult regardless of how many jobs share the schedule — the
+seeded preemption-storm generator.
+
 ``kill_node`` / ``flap_node`` are NODE-level primitives, consulted at
 the same deterministic client-send boundary as the message-level
 actions but by the entity that OWNS a node's connections (the scale
@@ -115,13 +126,16 @@ import threading
 import time
 
 ACTIONS = ("drop", "delay", "dup", "disconnect", "slow_reply",
-           "kill_actor", "kill_node", "flap_node")
+           "kill_actor", "kill_node", "flap_node", "preempt_job")
 # actions applied at the client send boundary vs the server reply boundary
 _SEND_ACTIONS = frozenset({"drop", "delay", "dup", "disconnect"})
 _REPLY_ACTIONS = frozenset({"slow_reply", "kill_actor"})
 # node-level actions, consulted by the node's owner (sim_cluster) at its
 # own deterministic send boundary via on_node(tag, method)
 _NODE_ACTIONS = frozenset({"kill_node", "flap_node"})
+# job-level actions, consulted by the entity driving a named job
+# (multi-tenant soak harness / chaos tests) via on_job(job, method)
+_JOB_ACTIONS = frozenset({"preempt_job"})
 
 _DEFAULT_PARAM_MS = 10.0
 
@@ -270,6 +284,8 @@ class FaultInjector:
                              if r.action in _REPLY_ACTIONS]
         self._node_rules = [r for r in self.rules
                             if r.action in _NODE_ACTIONS]
+        self._job_rules = [r for r in self.rules
+                           if r.action in _JOB_ACTIONS]
         self._lock = threading.Lock()
         self.events: list[tuple] = []
         # None = follow the process-global role (set_role); a role given
@@ -351,6 +367,28 @@ class FaultInjector:
             with self._lock:
                 self.events.append((rule.action, tag, method, n))
             _note_fault(rule.action, tag, method, n)
+            fired.append((rule.action, rule.param_s))
+        return fired
+
+    def on_job(self, job: str, method: str) -> list[tuple[str, float]]:
+        """Job boundary: decisions for the named ``job`` at the caller's
+        deterministic consult point ``method``. Returns
+        [(action, param_s)] for every job rule that fired; the CALLER
+        applies them (issue the GCS ``preempt_job`` RPC) — the
+        transports never see job actions. Counters are per
+        (job, method) like ``on_node``'s per-(tag, method), so one
+        schedule shared by several jobs keeps an independent
+        deterministic sequence per job."""
+        fired: list[tuple[str, float]] = []
+        for rule in self._job_rules:
+            if not rule.matches_scope(job, method, frozenset((job,))):
+                continue
+            n = rule.fires(self.seed, f"{job}|{method}", self._lock)
+            if not n:
+                continue
+            with self._lock:
+                self.events.append((rule.action, job, method, n))
+            _note_fault(rule.action, job, method, n)
             fired.append((rule.action, rule.param_s))
         return fired
 

@@ -17,8 +17,20 @@ holding the cluster-global state machines —
   connections) and legacy tables for observability stats,
 - placement groups (gcs_placement_group_manager.h): bundle reservation with
   PACK/SPREAD/STRICT_PACK/STRICT_SPREAD over the node table,
-- pubsub (pubsub_handler.h): actor state and node membership channels pushed
-  to subscribed connections.
+- job table (gcs_job_manager.h, extended): named jobs carrying per-job
+  resource QUOTAS and a PRIORITY CLASS — enforced at placement-group
+  admission (all-or-nothing over the whole gang) and, via the `jobs`
+  pubsub channel, at raylet lease grant; pending bundles are scheduled
+  fair-share (dominant-resource, weighted by quota) off a
+  priority-ordered queue, and a higher-priority gang that cannot place
+  PREEMPTS the lowest-priority job's newest gang: a warning with a
+  grace window (`gcs_preempt_grace_s`) lets the victim cut a
+  checkpoint, then its bundles are reclaimed and it re-queues to
+  resume when capacity returns (the Ray paper's multi-tenant
+  GCS/distributed-scheduler arbitration, arXiv:1712.05889 §4),
+- pubsub (pubsub_handler.h): actor state, node membership, placement
+  group state (`pg_state`, with snapshot-resync) and job quota
+  channels pushed to subscribed connections.
 
 State is held in memory (the reference's default InMemoryStoreClient) and
 made durable by a pluggable write-through store (gcs_store.py: sqlite or
@@ -101,26 +113,73 @@ class ActorInfo:
         }
 
 
+class JobInfo:
+    """One named tenant in the scheduling plane: a resource quota (max
+    concurrent usage per resource; empty = unlimited) and a priority
+    class (higher preempts lower). Placement groups and leases carry
+    the job NAME as a label; usage is derived from the PG table plus
+    the per-job lease usage raylets gossip — the job table itself holds
+    only policy + counters."""
+
+    def __init__(self, name: str, quota: dict | None = None,
+                 priority: int = 0):
+        self.name = name
+        self.quota = {k: float(v) for k, v in (quota or {}).items()}
+        self.priority = int(priority)
+        self.created_at = time.time()
+        self.preemptions = 0          # gangs of THIS job preempted
+        self.quota_rejections = 0     # admissions blocked on quota
+
+    def snapshot(self) -> dict:
+        return {
+            "Job": self.name,
+            "Priority": self.priority,
+            "Quota": dict(self.quota),
+            "CreatedAt": self.created_at,
+            "Preemptions": self.preemptions,
+            "QuotaRejections": self.quota_rejections,
+        }
+
+
 class PlacementGroupInfo:
     def __init__(self, pg_id: bytes, bundles: list[dict], strategy: str,
-                 name: str = ""):
+                 name: str = "", job: str = ""):
         self.pg_id = pg_id
         self.bundles = bundles            # list of resource dicts
         self.strategy = strategy
         self.name = name
+        self.job = job or ""              # owning job label ("" = none)
         self.state = "PENDING"            # CREATED / REMOVED / RESCHEDULING
         self.bundle_nodes: list[str | None] = [None] * len(bundles)
         self.commit_ts = 0.0              # when it became CREATED
         self.last_sched_attempt = 0.0     # rate-limits PENDING rescans
+        self.created_seq = 0              # FIFO tiebreak in the queue
+        self.quota_blocked = False        # rejection counted once per
+        #                                   transition into the state
+        self.preempt_deadline: float | None = None   # warned; fires then
+        self.preemptor: bytes | None = None
+        # post-fire re-queue holdoff: a just-preempted gang must not be
+        # re-placed in the same scheduling pass that freed its bundles
+        # (with no waiting preemptor it would bounce CREATED->CREATED
+        # before its driver's teardown even observes the preemption)
+        self.holdoff_until = 0.0
+        # when (if ever) a preemption FIRED on this pg: the pg_state
+        # resync snapshot carries it so a preemption monitor that
+        # missed the PREEMPTED push can distinguish "my gang was
+        # preempted" from "my gang is RESCHEDULING after a node death"
+        # (the latter must charge the failure budget, not requeue free)
+        self.preempted_at: float | None = None
 
     def snapshot(self) -> dict:
         return {
             "PlacementGroupID": self.pg_id.hex(),
             "Name": self.name,
+            "Job": self.job,
             "State": self.state,
             "Strategy": self.strategy,
             "Bundles": [dict(b) for b in self.bundles],
             "BundleNodes": list(self.bundle_nodes),
+            "PreemptDeadline": self.preempt_deadline,
         }
 
 
@@ -144,6 +203,19 @@ class GcsServer:
         self.object_sizes: dict[bytes, int] = {}
         self.lost_objects: set[bytes] = set()  # created, then all copies died
         self.placement_groups: dict[bytes, PlacementGroupInfo] = {}
+        self.jobs: dict[str, JobInfo] = {}   # removed via rpc_remove_job
+        # Fair-share scheduling queue: ONLY the PENDING/RESCHEDULING pg
+        # ids. Capacity events used to rescan the whole PG table
+        # (O(hosts² · bundles) under this lock, per gossip tick); now
+        # they walk this queue and return immediately when it is empty.
+        self._pending_pgs: set[bytes] = set()
+        self._pg_seq = 0                     # admission order tiebreak
+        self._sched_pass_at = 0.0            # pass-level rate limit
+        # node_id -> {job: {resource: amount}} gossiped by raylets
+        # (lease-grant usage; popped when the node dies)
+        self._lease_usage: dict[str, dict] = {}
+        self._quota_over: set[str] = set()   # jobs currently over quota
+        self._quota_refreshed = 0.0
         self.job_counter = 0
         self.cluster_id = uuid.uuid4().hex
         self._subscribers: dict[str, list] = {}   # channel -> [Connection]
@@ -165,6 +237,8 @@ class GcsServer:
             "actors", self._actors_resync_snapshot)
         self._long_poll.set_snapshot_provider(
             "nodes", self._nodes_resync_snapshot)
+        self._long_poll.set_snapshot_provider(
+            "pg_state", self._pg_state_resync_snapshot)
         # Death-feed coalescing (cluster-scale soak, PR 12): simultaneous
         # node deaths (a rack loss, a seeded 10% mass kill) within the
         # coalesce window are swept in ONE locked pass and fanned out as
@@ -370,7 +444,19 @@ class GcsServer:
                 if pg.state in ("CREATED", "PENDING") and \
                         any(n in dead_ids for n in pg.bundle_nodes):
                     pg.state = "RESCHEDULING"
+                    # node death supersedes an in-flight preemption (the
+                    # fire would find state != CREATED and abort anyway)
+                    pg.preempt_deadline = None
+                    pg.preemptor = None
+                    self._pending_pgs.add(pg.pg_id)
                     self._persist_pg(pg)
+                    fanout.append(("pg_state", {
+                        "event": "state", "pg_id": pg.pg_id,
+                        "state": "RESCHEDULING", "job": pg.job}))
+            for node_id in dead_ids:
+                # per-job lease usage gossiped by a dead raylet is gone
+                # with its leases (RTL106: keyed per node, removed here)
+                self._lease_usage.pop(node_id, None)
         # ---- fanout, OFF the GCS lock, on the snapshot above ----
         t0 = time.monotonic()
         batch_min = max(2, int(get_config("gcs_death_batch_min")))
@@ -478,7 +564,8 @@ class GcsServer:
 
     def rpc_report_resources(self, conn, node_id: str, available: dict,
                              pending_demand: list | None = None,
-                             busy: int = 0):
+                             busy: int = 0,
+                             job_busy: dict | None = None):
         with self._lock:
             node = self.nodes.get(node_id)
             if node is not None:
@@ -486,15 +573,27 @@ class GcsServer:
                 node.reported_at = time.time()
                 node.pending_demand = list(pending_demand or [])
                 node.busy = int(busy)
-            # fresh capacity may unblock pending placement groups (rate-
-            # limited: every raylet gossips ~600ms and the window scan is
-            # O(hosts² · bundles) under the GCS lock)
-            now = time.time()
-            for pg in self.placement_groups.values():
-                if pg.state in ("PENDING", "RESCHEDULING") and \
-                        now - pg.last_sched_attempt > 0.25:
-                    pg.last_sched_attempt = now
-                    self._try_schedule_pg(pg)
+                if job_busy is not None:
+                    # per-job lease usage on this node (quota enforcement
+                    # input); empty dict clears the entry
+                    if job_busy:
+                        self._lease_usage[node_id] = {
+                            j: dict(r) for j, r in job_busy.items()}
+                    else:
+                        self._lease_usage.pop(node_id, None)
+            # fresh capacity may unblock pending placement groups. This
+            # used to rescan the WHOLE PG table (O(hosts² · bundles)
+            # under the GCS lock, per ~600ms gossip tick per raylet);
+            # now it walks only the priority-ordered pending queue and
+            # returns immediately when it is empty.
+            self._maybe_schedule_pending()
+            # rate-limited even when lease usage changed: with
+            # job-labeled task churn most gossip pushes change SOME
+            # node's job_busy, and a forced O(jobs · PGs) recompute per
+            # push is the per-tick hot-spot class this PR removes from
+            # the PG path — the raylet throttle is documented as
+            # eventually consistent by one beat anyway
+            self._refresh_quota_throttle_locked()
         return True
 
     def rpc_get_cluster_load(self, conn):
@@ -567,6 +666,248 @@ class GcsServer:
             self.job_counter += 1
             self._persist_meta()
             return self.job_counter
+
+    # ---- named jobs: quotas, priority, fair share ---------------------------
+    # The multi-tenant arbitration layer (reference:
+    # gcs_job_manager.h extended per the Ray paper's §4 scheduler).
+    # Enforcement points: placement-group admission here (all-or-
+    # nothing over the gang), lease grant at the raylets (they ride the
+    # `jobs` channel's over-quota set). Fair share: pending bundles are
+    # served highest priority first, then lowest dominant resource
+    # share (usage / quota, falling back to usage / cluster total).
+
+    @staticmethod
+    def _validate_quota(quota: dict | None) -> dict:
+        from ray_tpu.exceptions import JobQuotaError
+
+        out = {}
+        for k, v in (quota or {}).items():
+            if not isinstance(k, str):
+                raise JobQuotaError(f"quota resource name {k!r} not a str")
+            try:
+                amt = float(v)
+            except (TypeError, ValueError):
+                raise JobQuotaError(
+                    f"quota amount {v!r} for {k!r} is not a number") \
+                    from None
+            if amt < 0:
+                raise JobQuotaError(f"quota {k!r} amount {amt} < 0")
+            out[k] = amt
+        return out
+
+    def rpc_register_job(self, conn, name: str, quota: dict | None = None,
+                         priority: int | None = None):
+        """Create-or-update (idempotent: clients retry across GCS
+        restarts; re-registering updates quota/priority in place — a
+        quota RAISED at runtime immediately re-drives the pending queue
+        so a quota-blocked gang unblocks without waiting for a
+        capacity event). ``None`` for quota/priority means KEEP the
+        existing value (default priority 0 on create) — a quota-only
+        re-register must not silently demote the job to priority 0 and
+        hand its gangs to the preemptor (review finding)."""
+        from ray_tpu.exceptions import JobQuotaError
+
+        if not name or not isinstance(name, str):
+            raise JobQuotaError(f"job name must be a non-empty str, "
+                                f"got {name!r}")
+        quota = self._validate_quota(quota) if quota is not None else None
+        with self._lock:
+            job = self.jobs.get(name)
+            created = job is None
+            if created:
+                job = JobInfo(name, quota,
+                              0 if priority is None else priority)
+                self.jobs[name] = job
+            else:
+                if quota is not None:
+                    job.quota = quota
+                if priority is not None:
+                    job.priority = int(priority)
+            self._persist_job(job)
+            self._refresh_quota_throttle_locked(force=True)
+            self._maybe_schedule_pending(force=True)
+            snap = self._job_snapshot_locked(job)
+        if created:
+            _events.record("JOB_REGISTERED", job=name,
+                           priority=0 if priority is None
+                           else int(priority), quota=quota or {})
+        return snap
+
+    def rpc_update_job(self, conn, name: str, quota: dict | None = None,
+                       priority: int | None = None):
+        """Runtime policy change for a registered job; raising a quota
+        unblocks queued gangs on the spot (tested edge)."""
+        from ray_tpu.exceptions import JobQuotaError
+
+        quota = self._validate_quota(quota) if quota is not None else None
+        with self._lock:
+            job = self.jobs.get(name)
+            if job is None:
+                raise JobQuotaError(f"unknown job {name!r}")
+            if quota is not None:
+                job.quota = quota
+            if priority is not None:
+                job.priority = int(priority)
+            self._persist_job(job)
+            self._refresh_quota_throttle_locked(force=True)
+            self._maybe_schedule_pending(force=True)
+            return self._job_snapshot_locked(job)
+
+    def rpc_remove_job(self, conn, name: str):
+        """Retire a job's policy entry (its PGs keep the label; with no
+        JobInfo they fall back to priority 0 / no quota)."""
+        with self._lock:
+            existed = self.jobs.pop(name, None) is not None
+            if existed:
+                if self._store is not None:
+                    self._store.delete("jobs", name)
+                # always clear the throttle state — a storeless GCS must
+                # not keep throttling a retired job's leases
+                self._refresh_quota_throttle_locked(force=True)
+        return existed
+
+    def rpc_get_job_throttle(self, conn):
+        """The current over-quota job set — raylets SEED their lease
+        throttle view from this at (re-)registration: the `jobs`
+        channel only publishes on CHANGE, so a node joining (or
+        healing across a GCS restart) while the set is stable would
+        otherwise never learn it and grant past-quota leases from
+        exactly the capacity everyone else is throttling."""
+        with self._lock:
+            return sorted(self._quota_over)
+
+    def rpc_get_job(self, conn, name: str):
+        with self._lock:
+            job = self.jobs.get(name)
+            return self._job_snapshot_locked(job) if job else None
+
+    def rpc_list_jobs(self, conn):
+        """Per-job policy + live usage rollup — `summarize_jobs()` /
+        `ray-tpu jobs` source. Jobs seen only as PG labels (never
+        registered) appear with default policy so usage is never
+        hidden."""
+        with self._lock:
+            labels = {pg.job for pg in self.placement_groups.values()
+                      if pg.job and pg.state != "REMOVED"}
+            rows = [self._job_snapshot_locked(j)
+                    for j in self.jobs.values()]
+            rows.extend(self._job_snapshot_locked(JobInfo(name))
+                        for name in sorted(labels - set(self.jobs)))
+            return rows
+
+    def _job_snapshot_locked(self, job: "JobInfo") -> dict:
+        snap = job.snapshot()
+        usage = self._job_usage(job.name)
+        pgs = {"created": 0, "pending": 0}
+        for pg in self.placement_groups.values():
+            if pg.job != job.name:
+                continue
+            if pg.state == "CREATED":
+                pgs["created"] += 1
+            elif pg.state in ("PENDING", "RESCHEDULING"):
+                pgs["pending"] += 1
+        snap.update({
+            "Usage": usage,
+            "DominantShare": self._dominant_share(job.name),
+            "PlacementGroups": pgs,
+            "OverQuota": any(usage.get(k, 0.0) > cap + 1e-9
+                             for k, cap in job.quota.items()),
+        })
+        return snap
+
+    def _job_usage(self, name: str) -> dict:
+        """Cluster-wide usage attributed to a job: bundles of its
+        CREATED placement groups plus the per-job lease usage raylets
+        gossip. Caller holds self._lock."""
+        usage: dict[str, float] = {}
+        for pg in self.placement_groups.values():
+            if pg.job != name or pg.state != "CREATED":
+                continue
+            for b in pg.bundles:
+                for k, v in b.items():
+                    usage[k] = usage.get(k, 0.0) + v
+        for per_job in self._lease_usage.values():
+            for k, v in (per_job.get(name) or {}).items():
+                usage[k] = usage.get(k, 0.0) + v
+        return usage
+
+    def _pg_priority(self, pg: "PlacementGroupInfo") -> int:
+        job = self.jobs.get(pg.job) if pg.job else None
+        return job.priority if job is not None else 0
+
+    def _dominant_share(self, name: str) -> float:
+        """Dominant-resource share: max over resources of
+        usage / weight, weight = the job's quota for that resource when
+        set, else the cluster total (DRF over quota-normalized
+        capacity). Caller holds self._lock."""
+        if not name:
+            return 0.0
+        job = self.jobs.get(name)
+        usage = self._job_usage(name)
+        if not usage:
+            return 0.0
+        totals: dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.resources.items():
+                    totals[k] = totals.get(k, 0.0) + v
+        share = 0.0
+        for k, v in usage.items():
+            weight = 0.0
+            if job is not None and job.quota.get(k):
+                weight = job.quota[k]
+            elif totals.get(k):
+                weight = totals[k]
+            if weight > 0:
+                share = max(share, v / weight)
+        return share
+
+    def _quota_blocked_pg(self, pg: "PlacementGroupInfo") -> bool:
+        """Would admitting this WHOLE gang push its job over quota?
+        All-or-nothing: the Nth bundle exceeding the quota blocks the
+        entire gang (a partial gang is useless to a collective
+        workload). Caller holds self._lock."""
+        job = self.jobs.get(pg.job) if pg.job else None
+        if job is None or not job.quota:
+            return False
+        usage = self._job_usage(pg.job)
+        demand: dict[str, float] = {}
+        for b in pg.bundles:
+            for k, v in b.items():
+                demand[k] = demand.get(k, 0.0) + v
+        return any(usage.get(k, 0.0) + demand.get(k, 0.0) > cap + 1e-9
+                   for k, cap in job.quota.items())
+
+    def _refresh_quota_throttle_locked(self, force: bool = False):
+        """Recompute the over-quota job set and publish it on the
+        `jobs` channel when it changes — raylets throttle lease grants
+        for listed jobs. Rate-limited off the gossip path (per-call
+        cost is O(jobs · PGs)); `force` bypasses for policy changes."""
+        now = time.monotonic()
+        if not force and now - self._quota_refreshed < 0.25:
+            return
+        self._quota_refreshed = now
+        over = set()
+        for name, job in self.jobs.items():
+            if not job.quota:
+                continue
+            usage = self._job_usage(name)
+            if any(usage.get(k, 0.0) > cap + 1e-9
+                   for k, cap in job.quota.items()):
+                over.add(name)
+        if over != self._quota_over:
+            self._quota_over = over
+            self._publish("jobs", {"event": "quota",
+                                   "over": sorted(over)})
+
+    def _persist_job(self, job: "JobInfo"):
+        if self._store is None:
+            return
+        self._store.put("jobs", job.name, pickle.dumps({
+            "name": job.name, "quota": job.quota,
+            "priority": job.priority, "created_at": job.created_at,
+            "preemptions": job.preemptions,
+            "quota_rejections": job.quota_rejections}))
 
     # ---- KV (function table, metadata) -------------------------------------
 
@@ -805,15 +1146,106 @@ class GcsServer:
 
     def rpc_create_placement_group(self, conn, pg_id: bytes,
                                    bundles: list[dict], strategy: str,
-                                   name: str = ""):
+                                   name: str = "", job: str = ""):
         if strategy not in PG_STRATEGIES:
             raise ValueError(f"unknown strategy {strategy}")
         with self._lock:
-            pg = PlacementGroupInfo(pg_id, bundles, strategy, name)
+            if pg_id in self.placement_groups:
+                # replay of our own creation (client retried across a
+                # GCS restart that had already applied it) — idempotent
+                return self.placement_groups[pg_id].snapshot()
+            pg = PlacementGroupInfo(pg_id, bundles, strategy, name, job)
+            self._pg_seq += 1
+            pg.created_seq = self._pg_seq
             self.placement_groups[pg_id] = pg
-            self._try_schedule_pg(pg)
+            self._pending_pgs.add(pg_id)
+            # forced: admission must attempt THIS gang now (not wait
+            # out the pass rate limit) — still through the fair-share
+            # order, so a new low-priority gang can't jump older
+            # higher-priority demand
+            self._maybe_schedule_pending(force=True)
             self._persist_pg(pg)
             return pg.snapshot()
+
+    def _maybe_schedule_pending(self, force: bool = False):
+        """Serve the pending queue: highest job priority first, then
+        lowest dominant resource share (fair share), then admission
+        order. Empty queue = immediate return (the capacity-event hot
+        path). Quota-blocked gangs are skipped whole (all-or-nothing);
+        a schedulable gang that still cannot place may trigger
+        preemption of lower-priority capacity. Caller holds self._lock;
+        ``force`` bypasses the per-PG attempt rate limit (job policy
+        changes, preemption completions)."""
+        if not self._pending_pgs:
+            return
+        now = time.time()
+        # pass-level rate limit: the sort + dominant-share math below
+        # is O(pending·jobs·PGs) under the GCS lock, and the hot
+        # callers (per-raylet gossip, queued-creation polls) can hit
+        # this hundreds of times a second — one pass per beat serves
+        # every PG whose own limit expired, the rest were pure waste
+        if not force and now - self._sched_pass_at < 0.25:
+            return
+        self._sched_pass_at = now
+        from ray_tpu._private import telemetry as _tm
+
+        shares: dict[str, float] = {}
+
+        def _share(name: str) -> float:
+            if name not in shares:
+                shares[name] = self._dominant_share(name)
+            return shares[name]
+
+        def _order(pg_id):
+            pg = self.placement_groups[pg_id]
+            return (-self._pg_priority(pg), _share(pg.job),
+                    pg.created_seq)
+
+        # Priority blocking: once a FEASIBLE higher-priority gang fails
+        # to place in this pass, strictly-lower-priority gangs are not
+        # attempted — freed/fresh capacity is held for the blocked gang
+        # instead of being backfilled out from under it (which forced a
+        # second preemption round: the victim's requeued gang would
+        # grab its own freed bundles before the preemptor's gossip view
+        # caught up). A gang that can't fit even an EMPTY cluster never
+        # raises the barrier, so an infeasible shape can't starve the
+        # tenants below it.
+        barrier_pri: int | None = None
+        for pg_id in sorted(self._pending_pgs, key=_order):
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.state not in ("PENDING", "RESCHEDULING"):
+                self._pending_pgs.discard(pg_id)
+                continue
+            pri = self._pg_priority(pg)
+            if barrier_pri is not None and pri < barrier_pri:
+                continue
+            if now < pg.holdoff_until:
+                continue   # freshly preempted: even force waits this out
+            if not force and now - pg.last_sched_attempt <= 0.25:
+                continue
+            pg.last_sched_attempt = now
+            if self._quota_blocked_pg(pg):
+                if not pg.quota_blocked:
+                    pg.quota_blocked = True
+                    job = self.jobs.get(pg.job)
+                    if job is not None:
+                        job.quota_rejections += 1
+                        self._persist_job(job)
+                    if _tm.ENABLED:
+                        _tm.counter_inc("ray_tpu_quota_rejections_total",
+                                        tags={"job": pg.job})
+                continue
+            pg.quota_blocked = False
+            self._try_schedule_pg(pg)
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                self._maybe_preempt_for(pg)
+                if self._feasible_on_totals(pg):
+                    barrier_pri = pri if barrier_pri is None \
+                        else max(barrier_pri, pri)
+        if _tm.ENABLED:
+            for name in self.jobs:
+                _tm.gauge_set("ray_tpu_job_dominant_share_ratio",
+                              _share(name), tags={"job": name})
 
     def _try_schedule_pg(self, pg: PlacementGroupInfo):
         """Bundle→node assignment over the live node table. The 2-phase
@@ -896,12 +1328,18 @@ class GcsServer:
             pg.bundle_nodes = assignment
             pg.state = "CREATED"
             pg.commit_ts = time.time()
+            self._pending_pgs.discard(pg.pg_id)
+            pg.quota_blocked = False
+            self._persist_pg(pg)
             # bundles ride along so raylets can reserve without calling back
             # into GCS (the push handler runs on their RPC reader thread)
             self._publish("placement_groups",
                           {"event": "created", "pg_id": pg.pg_id,
                            "bundle_nodes": assignment,
                            "bundles": [dict(b) for b in pg.bundles]})
+            self._publish("pg_state", {"event": "state",
+                                       "pg_id": pg.pg_id,
+                                       "state": "CREATED", "job": pg.job})
 
     def _place_on_contiguous_slice(self, pg, avail, take):
         """Try to place every bundle on a contiguous run of hosts (by TPU
@@ -992,6 +1430,183 @@ class GcsServer:
                         avail[k] = avail.get(k, 0) - v
         return avail
 
+    # ---- priority preemption ------------------------------------------------
+    # Graceful degradation, not failure: when a higher-priority gang
+    # cannot place, victims come from the LOWEST-priority job,
+    # newest-gang-first; each gets a PREEMPTION warning with a grace
+    # window (`gcs_preempt_grace_s`) — the Train plane's notice handler
+    # cuts a checkpoint inside it — then its bundles are reclaimed and
+    # it re-queues PENDING, resuming when capacity returns.
+
+    def _maybe_preempt_for(self, pg: "PlacementGroupInfo"):
+        """Pick and warn victims for an unplaceable pending gang.
+        Caller holds self._lock."""
+        from ray_tpu._private.config import get_config
+
+        my_pri = self._pg_priority(pg)
+        # Reclaims already in flight count as INCOMING capacity: the
+        # pending queue re-attempts this gang every rate-limit beat for
+        # the whole grace window, and without this each pass would warn
+        # one MORE victim than the preemptor needs (cascading
+        # over-preemption — three gangs checkpoint-interrupted where
+        # one sufficed; review finding).
+        inflight = [v for v in self.placement_groups.values()
+                    if v.state == "CREATED"
+                    and v.preempt_deadline is not None]
+        if inflight and self._placeable_with_freed(pg, inflight):
+            return   # enough already cooking — wait for the fires
+        cands = [v for v in self.placement_groups.values()
+                 if v.state == "CREATED" and v.preempt_deadline is None
+                 and self._pg_priority(v) < my_pri]
+        if not cands:
+            return
+        # lowest-priority job first; within it, newest gang first —
+        # the oldest (longest-amortized) work survives longest
+        cands.sort(key=lambda v: (self._pg_priority(v), -v.commit_ts,
+                                  -v.created_seq))
+        chosen: list = list(inflight)
+        for v in cands:
+            chosen.append(v)
+            if self._placeable_with_freed(pg, chosen):
+                break
+        if not self._placeable_with_freed(pg, chosen):
+            return   # even every lower-pri gang freed wouldn't fit: don't
+            #          preempt for nothing (infeasible shape)
+        grace = float(get_config("gcs_preempt_grace_s"))
+        for v in chosen:
+            if v.preempt_deadline is None:
+                self._warn_preemption(v, pg, grace)
+
+    def _feasible_on_totals(self, pg) -> bool:
+        """Could this gang fit an EMPTY cluster (first-fit over node
+        TOTALS)? The priority barrier only holds for feasible gangs."""
+        totals = {n.node_id: dict(n.resources)
+                  for n in self.nodes.values() if n.alive}
+        for bundle in pg.bundles:
+            for nid in totals:
+                a = totals[nid]
+                if all(a.get(k, 0.0) >= v for k, v in bundle.items()):
+                    for k, v in bundle.items():
+                        a[k] = a.get(k, 0.0) - v
+                    break
+            else:
+                return False
+        return True
+
+    def _placeable_with_freed(self, pg, victims: list) -> bool:
+        """First-fit feasibility check of ``pg`` against current
+        availability plus the victims' bundles added back (approximate:
+        strategy constraints are re-judged for real by
+        _try_schedule_pg once the bundles are actually released)."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        avail = {n.node_id: self._node_available_for_pg(n) for n in alive}
+        for v in victims:
+            for bundle, nid in zip(v.bundles, v.bundle_nodes):
+                if nid in avail:
+                    for k, amt in bundle.items():
+                        avail[nid][k] = avail[nid].get(k, 0.0) + amt
+        order = sorted(avail, key=lambda n: -sum(avail[n].values()))
+        for bundle in pg.bundles:
+            for nid in order:
+                a = avail[nid]
+                if all(a.get(k, 0.0) >= v for k, v in bundle.items()):
+                    for k, v in bundle.items():
+                        a[k] = a.get(k, 0.0) - v
+                    break
+            else:
+                return False
+        return True
+
+    def _warn_preemption(self, victim, preemptor, grace: float):
+        """Stamp the deadline, broadcast the warning, arm the fire
+        timer. Caller holds self._lock."""
+        victim.preempt_deadline = time.time() + grace
+        victim.preemptor = preemptor.pg_id if preemptor else None
+        self._publish("pg_state", {
+            "event": "preempt_warning", "pg_id": victim.pg_id,
+            "job": victim.job, "grace_s": grace,
+            "preemptor": victim.preemptor.hex()
+            if victim.preemptor else None})
+        _events.record("PREEMPTION_WARNED", pg_id=victim.pg_id.hex(),
+                       job=victim.job, grace_s=grace,
+                       preemptor=victim.preemptor.hex()
+                       if victim.preemptor else None)
+        threading.Thread(target=self._fire_after,
+                         args=(victim.pg_id, grace), daemon=True,
+                         name="gcs-preempt-fire").start()
+
+    def _fire_after(self, pg_id: bytes, grace: float):
+        time.sleep(grace)
+        if not self._server._stopped:
+            self._fire_preemption(pg_id)
+
+    def _fire_preemption(self, pg_id: bytes) -> bool:
+        """Grace elapsed: reclaim the victim's bundles (raylets release
+        reservations via the standard `removed` push), re-queue it
+        PENDING, and re-drive the queue so the preemptor places. The
+        victim's worker processes are the DRIVER'S to tear down (the
+        Train plane raises TrainPreemptedError and goes through the
+        gang-teardown path); until it does, the freed logical capacity
+        may briefly be oversubscribed — the documented teardown
+        bound."""
+        from ray_tpu._private import telemetry as _tm
+
+        with self._lock:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.state != "CREATED" \
+                    or pg.preempt_deadline is None:
+                return False   # removed/re-placed/node-death superseded
+            preemptor = pg.preemptor
+            pg.preempt_deadline = None
+            pg.preemptor = None
+            pg.state = "PENDING"
+            pg.bundle_nodes = [None] * len(pg.bundles)
+            pg.commit_ts = 0.0
+            pg.holdoff_until = time.time() + 0.5
+            pg.preempted_at = time.time()
+            self._pending_pgs.add(pg_id)
+            self._persist_pg(pg)
+            job = self.jobs.get(pg.job)
+            if job is not None:
+                job.preemptions += 1
+                self._persist_job(job)
+            self._publish("placement_groups", {"event": "removed",
+                                               "pg_id": pg_id})
+            self._publish("pg_state", {"event": "state", "pg_id": pg_id,
+                                       "state": "PREEMPTED",
+                                       "job": pg.job})
+            _events.record("PREEMPTION_FIRED", pg_id=pg_id.hex(),
+                           job=pg.job,
+                           preemptor=preemptor.hex() if preemptor
+                           else None)
+            if _tm.ENABLED:
+                _tm.counter_inc("ray_tpu_preemptions_total",
+                                tags={"job": pg.job})
+            self._maybe_schedule_pending(force=True)
+            self._refresh_quota_throttle_locked(force=True)
+        return True
+
+    def rpc_preempt_job(self, conn, name: str, grace_s: float = None):
+        """Force-preempt the named job's newest CREATED gang (the fault
+        DSL's `preempt_job` primitive and the admin escape hatch): same
+        warning → grace → reclaim lifecycle as an organic priority
+        preemption. Returns the victim pg id hex, or None when the job
+        holds no preemptible gang."""
+        from ray_tpu._private.config import get_config
+
+        grace = (float(grace_s) if grace_s is not None
+                 else float(get_config("gcs_preempt_grace_s")))
+        with self._lock:
+            cands = [pg for pg in self.placement_groups.values()
+                     if pg.job == name and pg.state == "CREATED"
+                     and pg.preempt_deadline is None]
+            if not cands:
+                return None
+            victim = max(cands, key=lambda p: (p.commit_ts,
+                                               p.created_seq))
+            self._warn_preemption(victim, None, grace)
+            return victim.pg_id.hex()
+
     def rpc_get_placement_group(self, conn, pg_id: bytes = None,
                                 name: str = None):
         with self._lock:
@@ -1001,14 +1616,12 @@ class GcsServer:
                         return pg.snapshot()
                 return None
             pg = self.placement_groups.get(pg_id)
-            # Late scheduling: nodes may have joined since creation. Rate-
-            # limited — dozens of queued actor creations poll this RPC at
-            # 50/s each and the window scan is O(hosts² · bundles).
+            # Late scheduling: nodes may have joined since creation —
+            # re-drive the QUEUE (rate-limited per PG) so a poll can
+            # unblock its gang without letting a hard-polled low-pri
+            # PG jump the fair-share order.
             if pg is not None and pg.state in ("PENDING", "RESCHEDULING"):
-                now = time.time()
-                if now - pg.last_sched_attempt > 0.25:
-                    pg.last_sched_attempt = now
-                    self._try_schedule_pg(pg)
+                self._maybe_schedule_pending()
             return pg.snapshot() if pg else None
 
     def rpc_remove_placement_group(self, conn, pg_id: bytes):
@@ -1017,9 +1630,21 @@ class GcsServer:
             if pg is None:
                 return False
             pg.state = "REMOVED"
+            pg.preempt_deadline = None
+            pg.preemptor = None
+            self._pending_pgs.discard(pg_id)
             self._persist_pg(pg)
+            # removal IS a capacity event: the freed bundles may place
+            # queued demand (the gossip tick would also get there, but
+            # a tenant releasing capacity shouldn't make the next one
+            # wait out a gossip round)
+            self._maybe_schedule_pending(force=True)
+            self._refresh_quota_throttle_locked(force=True)
         self._publish("placement_groups", {"event": "removed",
                                            "pg_id": pg_id})
+        self._publish("pg_state", {"event": "state", "pg_id": pg_id,
+                                   "state": "REMOVED",
+                                   "job": pg.job})
         return True
 
     def rpc_list_placement_groups(self, conn):
@@ -1093,6 +1718,19 @@ class GcsServer:
             return [{"node_id": n.node_id, "alive": n.alive}
                     for n in self.nodes.values()]
 
+    def _pg_state_resync_snapshot(self) -> list[dict]:
+        """PG-table state for a `pg_state` subscriber reconverging after
+        a feed gap: a waiter that missed its CREATED transition (or a
+        preemption monitor that missed the warning) re-reads it from
+        here instead of hanging on the feed. REMOVED rows are excluded —
+        the table retains them and consumers only wait on live ids."""
+        with self._lock:
+            return [{"pg_id": pg.pg_id, "state": pg.state, "job": pg.job,
+                     "preempt_deadline": pg.preempt_deadline,
+                     "preempted_at": pg.preempted_at}
+                    for pg in self.placement_groups.values()
+                    if pg.state != "REMOVED"]
+
     # ---- durable store (write-through fault tolerance) ----------------------
     # Reference: src/ray/gcs/store_client/redis_store_client.h — in
     # fault-tolerant mode every actor/PG/KV/job mutation lands in the
@@ -1118,7 +1756,9 @@ class GcsServer:
         self._store.put("pgs", pg.pg_id.hex(), pickle.dumps({
             "pg_id": pg.pg_id, "bundles": pg.bundles,
             "strategy": pg.strategy, "name": pg.name, "state": pg.state,
-            "bundle_nodes": pg.bundle_nodes}))
+            "bundle_nodes": pg.bundle_nodes, "job": pg.job,
+            "created_seq": pg.created_seq,
+            "preempted_at": pg.preempted_at}))
 
     def _persist_node(self, node: "NodeInfo"):
         """Node-table durability (reference: gcs_node_manager over the
@@ -1156,8 +1796,9 @@ class GcsServer:
         pgs = self._store.get_all("pgs")
         kv = self._store.get_all("kv")
         nodes = self._store.get_all("nodes")
+        job_rows = self._store.get_all("jobs")
         if meta is None and not actors and not pgs and not kv \
-                and not nodes:
+                and not nodes and not job_rows:
             return   # fresh store: nothing to restore
         if meta is not None:
             m = pickle.loads(meta)
@@ -1188,10 +1829,23 @@ class GcsServer:
         for blob in pgs.values():
             d = pickle.loads(blob)
             pg = PlacementGroupInfo(d["pg_id"], d["bundles"],
-                                    d["strategy"], d["name"])
+                                    d["strategy"], d["name"],
+                                    d.get("job", ""))
             pg.state = d["state"]
             pg.bundle_nodes = d["bundle_nodes"]
+            pg.created_seq = d.get("created_seq", 0)
+            pg.preempted_at = d.get("preempted_at")
+            self._pg_seq = max(self._pg_seq, pg.created_seq)
             self.placement_groups[d["pg_id"]] = pg
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                self._pending_pgs.add(pg.pg_id)
+        for blob in job_rows.values():
+            d = pickle.loads(blob)
+            job = JobInfo(d["name"], d["quota"], d["priority"])
+            job.created_at = d["created_at"]
+            job.preemptions = d["preemptions"]
+            job.quota_rejections = d["quota_rejections"]
+            self.jobs[d["name"]] = job
         for skey, value in kv.items():
             ns, _, keyhex = skey.partition("\x00")
             self.kv.setdefault(ns, {})[bytes.fromhex(keyhex)] = value
@@ -1240,9 +1894,10 @@ class GcsServer:
                 if pg.state == "CREATED" and \
                         not all(n in alive for n in pg.bundle_nodes):
                     pg.state = "RESCHEDULING"
+                    self._pending_pgs.add(pg.pg_id)
                     self._persist_pg(pg)
                 # PENDING/RESCHEDULING PGs reschedule on the next
-                # report_resources gossip tick
+                # report_resources gossip tick (via the pending queue)
         for actor_id in to_recreate:
             self._push_recreate(actor_id)
 
@@ -1302,6 +1957,13 @@ class GcsServer:
                                     for a in self.actors.values()),
                 "objects_tracked": len(self.object_locations),
                 "placement_groups": len(self.placement_groups),
+                "pending_pgs": len(self._pending_pgs),
+                "jobs": len(self.jobs),
+                "preemptions_fired": sum(j.preemptions
+                                         for j in self.jobs.values()),
+                "quota_rejections": sum(j.quota_rejections
+                                        for j in self.jobs.values()),
+                "jobs_over_quota": sorted(self._quota_over),
             }
         # control-plane scale counters (soak harness / `ray-tpu control`)
         with self._death_lock:

@@ -455,9 +455,9 @@ class Subscriber:
 
 
 class ActorDeathWatch:
-    """Handle for one GCS actor-death subscription (see
-    ``watch_actor_deaths``); ``stop()`` tears down both the poll loop
-    and its dedicated GCS connection."""
+    """Handle for one GCS channel subscription (see
+    ``watch_channel`` / ``watch_actor_deaths``); ``stop()`` tears down
+    both the poll loop and its dedicated GCS connection."""
 
     def __init__(self, rpc, sub):
         self._rpc = rpc
@@ -476,6 +476,33 @@ class ActorDeathWatch:
                 rpc.close()
             except Exception:
                 pass
+
+
+def watch_channel(channel: str, callback, gcs_addr,
+                  poll_timeout: float = 5.0) -> ActorDeathWatch:
+    """One GCS channel subscription on a DEDICATED
+    ``ReconnectingRpcClient`` with ``auto_resync`` — the shared
+    plumbing under ``watch_actor_deaths``, the placement-group waiter,
+    and the Train plane's preemption monitor, so the
+    reconnect/resync semantics cannot drift between them. ``callback``
+    receives raw channel messages INCLUDING the synthetic
+    ``{"event": "resync", "snapshot": ...}``. Raises on setup failure
+    (callers pick their degraded mode); returns a handle whose
+    ``stop()`` tears down the loop + connection."""
+    from ray_tpu._private.protocol import ReconnectingRpcClient
+
+    rpc = ReconnectingRpcClient(tuple(gcs_addr), timeout=30.0)
+    try:
+        sub = Subscriber(rpc, poll_timeout=poll_timeout,
+                         auto_resync=True)
+        sub.subscribe(channel, callback)
+    except Exception:
+        try:
+            rpc.close()
+        except Exception:
+            pass
+        raise
+    return ActorDeathWatch(rpc, sub)
 
 
 def watch_actor_deaths(on_death, poll_timeout: float = 5.0,
@@ -508,8 +535,6 @@ def watch_actor_deaths(on_death, poll_timeout: float = 5.0,
     messages but never a death (consumers are duplicate-tolerant by
     the at-least-once contract).
     """
-    from ray_tpu._private.protocol import ReconnectingRpcClient
-
     if gcs_addr is None:
         from ray_tpu._private.worker_runtime import current_worker
 
@@ -517,33 +542,24 @@ def watch_actor_deaths(on_death, poll_timeout: float = 5.0,
         if worker is None:
             return None
         gcs_addr = worker.gcs.addr
-    rpc = ReconnectingRpcClient(tuple(gcs_addr), timeout=30.0)
-    try:
-        sub = Subscriber(rpc, poll_timeout=poll_timeout, auto_resync=True)
 
-        def _cb(msg):
-            if not isinstance(msg, dict):
-                return
-            if msg.get("event") == "resync":
-                for row in (msg.get("snapshot") or ()):
-                    if row.get("state") in ("DEAD", "RESTARTING") and \
-                            row.get("actor_id") is not None:
-                        on_death(row["actor_id"],
-                                 str(row.get("reason")
-                                     or row["state"].lower()))
-                return
-            if msg.get("event") not in ("dead", "restarting"):
-                return
-            actor_id = msg.get("actor_id")
-            if actor_id is None:
-                return
-            on_death(actor_id, str(msg.get("reason") or msg["event"]))
+    def _cb(msg):
+        if not isinstance(msg, dict):
+            return
+        if msg.get("event") == "resync":
+            for row in (msg.get("snapshot") or ()):
+                if row.get("state") in ("DEAD", "RESTARTING") and \
+                        row.get("actor_id") is not None:
+                    on_death(row["actor_id"],
+                             str(row.get("reason")
+                                 or row["state"].lower()))
+            return
+        if msg.get("event") not in ("dead", "restarting"):
+            return
+        actor_id = msg.get("actor_id")
+        if actor_id is None:
+            return
+        on_death(actor_id, str(msg.get("reason") or msg["event"]))
 
-        sub.subscribe("actors", _cb)
-    except Exception:
-        try:
-            rpc.close()
-        except Exception:
-            pass
-        raise
-    return ActorDeathWatch(rpc, sub)
+    return watch_channel("actors", _cb, gcs_addr,
+                         poll_timeout=poll_timeout)

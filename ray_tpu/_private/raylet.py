@@ -123,7 +123,7 @@ class WorkerHandle:
 
 class Lease:
     def __init__(self, lease_id: str, resources: dict, worker: WorkerHandle,
-                 lessee: tuple | None = None):
+                 lessee: tuple | None = None, job: str | None = None):
         self.lease_id = lease_id
         self.resources = resources
         self.worker = worker
@@ -133,6 +133,9 @@ class Lease:
         # connection; a dead lessee's resources must be reclaimed)
         self.lessee_id = lessee[0] if lessee else None
         self.lessee_addr = tuple(lessee[1]) if lessee else None
+        # multi-tenant label: per-job lease usage is gossiped to the GCS
+        # (quota accounting) and over-quota jobs are throttled at grant
+        self.job = job or None
 
 
 class Raylet:
@@ -184,6 +187,10 @@ class Raylet:
         # resource shapes of requests currently queued on this node — the
         # autoscaler's demand signal (reference: LoadMetrics resource_load)
         self._queued_demand: list[dict] = []
+        # jobs the GCS currently reports over quota (`jobs` channel):
+        # lease grants for these queue until the throttle clears.
+        # Replaced wholesale per quota push, never grown per id.
+        self._job_throttle: frozenset[str] = frozenset()
         self._stopped = False
 
         # Monitors are CONSTRUCTED before the RPC server starts: the
@@ -261,7 +268,17 @@ class Raylet:
                        "pid": os.getpid(),
                        "object_data_port": self.data_port,
                        "tpu": self.tpu_topology})
-        gcs.call("subscribe", channels=["placement_groups"])
+        gcs.call("subscribe", channels=["placement_groups", "jobs"])
+        try:
+            # seed the over-quota view: the jobs channel is
+            # publish-on-change, so a fresh (or re-registering) node
+            # can't wait for the next transition to learn the CURRENT
+            # set. Best-effort — a miss degrades to unthrottled grants
+            # until the next change push, never fails registration.
+            self._job_throttle = frozenset(
+                gcs.call("get_job_throttle"))
+        except Exception:
+            pass
         with self._lock:
             live = [(h.actor_id, h.addr)
                     for h in self._workers.values()
@@ -335,6 +352,12 @@ class Raylet:
                                          msg["bundles"])
             elif msg["event"] == "removed":
                 self._release_pg_bundles(msg["pg_id"])
+        elif method == "pubsub" and kwargs.get("channel") == "jobs":
+            msg = kwargs["message"]
+            if msg.get("event") == "quota":
+                # cluster-wide quota view (eventually consistent by one
+                # gossip round); queued lease grants re-check it per poll
+                self._job_throttle = frozenset(msg.get("over", ()))
 
     def _reserve_pg_bundles(self, pg_id: bytes, bundle_nodes: list[str],
                             bundles: list[dict]):
@@ -495,6 +518,12 @@ class Raylet:
                         demand = [dict(d) for d in self._queued_demand]
                         busy = len(self._leases) + sum(
                             1 for w in self._workers.values() if w.is_actor)
+                        job_busy: dict[str, dict] = {}
+                        for lease in self._leases.values():
+                            if lease.job:
+                                agg = job_busy.setdefault(lease.job, {})
+                                for k, v in lease.resources.items():
+                                    agg[k] = agg.get(k, 0.0) + v
                     from ray_tpu._private import telemetry as _tm
 
                     _tm.gauge_set("ray_tpu_scheduler_queue_tasks",
@@ -502,7 +531,8 @@ class Raylet:
                                   tags={"node_id": self.node_id})
                     self._gcs.push("report_resources",
                                    node_id=self.node_id, available=avail,
-                                   pending_demand=demand, busy=busy)
+                                   pending_demand=demand, busy=busy,
+                                   job_busy=job_busy)
                 except Exception:
                     pass
 
@@ -747,7 +777,10 @@ class Raylet:
         resources free (long-poll: the reply is sent when granted)."""
         t0 = time.monotonic()
         strategy = strategy or {}
-        # Placement-group leases consume the reserved bundle resources.
+        job = strategy.get("job")
+        # Placement-group leases consume the reserved bundle resources —
+        # their job's quota was already enforced at PG admission (the
+        # all-or-nothing gang check), so no second gate here.
         pg_id = strategy.get("placement_group_id")
         if pg_id is not None:
             return self._pg_lease(pg_id, strategy.get("bundle_index", -1),
@@ -767,12 +800,28 @@ class Raylet:
             target = self._pick_spillback(resources)
             if target is not None and os.urandom(1)[0] < 128:
                 return {"spillback": target}
-        if self._try_reserve(resources):
-            return self._observe_grant(t0, self._grant(resources, lessee))
+        # zero-resource leases (utility tasks like the PG-ready waiter)
+        # consume nothing — parking them on the quota throttle would
+        # hang control work without protecting any capacity
+        consumes = any(v > 0 for v in resources.values())
+        throttled = job is not None and consumes \
+            and job in self._job_throttle
+        if throttled:
+            # lease-grant quota enforcement: the job is over its
+            # cluster-wide quota — queue (don't grant, don't bounce
+            # around the cluster) until the GCS clears the throttle
+            from ray_tpu._private import telemetry as _tm
+
+            if _tm.ENABLED:
+                _tm.counter_inc("ray_tpu_quota_rejections_total",
+                                tags={"job": job})
+        elif self._try_reserve(resources):
+            return self._observe_grant(t0,
+                                       self._grant(resources, lessee, job))
         # no_spill: the caller exhausted its spillback hops on a saturated
         # cluster — queue here instead of bouncing (the reference keeps the
         # request in ClusterTaskManager's queue in this state).
-        if not strategy.get("no_spill"):
+        if not throttled and not strategy.get("no_spill"):
             target = self._pick_spillback(resources)
             if target is not None:
                 return {"spillback": target}
@@ -787,9 +836,13 @@ class Raylet:
             while time.time() < deadline:
                 if self._stopped:
                     raise ConnectionLost("raylet shutting down")
+                if job is not None and consumes \
+                        and job in self._job_throttle:
+                    time.sleep(_LEASE_QUEUE_POLL)
+                    continue   # quota throttle: park without reserving
                 if self._try_reserve(resources):
                     return self._observe_grant(
-                        t0, self._grant(resources, lessee))
+                        t0, self._grant(resources, lessee, job))
                 # Re-evaluate spillback while queued: a node that joined
                 # (autoscaler, chaos replacement) after we started waiting
                 # may be able to serve this request right now.
@@ -848,7 +901,8 @@ class Raylet:
                                for k, v in resources.items())
             for n in nodes)
 
-    def _grant(self, resources: dict, lessee: tuple | None = None) -> dict:
+    def _grant(self, resources: dict, lessee: tuple | None = None,
+               job: str | None = None) -> dict:
         """Resources must already be reserved via _try_reserve. Runs outside
         _lock because _pop_worker may block on worker registration."""
         try:
@@ -858,7 +912,7 @@ class Raylet:
                 self._give_back(resources)
             raise
         lease_id = uuid.uuid4().hex
-        lease = Lease(lease_id, resources, worker, lessee)
+        lease = Lease(lease_id, resources, worker, lessee, job)
         worker.assigned_lease = lease_id
         with self._lock:
             self._leases[lease_id] = lease

@@ -74,6 +74,12 @@ class SimRaylet:
         self.accepted_leases: dict[str, dict] = {}
         self._lease_counter = 0
         self._watching_actors = False
+        # PG bundle reservations on this node (pg_id -> summed resources;
+        # released on the `removed` push) — the real raylet's
+        # _pg_reserved analog, so the availability this node gossips
+        # reflects committed gangs and the multi-tenant scheduler packs
+        # against reality instead of forever-full nodes
+        self._pg_reserved: dict[bytes, dict] = {}
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
@@ -99,7 +105,7 @@ class SimRaylet:
                  addr=("127.0.0.1", _FAKE_PORT_BASE + self.index),
                  resources=self.resources,
                  meta={"hostname": self.tag, "sim": True})
-        gcs.call("subscribe", channels=["nodes"])
+        gcs.call("subscribe", channels=["nodes", "placement_groups"])
 
     def _teardown_connections(self):
         for c in (self._watch, self._sub):
@@ -128,6 +134,11 @@ class SimRaylet:
         self.state = "flapping"
         self._rejoin_at_tick = self.cluster.tick_count + max(1, down_ticks)
         self._teardown_connections()
+        with self._lock:
+            # the GCS reschedules our gangs onto survivors while we are
+            # away; a rejoin must not keep gossiping phantom
+            # reservations for bundles that moved
+            self._pg_reserved.clear()
 
     def _rejoin(self):
         self.start()
@@ -160,6 +171,34 @@ class SimRaylet:
             return
         if method == "pubsub" and kwargs.get("channel") == "nodes":
             self._consume_nodes_message(kwargs.get("message"))
+        elif method == "pubsub" and \
+                kwargs.get("channel") == "placement_groups":
+            self._consume_pg_message(kwargs.get("message"))
+
+    def _consume_pg_message(self, msg):
+        if not isinstance(msg, dict):
+            return
+        if msg.get("event") == "created":
+            reserved: dict = {}
+            for bundle, nid in zip(msg.get("bundles", ()),
+                                   msg.get("bundle_nodes", ())):
+                if nid == self.node_id:
+                    for k, v in bundle.items():
+                        reserved[k] = reserved.get(k, 0.0) + v
+            if reserved:
+                with self._lock:
+                    self._pg_reserved[msg["pg_id"]] = reserved
+        elif msg.get("event") == "removed":
+            with self._lock:
+                self._pg_reserved.pop(msg.get("pg_id"), None)
+
+    def available(self) -> dict:
+        with self._lock:
+            out = dict(self.resources)
+            for reserved in self._pg_reserved.values():
+                for k, v in reserved.items():
+                    out[k] = out.get(k, 0.0) - v
+        return out
 
     def _on_feed(self, msg):
         """Long-poll plane (Subscriber callback, incl. resync)."""
@@ -207,7 +246,7 @@ class SimRaylet:
                 return
         try:
             self._gcs.push("report_resources", node_id=self.node_id,
-                           available=dict(self.resources),
+                           available=self.available(),
                            busy=len(self.accepted_leases))
         except Exception:   # ConnectionLost while the GCS restarts —
             pass            # the next tick's push heals the channel
@@ -282,6 +321,10 @@ class SimCluster:
         self.gcs_addr: tuple | None = None
         self._probe_n = 0
         self.raylets: list[SimRaylet] = []
+        # multi-tenant driving state: job name -> deterministic PG
+        # counter (jobs are registered once per soak; `stop()` is the
+        # removal path for the whole harness)
+        self._jobs: dict[str, int] = {}
 
     # ------------------------------------------------------------------ GCS
     def start(self):
@@ -398,6 +441,87 @@ class SimCluster:
         self._journal(f"{method} fired={sorted(verdicts)}")
         return verdicts
 
+    # ----------------------------------------------------- multi-tenancy
+    def register_job(self, name: str, quota: dict | None = None,
+                     priority: int = 0):
+        """Register one tenant against the harness GCS (journaled — the
+        registration order is part of the deterministic schedule)."""
+        self.gcs_call("register_job", name=name, quota=quota,
+                      priority=priority)
+        self._jobs.setdefault(name, 0)
+        self._journal(f"register_job {name} pri={priority} "
+                      f"quota={sorted((quota or {}).items())}")
+
+    def create_job_pg(self, job: str, n_bundles: int = 1,
+                      cpu: float = 1.0, strategy: str = "SPREAD") -> bytes:
+        """One gang for ``job`` with a DETERMINISTIC pg id (derived from
+        the per-job counter, not urandom — pg identity must not vary
+        run-to-run or the journal could not stay byte-identical)."""
+        import hashlib
+
+        self._jobs.setdefault(job, 0)
+        self._jobs[job] += 1
+        # hash the FULL job name into the id: a truncated-prefix scheme
+        # collides for jobs sharing 8 leading chars, and the GCS's
+        # idempotent-create replay would silently alias the second gang
+        # onto the first
+        pg_id = hashlib.sha256(
+            f"simpg|{job}|{self._jobs[job]}".encode()).digest()[:16]
+        self.gcs_call("create_placement_group", pg_id=pg_id,
+                      bundles=[{"CPU": float(cpu)}] * n_bundles,
+                      strategy=strategy,
+                      name=f"{job}-g{self._jobs[job]}", job=job)
+        self._journal(f"create_pg {job} g{self._jobs[job]} "
+                      f"n={n_bundles} cpu={cpu:g}")
+        return pg_id
+
+    def jobs_tick(self, method: str = "job_tick") -> dict[str, list]:
+        """Consult the chaos schedule ONCE per registered job at this
+        deterministic boundary; a fired ``preempt_job`` rule issues the
+        GCS preempt RPC (warning + grace + reclaim) against that job's
+        newest gang. The consult outcome is journaled; WHICH gang the
+        GCS picks is wall-clock-dependent scheduling state and goes to
+        ``metrics`` only."""
+        self.tick_count += 1
+        fired: dict[str, list] = {}
+        for job in sorted(self._jobs):
+            inj = _fi.ACTIVE
+            verdicts = (inj.on_job(job, method)
+                        if inj is not None else [])
+            if not verdicts:
+                continue
+            fired[job] = verdicts
+            for action, param_s in verdicts:
+                if action == "preempt_job":
+                    self._journal(f"preempt_job {job} ({method})")
+                    try:
+                        victim = self.gcs_call("preempt_job", name=job,
+                                               grace_s=param_s)
+                    except Exception:
+                        victim = None
+                    stat = self.metrics.setdefault("preempt_rpcs", [])
+                    stat.append({"job": job, "victim": victim})
+        return fired
+
+    def sample_jobs(self) -> dict:
+        """One `list_jobs` sample folded to the soak's acceptance
+        numbers; the deterministic violation COUNT is journaled (always
+        zero on a correct scheduler — a nonzero count diverges the
+        journal exactly when the run fails anyway)."""
+        rows = self.gcs_call("list_jobs")
+        violations = sorted(r["Job"] for r in rows if r.get("OverQuota"))
+        sample = {
+            "violations": violations,
+            "preemptions": sum(r.get("Preemptions", 0) for r in rows),
+            "quota_rejections": sum(r.get("QuotaRejections", 0)
+                                    for r in rows),
+            "created": sum(r["PlacementGroups"]["created"] for r in rows),
+            "pending": sum(r["PlacementGroups"]["pending"] for r in rows),
+        }
+        self.metrics.setdefault("job_samples", []).append(sample)
+        self._journal(f"jobs_sampled violations={len(violations)}")
+        return sample
+
     # -------------------------------------------------------- convergence
     def gcs_call(self, method: str, **kw):
         client = RpcClient(self.gcs_addr, timeout=15.0)
@@ -499,6 +623,7 @@ class SimCluster:
         return out
 
     def stop(self):
+        self._jobs.clear()   # tenant counters die with the harness
         for r in self.raylets:
             r.stop()
         if self._gcs_obj is not None:

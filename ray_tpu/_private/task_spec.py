@@ -109,7 +109,7 @@ def validate_task_spec(spec: dict[str, Any], *, actor: bool = False):
 # (raylet.rpc_request_worker_lease + the PG/spread policies)
 LEASE_STRATEGY_KEYS = frozenset({
     "placement_group_id", "bundle_index", "node_id", "soft", "spread",
-    "no_spill",
+    "no_spill", "job",
 })
 
 # keys the lessee reads off a grant (_LeasedWorker + return_lease)

@@ -130,6 +130,30 @@ CATALOG: dict[str, dict] = {
         "description": "register_node calls that queued on the bounded "
                        "admission gate during a registration burst",
     },
+    # --- multi-tenant scheduling (gcs.py job registry) ---
+    # job names are operator-chosen and bounded (one per tenant /
+    # workload), the same cardinality class as Serve deployment names
+    "ray_tpu_preemptions_total": {
+        "kind": "Counter", "tags": ("job",),
+        "description": "Placement groups preempted (bundles reclaimed "
+                       "after the grace window) per victim job — the "
+                       "priority plane's graceful-degradation counter",
+    },
+    "ray_tpu_quota_rejections_total": {
+        "kind": "Counter", "tags": ("job",),
+        "description": "Admissions refused because they would push a "
+                       "job over its resource quota: placement groups "
+                       "held PENDING at the GCS (counted once per "
+                       "transition into the blocked state) and leases "
+                       "throttled at raylet grant",
+    },
+    "ray_tpu_job_dominant_share_ratio": {
+        "kind": "Gauge", "tags": ("job",),
+        "description": "Each job's dominant resource share — max over "
+                       "resources of usage / (quota if set, else "
+                       "cluster total) — the weight the fair-share "
+                       "scheduler orders pending bundles by",
+    },
     # --- event log (events.py) ---
     "ray_tpu_events_dropped_total": {
         "kind": "Counter", "tags": (),

@@ -2129,6 +2129,16 @@ class CoreWorker:
         direct_task_transport RequestNewWorkerIfNeeded + spillback replies)."""
         from ray_tpu._private.task_spec import validate_lease_request
 
+        if strategy is None or "job" not in strategy:
+            # multi-tenant label: leases inherit this process's current
+            # job so raylet-side quota throttling and the GCS's per-job
+            # usage gossip see plain task/actor work, not just PGs
+            from ray_tpu.util import jobs as _jobs
+
+            job = _jobs.current_job()
+            if job:
+                strategy = dict(strategy or {})
+                strategy["job"] = job
         # producer-side shape check: a typo'd resource/strategy key fails
         # here, not as an ignored kwarg inside a remote raylet
         validate_lease_request(resources, strategy)

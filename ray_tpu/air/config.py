@@ -19,6 +19,11 @@ class ScalingConfig:
     resources_per_worker: dict | None = None
     placement_strategy: str = "PACK"
     trainer_resources: dict | None = None
+    # multi-tenant label: the gang's placement group (and therefore its
+    # quota accounting, fair-share weight, and preemption priority) is
+    # attributed to this named job (ray_tpu.util.jobs). None inherits
+    # the process's current job.
+    job: str | None = None
 
     @property
     def num_chips(self) -> int:

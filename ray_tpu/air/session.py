@@ -24,6 +24,9 @@ class _Session:
         self.finished = threading.Event()
         self.error: BaseException | None = None
         self.iteration = 0
+        # set by TrainWorker.notify_preemption when the gang's placement
+        # group receives a PREEMPTION warning: {"grace_s", "warned_at"}
+        self.preempt_notice: dict | None = None
 
     def report(self, metrics: dict, checkpoint=None):
         self.iteration += 1
@@ -84,6 +87,18 @@ def get_dataset_shard(dataset_name: str = "train"):
 def get_checkpoint():
     """Starting checkpoint when resuming (Tune restore / PBT exploit)."""
     return getattr(_get_session(), "resume_checkpoint", None)
+
+
+def preemption_warned() -> dict | None:
+    """Non-None once this gang's placement group received a PREEMPTION
+    warning from the multi-tenant scheduler: a higher-priority job will
+    reclaim its bundles after the grace window. A cooperative train
+    loop checks this between steps and cuts a checkpoint (via
+    ``report(..., checkpoint=...)``) inside the window — the driver
+    then tears the gang down gracefully and resumes it from that
+    checkpoint when capacity returns. Returns
+    ``{"grace_s": float, "warned_at": epoch_s}``."""
+    return _get_session().preempt_notice
 
 
 def get_trial_name() -> str:

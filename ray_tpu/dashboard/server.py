@@ -9,6 +9,9 @@ Routes (reference modules in parens — dashboard/modules/*):
     /api/workers            (reporter)
     /api/placement_groups   (state)
     /api/jobs               (job)
+    /api/tenancy            multi-tenant summary: per-job priority/
+                            quota/usage/share, preemption + quota
+                            rejection rollups
     /api/events             structured runtime event log (cluster events)
     /api/collectives        data-plane summary: collective ops,
                             stragglers, compile stats, device gauges
@@ -116,6 +119,8 @@ class DashboardServer:
                 payload = generate_default_dashboard()
             elif path == "/api/jobs":
                 payload = self._jobs()
+            elif path == "/api/tenancy":
+                payload = state.summarize_jobs(address=self.address)
             elif path == "/api/serve":
                 payload = self._serve_status()
             elif path == "/api/timeline":

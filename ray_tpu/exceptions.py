@@ -160,6 +160,24 @@ class TrainWorkerGroupError(RayTpuError):
         return (type(self), (errs, self.dead_ranks, str(self)))
 
 
+class JobQuotaError(RayTpuError, ValueError):
+    """A job-registry operation carried an invalid quota/priority shape
+    (negative amounts, non-numeric values, unknown job on update). Raised
+    at the GCS admission boundary so a mis-specified tenant fails at
+    registration, not as a silently never-scheduling placement group."""
+
+
+class TrainPreemptedError(TrainWorkerGroupError):
+    """The training gang's placement group was preempted by a
+    higher-priority job (multi-tenant control plane). This is graceful
+    degradation, not a failure: the victim received a PREEMPTION warning
+    with a grace window to cut a checkpoint, the GCS reclaimed its
+    bundles, and ``fit()`` tears the gang down through the elastic-FT
+    path and re-queues it — WITHOUT charging a
+    ``FailureConfig.max_failures`` token — to resume from the latest
+    checkpoint when capacity returns."""
+
+
 class ServeConfigError(RayTpuError, ValueError):
     """A Serve DeploymentConfig / AutoscalingConfig carried an invalid
     value (num_replicas <= 0, min_replicas > max_replicas, negative

@@ -439,6 +439,30 @@ def summarize_control_plane(*, address: str | None = None) -> dict:
     }
 
 
+def summarize_jobs(*, address: str | None = None) -> dict:
+    """Multi-tenant rollup (the GCS job table + live usage): one row
+    per job — priority, quota, cluster-wide usage (CREATED PG bundles +
+    gossiped lease usage), dominant resource share, created/pending PG
+    counts, preemption and quota-rejection counters — plus the
+    cluster totals the soak asserts against:
+
+    - ``quota_violations``: jobs whose live usage exceeds their quota
+      (MUST be empty — quota enforcement is admission-time, so a
+      violation means the scheduler placed past a cap);
+    - ``preemptions`` / ``quota_rejections``: cluster totals.
+    """
+    with _gcs(address) as call:
+        rows = call("list_jobs")
+    return {
+        "jobs": rows,
+        "quota_violations": sorted(r["Job"] for r in rows
+                                   if r.get("OverQuota")),
+        "preemptions": sum(r.get("Preemptions", 0) for r in rows),
+        "quota_rejections": sum(r.get("QuotaRejections", 0)
+                                for r in rows),
+    }
+
+
 def cluster_status(*, address: str | None = None) -> str:
     """`ray status` analog (reference: scripts.py:1872): node table +
     resource usage summary."""

@@ -333,6 +333,18 @@ def cmd_control(args):
     return 0
 
 
+def cmd_jobs(args):
+    """Multi-tenant job summary — the CLI face of
+    `experimental.state.api.summarize_jobs`: per-job priority/quota/
+    usage/dominant-share plus preemption and quota-rejection rollups
+    (and the quota-violation list, which must stay empty)."""
+    from ray_tpu.experimental.state.api import summarize_jobs
+
+    print(json.dumps(summarize_jobs(address=args.address),
+                     indent=2, default=str))
+    return 0
+
+
 def cmd_steps(args):
     """Step-anatomy summary — the CLI face of
     `experimental.state.api.summarize_steps`: per-step/per-rank
@@ -555,6 +567,12 @@ def main(argv=None):
                              "block locality)")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_data)
+
+    sp = sub.add_parser("jobs",
+                        help="multi-tenant job quota/priority/preemption "
+                             "summary")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_jobs)
 
     sp = sub.add_parser("control",
                         help="control-plane scale/health summary "

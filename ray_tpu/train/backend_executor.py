@@ -76,6 +76,127 @@ class _GangDeathMonitor:
             watch.stop()
 
 
+class _PreemptionMonitor:
+    """Driver-side preemption-notice handler (multi-tenant control
+    plane): subscribes to the GCS `pg_state` channel for the gang's
+    placement group. On the PREEMPTION WARNING it pushes the notice to
+    every rank (``TrainWorker.notify_preemption`` →
+    ``session.preemption_warned()``) so the train loop can cut a
+    checkpoint inside the grace window; when the preemption FIRES (the
+    GCS reclaimed the bundles) it flips ``fired``, which
+    ``next_results``'s abort check turns into ``TrainPreemptedError`` —
+    the graceful teardown-requeue-resume path, not a failure. Rides
+    PR 12's snapshot-resync so a missed feed message cannot hide a
+    preemption."""
+
+    def __init__(self, pg_id: bytes):
+        self._pg_id = pg_id
+        self._lock = threading.Lock()
+        self._warned: dict | None = None
+        self._fired = False
+        self._notify = None          # set by attach(): notify_cb(grace_s)
+        self._watch = None
+        # CREATED observed for our pg — BackendExecutor.start hands
+        # this to PlacementGroup.wait so the gang-schedule wait rides
+        # THIS subscription instead of opening a second one per start
+        self._created = threading.Event()
+        try:
+            from ray_tpu._private.api import _require_worker
+            from ray_tpu._private.pubsub import watch_channel
+
+            self._watch = watch_channel(
+                "pg_state", self._on_msg, _require_worker().gcs.addr,
+                poll_timeout=2.0)
+        except Exception:
+            pass   # degraded: preemption then surfaces as PG loss
+
+    def attach(self, notify_cb):
+        """``notify_cb(grace_s)`` fans the warning out to the workers
+        (set once the worker group exists). A warning that arrived in
+        the window between CREATED and attach is REPLAYED — dropping it
+        would leave the ranks without their checkpoint-then-yield
+        notice, defeating the grace window."""
+        with self._lock:
+            self._notify = notify_cb
+            pending = dict(self._warned) if self._warned else None
+        if pending is not None:
+            try:
+                notify_cb(pending["grace_s"])
+            except Exception:
+                pass
+
+    def created_event(self) -> "threading.Event":
+        return self._created
+
+    def _on_msg(self, msg):
+        if not isinstance(msg, dict):
+            return
+        if msg.get("event") == "resync":
+            for row in (msg.get("snapshot") or ()):
+                if isinstance(row, dict) and row.get("pg_id") == self._pg_id:
+                    if row.get("state") == "CREATED":
+                        self._created.set()
+                    # a still-live deadline means we may have missed the
+                    # warning push; `preempted_at` set means the FIRE
+                    # itself was missed (stamped only by
+                    # _fire_preemption — a PENDING/RESCHEDULING row
+                    # alone could be a node-death reschedule, which
+                    # must charge the failure budget, not requeue free)
+                    if row.get("preempt_deadline"):
+                        # the deadline is an epoch stamp: hand the loop
+                        # the REMAINING window, not 0.0 — first-warning
+                        # -wins would otherwise pin grace_s at zero and
+                        # a cooperative loop would skip a checkpoint it
+                        # had seconds to cut
+                        self._handle_warning({"grace_s": max(
+                            0.0, row["preempt_deadline"] - time.time())})
+                    if row.get("preempted_at"):
+                        self._handle_fired()
+            return
+        if msg.get("pg_id") != self._pg_id:
+            return
+        if msg.get("event") == "state" and msg.get("state") == "CREATED":
+            self._created.set()
+        elif msg.get("event") == "preempt_warning":
+            self._handle_warning(msg)
+        elif msg.get("event") == "state" and msg.get("state") == "PREEMPTED":
+            self._handle_fired()
+
+    def _handle_warning(self, msg):
+        with self._lock:
+            if self._warned is not None:
+                return
+            self._warned = {"grace_s": float(msg.get("grace_s") or 0.0)}
+            notify = self._notify
+        if notify is not None:
+            try:
+                notify(self._warned["grace_s"])
+            except Exception:
+                pass   # dying ranks can't take the notice; fire covers it
+
+
+    def _handle_fired(self):
+        with self._lock:
+            self._fired = True
+
+    def warned(self) -> dict | None:
+        with self._lock:
+            return dict(self._warned) if self._warned else None
+
+    def fired(self) -> bool:
+        with self._lock:
+            return self._fired
+
+    def active(self) -> bool:
+        """True only while the pg_state subscription is live."""
+        return self._watch is not None
+
+    def stop(self):
+        watch, self._watch = self._watch, None
+        if watch is not None:
+            watch.stop()
+
+
 class Backend:
     """Pluggable per-framework setup (reference: train/backend.py Backend /
     BackendConfig — e.g. _TorchBackend sets up the process group,
@@ -178,18 +299,51 @@ class BackendExecutor:
     def start(self):
         bundles = self.scaling.as_placement_group_bundles()
         self.pg = placement_group(bundles,
-                                  strategy=self.scaling.placement_strategy)
-        if not self.pg.wait(120.0):
-            remove_placement_group(self.pg)
-            self.pg = None
-            raise RuntimeError(
-                f"could not gang-schedule {len(bundles)} training bundles "
-                f"{bundles}: insufficient cluster resources")
-        self.worker_group = WorkerGroup(
-            self.scaling.num_workers, self.scaling.worker_resources(),
-            placement_group=self.pg)
-        self.backend = self.backend_config.backend_cls()
-        self.backend.on_start(self.worker_group, self.scaling)
+                                  strategy=self.scaling.placement_strategy,
+                                  job=getattr(self.scaling, "job", None))
+        # subscribe BEFORE waiting: a warning can only arrive once the
+        # PG is CREATED, and the monitor must already be listening then.
+        # The gang-schedule wait below rides THIS subscription (its
+        # created_event) instead of opening a second pg_state
+        # connection per start.
+        self._preempt = _PreemptionMonitor(self.pg.id)
+        try:
+            ok = self.pg.wait(
+                120.0,
+                _created_event=(self._preempt.created_event()
+                                if self._preempt.active() else None))
+            if not ok:
+                remove_placement_group(self.pg)
+                self.pg = None
+                from ray_tpu.exceptions import (
+                    PlacementGroupUnschedulableError,
+                )
+
+                # typed so fit() can tell "still waiting for capacity
+                # after a preemption requeue" (keep waiting, no budget
+                # charge) from a real gang failure
+                raise PlacementGroupUnschedulableError(
+                    f"could not gang-schedule {len(bundles)} training "
+                    f"bundles {bundles}: insufficient cluster resources")
+            self.worker_group = WorkerGroup(
+                self.scaling.num_workers, self.scaling.worker_resources(),
+                placement_group=self.pg)
+            # checkpoint-then-yield fan-out: the warning reaches every
+            # rank's session so the train loop can checkpoint in the
+            # grace window (fire-and-forget refs: a rank that can't
+            # take the notice is torn down when the fire lands anyway);
+            # attach replays a warning that landed before this point
+            self._preempt.attach(lambda grace_s: [
+                w.notify_preemption.remote(grace_s)
+                for w in self.worker_group.workers])
+            self.backend = self.backend_config.backend_cls()
+            self.backend.on_start(self.worker_group, self.scaling)
+        except BaseException:
+            # a failure ANYWHERE in startup must release the monitor's
+            # dedicated GCS connection + poll thread — a crash-looping
+            # gang otherwise leaks one per retry (review finding)
+            self._preempt.stop()
+            raise
         self._monitor = _GangDeathMonitor(self.worker_group)
         self.worker_devices = self._record_group_devices()
         return self
@@ -236,15 +390,33 @@ class BackendExecutor:
         (abort_check — a death interrupts the wait within seconds even
         if the transport never surfaces it), per-rank attribution comes
         from WorkerGroup.execute, and anything the monitor learned is
-        merged into the raised error's dead_ranks."""
+        merged into the raised error's dead_ranks.
+
+        A FIRED preemption (the GCS reclaimed the gang's bundles after
+        the grace window) surfaces as TrainPreemptedError through the
+        same abort path — fit() treats it as a graceful requeue, not a
+        failure."""
         monitor = getattr(self, "_monitor", None)
+        pm = getattr(self, "_preempt", None)
+        if pm is not None and pm.fired():
+            raise self._preempted_error()
+        death_check = (monitor.dead_ranks
+                       if monitor is not None and monitor.active()
+                       else None)
+        abort_check = None
+        if death_check is not None or pm is not None:
+            def abort_check():
+                known = dict(death_check()) if death_check else {}
+                if pm is not None and pm.fired():
+                    for rank in range(len(self.worker_group)):
+                        known.setdefault(rank, "placement group preempted")
+                return known
         try:
             rows = self.worker_group.execute(
-                "next_result", timeout=timeout,
-                abort_check=(monitor.dead_ranks
-                             if monitor is not None and monitor.active()
-                             else None))
+                "next_result", timeout=timeout, abort_check=abort_check)
         except exc.TrainWorkerGroupError as e:
+            if pm is not None and pm.fired():
+                raise self._preempted_error() from e
             if monitor is not None:
                 known = monitor.dead_ranks()
                 if set(known) - set(e.dead_ranks):
@@ -257,7 +429,19 @@ class BackendExecutor:
             raise
         return rows
 
+    def _preempted_error(self) -> "exc.TrainPreemptedError":
+        pg_hex = self.pg.id.hex() if self.pg is not None else "?"
+        return exc.TrainPreemptedError(
+            message=f"training gang preempted: placement group {pg_hex} "
+                    f"was reclaimed by a higher-priority job (graceful "
+                    f"requeue — resumes from the latest checkpoint when "
+                    f"capacity returns)")
+
     def shutdown(self):
+        pm = getattr(self, "_preempt", None)
+        if pm is not None:
+            pm.stop()
+            self._preempt = None
         monitor = getattr(self, "_monitor", None)
         if monitor is not None:
             monitor.stop()

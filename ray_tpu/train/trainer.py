@@ -111,8 +111,11 @@ class DataParallelTrainer(BaseTrainer):
         # Only backoff() is consulted — the retry budget here is
         # FailureConfig.max_failures (checked below), not the policy's
         # attempt cap
+        from ray_tpu import exceptions as exc
+
         policy = RetryPolicy(base_backoff_s=0.5, max_backoff_s=10.0)
         attempt = 0
+        preempt_requeues = 0
         self._group = group
         self._resume_ckpt = self.resume_from_checkpoint
         self._latest_checkpoint = None
@@ -121,14 +124,36 @@ class DataParallelTrainer(BaseTrainer):
             self._attempt = attempt + 1
             try:
                 return self._fit_once()
-            except Exception:
+            except Exception as e:
                 # GANG_FAILED event + flight-recorder dump were recorded
                 # inside _fit_once, BEFORE its finally tore the gang
                 # down — a post-teardown dump would capture only idle
                 # pool workers, not the survivors' final spans
-                attempt += 1
-                if max_failures != -1 and attempt > max_failures:
-                    raise
+                preempted = isinstance(e, exc.TrainPreemptedError)
+                if preempted:
+                    # graceful degradation, not failure: a preempted
+                    # gang re-queues and resumes from its checkpoint
+                    # WITHOUT burning a max_failures token — the victim
+                    # of another tenant's scale-up must not exhaust its
+                    # own failure budget. The GCS's PREEMPTION_* events
+                    # carry the audit trail.
+                    preempt_requeues += 1
+                    self._requeue_wait = True
+                elif isinstance(e, exc.PlacementGroupUnschedulableError) \
+                        and getattr(self, "_requeue_wait", False):
+                    # the re-queued gang timed out WAITING for the
+                    # preemptor to release capacity — still the
+                    # preemption, not a new failure: keep waiting (the
+                    # contract is "resumes when capacity returns", and
+                    # charging the budget here would kill a preempted
+                    # run whose preemptor merely outlives a few
+                    # 120s placement windows)
+                    preempt_requeues += 1
+                else:
+                    self._requeue_wait = False
+                    attempt += 1
+                    if max_failures != -1 and attempt > max_failures:
+                        raise
                 if getattr(fc, "restore_from_latest_checkpoint", True) \
                         and self._latest_checkpoint is not None:
                     self._resume_ckpt = self._latest_checkpoint
@@ -141,12 +166,15 @@ class DataParallelTrainer(BaseTrainer):
                                attempt=attempt,
                                max_failures=max_failures,
                                budget_ok=budget_ok,
+                               preempted=preempted,
+                               preempt_requeues=preempt_requeues,
                                resume_iteration=self._latest_iteration)
-                time.sleep(policy.backoff(attempt))
+                time.sleep(policy.backoff(max(1, attempt)))
                 _tm.counter_inc("ray_tpu_train_gang_restarts_total",
                                 tags={"group": group})
                 _events.record("GANG_RESTARTED", group=group,
                                attempt=attempt,
+                               preempted=preempted,
                                resume_iteration=self._latest_iteration)
 
     def _fit_once(self) -> Result:
@@ -156,6 +184,9 @@ class DataParallelTrainer(BaseTrainer):
         try:
             executor = BackendExecutor(self.backend_config,
                                        self.scaling_config).start()
+            # the gang placed: a LATER unschedulable error is a fresh
+            # capacity problem, not the preemption's requeue wait
+            self._requeue_wait = False
             self._setup_datasets(executor)
             config = dict(self.train_loop_config)
             resume = getattr(self, "_resume_ckpt", None) \
@@ -165,6 +196,20 @@ class DataParallelTrainer(BaseTrainer):
             executor.start_training(self.train_loop_per_worker, config)
             return self._drive(executor)
         except Exception as e:
+            from ray_tpu import exceptions as exc
+
+            if isinstance(e, exc.TrainPreemptedError) or (
+                    isinstance(e, exc.PlacementGroupUnschedulableError)
+                    and getattr(self, "_requeue_wait", False)):
+                # graceful preemption — including the requeued gang
+                # timing out WAITING for the preemptor's capacity — is
+                # NOT a failure: no GANG_FAILED, no flight-recorder
+                # dump (a preemptor holding capacity for minutes would
+                # otherwise force a full-cluster dump per 120s wait
+                # cycle). The GCS's PREEMPTION_WARNED/PREEMPTION_FIRED
+                # events are the audit trail, and the black box must
+                # stay armed for real incidents.
+                raise
             # The gang's surviving workers are STILL ALIVE here (the
             # finally below is what tears them down): record the
             # failure and cut the cluster black box now, so the dump

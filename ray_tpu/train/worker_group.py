@@ -176,6 +176,16 @@ class TrainWorker:
         except Exception:
             pass
 
+    def notify_preemption(self, grace_s: float):
+        """Driver push on a PREEMPTION warning: surface it to the train
+        loop through ``session.preemption_warned()`` so a cooperative
+        loop checkpoints inside the grace window (checkpoint-then-yield)
+        instead of losing everything since its last natural
+        checkpoint."""
+        self.session.preempt_notice = {"grace_s": float(grace_s),
+                                       "warned_at": time.time()}
+        return True
+
     def shutdown(self):
         return True
 

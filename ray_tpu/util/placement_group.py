@@ -10,6 +10,7 @@ topology awareness lands with the multi-host scheduler).
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 from ray_tpu._private import api
@@ -29,30 +30,82 @@ class PlacementGroup:
 
         @api.remote
         def _pg_ready_waiter():
-            # runs on any worker; PG readiness is a GCS question
-            from ray_tpu._private.worker_runtime import current_worker
-
-            worker = current_worker()
-            deadline = time.time() + 300.0
-            while time.time() < deadline:
-                snap = worker.gcs.call("get_placement_group", pg_id=pg_id)
-                if snap and snap["State"] == "CREATED":
-                    return True
-                time.sleep(0.05)
-            raise PlacementGroupUnschedulableError(
-                f"placement group {pg_id.hex()} not schedulable")
+            # runs on any worker; PG readiness is a GCS question — the
+            # waiter rides the same pg_state subscription wait() uses
+            if not PlacementGroup(pg_id).wait(300.0):
+                raise PlacementGroupUnschedulableError(
+                    f"placement group {pg_id.hex()} not schedulable")
+            return True
 
         return _pg_ready_waiter.options(num_cpus=0.0).remote()
 
-    def wait(self, timeout_seconds: float = 30.0) -> bool:
+    def wait(self, timeout_seconds: float = 30.0, *,
+             _created_event: "threading.Event | None" = None) -> bool:
+        """Block until the PG is CREATED (or timeout). Rides the GCS's
+        ``pg_state`` pubsub channel — the waiter wakes on the CREATED
+        push instead of hammering `get_placement_group` at 20 Hz — with
+        PR 12's snapshot-resync covering feed gaps, and a direct-RPC
+        poll kept underneath as FALLBACK (`pg_wait_poll_fallback_s`
+        cadence) so a missed transition can never hang the waiter. The
+        fallback poll doubles as the lazy scheduling kick for clusters
+        whose capacity events are sparse.
+
+        ``_created_event`` (internal): an Event some existing pg_state
+        subscription sets on this PG's CREATED — callers that already
+        hold one (the Train plane's preemption monitor) reuse it
+        instead of paying a second dedicated GCS subscription per gang
+        start."""
+        from ray_tpu._private.config import get_config
+
         worker = api._require_worker()
+        snap = worker.gcs.call("get_placement_group", pg_id=self.id)
+        if snap and snap["State"] == "CREATED":
+            return True
         deadline = time.time() + timeout_seconds
-        while time.time() < deadline:
-            snap = worker.gcs.call("get_placement_group", pg_id=self.id)
-            if snap and snap["State"] == "CREATED":
-                return True
-            time.sleep(0.05)
-        return False
+        created = _created_event if _created_event is not None \
+            else threading.Event()
+        pg_id = self.id
+
+        def _on_msg(msg):
+            if not isinstance(msg, dict):
+                return
+            if msg.get("event") == "resync":
+                for row in (msg.get("snapshot") or ()):
+                    if isinstance(row, dict) and row.get("pg_id") == pg_id \
+                            and row.get("state") == "CREATED":
+                        created.set()
+            elif msg.get("event") == "state" and msg.get("pg_id") == pg_id \
+                    and msg.get("state") == "CREATED":
+                created.set()
+
+        watch = None
+        poll_s = max(0.05, float(get_config("pg_wait_poll_fallback_s")))
+        if _created_event is None:
+            try:
+                from ray_tpu._private.pubsub import watch_channel
+
+                watch = watch_channel("pg_state", _on_msg,
+                                      worker.gcs.addr, poll_timeout=2.0)
+            except Exception:
+                # no pubsub (degraded GCS): poll at the legacy cadence
+                poll_s = 0.05
+        try:
+            while True:
+                # poll first: it closes the race where the transition
+                # landed between the entry snapshot and the subscribe
+                snap = worker.gcs.call("get_placement_group",
+                                       pg_id=self.id)
+                if (snap and snap["State"] == "CREATED") \
+                        or created.is_set():
+                    return True
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                if created.wait(min(poll_s, remaining)):
+                    return True
+        finally:
+            if watch is not None:
+                watch.stop()
 
     @property
     def bundle_specs(self):
@@ -69,19 +122,28 @@ class PlacementGroup:
 
 
 def placement_group(bundles: list[dict], strategy: str = "PACK",
-                    name: str = "", lifetime=None) -> PlacementGroup:
+                    name: str = "", lifetime=None,
+                    job: str | None = None) -> PlacementGroup:
+    """``job`` labels the gang for the multi-tenant scheduling plane
+    (quota accounting, fair share, priority preemption —
+    ``ray_tpu.util.jobs``); omitted, it inherits this process's current
+    job (``jobs.set_current_job``)."""
     if strategy not in VALID_STRATEGIES:
         raise ValueError(
             f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
     if not bundles or any(not b for b in bundles):
         raise ValueError("bundles must be a non-empty list of non-empty "
                          "resource dicts")
+    if job is None:
+        from ray_tpu.util import jobs as _jobs
+
+        job = _jobs.current_job()
     worker = api._require_worker()
     pg_id = os.urandom(16)
     worker.gcs.call("create_placement_group", pg_id=pg_id,
                     bundles=[{k: float(v) for k, v in b.items()}
                              for b in bundles],
-                    strategy=strategy, name=name)
+                    strategy=strategy, name=name, job=job or "")
     return PlacementGroup(pg_id)
 
 

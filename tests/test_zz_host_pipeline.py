@@ -375,8 +375,11 @@ def test_shm_segment_transport_oracle(ray_start_regular):
                          for r, a in enumerate(actors)], timeout=60)
             # settle-poll: task-ARG objects (the 800 KB inputs) are
             # freed asynchronously by the ref reaper, so the count
-            # fluctuates; leaked SEGMENT objects would never go away
-            deadline = _time.time() + 20
+            # fluctuates; leaked SEGMENT objects would never go away —
+            # a longer deadline only trades wall-clock on a loaded
+            # full-suite box, never masks a real leak (45s: the 20s
+            # window flaked under the 870s tier-1 run's load)
+            deadline = _time.time() + 45
             while True:
                 after = ray.get(actors[0].store_stats.remote(),
                                 timeout=30)
@@ -419,7 +422,9 @@ def test_dropped_shm_notify_raises_timeout(ray_start_regular):
         # anywhere) — group destroy must sweep it via the group-tagged
         # oid prefix
         ray.get([a.destroy.remote(name) for a in actors], timeout=30)
-        deadline = _time.time() + 20
+        # 45s like the oracle test's settle poll: load-tolerant, never
+        # leak-masking (a stranded segment would outlive any deadline)
+        deadline = _time.time() + 45
         while True:
             after = ray.get(actors[0].store_stats.remote(), timeout=30)
             if after["num_objects"] <= base["num_objects"]:
