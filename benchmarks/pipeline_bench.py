@@ -1,0 +1,201 @@
+"""Multi-slice MPMD pipeline bench (round 14).
+
+Phases:
+
+1. **bubble sweep** — a 2-stage SleepStage pipeline (contention-immune
+   per-microbatch compute) swept over the microbatch count: measured
+   per-step bubble fraction (schedule stalls stamped by the train loop)
+   against the (P-1)/(M+P-1) theoretical curve, plus step wall clock.
+   This is the acceptance artifact: the schedule's bubble obeys theory,
+   and adding microbatches buys the predicted efficiency.
+2. **wire** — a 2-stage DenseStage pipeline with a wide activation
+   (microbatch x 4096 float32) run with the inter-stage hop exact vs
+   bf16 (`PipelineConfig.wire_dtype`), reporting step walls and the
+   LIVE `ray_tpu_collective_wire_bytes_total` compression ratio
+   (sender-side accounting; polled while the gang runs).
+
+Runs on an in-process simulated 2-slice cluster (one host per slice,
+fake topology injected through the raylet's `tpu_topology` hook), so
+the SPREAD_ACROSS_SLICES scheduler and the whole stage-per-slice data
+path are exercised for real — only the ICI itself is simulated.
+
+Usage: python benchmarks/pipeline_bench.py [--json-out BENCH_r14.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import threading
+import time
+
+
+def _start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False)
+    cluster.head_node = cluster.add_node(num_cpus=4)
+    for sid in ("s0", "s1"):
+        cluster.add_node(num_cpus=4, num_tpus=4,
+                         tpu_topology={"slice_id": sid, "worker_id": 0,
+                                       "chips": 4})
+    cluster.connect()
+    return cluster
+
+
+def bubble_sweep(microbatch_counts, steps: int, fwd_s: float) -> list[dict]:
+    from ray_tpu.train.pipeline import (PipelineConfig, PipelineTrainer,
+                                        SleepStage,
+                                        theoretical_bubble_fraction)
+
+    P = 2
+    rows = []
+    for m in microbatch_counts:
+        stages = [SleepStage(4, fwd_s=fwd_s) for _ in range(P)]
+        result = PipelineTrainer(
+            stages,
+            pipeline_config=PipelineConfig(num_microbatches=m,
+                                           group_name=f"bench_bub_m{m}"),
+            num_steps=steps, microbatch_size=2, learning_rate=0.0,
+            seed=1).fit()
+        assert result.error is None, result.error
+        hist = result.metrics_history[1:]   # drop the warmup step
+        fracs = [r["bubble_fraction"] for r in hist]
+        walls = [r["step_wall_s"] for r in hist]
+        theory = theoretical_bubble_fraction(P, m)
+        rows.append({
+            "microbatches": m,
+            "bubble_theory": round(theory, 4),
+            "bubble_measured_mean": round(statistics.mean(fracs), 4),
+            "bubble_measured_p50": round(statistics.median(fracs), 4),
+            "step_wall_p50_s": round(statistics.median(walls), 4),
+            # ideal wall = 2 * (M + P - 1) * fwd_s (fwd+bwd slots)
+            "step_wall_ideal_s": round(2 * (m + P - 1) * fwd_s, 4),
+            "abs_err": round(abs(statistics.mean(fracs) - theory), 4),
+        })
+        print(f"  M={m:>2}  theory={theory:.3f}  "
+              f"measured={rows[-1]['bubble_measured_mean']:.3f}  "
+              f"wall_p50={rows[-1]['step_wall_p50_s']:.3f}s")
+    return rows
+
+
+def wire_phase(steps: int, dim: int, mb_size: int) -> dict:
+    import numpy as np   # noqa: F401  (DenseStage pulls it anyway)
+
+    from ray_tpu.train.pipeline import (DenseStage, PipelineConfig,
+                                        PipelineTrainer)
+
+    M = 4
+    out: dict = {"activation_elems": mb_size * dim, "microbatches": M}
+    for fmt in ("off", "bf16"):
+        group = f"bench_wire_{fmt}"
+        wire_rows: list = []
+        stop = threading.Event()
+
+        def _poll(group=group, wire_rows=wire_rows, stop=stop):
+            from ray_tpu.experimental.state.api import metrics_summary
+
+            while not stop.is_set():
+                try:
+                    snaps = {m["name"]: m for m in metrics_summary()}
+                    wb = snaps.get("ray_tpu_collective_wire_bytes_total")
+                    rows = [v for v in (wb or {}).get("values", ())
+                            if v["tags"].get("group") == group
+                            and v["tags"].get("op") == "send"]
+                    if rows:
+                        wire_rows[:] = [dict(v) for v in rows]
+                except Exception:
+                    pass
+                time.sleep(0.15)
+
+        poller = threading.Thread(target=_poll, daemon=True)
+        poller.start()
+        stages = [DenseStage(dim, dim, "none"), DenseStage(dim, 3, "none")]
+        t0 = time.monotonic()
+        result = PipelineTrainer(
+            stages,
+            pipeline_config=PipelineConfig(
+                num_microbatches=M,
+                wire_dtype=None if fmt == "off" else fmt,
+                group_name=group),
+            num_steps=steps, microbatch_size=mb_size,
+            learning_rate=0.01, seed=2).fit()
+        stop.set()
+        poller.join(timeout=5)
+        assert result.error is None, result.error
+        walls = [r["step_wall_s"] for r in result.metrics_history[1:]]
+        by_fmt: dict = {}
+        for v in wire_rows:
+            by_fmt[v["tags"].get("format")] = \
+                by_fmt.get(v["tags"].get("format"), 0.0) + v["value"]
+        out[fmt] = {"step_wall_p50_s": round(statistics.median(walls), 4),
+                    "wire_bytes_by_format": by_fmt,
+                    "final_loss": result.metrics["loss"]}
+        print(f"  wire={fmt}: wall_p50="
+              f"{out[fmt]['step_wall_p50_s']}s bytes={by_fmt}")
+    bf16_b = out["bf16"]["wire_bytes_by_format"].get("bf16", 0.0)
+    # exact sends don't account wire bytes, so the honest denominator is
+    # the ANALYTIC activation payload of the hops the bf16 run
+    # quantized: steps x M microbatches x (mb x dim) float32 (grads stay
+    # exact in both runs and aren't counted on either side)
+    payload = float(steps * M * mb_size * dim * 4)
+    out["bf16_activation_bytes"] = bf16_b
+    out["exact_activation_payload_bytes"] = payload
+    out["compression_vs_payload"] = round(payload / bf16_b, 3) \
+        if bf16_b else None
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json-out", default=None)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--fwd-s", type=float, default=0.03)
+    ap.add_argument("--microbatches", default="1,2,4,8,16")
+    ap.add_argument("--wire-dim", type=int, default=4096)
+    args = ap.parse_args(argv)
+    os.environ.setdefault("RAY_TPU_TESTING", "1")
+
+    cluster = _start_cluster()
+    try:
+        print("== phase 1: bubble sweep (P=2, SleepStage) ==")
+        ms = [int(x) for x in str(args.microbatches).split(",") if x]
+        sweep = bubble_sweep(ms, args.steps, args.fwd_s)
+        print("== phase 2: inter-stage wire (DenseStage, bf16 vs off) ==")
+        wire = wire_phase(args.steps, args.wire_dim, mb_size=8)
+        worst = max(r["abs_err"] for r in sweep)
+        report = {
+            "bench": "pipeline_mpmd",
+            "round": 14,
+            "host": os.uname().nodename,
+            "when_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "num_stages": 2,
+            "bubble_sweep": sweep,
+            "wire": wire,
+            "acceptance": {
+                "bubble_within_tolerance": bool(worst <= 0.1),
+                "bubble_worst_abs_err": worst,
+                "bf16_wire_bytes_recorded":
+                    bool(wire["bf16_activation_bytes"] > 0),
+            },
+        }
+        out = json.dumps(report, indent=1, sort_keys=True)
+        print(out)
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                f.write(out + "\n")
+            print(f"wrote {args.json_out}")
+        return 0
+    finally:
+        try:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+            cluster.shutdown()
+        except Exception:
+            pass
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

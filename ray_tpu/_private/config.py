@@ -111,6 +111,21 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "collective_straggler_multiple": 3.0,   # lag > multiple * median lag
     "collective_straggler_min_lag_s": 0.05,  # floor: ignore µs jitter in
                                              # tight groups (median ~ 0)
+    # --- multi-slice MPMD pipeline training (train/pipeline/) ---
+    # Default wire format for inter-stage activation/grad hops: "off"
+    # (exact), "bf16" (the classic half-width activation wire; ~2x
+    # smaller inter-slice traffic, error <= 2^-8 * |x| per element) or
+    # "int8" (per-block scales). PipelineConfig.wire_dtype overrides
+    # per trainer; gradients always travel exact unless
+    # pipeline_quantize_grads is also set.
+    "pipeline_wire_dtype": "off",
+    "pipeline_quantize_grads": False,
+    # GPipe in-flight window: how many un-acked microbatch activations
+    # a stage may have posted downstream before it parks for an ack
+    # credit (bounds the receiver's mailbox/activation memory under
+    # one-way pushes). 0 = unbounded. 1F1B ignores it — its warmup
+    # depth (<= P - stage) is the inherent bound.
+    "pipeline_inflight_window": 0,
     # --- step anatomy (parallel/step_anatomy.py) ---
     # Rolling-baseline step-time regression detector: compare p50 of the
     # last `window` steps against p50 of the window before it; fire a

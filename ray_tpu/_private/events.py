@@ -80,6 +80,11 @@ Event kinds recorded by the runtime:
                      bundles were reclaimed; the victim re-queued
                      PENDING to resume when capacity returns
                      (_private/gcs.py): pg_id, job, preemptor.
+- ``PIPELINE_GANG_STARTED`` — a multi-slice MPMD pipeline gang came up
+                     (train/pipeline/trainer.py): group, stage count,
+                     ranks per stage, microbatches, schedule, and the
+                     per-stage slice placement reported by the
+                     SPREAD_ACROSS_SLICES scheduler.
 - ``PUBSUB_RESYNC`` — a long-poll subscriber detected a feed gap
                      (mailbox overflow / publisher GC) and reconverged
                      from the channel's state snapshot

@@ -52,7 +52,8 @@ import uuid
 from ray_tpu._private import events as _events
 from ray_tpu._private.protocol import RpcServer
 
-PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD",
+                 "SPREAD_ACROSS_SLICES")
 
 
 class NodeInfo:
@@ -143,12 +144,18 @@ class JobInfo:
 
 class PlacementGroupInfo:
     def __init__(self, pg_id: bytes, bundles: list[dict], strategy: str,
-                 name: str = "", job: str = ""):
+                 name: str = "", job: str = "", stages: list | None = None):
         self.pg_id = pg_id
         self.bundles = bundles            # list of resource dicts
         self.strategy = strategy
         self.name = name
         self.job = job or ""              # owning job label ("" = none)
+        # per-bundle stage labels (SPREAD_ACROSS_SLICES): bundles sharing
+        # a label form one stage sub-gang that must land contiguous
+        # inside ONE slice, with distinct stages on distinct slices.
+        # None = every bundle is its own stage (plain one-per-slice
+        # spread). Parallel to `bundles` when given.
+        self.stages = list(stages) if stages is not None else None
         self.state = "PENDING"            # CREATED / REMOVED / RESCHEDULING
         self.bundle_nodes: list[str | None] = [None] * len(bundles)
         self.commit_ts = 0.0              # when it became CREATED
@@ -179,6 +186,7 @@ class PlacementGroupInfo:
             "Strategy": self.strategy,
             "Bundles": [dict(b) for b in self.bundles],
             "BundleNodes": list(self.bundle_nodes),
+            "Stages": list(self.stages) if self.stages is not None else None,
             "PreemptDeadline": self.preempt_deadline,
         }
 
@@ -1146,15 +1154,21 @@ class GcsServer:
 
     def rpc_create_placement_group(self, conn, pg_id: bytes,
                                    bundles: list[dict], strategy: str,
-                                   name: str = "", job: str = ""):
+                                   name: str = "", job: str = "",
+                                   stages: list | None = None):
         if strategy not in PG_STRATEGIES:
             raise ValueError(f"unknown strategy {strategy}")
+        if stages is not None and len(stages) != len(bundles):
+            raise ValueError(
+                f"stages must label every bundle: got {len(stages)} "
+                f"labels for {len(bundles)} bundles")
         with self._lock:
             if pg_id in self.placement_groups:
                 # replay of our own creation (client retried across a
                 # GCS restart that had already applied it) — idempotent
                 return self.placement_groups[pg_id].snapshot()
-            pg = PlacementGroupInfo(pg_id, bundles, strategy, name, job)
+            pg = PlacementGroupInfo(pg_id, bundles, strategy, name, job,
+                                    stages=stages)
             self._pg_seq += 1
             pg.created_seq = self._pg_seq
             self.placement_groups[pg_id] = pg
@@ -1273,7 +1287,19 @@ class GcsServer:
         # hosts inside ONE slice, so the gang's collectives ride ICI
         # instead of DCN. Falls through to the generic policy when no
         # slice can host the gang.
-        if pg.strategy in ("PACK", "STRICT_PACK"):
+        if pg.strategy == "SPREAD_ACROSS_SLICES":
+            # Multi-slice MPMD gang: each stage's bundle sub-gang lands
+            # contiguous inside ONE slice, distinct stages on distinct
+            # slices (activations hop the inter-slice plane, compute
+            # rides ICI). Strictly all-or-nothing: a gang that cannot
+            # place EVERY stage this way stays PENDING whole — there is
+            # no generic fallback, because a stage split across slices
+            # would silently put the pipeline's inner collectives on
+            # the wrong plane.
+            placed = self._place_across_slices(pg, avail, take)
+            if placed is not None:
+                assignment = placed
+        elif pg.strategy in ("PACK", "STRICT_PACK"):
             ici_placed = False
             if all(b.get("TPU", 0) > 0 for b in pg.bundles):
                 ici = self._place_on_contiguous_slice(pg, avail, take)
@@ -1341,11 +1367,10 @@ class GcsServer:
                                        "pg_id": pg.pg_id,
                                        "state": "CREATED", "job": pg.job})
 
-    def _place_on_contiguous_slice(self, pg, avail, take):
-        """Try to place every bundle on a contiguous run of hosts (by TPU
-        worker index) within a single slice. Returns the assignment list or
-        None. Contiguous worker indices share ICI neighbours on TPU pods,
-        so the gang's mesh axes map onto torus links instead of DCN."""
+    def _slice_inventory(self, avail) -> dict[str, list]:
+        """slice_id -> sorted [(worker_id, node_id)] over the schedulable
+        nodes that report TPU topology (raylet `tpu_topology` meta, from
+        tpu_probe slice identity / the TPU runtime env)."""
         slices: dict[str, list] = {}
         for node_id in avail:
             node = self.nodes.get(node_id)
@@ -1354,50 +1379,121 @@ class GcsServer:
                 continue
             slices.setdefault(str(tpu.get("slice_id", "slice-0")), []).append(
                 (int(tpu.get("worker_id", 0)), node_id))
-        best = None
-        for slice_id, hosts in sorted(slices.items()):
+        for hosts in slices.values():
             hosts.sort()
-            worker_ids = [w for w, _ in hosts]
-            # hosts must themselves be consecutive worker indices to form a
-            # window; scan all windows of every length ≥ 1
-            n = len(hosts)
-            for width in range(1, n + 1):
-                for start in range(0, n - width + 1):
-                    window = hosts[start:start + width]
-                    if window[-1][0] - window[0][0] != width - 1:
-                        continue   # gap (a dead host) breaks contiguity
-                    trial_avail = {nid: dict(avail[nid])
-                                   for _, nid in window}
+        return slices
 
-                    def t_fits(nid, b):
+    @staticmethod
+    def _fit_contiguous_window(bundles, hosts, avail):
+        """Trial-fit `bundles` onto a contiguous run of hosts (by TPU
+        worker index) within one slice's host list. Scans all windows of
+        every length ≥ 1, SMALLEST first (tight packing leaves the big
+        runs whole for bigger gangs). Hosts must be consecutive worker
+        indices to form a window — a gap (dead/absent host) breaks
+        contiguity, because contiguous worker indices are what share ICI
+        neighbours on TPU pods. Returns the per-bundle node assignment,
+        or None. Pure trial: `avail` is never mutated."""
+        n = len(hosts)
+        for width in range(1, n + 1):
+            for start in range(0, n - width + 1):
+                window = hosts[start:start + width]
+                if window[-1][0] - window[0][0] != width - 1:
+                    continue   # gap (a dead host) breaks contiguity
+                trial_avail = {nid: dict(avail[nid]) for _, nid in window}
+                assignment = []
+                ok = True
+                for bundle in bundles:
+                    for _, nid in window:
                         a = trial_avail[nid]
-                        return all(a.get(k, 0) >= v for k, v in b.items())
-
-                    assignment = [None] * len(pg.bundles)
-                    ok = True
-                    for i, bundle in enumerate(pg.bundles):
-                        for _, nid in window:
-                            if t_fits(nid, bundle):
-                                assignment[i] = nid
-                                for k, v in bundle.items():
-                                    trial_avail[nid][k] = \
-                                        trial_avail[nid].get(k, 0) - v
-                                break
-                        else:
-                            ok = False
+                        if all(a.get(k, 0) >= v for k, v in bundle.items()):
+                            assignment.append(nid)
+                            for k, v in bundle.items():
+                                a[k] = a.get(k, 0) - v
                             break
-                    if ok:
-                        best = assignment
+                    else:
+                        ok = False
                         break
-                if best:
-                    break
-            if best:
+                if ok:
+                    return assignment
+        return None
+
+    def _place_on_contiguous_slice(self, pg, avail, take):
+        """Try to place every bundle on a contiguous run of hosts (by TPU
+        worker index) within a single slice. Returns the assignment list or
+        None. Contiguous worker indices share ICI neighbours on TPU pods,
+        so the gang's mesh axes map onto torus links instead of DCN."""
+        best = None
+        for slice_id, hosts in sorted(self._slice_inventory(avail).items()):
+            best = self._fit_contiguous_window(pg.bundles, hosts, avail)
+            if best is not None:
                 break
         if best is None:
             return None
         for i, bundle in enumerate(pg.bundles):
             take(best[i], bundle)
         return best
+
+    def _spread_slices_trial(self, pg, avail):
+        """SPREAD_ACROSS_SLICES trial placement against ``avail`` (never
+        mutated): group bundles by their stage label and fit each
+        stage's sub-gang contiguous inside one slice, with DISTINCT
+        stages on DISTINCT slices. Returns the per-bundle assignment or
+        None — strictly all-or-nothing: fewer usable slices than
+        stages, or any one stage that cannot fit a slice contiguously,
+        fails the whole gang.
+
+        Slice choice is best-fit when slices outnumber stages: each
+        stage prefers the slice with the FEWEST schedulable hosts that
+        still fits it (intra-slice-first packing — small pipelines
+        consume the small slices and leave the big contiguous runs
+        whole for gangs that actually need them). Stages place largest
+        sub-gang first so a big stage is not starved by a small one
+        grabbing the only slice that could hold it; ties break on
+        declared stage order."""
+        labels = pg.stages if pg.stages is not None \
+            else list(range(len(pg.bundles)))
+        stage_idxs: dict = {}
+        for i, lab in enumerate(labels):
+            stage_idxs.setdefault(lab, []).append(i)
+        slices = self._slice_inventory(avail)
+        if len(slices) < len(stage_idxs):
+            return None
+        assignment: list = [None] * len(pg.bundles)
+        trial_avail = {nid: dict(avail[nid]) for nid in avail}
+        used_slices: set[str] = set()
+        order = sorted(stage_idxs.items(),
+                       key=lambda kv: (-len(kv[1]), labels.index(kv[0])))
+        for lab, idxs in order:
+            bundles = [pg.bundles[i] for i in idxs]
+            best = None   # ((free_hosts, slice_id), placement)
+            for sid, hosts in slices.items():
+                if sid in used_slices:
+                    continue
+                placement = self._fit_contiguous_window(bundles, hosts,
+                                                        trial_avail)
+                if placement is None:
+                    continue
+                key = (len(hosts), sid)
+                if best is None or key < best[0]:
+                    best = (key, placement)
+            if best is None:
+                return None
+            used_slices.add(best[0][1])
+            for i, nid in zip(idxs, best[1]):
+                assignment[i] = nid
+                for k, v in pg.bundles[i].items():
+                    trial_avail[nid][k] = trial_avail[nid].get(k, 0) - v
+        return assignment
+
+    def _place_across_slices(self, pg, avail, take):
+        """Commit wrapper over ``_spread_slices_trial``: on success the
+        assignment's takes are applied to ``avail``."""
+        assignment = self._spread_slices_trial(pg, avail)
+        if assignment is None:
+            return None
+        for i, bundle in enumerate(pg.bundles):
+            take(assignment[i], bundle)
+        return assignment
 
     def _node_available_for_pg(self, node: NodeInfo) -> dict:
         """Capacity the PG scheduler may hand out on this node. Prefer the
@@ -1482,6 +1578,13 @@ class GcsServer:
         TOTALS)? The priority barrier only holds for feasible gangs."""
         totals = {n.node_id: dict(n.resources)
                   for n in self.nodes.values() if n.alive}
+        if pg.strategy == "SPREAD_ACROSS_SLICES":
+            # the strategy is STRUCTURAL (distinct slices per stage,
+            # contiguous windows), not just resource sums: a gang with
+            # more stages than the cluster has slices must never raise
+            # the priority barrier — it would starve every lower-
+            # priority tenant forever for a gang that can never place
+            return self._spread_slices_trial(pg, totals) is not None
         for bundle in pg.bundles:
             for nid in totals:
                 a = totals[nid]
@@ -1505,6 +1608,12 @@ class GcsServer:
                 if nid in avail:
                     for k, amt in bundle.items():
                         avail[nid][k] = avail[nid].get(k, 0.0) + amt
+        if pg.strategy == "SPREAD_ACROSS_SLICES":
+            # judge the REAL structural constraint: freeing resources on
+            # too few slices cannot help a gang that needs more slices —
+            # without this, a slice-infeasible high-priority gang would
+            # warn and tear down checkpointed victims for nothing
+            return self._spread_slices_trial(pg, avail) is not None
         order = sorted(avail, key=lambda n: -sum(avail[n].values()))
         for bundle in pg.bundles:
             for nid in order:
@@ -1757,7 +1866,7 @@ class GcsServer:
             "pg_id": pg.pg_id, "bundles": pg.bundles,
             "strategy": pg.strategy, "name": pg.name, "state": pg.state,
             "bundle_nodes": pg.bundle_nodes, "job": pg.job,
-            "created_seq": pg.created_seq,
+            "created_seq": pg.created_seq, "stages": pg.stages,
             "preempted_at": pg.preempted_at}))
 
     def _persist_node(self, node: "NodeInfo"):
@@ -1830,7 +1939,8 @@ class GcsServer:
             d = pickle.loads(blob)
             pg = PlacementGroupInfo(d["pg_id"], d["bundles"],
                                     d["strategy"], d["name"],
-                                    d.get("job", ""))
+                                    d.get("job", ""),
+                                    stages=d.get("stages"))
             pg.state = d["state"]
             pg.bundle_nodes = d["bundle_nodes"]
             pg.created_seq = d.get("created_seq", 0)

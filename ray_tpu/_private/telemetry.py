@@ -227,6 +227,33 @@ CATALOG: dict[str, dict] = {
                        "incarnation's epoch (plus dead-epoch mailbox "
                        "entries swept at group rejoin)",
     },
+    # --- multi-slice MPMD pipeline training (train/pipeline/) ---
+    # stage indices are bounded (pipeline depth, single digits in
+    # practice); group names are the same cardinality class as
+    # collective groups
+    "ray_tpu_pipeline_bubble_seconds": {
+        "kind": "Histogram", "tags": ("group", "stage"),
+        "boundaries": [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                       30.0],
+        "description": "Per-step wall time one pipeline stage spent "
+                       "parked in schedule stalls (waiting for an "
+                       "upstream activation, a downstream gradient, or "
+                       "an in-flight-window credit) — the measured "
+                       "bubble the (P-1)/(M+P-1) schedule theory "
+                       "predicts",
+    },
+    "ray_tpu_pipeline_microbatches_total": {
+        "kind": "Counter", "tags": ("group", "stage", "phase"),
+        "description": "Microbatches processed by one pipeline stage, "
+                       "split by phase (forward/backward)",
+    },
+    "ray_tpu_pipeline_step_seconds": {
+        "kind": "Histogram", "tags": ("group", "stage"),
+        "boundaries": [0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0],
+        "description": "Wall time of one optimizer step on one pipeline "
+                       "stage (all microbatch forwards + backwards + "
+                       "the intra-stage grad allreduce + the update)",
+    },
     # --- streaming data plane (data/_internal/streaming/) ---
     # consumer names are bounded: "default", bench harness labels, or
     # train/<dataset>/rank<k> (one per gang member) — same cardinality

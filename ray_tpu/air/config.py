@@ -18,6 +18,12 @@ class ScalingConfig:
     chips_per_worker: int | None = None
     resources_per_worker: dict | None = None
     placement_strategy: str = "PACK"
+    # per-bundle stage labels for SPREAD_ACROSS_SLICES gangs (the
+    # multi-slice MPMD pipeline layout): workers sharing a label form
+    # one stage sub-gang placed contiguous inside one slice, distinct
+    # stages on distinct slices. Parallel to the bundle list (one
+    # entry per worker); None for single-slice gangs.
+    bundle_stages: list | None = None
     trainer_resources: dict | None = None
     # multi-tenant label: the gang's placement group (and therefore its
     # quota accounting, fair-share weight, and preemption priority) is

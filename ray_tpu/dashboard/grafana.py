@@ -58,6 +58,18 @@ _PANELS = [
     # --- gang fault tolerance (PR 5: detection / poisoning / restart) ---
     ("Training gang restarts",
      "rate(ray_tpu_train_gang_restarts_total[5m])", "ops"),
+    # --- pipeline parallelism (multi-slice MPMD train plane) ---
+    ("Pipeline bubble p50 (per stage)",
+     "histogram_quantile(0.5, rate(ray_tpu_pipeline_bubble_seconds"
+     "_bucket[5m]))", "s"),
+    ("Pipeline step p50 (per stage)",
+     "histogram_quantile(0.5, rate(ray_tpu_pipeline_step_seconds"
+     "_bucket[5m]))", "s"),
+    ("Pipeline microbatch throughput",
+     "rate(ray_tpu_pipeline_microbatches_total[1m])", "ops"),
+    ("Pipeline bubble fraction",
+     "rate(ray_tpu_pipeline_bubble_seconds_sum[5m]) / "
+     "rate(ray_tpu_pipeline_step_seconds_sum[5m])", "percentunit"),
     ("Collective groups poisoned",
      "rate(ray_tpu_collective_groups_poisoned_total[5m])", "ops"),
     ("Stale-epoch traffic rejected",

@@ -12,6 +12,9 @@ Routes (reference modules in parens — dashboard/modules/*):
     /api/tenancy            multi-tenant summary: per-job priority/
                             quota/usage/share, preemption + quota
                             rejection rollups
+    /api/topology           TPU slice topology: per-slice hosts/coords
+                            and which placement groups / pipeline
+                            stages occupy each slice
     /api/events             structured runtime event log (cluster events)
     /api/collectives        data-plane summary: collective ops,
                             stragglers, compile stats, device gauges
@@ -121,6 +124,8 @@ class DashboardServer:
                 payload = self._jobs()
             elif path == "/api/tenancy":
                 payload = state.summarize_jobs(address=self.address)
+            elif path == "/api/topology":
+                payload = state.summarize_topology(address=self.address)
             elif path == "/api/serve":
                 payload = self._serve_status()
             elif path == "/api/timeline":

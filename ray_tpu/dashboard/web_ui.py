@@ -67,6 +67,7 @@ const TABS = [
   {id:"tasks", label:"Tasks", api:"/api/tasks"},
   {id:"workers", label:"Workers", api:"/api/workers"},
   {id:"pgs", label:"Placement groups", api:"/api/placement_groups"},
+  {id:"topology", label:"Topology", api:"/api/topology"},
   {id:"objects", label:"Objects", api:"/api/objects"},
   {id:"jobs", label:"Jobs", api:"/api/jobs"},
   {id:"events", label:"Events", api:"/api/events"},

@@ -439,6 +439,74 @@ def summarize_control_plane(*, address: str | None = None) -> dict:
     }
 
 
+def summarize_topology(*, address: str | None = None) -> dict:
+    """ICI-topology rollup: every TPU slice the raylets report (hosts
+    with worker index / coords / chips, aliveness) plus which placement
+    groups — and which pipeline STAGES of them — currently occupy each
+    slice. The operator face of the SPREAD_ACROSS_SLICES scheduler:
+    ``ray-tpu topology`` / dashboard ``/api/topology``."""
+    with _gcs(address) as call:
+        nodes = call("get_nodes")
+        pgs = call("list_placement_groups")
+    slice_of_node: dict[str, str] = {}
+    slices: dict[str, dict] = {}
+    for n in nodes:
+        tpu = n.get("tpu") or {}
+        if not tpu:
+            continue
+        sid = str(tpu.get("slice_id", "slice-0"))
+        slice_of_node[n["NodeID"]] = sid
+        entry = slices.setdefault(sid, {
+            "hosts": [], "chips": 0, "alive_hosts": 0,
+            "accelerator_type": tpu.get("accelerator_type"),
+            "topology": tpu.get("topology")})
+        host = {"node_id": n["NodeID"],
+                "worker_id": int(tpu.get("worker_id", 0)),
+                "hostname": n.get("hostname"),
+                "alive": bool(n.get("Alive")),
+                "chips": int(tpu.get("chips", 0) or 0)}
+        if tpu.get("coords"):
+            host["coords"] = tpu["coords"]
+        entry["hosts"].append(host)
+        entry["chips"] += host["chips"]
+        entry["alive_hosts"] += 1 if host["alive"] else 0
+    for entry in slices.values():
+        entry["hosts"].sort(key=lambda h: h["worker_id"])
+    occupants: list[dict] = []
+    for pg in pgs:
+        if pg.get("State") != "CREATED":
+            continue
+        labels = pg.get("Stages")
+        bundle_nodes = pg.get("BundleNodes") or []
+        if labels is None:
+            labels = list(range(len(bundle_nodes)))
+        stage_slices: dict[str, list] = {}
+        touched = False
+        for lab, nid in zip(labels, bundle_nodes):
+            sid = slice_of_node.get(nid)
+            if sid is None:
+                continue
+            touched = True
+            bucket = stage_slices.setdefault(str(lab), [])
+            if sid not in bucket:
+                bucket.append(sid)
+        if not touched:
+            continue
+        row = {"placement_group_id": pg["PlacementGroupID"],
+               "name": pg.get("Name", ""), "job": pg.get("Job", ""),
+               "strategy": pg.get("Strategy"),
+               "stages": stage_slices}
+        occupants.append(row)
+        for sids in stage_slices.values():
+            for sid in sids:
+                occ = slices[sid].setdefault("occupants", [])
+                if row["placement_group_id"] not in occ:
+                    occ.append(row["placement_group_id"])
+    return {"num_slices": len(slices),
+            "slices": dict(sorted(slices.items())),
+            "placement_groups": occupants}
+
+
 def summarize_jobs(*, address: str | None = None) -> dict:
     """Multi-tenant rollup (the GCS job table + live usage): one row
     per job — priority, quota, cluster-wide usage (CREATED PG bundles +

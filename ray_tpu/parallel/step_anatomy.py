@@ -320,7 +320,15 @@ def _subtract(intervals: list[tuple], cover: list[tuple]) -> float:
 
 
 _EXPOSED_KINDS = {"collective": "comm_exposed_s", "data_wait":
-                  "data_wait_s", "compile": "compile_s"}
+                  "data_wait_s", "compile": "compile_s",
+                  # pipeline-parallel schedule stall: wall clock a stage
+                  # spent parked waiting for an upstream activation /
+                  # downstream gradient / in-flight-window credit (the
+                  # train/pipeline loop stamps these). Kept distinct
+                  # from generic comm so the measured per-stage bubble
+                  # fraction can be checked against (P-1)/(M+P-1)
+                  # schedule theory.
+                  "pipeline_bubble": "bubble_s"}
 _HIDDEN_KINDS = {"collective": "comm_hidden_s", "data_produce":
                  "data_hidden_s"}
 
@@ -350,9 +358,20 @@ def anatomize_rank_step(step: dict, acts: list[dict]) -> dict:
                             for iv in ivs])
     out = {"wall_s": wall, "comm_exposed_s": 0.0, "comm_hidden_s": 0.0,
            "data_wait_s": 0.0, "data_hidden_s": 0.0, "compile_s": 0.0,
-           "other_s": 0.0, "other_hidden_s": 0.0}
+           "bubble_s": 0.0, "other_s": 0.0, "other_hidden_s": 0.0}
     for key, ivs in exposed_by.items():
         out[key] = _total(_merge(ivs))
+    if out["bubble_s"] and out["comm_exposed_s"]:
+        # a pipeline schedule stall IS a blocking recv, so the same wall
+        # interval arrives under both kinds (the collective op records
+        # itself, and the pipeline loop stamps the stall). Keep the two
+        # phases DISJOINT: bubble owns the stall, comm_exposed keeps
+        # only communication that wasn't a schedule stall — otherwise
+        # the per-rank phases sum past wall_s and comm stops measuring
+        # the network.
+        out["comm_exposed_s"] = _subtract(
+            _merge(exposed_by["comm_exposed_s"]),
+            _merge(exposed_by["bubble_s"]))
     for key, ivs in hidden_by.items():
         out[key] = _subtract(_merge(ivs), exposed_union)
     exposed_total = _total(exposed_union)
@@ -457,7 +476,8 @@ def fuse(exports: list[dict]) -> dict:
         ranks[rank] = {**{k: roll.get(k, 0.0) for k in
                           ("wall_s", "compute_s", "comm_exposed_s",
                            "comm_hidden_s", "data_wait_s",
-                           "data_hidden_s", "compile_s", "other_s")},
+                           "data_hidden_s", "compile_s", "bubble_s",
+                           "other_s")},
                        "steps": n,
                        "mean_step_s": roll.get("wall_s", 0.0) / n}
     return {"steps": out_steps, "ranks": ranks,

@@ -333,6 +333,19 @@ def cmd_control(args):
     return 0
 
 
+def cmd_topology(args):
+    """ICI-topology summary — the CLI face of
+    `experimental.state.api.summarize_topology`: every TPU slice the
+    raylets report (hosts, worker indices, coords, chips) and which
+    placement groups / pipeline stages occupy each slice (the
+    SPREAD_ACROSS_SLICES scheduler's operator view)."""
+    from ray_tpu.experimental.state.api import summarize_topology
+
+    print(json.dumps(summarize_topology(address=args.address),
+                     indent=2, default=str))
+    return 0
+
+
 def cmd_jobs(args):
     """Multi-tenant job summary — the CLI face of
     `experimental.state.api.summarize_jobs`: per-job priority/quota/
@@ -567,6 +580,11 @@ def main(argv=None):
                              "block locality)")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_data)
+
+    sp = sub.add_parser("topology",
+                        help="TPU slice topology + placement occupancy")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_topology)
 
     sp = sub.add_parser("jobs",
                         help="multi-tenant job quota/priority/preemption "

@@ -9,6 +9,10 @@ from ray_tpu.train.trainer import (  # noqa: F401
     DataParallelTrainer,
     JaxTrainer,
 )
+from ray_tpu.train.pipeline import (  # noqa: F401
+    PipelineConfig,
+    PipelineTrainer,
+)
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401
 from ray_tpu.train.predictor import (  # noqa: F401
     BatchPredictor,

@@ -298,9 +298,10 @@ class BackendExecutor:
 
     def start(self):
         bundles = self.scaling.as_placement_group_bundles()
-        self.pg = placement_group(bundles,
-                                  strategy=self.scaling.placement_strategy,
-                                  job=getattr(self.scaling, "job", None))
+        self.pg = placement_group(
+            bundles, strategy=self.scaling.placement_strategy,
+            job=getattr(self.scaling, "job", None),
+            bundle_stages=getattr(self.scaling, "bundle_stages", None))
         # subscribe BEFORE waiting: a warning can only arrive once the
         # PG is CREATED, and the monitor must already be listening then.
         # The gang-schedule wait below rides THIS subscription (its

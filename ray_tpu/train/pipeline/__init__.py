@@ -1,0 +1,36 @@
+"""Multi-slice MPMD pipeline parallelism (stage-per-slice training).
+
+The workload the ICI-topology-aware scheduler unlocks: a
+SPREAD_ACROSS_SLICES placement group lands each pipeline stage's
+sub-gang contiguous inside its own TPU slice, and ``PipelineTrainer``
+runs an actor-level GPipe/1F1B microbatch schedule with activations
+hopping stage-to-stage over the host send/recv plane (optionally bf16
+on the wire). See README "Pipeline parallelism & topology".
+"""
+from ray_tpu.train.pipeline.schedule import (
+    build_schedule,
+    gpipe_schedule,
+    max_inflight,
+    one_f_one_b_schedule,
+    theoretical_bubble_fraction,
+)
+from ray_tpu.train.pipeline.stage import (
+    DenseStage,
+    SleepStage,
+    Stage,
+    mse_loss,
+    sgd_update,
+    synth_microbatch,
+)
+from ray_tpu.train.pipeline.trainer import (
+    PipelineConfig,
+    PipelineTrainer,
+    reference_run,
+)
+
+__all__ = [
+    "DenseStage", "PipelineConfig", "PipelineTrainer", "SleepStage",
+    "Stage", "build_schedule", "gpipe_schedule", "max_inflight",
+    "mse_loss", "one_f_one_b_schedule", "reference_run", "sgd_update",
+    "synth_microbatch", "theoretical_bubble_fraction",
+]

@@ -1,0 +1,89 @@
+"""Pipeline microbatch schedules (GPipe / 1F1B) as pure functions.
+
+A schedule is the per-stage ordered action list ``[("fwd", mb), ("bwd",
+mb), ...]`` an MPMD pipeline stage executes for ONE optimizer step.
+Both sides of every inter-stage channel derive their send/recv order
+from the same schedule, so the host p2p plane's per-channel sequence
+counters pair messages without any tagging beyond arrival order.
+
+Both schedules issue backwards in microbatch order 0..M-1 (GPipe could
+equally run them reversed, but a FIXED order shared with 1F1B and with
+``trainer.reference_run`` is what makes the single-gang loss oracle
+bit-for-bit: float gradient accumulation is order-sensitive).
+
+Grounded in "Scaling Deep Learning Training with MPMD Pipeline
+Parallelism" (arXiv:2412.14374: JaxPP's 1F1B task schedules) and the
+GPipe bubble analysis: with P stages and M microbatches the schedule
+leaves each stage idle for (P-1) of the (M+P-1) microbatch slots per
+phase — ``theoretical_bubble_fraction`` is the number the step-anatomy
+plane's measured per-stage bubble is checked against.
+"""
+from __future__ import annotations
+
+SCHEDULES = ("gpipe", "1f1b")
+
+
+def gpipe_schedule(stage: int, num_stages: int,
+                   num_microbatches: int) -> list[tuple[str, int]]:
+    """All forwards, then all backwards (the flush-per-step schedule).
+    Peak in-flight activations = M on every stage."""
+    _check(stage, num_stages, num_microbatches)
+    m = num_microbatches
+    return [("fwd", i) for i in range(m)] + [("bwd", i) for i in range(m)]
+
+
+def one_f_one_b_schedule(stage: int, num_stages: int,
+                         num_microbatches: int) -> list[tuple[str, int]]:
+    """Non-interleaved 1F1B: ``warmup`` forwards, then alternating
+    fwd/bwd pairs, then the cooldown backwards. Peak in-flight
+    activations on stage ``s`` is ``min(M, P - s)`` — the schedule's
+    inherent bounded window (deepest at stage 0, 1 at the last stage),
+    vs GPipe's M everywhere. Backward order is 0..M-1, same as GPipe."""
+    _check(stage, num_stages, num_microbatches)
+    m, p = num_microbatches, num_stages
+    warmup = min(m, p - 1 - stage)
+    actions: list[tuple[str, int]] = [("fwd", i) for i in range(warmup)]
+    for i in range(m - warmup):
+        actions.append(("fwd", warmup + i))
+        actions.append(("bwd", i))
+    actions.extend(("bwd", i) for i in range(m - warmup, m))
+    return actions
+
+
+def build_schedule(name: str, stage: int, num_stages: int,
+                   num_microbatches: int) -> list[tuple[str, int]]:
+    if name == "gpipe":
+        return gpipe_schedule(stage, num_stages, num_microbatches)
+    if name == "1f1b":
+        return one_f_one_b_schedule(stage, num_stages, num_microbatches)
+    raise ValueError(
+        f"unknown pipeline schedule {name!r}: expected one of {SCHEDULES}")
+
+
+def max_inflight(actions: list[tuple[str, int]]) -> int:
+    """Peak number of microbatches forwarded but not yet backwarded —
+    the stage's activation-memory high-water mark under this schedule."""
+    live = peak = 0
+    for kind, _ in actions:
+        live += 1 if kind == "fwd" else -1
+        peak = max(peak, live)
+    return peak
+
+
+def theoretical_bubble_fraction(num_stages: int,
+                                num_microbatches: int) -> float:
+    """(P-1)/(M+P-1): the fraction of a step each stage spends idle
+    under a flush-per-step schedule with uniform microbatch cost (both
+    GPipe and non-interleaved 1F1B share it — 1F1B bounds MEMORY, not
+    the bubble)."""
+    p, m = int(num_stages), int(num_microbatches)
+    if p <= 1:
+        return 0.0
+    return (p - 1) / (m + p - 1)
+
+
+def _check(stage: int, num_stages: int, num_microbatches: int):
+    if not 0 <= stage < num_stages:
+        raise ValueError(f"stage {stage} out of range for {num_stages}")
+    if num_microbatches < 1:
+        raise ValueError("need at least one microbatch")

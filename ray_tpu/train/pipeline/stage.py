@@ -1,0 +1,144 @@
+"""Pipeline stage contract + numpy reference stages.
+
+A stage is the unit ``PipelineTrainer`` places one-per-slice: it owns a
+slice of the model's layers and exposes an explicit forward/backward
+pair over host numpy arrays (the inter-stage hop is host memory either
+way — activations cross the slice boundary over the send/recv plane,
+not ICI). Everything is float32 and deterministic, which is what lets
+``trainer.reference_run`` serve as a bit-for-bit single-gang oracle.
+
+A jax stage fits the same contract (forward returning a residual ctx,
+backward consuming it); the reference stages below keep the plane
+testable on CPU-only CI.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class Stage:
+    """One pipeline stage: parameters + explicit forward/backward."""
+
+    #: input/output feature widths — the trainer uses stage 0's in_dim
+    #: and the last stage's out_dim to synthesize data when no dataset
+    #: shard feeds stage 0.
+    in_dim: int = 0
+    out_dim: int = 0
+
+    def init_params(self, rng: np.random.Generator) -> list[np.ndarray]:
+        raise NotImplementedError
+
+    def forward(self, params: list, x: np.ndarray):
+        """-> (y, ctx): activation for the next stage + residuals the
+        backward needs."""
+        raise NotImplementedError
+
+    def backward(self, params: list, ctx, gy: np.ndarray):
+        """-> (gx, grads): gradient for the previous stage + this
+        stage's parameter gradients (same structure as params)."""
+        raise NotImplementedError
+
+
+class DenseStage(Stage):
+    """``act(W @ x + b)`` — the reference building block."""
+
+    def __init__(self, in_dim: int, out_dim: int, activation: str = "tanh"):
+        if activation not in ("tanh", "relu", "none"):
+            raise ValueError(f"unknown activation {activation!r}")
+        self.in_dim = int(in_dim)
+        self.out_dim = int(out_dim)
+        self.activation = activation
+
+    def init_params(self, rng):
+        scale = np.float32(1.0 / np.sqrt(self.in_dim))
+        w = (rng.standard_normal((self.in_dim, self.out_dim))
+             .astype(np.float32) * scale)
+        b = np.zeros(self.out_dim, np.float32)
+        return [w, b]
+
+    def forward(self, params, x):
+        w, b = params
+        pre = x @ w + b
+        if self.activation == "tanh":
+            y = np.tanh(pre)
+        elif self.activation == "relu":
+            y = np.maximum(pre, np.float32(0.0))
+        else:
+            y = pre
+        return y, (x, pre)
+
+    def backward(self, params, ctx, gy):
+        w, _b = params
+        x, pre = ctx
+        if self.activation == "tanh":
+            t = np.tanh(pre)
+            gz = gy * (np.float32(1.0) - t * t)
+        elif self.activation == "relu":
+            gz = gy * (pre > 0).astype(np.float32)
+        else:
+            gz = gy
+        gw = x.T @ gz
+        gb = gz.sum(axis=0)
+        gx = gz @ w.T
+        return gx, [gw, gb]
+
+
+class SleepStage(Stage):
+    """Pass-through stage with a fixed per-microbatch compute cost
+    (``time.sleep``). Sleeps are immune to CPU contention, which makes
+    the measured bubble fraction of a SleepStage pipeline reproduce the
+    (P-1)/(M+P-1) schedule theory even on a loaded CI box — the bench
+    and bubble tests are built on it."""
+
+    def __init__(self, dim: int, fwd_s: float = 0.02,
+                 bwd_s: float | None = None):
+        self.in_dim = self.out_dim = int(dim)
+        self.fwd_s = float(fwd_s)
+        self.bwd_s = float(bwd_s if bwd_s is not None else fwd_s)
+
+    def init_params(self, rng):
+        return [np.zeros(1, np.float32)]
+
+    def forward(self, params, x):
+        time.sleep(self.fwd_s)
+        return x, None
+
+    def backward(self, params, ctx, gy):
+        time.sleep(self.bwd_s)
+        return gy, [np.zeros(1, np.float32)]
+
+
+def mse_loss(pred: np.ndarray, target: np.ndarray):
+    """Mean-squared error + its gradient w.r.t. pred. Fixed op order —
+    both the pipeline's last stage and the reference oracle call exactly
+    this."""
+    diff = pred - target
+    loss = np.float32(np.mean(diff * diff))
+    gy = diff * np.float32(2.0 / diff.size)
+    return loss, gy
+
+
+def sgd_update(params: list, grads: list, lr: float, scale: float):
+    """In-place ``p -= (lr * scale) * g`` with one fixed multiplier —
+    shared by the pipeline loop and the oracle so the float op order is
+    identical (scale folds the 1/M microbatch average, and 1/(M*R) when
+    a stage is data-parallel)."""
+    step = np.float32(lr * scale)
+    for p, g in zip(params, grads):
+        p -= step * g
+    return params
+
+
+def synth_microbatch(seed: int, step: int, mb: int, batch: int,
+                     in_dim: int, out_dim: int):
+    """Deterministic synthetic (x, y) for one microbatch — a pure
+    function of (seed, step, mb), so every process (and the oracle)
+    derives identical bytes without any data movement."""
+    rng = np.random.default_rng(
+        np.uint64(seed) * np.uint64(1_000_003)
+        + np.uint64(step) * np.uint64(1_009) + np.uint64(mb))
+    x = rng.standard_normal((batch, in_dim)).astype(np.float32)
+    y = rng.standard_normal((batch, out_dim)).astype(np.float32)
+    return x, y

@@ -552,7 +552,14 @@ def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
                           payload=arr)
 
 
-def send(tensor, dst_rank: int, group_name: str = "default"):
+def send(tensor, dst_rank: int, group_name: str = "default",
+         wire_dtype: str | None = None):
+    """``wire_dtype`` ("bf16"/"int8", default off) quantizes THIS hop's
+    payload on the wire when it is an eligible float32 array — the
+    classic inter-stage activation trick the pipeline trainer uses; the
+    receiver detects the header and decodes, no negotiation. Exact by
+    default; per-call opt-in so exact-by-contract users of the same
+    group are never affected."""
     g = _manager.get(group_name)
     arr = (_coerce(g, tensor) if getattr(g, "backend", None) != "xla"
            else np.asarray(tensor))
@@ -560,7 +567,8 @@ def send(tensor, dst_rank: int, group_name: str = "default"):
     # p2p seq is per-channel, not group-wide: no straggler record
     # (seq=None), but latency/bytes metrics and spans still apply
     _coltel.run_op(g, "send", None,
-                   lambda: _p2p(g).send(arr, dst_rank, seq),
+                   lambda: _p2p(g).send(arr, dst_rank, seq,
+                                        wire_fmt=wire_dtype),
                    payload=arr)
 
 

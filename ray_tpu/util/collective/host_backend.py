@@ -303,13 +303,9 @@ class HostGroup:
         float32-scaled wire."""
         from ray_tpu._private.config import get_config
 
-        fmt = str(get_config("collective_wire_dtype")).strip().lower()
-        if fmt in ("", "off", "0", "false", "none"):
+        fmt = _wire.normalize_format(get_config("collective_wire_dtype"))
+        if fmt is None:
             return None
-        if fmt not in _wire.WIRE_FORMATS:
-            raise ValueError(
-                f"collective_wire_dtype={fmt!r}: expected one of off, "
-                f"{', '.join(sorted(_wire.WIRE_FORMATS))}")
         if not self._pipelined():
             return None   # legacy kill-switch ring stays bit-exact
         if np.dtype(dtype) != np.float32 or op != "sum":
@@ -1059,9 +1055,63 @@ class HostGroup:
             d *= 2
         self._note_segs("barrier")
 
-    def send(self, arr, dst: int, seq: int):
-        self._send(dst, ("p2p", seq), arr)
+    def _p2p_wire_ctx(self, fmt, dtype) -> _wire.WireCodec | None:
+        """Wire codec for one p2p hop, or None for the exact path.
+        Unlike the ring's `_wire_ctx` there is no reduce, so eligibility
+        is just float32 payloads on the pipelined path (bf16 is the
+        classic inter-stage activation wire; int8 works too for
+        activation tensors that tolerate it). `fmt` is per-CALL — the
+        pipeline trainer passes its own knob — so p2p quantization never
+        leaks into exact-by-contract users of the same group (the data
+        plane's shuffle exchange, checkpoint gathers)."""
+        fmt = _wire.normalize_format(fmt)
+        if fmt is None:
+            return None
+        if not self._pipelined():
+            return None   # legacy kill-switch path stays bit-exact
+        if np.dtype(dtype) != np.float32:
+            return None
+        from ray_tpu._private.config import get_config
+
+        block = int(get_config("collective_quant_block"))
+        key = ("p2p", fmt, block)
+        codec = self._wire_codecs.get(key)
+        if codec is None:
+            codec = self._wire_codecs[key] = _wire.WireCodec(fmt, block)
+        return codec
+
+    def send(self, arr, dst: int, seq: int, wire_fmt: str | None = None):
+        wire = None
+        if wire_fmt is not None and dst != self.rank \
+                and isinstance(arr, np.ndarray) and arr.size:
+            wire = self._p2p_wire_ctx(wire_fmt, arr.dtype)
+        if dst == self.rank or not self._pipelined():
+            # local delivery / legacy ring: original framing, and — like
+            # the legacy segment path — no wire accounting
+            self._send(dst, ("p2p", seq), arr)
+            self._note_segs("send")
+            return
+        payload, fmt_name = arr, "off"
+        if wire is not None:
+            enc = wire.encode(np.ascontiguousarray(arr).reshape(-1))
+            if enc is not None:
+                # the encoding aliases codec scratch, which is safe:
+                # push_parts writes the bytes to the socket before
+                # returning, so the next encode can reuse the buffers
+                payload = _wire.wrap_p2p(enc, arr.shape)
+                fmt_name = wire.name
+        # accounting mirrors the ring's _push_seg: every pipelined hop
+        # records its SERIALIZED size under its format, exact hops under
+        # "off" — so off-vs-quantized ratios read straight from
+        # ray_tpu_collective_wire_bytes_total
+        parts = ser.serialize_parts(payload)
+        if _tm.ENABLED:
+            self._wire_bytes[fmt_name] = \
+                self._wire_bytes.get(fmt_name, 0) + ser.parts_size(parts)
+        self._push_frame(dst, ("p2p", seq), parts)
         self._note_segs("send")
 
     def recv(self, src: int, seq: int):
-        return self._recv(src, ("p2p", seq))
+        # a quantized p2p payload self-describes via its header — the
+        # receiver needs no negotiation (and no codec when it's exact)
+        return _wire.maybe_decode_p2p(self._recv(src, ("p2p", seq)))

@@ -60,8 +60,38 @@ WIRE_OFF, WIRE_BF16, WIRE_INT8 = 0, 1, 2
 # codec at all", not a codec that tags frames WIRE_OFF)
 WIRE_FORMATS = {"bf16": WIRE_BF16, "int8": WIRE_INT8}
 
+# spellings that mean "exact wire" — ONE list, shared by every layer
+# that resolves a wire-format knob (ring ctx, p2p ctx, PipelineConfig)
+OFF_ALIASES = ("", "off", "0", "false", "none")
+
+
+def normalize_format(fmt) -> str | None:
+    """Canonicalize a wire-format knob value: None for the exact path
+    (None or any OFF_ALIASES spelling), the lowercase format name for a
+    known format, ValueError otherwise — so a typo fails at the
+    RESOLVING layer (config read, PipelineConfig construction) instead
+    of deep inside a worker's first send."""
+    if fmt is None:
+        return None
+    f = str(fmt).strip().lower()
+    if f in OFF_ALIASES:
+        return None
+    if f not in WIRE_FORMATS:
+        raise ValueError(
+            f"wire dtype {fmt!r}: expected one of off, "
+            f"{', '.join(sorted(WIRE_FORMATS))}")
+    return f
+
 # header sentinel: first element of every quantized-segment tuple
 _MAGIC = "rtqw1"
+
+# point-to-point wrapper sentinel: a quantized p2p payload travels as
+# (P2P_MAGIC, shape, <wire tuple>) so the receiver can restore the
+# original array shape (ring segments are always flat; p2p hops are
+# whole arrays). Receivers detect the header per message — a sender may
+# fall back to the exact path (ineligible dtype, codec declined) with
+# no negotiation, exactly like the segment wire.
+P2P_MAGIC = "rtqp2pw1"
 
 # int8 blocks whose absmax sits below this encode as zeros: the
 # reciprocal scale would overflow float32 (absmax/127 < ~1/FLT_MAX) and
@@ -370,6 +400,37 @@ class WireCodec:
         if denom == 0.0 or not np.isfinite(denom):
             return 0.0
         return float(np.abs(deq[:n] - ref).max()) / denom
+
+
+def wrap_p2p(enc: tuple, shape) -> tuple:
+    """Wrap one encoded wire tuple as a shape-carrying p2p payload."""
+    return (P2P_MAGIC, tuple(int(d) for d in shape), enc)
+
+
+def is_p2p_wire(val) -> bool:
+    return isinstance(val, tuple) and len(val) == 3 and val[0] == P2P_MAGIC
+
+
+_p2p_decoder: WireCodec | None = None
+
+
+def maybe_decode_p2p(val):
+    """Decode a p2p-wrapped wire payload back to a float32 array of the
+    original shape; anything else passes through unchanged. Allocates a
+    fresh owned array per call (p2p results escape to callers — codec
+    scratch reuse would alias successive receives)."""
+    global _p2p_decoder
+    if not is_p2p_wire(val):
+        return val
+    _, shape, enc = val
+    if _p2p_decoder is None:
+        # decode is format-driven by the tuple's own tag/block — the
+        # codec's configured format only governs ENCODE, so one shared
+        # instance serves both bf16 and int8 payloads
+        _p2p_decoder = WireCodec("bf16", 1024)
+    out = np.empty(int(enc[2]), np.float32)
+    _p2p_decoder._dec(enc, out)
+    return out.reshape(shape)
 
 
 def _trim(enc: tuple, n: int) -> tuple:

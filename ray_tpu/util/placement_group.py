@@ -16,7 +16,8 @@ import time
 from ray_tpu._private import api
 from ray_tpu.exceptions import PlacementGroupUnschedulableError
 
-VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD",
+                    "SPREAD_ACROSS_SLICES")
 
 
 class PlacementGroup:
@@ -123,17 +124,30 @@ class PlacementGroup:
 
 def placement_group(bundles: list[dict], strategy: str = "PACK",
                     name: str = "", lifetime=None,
-                    job: str | None = None) -> PlacementGroup:
+                    job: str | None = None,
+                    bundle_stages: list | None = None) -> PlacementGroup:
     """``job`` labels the gang for the multi-tenant scheduling plane
     (quota accounting, fair share, priority preemption —
     ``ray_tpu.util.jobs``); omitted, it inherits this process's current
-    job (``jobs.set_current_job``)."""
+    job (``jobs.set_current_job``).
+
+    ``bundle_stages`` (SPREAD_ACROSS_SLICES) labels each bundle with its
+    pipeline stage: bundles sharing a label form one stage sub-gang that
+    lands contiguous inside ONE slice, distinct stages land on distinct
+    slices (the multi-slice MPMD layout — inner collectives ride ICI,
+    inter-stage activations hop the inter-slice plane). Omitted, every
+    bundle is its own stage. Placement is all-or-nothing: a gang that
+    cannot place every stage this way stays PENDING whole."""
     if strategy not in VALID_STRATEGIES:
         raise ValueError(
             f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
     if not bundles or any(not b for b in bundles):
         raise ValueError("bundles must be a non-empty list of non-empty "
                          "resource dicts")
+    if bundle_stages is not None and len(bundle_stages) != len(bundles):
+        raise ValueError(
+            f"bundle_stages must label every bundle: got "
+            f"{len(bundle_stages)} labels for {len(bundles)} bundles")
     if job is None:
         from ray_tpu.util import jobs as _jobs
 
@@ -143,7 +157,9 @@ def placement_group(bundles: list[dict], strategy: str = "PACK",
     worker.gcs.call("create_placement_group", pg_id=pg_id,
                     bundles=[{k: float(v) for k, v in b.items()}
                              for b in bundles],
-                    strategy=strategy, name=name, job=job or "")
+                    strategy=strategy, name=name, job=job or "",
+                    stages=(list(bundle_stages)
+                            if bundle_stages is not None else None))
     return PlacementGroup(pg_id)
 
 

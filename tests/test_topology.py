@@ -96,6 +96,143 @@ def test_two_gangs_get_disjoint_slices(two_slice_cluster):
     assert not (set(a_nodes) & set(b_nodes))
 
 
+# ------------------------------------------- SPREAD_ACROSS_SLICES edges
+
+def test_spread_across_slices_distinct_slices_contiguous(two_slice_cluster):
+    """Each stage's sub-gang lands contiguous inside ONE slice; distinct
+    stages land on distinct slices."""
+    cluster, ray_tpu, nodes = two_slice_cluster
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"TPU": 4}] * 4,
+                         strategy="SPREAD_ACROSS_SLICES",
+                         bundle_stages=[0, 0, 0, 1])
+    assert pg.wait(10)
+    state, bundle_nodes = _pg_nodes(ray_tpu, pg)
+    assert state == "CREATED"
+    by_node = {nodes[k].node_id: k for k in nodes}
+    placed = [by_node[n] for n in bundle_nodes]
+    s0_slices = {s for s, _ in placed[:3]}
+    assert len(s0_slices) == 1, f"stage 0 split across slices: {placed}"
+    assert placed[3][0] not in s0_slices, f"stages share a slice: {placed}"
+    # stage 0 needs 3 hosts: only s0 has them, so stage 1 best-fits s1
+    assert s0_slices == {"s0"} and placed[3][0] == "s1", placed
+    wids = sorted(w for _, w in placed[:3])
+    assert wids == list(range(min(wids), min(wids) + 3)), \
+        f"stage 0 hosts not contiguous: {wids}"
+
+
+def test_spread_across_slices_pending_whole_when_short(two_slice_cluster):
+    """Fewer slices than stages: the gang stays PENDING with NO bundle
+    placed (all-or-nothing), and becomes CREATED the moment a slice
+    appears."""
+    cluster, ray_tpu, nodes = two_slice_cluster
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"TPU": 4}] * 3,
+                         strategy="SPREAD_ACROSS_SLICES",
+                         bundle_stages=[0, 1, 2])   # 3 stages, 2 slices
+    assert not pg.wait(2)
+    state, bundle_nodes = _pg_nodes(ray_tpu, pg)
+    assert state == "PENDING"
+    assert all(n is None for n in bundle_nodes), \
+        f"partial placement of an unplaceable gang: {bundle_nodes}"
+    cluster.add_node(num_cpus=2, num_tpus=4,
+                     tpu_topology={"slice_id": "s2", "worker_id": 0,
+                                   "chips": 4})
+    assert pg.wait(15), "gang should place once a third slice registers"
+    state, bundle_nodes = _pg_nodes(ray_tpu, pg)
+    assert state == "CREATED" and all(bundle_nodes)
+
+
+def test_spread_across_slices_default_stage_per_bundle(two_slice_cluster):
+    """No stage labels: every bundle is its own stage — classic
+    one-bundle-per-slice spread."""
+    cluster, ray_tpu, nodes = two_slice_cluster
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"TPU": 4}] * 2,
+                         strategy="SPREAD_ACROSS_SLICES")
+    assert pg.wait(10)
+    _, bundle_nodes = _pg_nodes(ray_tpu, pg)
+    by_node = {nodes[k].node_id: k for k in nodes}
+    slices = [by_node[n][0] for n in bundle_nodes]
+    assert len(set(slices)) == 2, f"bundles share a slice: {slices}"
+
+
+def test_spread_across_slices_quota_blocked_whole(two_slice_cluster):
+    """Multi-tenant interplay: an over-quota multi-slice gang stays
+    PENDING all-or-nothing (no bundle placed, no slice reserved), and
+    places whole the moment the quota is raised."""
+    cluster, ray_tpu, nodes = two_slice_cluster
+    from ray_tpu.util import jobs
+    from ray_tpu.util.placement_group import placement_group
+
+    jobs.register_job("mpmd", quota={"TPU": 4.0})
+    pg = placement_group([{"TPU": 4}] * 2,
+                         strategy="SPREAD_ACROSS_SLICES",
+                         bundle_stages=[0, 1], job="mpmd")
+    assert not pg.wait(2)
+    state, bundle_nodes = _pg_nodes(ray_tpu, pg)
+    assert state == "PENDING"
+    assert all(n is None for n in bundle_nodes), \
+        f"quota-blocked gang partially placed: {bundle_nodes}"
+    job = jobs.get_job("mpmd")
+    assert job["QuotaRejections"] >= 1
+    jobs.update_job("mpmd", quota={"TPU": 8.0})
+    assert pg.wait(10), "raised quota should unblock the whole gang"
+    state, bundle_nodes = _pg_nodes(ray_tpu, pg)
+    assert state == "CREATED" and all(bundle_nodes)
+
+
+def test_spread_slice_infeasible_high_pri_neither_preempts_nor_blocks(
+        two_slice_cluster):
+    """A high-priority SPREAD_ACROSS_SLICES gang with more STAGES than
+    the cluster has SLICES is structurally infeasible even though its
+    resource sums fit: it must not preempt checkpointed victims (the
+    freed bundles cannot add a third slice) and must not raise the
+    priority barrier that would starve lower-priority tenants."""
+    cluster, ray_tpu, nodes = two_slice_cluster
+    from ray_tpu._private import events
+    from ray_tpu.util import jobs
+    from ray_tpu.util.placement_group import placement_group
+
+    jobs.register_job("low", priority=0)
+    jobs.register_job("high", priority=10)
+    victim = placement_group([{"TPU": 4}] * 2, strategy="STRICT_PACK",
+                             job="low")
+    assert victim.wait(10)
+    base_warned = sum(1 for e in events.snapshot()
+                      if e["kind"] == "PREEMPTION_WARNED")
+    # 3 stages, 2 slices: resource totals fit, slices don't
+    infeasible = placement_group([{"TPU": 4}] * 3,
+                                 strategy="SPREAD_ACROSS_SLICES",
+                                 bundle_stages=[0, 1, 2], job="high")
+    assert not infeasible.wait(3)
+    assert sum(1 for e in events.snapshot()
+               if e["kind"] == "PREEMPTION_WARNED") == base_warned, \
+        "slice-infeasible gang fired preemption warnings"
+    # no priority barrier: a lower-priority gang still places
+    low2 = placement_group([{"TPU": 4}], strategy="PACK", job="low")
+    assert low2.wait(10), "infeasible high-pri gang starved the tenant"
+    state, _ = _pg_nodes(ray_tpu, victim)
+    assert state == "CREATED", "victim was torn down for nothing"
+
+
+def test_spread_across_slices_validation(ray_start_regular):
+    """bundle_stages must label every bundle; unknown strategies still
+    raise at the call site."""
+    import pytest as _pytest
+
+    from ray_tpu.util.placement_group import placement_group
+
+    with _pytest.raises(ValueError, match="bundle_stages"):
+        placement_group([{"CPU": 1}] * 3, strategy="SPREAD_ACROSS_SLICES",
+                        bundle_stages=[0, 1])
+    with _pytest.raises(ValueError, match="strategy"):
+        placement_group([{"CPU": 1}], strategy="SPREAD_SLICES")
+
+
 def test_tune_trials_gang_scheduled(ray_start_regular):
     """Every Tune trial runs inside its own placement group (reference:
     tune/execution/placement_groups.py)."""

@@ -77,6 +77,18 @@ def _rank_cls(ray):
 
             return current_worker().store.stats()
 
+        def segment_objects(self, name):
+            """Store objects whose id carries this group's oid prefix —
+            collective shm SEGMENTS only, invisible to the async frees
+            of unrelated task-arg/result objects."""
+            from ray_tpu._private.worker_runtime import (col_oid_prefix,
+                                                         current_worker)
+
+            prefix = col_oid_prefix(name)
+            store = current_worker().store
+            return sum(1 for oid, _ in store.list_objects()
+                       if oid.startswith(prefix))
+
         def destroy(self, name):
             from ray_tpu.util import collective as col
 
@@ -367,28 +379,26 @@ def test_shm_segment_transport_oracle(ray_start_regular):
             shards = np.array_split(expect, world)
             for r, got in enumerate(rs):
                 assert np.array_equal(np.asarray(got), shards[r])
-            import time as _time
-
-            base = ray.get(actors[0].store_stats.remote(), timeout=30)
             for _ in range(3):
                 ray.get([a.allreduce.remote(ins[r], name)
                          for r, a in enumerate(actors)], timeout=60)
-            # settle-poll: task-ARG objects (the 800 KB inputs) are
-            # freed asynchronously by the ref reaper, so the count
-            # fluctuates; leaked SEGMENT objects would never go away —
-            # a longer deadline only trades wall-clock on a loaded
-            # full-suite box, never masks a real leak (45s: the 20s
-            # window flaked under the 870s tier-1 run's load)
-            deadline = _time.time() + 45
-            while True:
-                after = ray.get(actors[0].store_stats.remote(),
-                                timeout=30)
-                if after["num_objects"] <= base["num_objects"]:
-                    break
-                if _time.time() > deadline:
-                    raise AssertionError(
-                        f"shm segment objects leaked: {base} -> {after}")
-                _time.sleep(0.25)
+            # Leak check, DETERMINISTIC: count only objects carrying
+            # this group's oid prefix. Every shm segment's last
+            # consumer deletes it synchronously before its collective
+            # call returns, so once all ranks' ops resolved the count
+            # must be exactly zero — no settle window. (The old check
+            # compared the store's TOTAL object count against a
+            # pre-sampled base, which raced the ref reaper's
+            # fire-and-forget free pipeline for the 800 KB task-arg
+            # objects: owner → GCS → raylet deletes ride best-effort
+            # one-way pushes with no retry/reconcile, so under
+            # full-suite load one arg object's free could land
+            # arbitrarily late — or never — and the test flaked ~1 in
+            # 5 with no segment leaked at all.)
+            leaked = ray.get(actors[0].segment_objects.remote(name),
+                             timeout=30)
+            assert leaked == 0, \
+                f"{leaked} shm segment objects leaked for group {name}"
         finally:
             _teardown(ray, actors, name)
 
