@@ -99,6 +99,29 @@ def _host_bench_actor_cls():
                     "err_bound": n * q * absmax_sum,
                     "absmax_sum": absmax_sum}
 
+        def bench_async(self, size_bytes: int, repeats: int,
+                        window: int) -> list:
+            """Per-op wall times of `window` async allreduces submitted
+            back-to-back and waited together. window=1 vs the sync
+            `bench` rows is the pure handle overhead (submit + issue-
+            thread handoff + handle wakeup); larger windows measure the
+            pipelined submission path the bucketed-DDP plane rides."""
+            from ray_tpu.util import collective as col
+
+            elems = max(1, size_bytes // 4)
+            arr = np.ones(elems, dtype=np.float32)
+            col.allreduce_async(arr).result(120)       # warmup
+            col.barrier()
+            out = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                handles = [col.allreduce_async(arr)
+                           for _ in range(window)]
+                for h in handles:
+                    h.result(600)
+                out.append((time.perf_counter() - t0) / window)
+            return out
+
         def bench(self, op: str, size_bytes: int, repeats: int) -> list:
             """Returns per-op wall times (seconds), one per repeat —
             the caller derives mean (headline, comparable to earlier
@@ -259,6 +282,65 @@ def run_wire_sweep(world: int, sizes: list[int], repeats: int,
     return rows
 
 
+def run_async_sweep(world: int, sizes: list[int], repeats: int,
+                    windows: list[int] | None = None) -> list[dict]:
+    """--async: handle-overhead sweep. For each size: a sync-allreduce
+    baseline, then async submissions at each window depth (window=1
+    isolates the per-op handle overhead; deeper windows measure the
+    pipelined submission path). One cluster for the whole sweep — the
+    knobs don't change between rows."""
+    import ray_tpu
+    from ray_tpu.util import collective as col
+
+    windows = windows or [1, 4]
+    ray_tpu.init(num_cpus=max(4, world),
+                 object_store_memory=256 * 1024 * 1024)
+    try:
+        BenchRank = _host_bench_actor_cls()
+        actors = [BenchRank.options(num_cpus=0).remote()
+                  for _ in range(world)]
+        col.create_collective_group(actors, world, list(range(world)),
+                                    backend="host")
+        rows = []
+        for size in sizes:
+            per_rank = ray_tpu.get(
+                [a.bench.remote("allreduce", size, repeats)
+                 for a in actors], timeout=1800)
+            sync_ops = [max(ts) for ts in zip(*per_rank)]
+            sync_p50 = sorted(sync_ops)[len(sync_ops) // 2]
+            rows.append({
+                "backend": "host", "op": "allreduce", "mode": "sync",
+                "size_bytes": size, "world": world,
+                "p50_time_s": round(sync_p50, 6),
+                "p50_busbw_GBps": round(
+                    size / sync_p50 / 1e9
+                    * bus_factor("allreduce", world), 4),
+            })
+            emit(rows[-1])
+            for window in windows:
+                per_rank = ray_tpu.get(
+                    [a.bench_async.remote(size, repeats, window)
+                     for a in actors], timeout=1800)
+                per_op = [max(ts) for ts in zip(*per_rank)]
+                p50 = sorted(per_op)[len(per_op) // 2]
+                row = {
+                    "backend": "host", "op": "allreduce",
+                    "mode": f"async_w{window}", "size_bytes": size,
+                    "world": world, "p50_time_s": round(p50, 6),
+                    "p50_busbw_GBps": round(
+                        size / p50 / 1e9
+                        * bus_factor("allreduce", world), 4),
+                }
+                if window == 1:
+                    row["handle_overhead_us"] = round(
+                        (p50 - sync_p50) * 1e6, 1)
+                rows.append(row)
+                emit(row)
+        return rows
+    finally:
+        ray_tpu.shutdown()
+
+
 # ---------------------------------------------------------- xla-local backend
 
 def run_xla_local(sizes: list[int], repeats: int,
@@ -355,6 +437,12 @@ def summarize(rows: list[dict]):
     print("\n" + hdr, file=sys.stderr)
     print("-" * len(hdr), file=sys.stderr)
     for r in rows:
+        if "algbw_GBps" not in r:          # --async rows: p50-only
+            print(f"{r['backend']:8} {r['op'] + ':' + r['mode']:14} "
+                  f"{r['size_bytes'] / 2**20:>8.1f}MB {r['world']:>3} "
+                  f"{'':>11} {r['p50_busbw_GBps']:>11.3f}",
+                  file=sys.stderr)
+            continue
         print(f"{r['backend']:8} {r['op']:14} "
               f"{r['size_bytes'] / 2**20:>8.1f}MB {r['world']:>3} "
               f"{r['algbw_GBps']:>11.3f} {r['busbw_GBps']:>11.3f}",
@@ -388,13 +476,29 @@ def main(argv=None):
                     help="with --wire-dtype: keep the same-node shm "
                          "segment transport on instead of measuring "
                          "the socket wire")
+    ap.add_argument("--async", dest="async_mode", action="store_true",
+                    help="host backend: async handle-overhead sweep — "
+                         "sync allreduce baseline vs allreduce_async "
+                         "at --async-windows submission depths")
+    ap.add_argument("--async-windows", type=int, nargs="+",
+                    default=[1, 4],
+                    help="submission window depths for --async")
     ap.add_argument("--json-out", default=None,
                     help="write all rows as one machine-readable JSON "
                          "record (busbw artifact, e.g. BENCH_r06.json)")
     args = ap.parse_args(argv)
     sizes = [int(mb * 2**20) for mb in args.sizes_mb]
 
-    if args.backend == "host" and args.wire_dtype:
+    if args.async_mode and args.backend != "host":
+        ap.error("--async requires --backend host (async handles are a "
+                 "host-backend feature)")
+    if args.async_mode and args.wire_dtype:
+        ap.error("--async and --wire-dtype are separate sweeps — run "
+                 "them as two invocations")
+    if args.backend == "host" and args.async_mode:
+        rows = run_async_sweep(args.world, sizes, args.repeats,
+                               args.async_windows)
+    elif args.backend == "host" and args.wire_dtype:
         rows = run_wire_sweep(args.world, sizes, args.repeats,
                               args.wire_dtype, args.wire_shm)
     elif args.backend == "host":

@@ -1,0 +1,203 @@
+"""Bucketed-DDP gradient-sync benchmark — PERF.md round 15 artifact.
+
+Two phases, one JSON artifact (BENCH_r15.json):
+
+1. **handle overhead** (`collective_bench.run_async_sweep`): sync
+   allreduce baseline vs `allreduce_async` at submission windows 1 and
+   4 — window 1 isolates the per-op cost of the handle plane (submit +
+   issue-thread handoff + handle wakeup), deeper windows measure the
+   pipelined submission path bucketed DDP rides.
+2. **train grad-sync step** — the acceptance measurement: a 2-worker
+   gang syncing a comm-bound grad pytree (default 64 MB, far past the
+   8 MB BENCH_r06/r08 regime) through `train.ddp.sync_gradients`,
+   bucketed (async, overlapped) vs `RAY_TPU_TRAIN_BUCKET_DDP=0`
+   (legacy single synchronous allreduce), same seed, several bucket
+   sizes. The headline is p50 of the slowest rank per sync — the
+   gang-blocking quantity a train step actually pays.
+
+Sizing note: the whole sweep must fit the node's shm store
+(`object_store_memory`); a single-op sync of G bytes stages ~G/2 of
+segments per rank concurrently, so keep 2 x grads + segments well
+under the store size (the harness uses 64 MB grads against a 256 MB
+store). Past that boundary the store starts evicting ephemeral
+segments and ops fail loudly — a real capacity limit, not a perf
+cliff.
+
+Usage:
+  python benchmarks/ddp_bench.py --json-out BENCH_r15.json
+  python benchmarks/ddp_bench.py --total-mb 64 --bucket-mb 2 4 8 \
+      --leaves 16 --repeats 7
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _sync_actor_cls():
+    import numpy as np
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    class DdpRank:
+        def join(self, world, rank, name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, "host", name)
+            return rank
+
+        def grad_sync_bench(self, rank, name, total_bytes, n_leaves,
+                            bucket_bytes, bucketed, repeats):
+            """Per-sync wall times for one configuration. The grads
+            tree is built once (seeded) and reused — sync_gradients
+            never mutates its input — so the timed region is exactly
+            pack + allreduce + unpack, overlapped or not."""
+            os.environ["RAY_TPU_TRAIN_BUCKET_DDP"] = \
+                "1" if bucketed else "0"
+            from ray_tpu.train import ddp
+            from ray_tpu.util import collective as col
+
+            rng = np.random.RandomState(3 + rank)
+            per = max(1, int(total_bytes) // 4 // n_leaves)
+            grads = {f"w{i:02d}": rng.standard_normal(per)
+                     .astype(np.float32) for i in range(n_leaves)}
+            ddp.sync_gradients(grads, name,
+                               bucket_bytes=bucket_bytes)   # warmup
+            col.barrier(name)
+            out = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                ddp.sync_gradients(grads, name,
+                                   bucket_bytes=bucket_bytes)
+                out.append(time.perf_counter() - t0)
+            return out
+
+        def bucket_stats(self):
+            from ray_tpu.util.metrics import registry_snapshot
+
+            out = {}
+            for fam in registry_snapshot():
+                if fam["name"].startswith("ray_tpu_train_bucket"):
+                    out[fam["name"]] = fam.get("values") or \
+                        fam.get("counts")
+            return out
+
+        def destroy(self, name):
+            from ray_tpu.util import collective as col
+
+            col.destroy_collective_group(name)
+            return True
+
+    return DdpRank
+
+
+def run_grad_sync(world: int, total_bytes: int, n_leaves: int,
+                  bucket_mbs: list[float], repeats: int) -> list[dict]:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(4, world),
+                 object_store_memory=256 * 1024 * 1024)
+    name = "ddp_bench"
+    try:
+        DdpRank = _sync_actor_cls()
+        actors = [DdpRank.options(num_cpus=0).remote()
+                  for _ in range(world)]
+        ray_tpu.get([a.join.remote(world, i, name)
+                     for i, a in enumerate(actors)], timeout=120)
+
+        def one(bucketed: bool, bucket_bytes: int) -> dict:
+            per_rank = ray_tpu.get(
+                [a.grad_sync_bench.remote(i, name, total_bytes,
+                                          n_leaves, bucket_bytes,
+                                          bucketed, repeats)
+                 for i, a in enumerate(actors)], timeout=1800)
+            per_op = [max(ts) for ts in zip(*per_rank)]
+            p50 = sorted(per_op)[len(per_op) // 2]
+            return {
+                "phase": "train_grad_sync", "world": world,
+                "total_bytes": total_bytes, "leaves": n_leaves,
+                "bucketed": bucketed, "bucket_bytes": bucket_bytes,
+                "p50_sync_s": round(p50, 6),
+                "best_sync_s": round(min(per_op), 6),
+                "mean_sync_s": round(sum(per_op) / len(per_op), 6),
+                "p50_effective_GBps": round(
+                    total_bytes / p50 / 1e9, 3),
+            }
+
+        rows = [one(False, total_bytes)]          # legacy baseline
+        print(json.dumps(rows[-1]), flush=True)
+        base = rows[0]["p50_sync_s"]
+        for mb in bucket_mbs:
+            row = one(True, int(mb * 2**20))
+            row["p50_speedup_vs_off"] = round(
+                base / row["p50_sync_s"], 3)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+        rows.append({"phase": "bucket_metrics",
+                     "stats": ray_tpu.get(
+                         actors[0].bucket_stats.remote())})
+        ray_tpu.get([a.destroy.remote(name) for a in actors],
+                    timeout=60)
+        return rows
+    finally:
+        ray_tpu.shutdown()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--total-mb", type=float, default=64)
+    ap.add_argument("--leaves", type=int, default=16)
+    ap.add_argument("--bucket-mb", type=float, nargs="+",
+                    default=[2, 4, 8])
+    ap.add_argument("--repeats", type=int, default=7)
+    ap.add_argument("--async-sizes-mb", type=float, nargs="+",
+                    default=[1, 8])
+    ap.add_argument("--skip-async", action="store_true",
+                    help="skip the handle-overhead phase")
+    ap.add_argument("--json-out", default=None)
+    args = ap.parse_args(argv)
+
+    rows = []
+    if not args.skip_async:
+        from benchmarks.collective_bench import run_async_sweep
+
+        for r in run_async_sweep(
+                args.world,
+                [int(mb * 2**20) for mb in args.async_sizes_mb],
+                args.repeats):
+            rows.append({"phase": "handle_overhead", **r})
+    rows += run_grad_sync(args.world, int(args.total_mb * 2**20),
+                          args.leaves, args.bucket_mb, args.repeats)
+
+    train_rows = [r for r in rows
+                  if r.get("phase") == "train_grad_sync"]
+    bucketed = [r for r in train_rows if r["bucketed"]]
+    if bucketed:
+        best = max(bucketed, key=lambda r: r.get("p50_speedup_vs_off", 0))
+        print(f"best bucketed config: {best['bucket_bytes'] // 2**20}MB "
+              f"buckets, {best['p50_speedup_vs_off']}x vs unbucketed "
+              f"({best['p50_sync_s'] * 1e3:.1f}ms vs "
+              f"{train_rows[0]['p50_sync_s'] * 1e3:.1f}ms p50)",
+              file=sys.stderr)
+    if args.json_out:
+        record = {"harness": "benchmarks/ddp_bench.py",
+                  "argv": list(argv) if argv is not None
+                  else sys.argv[1:],
+                  "rows": rows}
+        with open(args.json_out, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.json_out} ({len(rows)} rows)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,14 @@ mechanically checkable:
   attribute both read and written under a class's lock somewhere, but
   assigned lock-free in another method (the PR 5/6 unlocked
   double-checked-init / poison-check race class).
+- **RTL107 — condition used without holding it.** ``.notify()`` /
+  ``.notify_all()`` / ``.wait()`` / ``.wait_for()`` on a known
+  condition/lock token while that lock is NOT held. Notifying an
+  unheld ``threading.Condition`` raises ``RuntimeError`` at runtime,
+  and a wait outside the lock races its own predicate (lost wakeup).
+  Added with the async-collective issue thread (handle completion
+  state flips under the group condition; waiters park in ``wait_for``
+  under it) so that discipline is mechanically checked.
 - **RTL106 — unbounded per-id growth in a control-plane class.** A
   dict/list/set attribute of a class in one of the CONTROL-PLANE
   modules (``_CONTROL_PLANE_FILES``: gcs / raylet / pubsub /
@@ -92,6 +100,7 @@ class _FnReport:
     name: str
     qual: str
     blocks: list = dataclasses.field(default_factory=list)
+    cond_misuse: list = dataclasses.field(default_factory=list)  # (node, meth, tok)
     callbacks: list = dataclasses.field(default_factory=list)  # (node, pname, held)
     edges: list = dataclasses.field(default_factory=list)      # (A, B, node)
     acquired: set = dataclasses.field(default_factory=set)
@@ -291,6 +300,16 @@ class _FnWalker:
         if isinstance(call.func, ast.Name) and \
                 call.func.id in self.params and held:
             self.rep.callbacks.append((call, call.func.id, held))
+        # RTL107: condition primitives invoked while the condition's
+        # lock is NOT held. Skipped inside *_locked methods (the
+        # caller holds SOME lock; name-based identity can't tell which)
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in ("notify", "notify_all", "wait",
+                                   "wait_for") and \
+                "<caller's lock>" not in self.held:
+            tok = self.scope.lock_token(call.func.value)
+            if tok is not None and tok not in self.held:
+                self.rep.cond_misuse.append((call, call.func.attr, tok))
         desc = self._blocking_reason(call, name)
         if desc is not None:
             self.rep.blocks.append(_Block(call, desc, held))
@@ -410,6 +429,11 @@ def _findings_for_scope(path: str, scope: _Scope, reports: dict,
                 emit("RTL102", b.node, rep.qual,
                      f"{b.desc}: a lost wakeup hangs this thread "
                      f"forever instead of failing")
+        for node, meth, tok in rep.cond_misuse:
+            emit("RTL107", node, rep.qual,
+                 f".{meth}() on condition {tok} without holding it — "
+                 f"notify on an unheld Condition raises RuntimeError, "
+                 f"and a wait outside the lock races its own predicate")
         for node, pname, held in rep.callbacks:
             emit("RTL103", node, rep.qual,
                  f"user callback {pname}() invoked while holding "

@@ -80,6 +80,15 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # surfaces as TrainWorkerGroupError(dead_ranks=...) within seconds.
     # Kill switch: RAY_TPU_TRAIN_DEATH_MONITOR=0.
     "train_death_monitor": True,
+    # Bucketed data-parallel gradient sync (train/ddp.py): partition the
+    # grad pytree into size-targeted buckets and launch each bucket's
+    # allreduce asynchronously so comm overlaps the rest of the backward
+    # walk + pack/unpack. Kill switch RAY_TPU_TRAIN_BUCKET_DDP=0 =
+    # legacy single synchronous allreduce over the whole flattened tree
+    # (bit-identical at world 2 — see README "Overlapped gradient
+    # sync" for the determinism contract).
+    "train_bucket_ddp": True,
+    "train_grad_bucket_bytes": 4 * 1024 * 1024,   # target bucket size
     # Pipelined host-collective data path (util/collective/host_backend):
     # one-way zero-copy segment sends, double-buffered so the reduce of
     # segment k overlaps the transfer of segment k+1. Pipeline kill

@@ -74,6 +74,9 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _k("SERVE_SHAPE_BUCKETS", "1", "bool",
        "0 restores the pad-free legacy batcher (no bucketing, one "
        "compile per observed batch size)."),
+    _k("TRAIN_BUCKET_DDP", "1", "bool",
+       "0 restores the legacy single synchronous gradient allreduce in "
+       "train.ddp.sync_gradients (no bucketing, no async overlap)."),
     _k("TRAIN_DEATH_MONITOR", "1", "bool",
        "0 disables the driver-side gang death monitor (rank death then "
        "surfaces via collective poison or the op timeout)."),
@@ -110,6 +113,10 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "CPUs)."),
     _k("STORE_SIZE", "268435456", "int",
        "shm object store size in bytes for a spawned node."),
+    _k("TRAIN_GRAD_BUCKET_BYTES", "4194304", "int",
+       "target size of one gradient-sync bucket (train.ddp): grads are "
+       "packed into buckets of about this many bytes and each bucket's "
+       "allreduce is launched asynchronously as soon as it is packed."),
     # --- chaos / debugging -----------------------------------------------
     _k("FAULT_SCHEDULE", "", "str",
        "deterministic fault-injection schedule DSL; activates the "

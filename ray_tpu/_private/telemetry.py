@@ -207,6 +207,46 @@ CATALOG: dict[str, dict] = {
                        "0.0039 of each element; int8 bound: 1/254 ~ "
                        "0.0039 of the block absmax)",
     },
+    # --- async collective plane (util/collective/async_handles.py) ---
+    "ray_tpu_collective_async_inflight_tasks": {
+        "kind": "Gauge", "tags": ("group",),
+        "description": "Async collective ops submitted but not yet "
+                       "completed on this rank (queued on the group's "
+                       "issue thread + the op currently on the wire)",
+    },
+    # --- bucketed DDP gradient sync (train/ddp.py) ---
+    "ray_tpu_train_buckets_total": {
+        "kind": "Counter", "tags": ("group",),
+        "description": "Gradient-sync buckets launched by "
+                       "train.ddp.sync_gradients (one async allreduce "
+                       "each; 0 when RAY_TPU_TRAIN_BUCKET_DDP=0)",
+    },
+    "ray_tpu_train_bucket_bytes": {
+        "kind": "Histogram", "tags": ("group",),
+        "boundaries": [65536, 262144, 1048576, 4194304, 16777216,
+                       67108864, 268435456],
+        "description": "Payload size of one gradient-sync bucket "
+                       "(packed contiguous grads; targeted by "
+                       "RAY_TPU_TRAIN_GRAD_BUCKET_BYTES)",
+    },
+    "ray_tpu_train_bucket_sync_seconds": {
+        "kind": "Histogram", "tags": ("group",),
+        "boundaries": [0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                       5.0, 30.0],
+        "description": "Launch-to-completion latency of one bucket's "
+                       "async allreduce (background comm; compare "
+                       "against _bucket_wait_seconds — the exposed "
+                       "part — for the live overlap fraction)",
+    },
+    "ray_tpu_train_bucket_wait_seconds": {
+        "kind": "Histogram", "tags": ("group",),
+        "boundaries": [0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                       0.5, 1.0, 5.0],
+        "description": "Wall time the train loop was actually BLOCKED "
+                       "in handle.wait() per bucket at the optimizer "
+                       "boundary — the comm the backward pass failed "
+                       "to hide",
+    },
     # --- gang fault tolerance (train/, util/collective) ---
     "ray_tpu_train_gang_restarts_total": {
         "kind": "Counter", "tags": ("group",),

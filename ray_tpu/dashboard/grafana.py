@@ -70,6 +70,17 @@ _PANELS = [
     ("Pipeline bubble fraction",
      "rate(ray_tpu_pipeline_bubble_seconds_sum[5m]) / "
      "rate(ray_tpu_pipeline_step_seconds_sum[5m])", "percentunit"),
+    # --- bucketed DDP / async collective plane (overlapped grad sync) ---
+    ("Grad-sync overlap fraction (hidden comm share)",
+     "1 - (rate(ray_tpu_train_bucket_wait_seconds_sum[5m]) / "
+     "rate(ray_tpu_train_bucket_sync_seconds_sum[5m]))", "percentunit"),
+    ("Grad-sync buckets launched",
+     "sum by (group) (rate(ray_tpu_train_buckets_total[5m]))", "ops"),
+    ("Grad-sync comm hidden vs exposed",
+     "rate(ray_tpu_train_bucket_sync_seconds_sum[5m]) - "
+     "rate(ray_tpu_train_bucket_wait_seconds_sum[5m])", "s"),
+    ("Async collective ops in flight",
+     "ray_tpu_collective_async_inflight_tasks", "short"),
     ("Collective groups poisoned",
      "rate(ray_tpu_collective_groups_poisoned_total[5m])", "ops"),
     ("Stale-epoch traffic rejected",

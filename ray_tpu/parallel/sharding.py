@@ -72,6 +72,107 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+# --------------------------------------------------- gradient bucket plumbing
+#
+# Pytree plumbing for the bucketed-DDP gradient sync (train/ddp.py):
+# flatten a grad pytree in jax's canonical deterministic order, plan
+# size-targeted buckets over the leaves, and pack/unpack each bucket as
+# one contiguous array the collective plane can move. Planning depends
+# ONLY on the tree structure + leaf shapes/dtypes, so every rank of a
+# data-parallel gang derives byte-identical buckets locally — the
+# precondition for the allreduce results to agree.
+
+
+def flatten_tree(tree):
+    """(leaves, treedef) in jax's canonical flatten order (sorted dict
+    keys, registered-pytree field order) — deterministic across ranks
+    for identical model structures."""
+    import jax
+
+    return jax.tree_util.tree_flatten(tree)
+
+
+def unflatten_tree(treedef, leaves):
+    import jax
+
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def plan_buckets(leaves, bucket_bytes: int) -> list[list[int]]:
+    """Partition leaf indices into size-targeted buckets.
+
+    Leaves are grouped by dtype (first-appearance order — a bucket is
+    packed into ONE contiguous array, so members must share a dtype)
+    and, within each dtype, kept in flatten order and greedily filled
+    up to ``bucket_bytes``. A single leaf larger than the target gets
+    its own bucket (never split: the collective plane's segmented ring
+    already pipelines within one op). Every rank derives the same plan
+    from the same tree."""
+    bucket_bytes = max(1, int(bucket_bytes))
+    by_dtype: dict = {}
+    order: list = []
+    for i, leaf in enumerate(leaves):
+        dt = str(getattr(leaf, "dtype", "object"))
+        if dt not in by_dtype:
+            by_dtype[dt] = []
+            order.append(dt)
+        by_dtype[dt].append(i)
+    plan: list[list[int]] = []
+    for dt in order:
+        cur: list[int] = []
+        cur_bytes = 0
+        for i in by_dtype[dt]:
+            nbytes = int(getattr(leaves[i], "nbytes", 0))
+            if cur and cur_bytes + nbytes > bucket_bytes:
+                plan.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nbytes
+        if cur:
+            plan.append(cur)
+    return plan
+
+
+def pack_bucket(leaves, indices):
+    """One contiguous 1-D array holding the raveled members of a bucket
+    (C order). Materializes device-resident leaves (``np.asarray`` is
+    the device→host fetch for jax arrays) member by member, so packing
+    bucket k+1 can overlap bucket k's in-flight allreduce."""
+    import numpy as np
+
+    total = 0
+    for i in indices:
+        n = 1
+        for d in getattr(leaves[i], "shape", ()):
+            n *= int(d)
+        total += n
+    out = np.empty(total,
+                   dtype=np.dtype(getattr(leaves[indices[0]], "dtype",
+                                          np.float64)))
+    pos = 0
+    for i in indices:
+        arr = np.asarray(leaves[i]).reshape(-1)
+        out[pos:pos + arr.size] = arr
+        pos += arr.size
+    return out
+
+
+def unpack_bucket(flat, leaves, indices, out_leaves):
+    """Scatter one reduced bucket back into per-leaf arrays (shapes
+    taken from the original leaves); writes into ``out_leaves`` at the
+    bucket's indices."""
+    import numpy as np
+
+    pos = 0
+    for i in indices:
+        shape = tuple(getattr(leaves[i], "shape", ()))
+        n = 1
+        for d in shape:
+            n *= int(d)
+        out_leaves[i] = np.asarray(flat[pos:pos + n]).reshape(shape)
+        pos += n
+
+
 def axis_size(mesh: Mesh, axis: Optional[str]) -> int:
     if axis is None:
         return 1

@@ -375,8 +375,17 @@ def anatomize_rank_step(step: dict, acts: list[dict]) -> dict:
     for key, ivs in hidden_by.items():
         out[key] = _subtract(_merge(ivs), exposed_union)
     exposed_total = _total(exposed_union)
-    hidden_total = out["comm_hidden_s"] + out["data_hidden_s"] \
-        + out["other_hidden_s"]
+    # overlap accounting uses the UNION of all background intervals
+    # minus exposed time, NEVER the sum of the per-kind values: two
+    # concurrent async grad buckets (or a background bucket riding
+    # under a data_produce window) cover the same wall clock once, and
+    # a per-kind sum would double-count it — with enough concurrent
+    # comm, "hidden" would exceed the step wall. The per-kind fields
+    # above stay as attribution (they may legitimately overlap each
+    # other); the fraction is computed from real wall-clock coverage.
+    hidden_total = _subtract(
+        _merge([iv for ivs in hidden_by.values() for iv in ivs]),
+        exposed_union)
     out["compute_s"] = max(0.0, wall - exposed_total)
     out["overlap_fraction"] = (
         hidden_total / (hidden_total + exposed_total)

@@ -82,13 +82,25 @@ def make_train_step(
     *,
     batch_spec: P = P(("dp",), "sp"),
     donate: bool = True,
+    host_grad_sync: Optional[Callable[[Any], Any]] = None,
 ):
     """loss_fn(params, batch) -> (scalar_loss, metrics_dict).
 
     Returns jitted step(state, batch) -> (state, metrics).
+
+    ``host_grad_sync`` (optional) is the host-DP hook: a callable
+    ``grads_pytree -> synced_grads_pytree`` (canonically
+    ``ray_tpu.train.ddp.sync_gradients``) run OUTSIDE the compiled
+    program, between a jitted grad computation and a jitted optimizer
+    apply. This is the regime where each gang member owns its local
+    devices and grads cross hosts over the collective plane (the
+    reference's torch-DDP shape) instead of an XLA psum — the step
+    splits into two compiled functions so the host collective can run
+    in the middle, and the bucketed-DDP plane can overlap that comm
+    with the unpack/pack work around it.
     """
 
-    def step(state: TrainState, batch):
+    def _constrain_batch(batch):
         if mesh is not None:
             batch = jax.tree_util.tree_map(
                 lambda x: jax.lax.with_sharding_constraint(
@@ -96,23 +108,69 @@ def make_train_step(
                 ),
                 batch,
             )
-        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch
-        )
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        return batch
+
+    if host_grad_sync is None:
+        def step(state: TrainState, batch):
+            batch = _constrain_batch(batch)
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, batch)
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            metrics = dict(metrics)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return (
+                TrainState(step=state.step + 1, params=params,
+                           opt_state=opt_state),
+                metrics,
+            )
+
+        # compile observability: cache hit/miss counters, compile timing,
+        # COMPILE_BEGIN/END events — a slow step becomes attributable to
+        # recompilation (shape churn) instead of guessed at
+        return CompiledFunction(
+            jax.jit(step, donate_argnums=(0,) if donate else ()),
+            "train_step")
+
+    def grad_step(params, batch):
+        batch = _constrain_batch(batch)
+        # metrics pass through exactly as loss_fn returned them — the
+        # no-hook path adds only grad_norm, and the two modes must
+        # expose the same metric schema for the same loss_fn
+        (_loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        return dict(metrics), grads
+
+    def apply_step(state: TrainState, grads):
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        metrics = dict(metrics)
-        metrics["grad_norm"] = optax.global_norm(grads)
         return (
-            TrainState(step=state.step + 1, params=params, opt_state=opt_state),
-            metrics,
+            TrainState(step=state.step + 1, params=params,
+                       opt_state=opt_state),
+            optax.global_norm(grads),
         )
 
-    # compile observability: cache hit/miss counters, compile timing,
-    # COMPILE_BEGIN/END events — a slow step becomes attributable to
-    # recompilation (shape churn) instead of guessed at
-    return CompiledFunction(
-        jax.jit(step, donate_argnums=(0,) if donate else ()), "train_step")
+    grad_fn = CompiledFunction(jax.jit(grad_step), "train_grad_step")
+    apply_fn = CompiledFunction(
+        jax.jit(apply_step, donate_argnums=(0,) if donate else ()),
+        "train_apply_step")
+
+    def step(state: TrainState, batch):
+        metrics, grads = grad_fn(state.params, batch)
+        # the hook receives the device grads pytree; the bucketed sync
+        # materializes leaves per bucket (np.asarray is the device→host
+        # fetch), so later buckets' transfers overlap earlier buckets'
+        # allreduce. grad_norm is computed from the SYNCED grads — the
+        # quantity the optimizer actually applies.
+        synced = host_grad_sync(grads)
+        state, grad_norm = apply_fn(state, synced)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = grad_norm
+        return state, metrics
+
+    return step
 
 
 def eval_step(loss_fn, mesh: Optional[Mesh] = None, batch_spec: P = P(("dp",), "sp")):

@@ -13,6 +13,11 @@ from ray_tpu.train.pipeline import (  # noqa: F401
     PipelineConfig,
     PipelineTrainer,
 )
+from ray_tpu.train import ddp  # noqa: F401
+from ray_tpu.train.ddp import (  # noqa: F401
+    sync_gradients,
+    sync_gradients_async,
+)
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401
 from ray_tpu.train.predictor import (  # noqa: F401
     BatchPredictor,

@@ -1,0 +1,220 @@
+"""Bucketed data-parallel gradient synchronization over the host
+collective plane.
+
+Training on this framework's host-DP path was compute-then-communicate:
+run the whole backward, then one synchronous allreduce over the whole
+grad pytree — the wire idles during compute, the TPU idles during comm.
+This module hides one under the other ("Exploring the limits of
+Concurrency in ML Training on Google TPUs", arXiv:2011.03641; the same
+shape as torch DDP's gradient buckets / Horovod tensor fusion):
+
+- the grad pytree is flattened in jax's canonical deterministic order
+  and partitioned into size-targeted buckets
+  (``RAY_TPU_TRAIN_GRAD_BUCKET_BYTES``, ~4 MiB default; planning
+  depends only on shapes/dtypes, so every rank derives byte-identical
+  buckets — ``parallel/sharding.plan_buckets``);
+- each bucket's allreduce launches **asynchronously**
+  (``collective.allreduce_async`` → the group's background issue
+  thread) as soon as the bucket is packed, so bucket k's comm overlaps
+  the device→host fetch + packing of bucket k+1, the unpacking of
+  completed buckets, and whatever compute the caller runs before
+  ``result()`` — including the next microbatch's forward when used via
+  ``sync_gradients_async``;
+- ``result()`` waits all handles at the optimizer boundary, stamping
+  each bucket's *actually blocked* time (the comm the backward failed
+  to hide) into the metric + step-anatomy planes.
+
+Composition: the quantized wire (PR 8) and the intra-host hierarchy
+apply per bucket unchanged (each bucket is an ordinary float32-sum
+allreduce); a poisoned gang (PR 5) fails every pending handle fast
+with ``CollectiveGroupError``.
+
+Determinism contract (pinned in tests/test_zz_bucket_ddp.py): all
+ranks always return byte-identical synced grads (the ring/pair
+exchange guarantees it per op). Bucketed-on vs the
+``RAY_TPU_TRAIN_BUCKET_DDP=0`` kill switch (legacy single synchronous
+allreduce over the whole flattened tree) is additionally
+**bit-identical at world size 2** on the exact wire: the pairwise
+exchange reduces every element as one two-operand IEEE add, which is
+commutative, so bucket boundaries cannot change results. At larger
+world sizes the ring's per-chunk reduction order depends on chunk
+boundaries, so on-vs-off agree within float reassociation rounding
+(the same caveat as the collective hierarchy) while staying exactly
+rank-consistent either way.
+"""
+from __future__ import annotations
+
+import time
+
+from ray_tpu._private import profiling as _prof
+from ray_tpu._private import telemetry as _tm
+
+
+def _get_config(name):
+    from ray_tpu._private.config import get_config
+
+    return get_config(name)
+
+
+class PendingGradSync:
+    """In-flight bucketed gradient sync: every bucket's async allreduce
+    has been launched; ``result(timeout)`` waits them in launch order,
+    unpacks, and returns the synced grad pytree. Work the caller does
+    between launch and ``result()`` overlaps ALL of the comm."""
+
+    def __init__(self, group: str, treedef, leaves, launched,
+                 world: int, average: bool):
+        self._group = group
+        self._treedef = treedef
+        self._leaves = leaves
+        self._launched = launched    # [(indices, handle, t_launch)]
+        self._world = world
+        self._average = average
+        self._result = None
+        self._out_leaves: list = [None] * len(leaves)
+        self._next = 0               # harvest progress (retry-safe)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self._launched)
+
+    def poll(self) -> bool:
+        """True once every bucket's allreduce completed."""
+        return all(h.poll() for _, h, _ in self._launched)
+
+    def result(self, timeout: float | None = None):
+        """Wait every bucket at the optimizer boundary and return the
+        synced pytree. Raises ``CollectiveGroupError`` if the gang was
+        poisoned while buckets were in flight, ``TimeoutError`` on a
+        wire stall (timeout-not-hang; default: the collective op
+        timeout per bucket)."""
+        if self._result is not None:
+            return self._result
+        from ray_tpu.parallel import sharding as _sh
+        from ray_tpu.util import tracing as _tracing
+
+        out_leaves = self._out_leaves
+        tags = {"group": self._group}
+        # resume from the first un-harvested bucket: a retry after a
+        # failed/timed-out bucket must not re-observe the completed
+        # buckets' wait/sync histograms (counts would exceed
+        # buckets_total) nor re-unpack them
+        while self._next < len(self._launched):
+            b = self._next
+            indices, handle, t_launch = self._launched[b]
+            t0 = time.perf_counter()
+            with _prof.record_span("train", f"grad_bucket_wait::{b}",
+                                   {"group": self._group, "bucket": b}):
+                with _tracing.span(f"grad_bucket_wait {b}", "INTERNAL",
+                                   attributes={"group": self._group,
+                                               "bucket": b}):
+                    flat = handle.result(timeout)
+            now = time.perf_counter()
+            if _tm.ENABLED:
+                _tm.observe("ray_tpu_train_bucket_wait_seconds",
+                            now - t0, tags=tags)
+                # launch→COMPLETION (the handle stamps done_at when the
+                # op finishes on the issue thread) — NOT launch→harvest:
+                # a caller that overlapped long compute before result()
+                # must not inflate the bucket's apparent comm time (the
+                # overlap-fraction panel divides wait by this)
+                _tm.observe("ray_tpu_train_bucket_sync_seconds",
+                            (handle.done_at or now) - t_launch,
+                            tags=tags)
+            if self._average:
+                flat = flat / self._world
+            _sh.unpack_bucket(flat, self._leaves, indices, out_leaves)
+            self._next = b + 1
+        self._result = _sh.unflatten_tree(self._treedef, out_leaves)
+        # drop the launch-time references (packed buffers, raw grads)
+        self._launched = []
+        self._leaves = []
+        return self._result
+
+
+class _DoneSync:
+    """Kill-switch / degenerate result: the sync already happened."""
+
+    num_buckets = 0
+
+    def __init__(self, result):
+        self._result = result
+
+    def poll(self) -> bool:
+        return True
+
+    def result(self, timeout: float | None = None):
+        return self._result
+
+
+def sync_gradients_async(grads, group_name: str = "train_dp", *,
+                         average: bool = False,
+                         bucket_bytes: int | None = None):
+    """Launch the bucketed gradient sync and return a
+    ``PendingGradSync`` immediately — overlap the comm with anything
+    (the next microbatch's forward, metrics, logging), then call
+    ``.result()`` at the optimizer boundary.
+
+    With ``RAY_TPU_TRAIN_BUCKET_DDP=0`` the legacy path runs instead:
+    one synchronous allreduce over the whole flattened tree (one op per
+    dtype for mixed-dtype trees), completed before this returns."""
+    from ray_tpu.parallel import sharding as _sh
+    from ray_tpu.util import collective as col
+
+    leaves, treedef = _sh.flatten_tree(grads)
+    world = col.get_collective_group_size(group_name)
+    if not leaves or world == 1:
+        # world-1 sum is the identity (and average divides by 1):
+        # skip the pack/allreduce/unpack round entirely
+        return _DoneSync(grads)
+    bucketed = bool(_get_config("train_bucket_ddp"))
+    if bucket_bytes is None:
+        bucket_bytes = int(_get_config("train_grad_bucket_bytes"))
+    if not bucketed or not col.supports_async(group_name):
+        # legacy: the whole tree as ONE synchronous allreduce (one
+        # per dtype — a bucket must be contiguous in one dtype), the
+        # exact pre-bucketing semantics the kill switch promises.
+        # Also the degrade path for backends without async support
+        # (xla) — the sync allreduce works there, so a grad sync must
+        # not fail where the kill-switch path would succeed
+        plan = _sh.plan_buckets(leaves, 1 << 62)
+        out_leaves: list = [None] * len(leaves)
+        for indices in plan:
+            flat = col.allreduce(_sh.pack_bucket(leaves, indices),
+                                 group_name)
+            if average:
+                flat = flat / world
+            _sh.unpack_bucket(flat, leaves, indices, out_leaves)
+        return _DoneSync(_sh.unflatten_tree(treedef, out_leaves))
+    plan = _sh.plan_buckets(leaves, bucket_bytes)
+    launched = []
+    tags = {"group": group_name}
+    for b, indices in enumerate(plan):
+        # pack on the caller thread: bucket b's device→host fetch +
+        # memcpy runs while buckets < b are already on the wire
+        with _prof.record_span("train", f"grad_bucket_pack::{b}",
+                               {"group": group_name, "bucket": b}):
+            flat = _sh.pack_bucket(leaves, indices)
+        if _tm.ENABLED:
+            _tm.observe("ray_tpu_train_bucket_bytes", float(flat.nbytes),
+                        tags=tags)
+            _tm.counter_inc("ray_tpu_train_buckets_total", tags=tags)
+        launched.append((indices, col.allreduce_async(flat, group_name),
+                         time.perf_counter()))
+    return PendingGradSync(group_name, treedef, leaves, launched, world,
+                           average)
+
+
+def sync_gradients(grads, group_name: str = "train_dp", *,
+                   average: bool = False,
+                   bucket_bytes: int | None = None):
+    """Synchronize one grad pytree across the data-parallel gang and
+    return the summed (or averaged) grads. Bucketed + async under the
+    hood (see module docstring); the pack/unpack of neighboring buckets
+    still overlaps each bucket's comm even though this call itself
+    blocks until the full tree is synced."""
+    # timeout=None = the collective op timeout per bucket (the wire's
+    # failure detector of last resort) — bounded, never a silent hang
+    return sync_gradients_async(
+        grads, group_name, average=average,
+        bucket_bytes=bucket_bytes).result(timeout=None)

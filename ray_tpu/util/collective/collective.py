@@ -478,6 +478,14 @@ def destroy_collective_group(group_name: str = "default"):
     return _manager.destroy(group_name)
 
 
+def supports_async(group_name: str = "default") -> bool:
+    """True when the group's backend can issue async ops
+    (``allreduce_async``/``reducescatter_async``) — the host backend.
+    Callers with a synchronous fallback (e.g. bucketed DDP) consult
+    this instead of catching the submit-time ValueError."""
+    return hasattr(_manager.get(group_name).impl, "submit_async")
+
+
 def is_group_initialized(group_name: str = "default") -> bool:
     try:
         _manager.get(group_name)
@@ -487,6 +495,17 @@ def is_group_initialized(group_name: str = "default") -> bool:
 
 
 # ------------------------------------------------------------------ ops
+
+def _drain_pending(g: _GroupState):
+    """Ordering barrier for mixed sync/async call sites: a synchronous
+    op on a group with async handles in flight waits for the issue
+    queue to empty first, so ops hit the wire in submission order and
+    no two ops of this rank ever run concurrently on the group's
+    state. One attribute probe + int check when async was never used."""
+    drain = getattr(g.impl, "drain_async", None)
+    if drain is not None:
+        drain()
+
 
 def _coerce(g, tensor):
     """Per-backend input coercion: the host backend moves host memory, so
@@ -507,6 +526,7 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum"):
     """In the reference (collective.py:258) this mutates in place via NCCL;
     here the reduced array is returned (functional, jax-style)."""
     g = _manager.get(group_name)
+    _drain_pending(g)
     arr = _coerce(g, tensor)
     seq = g.next_seq()
     return _coltel.run_op(g, "allreduce", seq,
@@ -514,9 +534,51 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum"):
                           payload=arr)
 
 
+def _submit_async(g: _GroupState, op: str, arr, body) -> object:
+    submit = getattr(g.impl, "submit_async", None)
+    if submit is None:
+        raise ValueError(
+            f"async collective ops require the host backend "
+            f"(group {g.name!r} uses {g.backend!r})")
+    seq = g.next_seq()
+    # telemetry-wrapped: the op body executes on the group's issue
+    # thread, so run_op's span/metric/rank-timing planes all apply and
+    # step-anatomy records the comm interval as BACKGROUND (run_op
+    # stamps `blocking` iff the op ran on the thread driving the step
+    # loop — the async-DDP hook PR 11 left ready)
+    return submit(op, seq,
+                  lambda: _coltel.run_op(g, op, seq,
+                                         lambda: body(seq), payload=arr))
+
+
+def allreduce_async(tensor, group_name: str = "default", op: str = "sum"):
+    """Start an allreduce and return a ``CollectiveHandle`` immediately
+    (``wait(timeout)`` / ``poll()`` / ``result()``). Ops issue onto a
+    per-group background issue thread in submission order, so every
+    rank still sees the same op sequence; the caller must not mutate
+    ``tensor`` until the handle completes. A poisoned group (member
+    death, PR 5) fails pending handles fast with
+    ``CollectiveGroupError``. Host backend only."""
+    g = _manager.get(group_name)
+    arr = _coerce(g, tensor)
+    return _submit_async(g, "allreduce", arr,
+                         lambda seq: g.impl.allreduce(arr, op, seq))
+
+
+def reducescatter_async(tensor, group_name: str = "default",
+                        op: str = "sum"):
+    """Async reducescatter: each rank's handle resolves to its rank-th
+    chunk of the reduction. Same contract as ``allreduce_async``."""
+    g = _manager.get(group_name)
+    arr = _coerce(g, tensor)
+    return _submit_async(g, "reducescatter", arr,
+                         lambda seq: g.impl.reducescatter(arr, op, seq))
+
+
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: str = "sum"):
     g = _manager.get(group_name)
+    _drain_pending(g)
     arr = _coerce(g, tensor)
     seq = g.next_seq()
     return _coltel.run_op(g, "reduce", seq,
@@ -526,6 +588,7 @@ def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _manager.get(group_name)
+    _drain_pending(g)
     arr = _coerce(g, tensor)
     seq = g.next_seq()
     return _coltel.run_op(g, "broadcast", seq,
@@ -535,6 +598,7 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
 
 def allgather(tensor, group_name: str = "default") -> list:
     g = _manager.get(group_name)
+    _drain_pending(g)
     arr = _coerce(g, tensor)
     seq = g.next_seq()
     return _coltel.run_op(g, "allgather", seq,
@@ -545,6 +609,7 @@ def allgather(tensor, group_name: str = "default") -> list:
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
     """Each rank gets the rank-th equal chunk of the reduction."""
     g = _manager.get(group_name)
+    _drain_pending(g)
     arr = _coerce(g, tensor)
     seq = g.next_seq()
     return _coltel.run_op(g, "reducescatter", seq,
@@ -561,6 +626,7 @@ def send(tensor, dst_rank: int, group_name: str = "default",
     default; per-call opt-in so exact-by-contract users of the same
     group are never affected."""
     g = _manager.get(group_name)
+    _drain_pending(g)
     arr = (_coerce(g, tensor) if getattr(g, "backend", None) != "xla"
            else np.asarray(tensor))
     seq = g.next_p2p_seq(g.rank, dst_rank)
@@ -576,6 +642,7 @@ def recv(src_rank: int, group_name: str = "default"):
     """Unlike the reference (which writes into a passed buffer), returns the
     received array."""
     g = _manager.get(group_name)
+    _drain_pending(g)
     seq = g.next_p2p_seq(src_rank, g.rank)
     return _coltel.run_op(g, "recv", None,
                           lambda: _p2p(g).recv(src_rank, seq),
@@ -614,6 +681,7 @@ def recv_device(shape, dtype, src_rank: int, group_name: str = "default"):
 
 def barrier(group_name: str = "default"):
     g = _manager.get(group_name)
+    _drain_pending(g)
     seq = g.next_seq()
     _coltel.run_op(g, "barrier", seq, lambda: g.impl.barrier(seq))
 

@@ -75,6 +75,8 @@ from ray_tpu._private.protocol import (ConnectionLost, PyRpcClient,
 from ray_tpu._private.worker_runtime import (ColShmRef, col_epoch_tag,
                                              col_oid_prefix, current_worker)
 from ray_tpu.util.collective import wire as _wire
+from ray_tpu.util.collective.async_handles import (CollectiveHandle,
+                                                   IssueQueue)
 
 _OPS = {
     "sum": np.add,
@@ -185,6 +187,10 @@ class HostGroup:
         if self._worker is None:
             raise RuntimeError("collective group requires a ray_tpu worker "
                                "or driver runtime in this process")
+        # async op plane: per-group issue thread (lazy — the thread only
+        # spawns on the first async submission; sync-only groups pay one
+        # eagerly built Condition + deque)
+        self._issue = IssueQueue(name)
 
     # -- plumbing -----------------------------------------------------------
 
@@ -609,12 +615,52 @@ class HostGroup:
         return locals_, leaders
 
     def close(self):
+        # fail queued async handles fast (CollectiveGroupError naming the
+        # teardown) before cutting the transport out from under them
+        try:
+            self._issue.close()
+        except Exception:
+            pass
         for c in self._clients.values():
             try:
                 c.close()
             except Exception:
                 pass
         self._clients.clear()
+
+    # -- async op plane -----------------------------------------------------
+
+    def submit_async(self, op: str, seq, thunk) -> CollectiveHandle:
+        """Enqueue one collective op thunk onto this group's issue
+        thread; ops execute strictly in submission order (the per-group
+        seq order every rank already agrees on). The module-level API
+        (`collective.allreduce_async`) submits telemetry-wrapped thunks
+        through this."""
+        return self._issue.submit(op, seq, thunk)
+
+    def allreduce_async(self, arr: np.ndarray, op: str,
+                        seq: int) -> CollectiveHandle:
+        """Bare async allreduce (unit-test / embedded-group entry point;
+        no telemetry wrapping). The caller must not mutate ``arr`` until
+        the handle completes."""
+        arr = np.asarray(arr)
+        return self._issue.submit("allreduce", seq,
+                                  lambda: self.allreduce(arr, op, seq))
+
+    def reducescatter_async(self, arr: np.ndarray, op: str,
+                            seq: int) -> CollectiveHandle:
+        arr = np.asarray(arr)
+        return self._issue.submit("reducescatter", seq,
+                                  lambda: self.reducescatter(arr, op, seq))
+
+    def drain_async(self, timeout: float | None = None):
+        """Barrier for mixed sync/async call sites: block until every
+        async submission on this group completed. Synchronous module-API
+        ops call this before touching group state, so a sync op issued
+        after async ones keeps the submission order on the wire."""
+        if self._issue.pending():
+            self._issue.drain(timeout if timeout is not None
+                              else self._op_timeout())
 
     # -- pipelined ring core ------------------------------------------------
 

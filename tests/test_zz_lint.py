@@ -313,6 +313,62 @@ def test_condition_wait_under_its_own_lock_is_clean():
     assert "RTL101" not in codes
 
 
+def test_condition_notify_without_lock_fixture():
+    """RTL107 (async-collective issue-thread discipline): notify on an
+    unheld Condition raises at runtime; wait outside the lock races its
+    own predicate. Both must be findings; the held variants must not."""
+    codes = _lock_codes("""
+        import threading
+
+        class Handle:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._done = False
+
+            def bad_finish(self):
+                self._done = True
+                self._cond.notify_all()      # not held: RuntimeError
+
+            def bad_wait(self):
+                self._cond.wait_for(lambda: self._done, timeout=5.0)
+    """)
+    assert "RTL107" in codes
+    clean = _lock_codes("""
+        import threading
+
+        class Handle:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._done = False
+
+            def finish(self):
+                with self._cond:
+                    self._done = True
+                    self._cond.notify_all()
+
+            def wait(self):
+                with self._cond:
+                    self._cond.wait_for(lambda: self._done, timeout=5.0)
+    """)
+    assert "RTL107" not in clean
+
+
+def test_condition_notify_in_locked_method_not_flagged():
+    """*_locked methods run with the CALLER's lock held; name-based
+    identity can't prove which, so RTL107 stays quiet there."""
+    codes = _lock_codes("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def _finish_locked(self):
+                self._cond.notify_all()
+    """)
+    assert "RTL107" not in codes
+
+
 def test_nested_function_runs_lock_free():
     """A closure defined under a lock runs LATER (its own thread) —
     its blocking calls are not under-the-lock findings."""
