@@ -1,4 +1,4 @@
-"""Cluster-scale soak bench — rounds 12/13 (BENCH_r12/BENCH_r13.json).
+"""Cluster-scale soak bench — rounds 12/13/16 (BENCH_r*.json).
 
 Stands up ``RAY_TPU_SOAK_NODES`` (default 100) simulated raylets
 (`ray_tpu/_private/sim_cluster.py`: real GCS registration/heartbeat/
@@ -23,11 +23,22 @@ pubsub, no workers) and measures the control plane under seeded chaos:
   preemption path end to end) and asserts zero quota violations in
   every ``summarize_jobs`` sample plus a byte-identical journal
   across two runs.
+- **serving** (round 16): Serve as a first-class tenant. Two tenant
+  Serve apps (real controller FSM + capacity gangs, sim replicas —
+  ``SimServeApp``) take O(10^6) seeded open-loop requests with diurnal
+  spikes against a cluster ~98% full of training gangs: every spike
+  scale-up preempts training capacity, a seeded slot-scoped
+  ``preempt_job`` storm warns every chat replica mid-spike, and
+  scale-down drains back through the preemption-warning machinery.
+  Asserts zero lost accepted requests, zero quota violations, bounded
+  p99 through the storms, every serve drain completing PRE-fire (no
+  serve gang ever burns a fire), every preempted training gang
+  resuming afterward, and a byte-identical journal across two runs.
 
 Usage::
 
     RAY_TPU_SOAK_NODES=100 python benchmarks/soak_bench.py \
-        --json-out BENCH_r13.json
+        --json-out BENCH_r16.json
 """
 from __future__ import annotations
 
@@ -276,6 +287,131 @@ def multitenant_phase(nodes: int, seed: int, verbose=print) -> dict:
         del os.environ["RAY_TPU_GCS_PREEMPT_GRACE_S"]
 
 
+# one slot-scoped preemption storm rule: counters are per (slot-tag,
+# method), so every chat slot's counter crosses 260 on the same tick —
+# all four replicas warned SIMULTANEOUSLY, mid-spike (and again ~13s
+# later at counter 520, post-spike)
+SERVE_SCHEDULE = "preempt_job:svc-chat.serve_tick:%260:400"
+
+
+def _wait_gangs_created(cluster, pg_ids, timeout_s: float) -> list:
+    """Poll the gangs to CREATED, keeping the (journal-silent) gossip
+    ticks flowing — pending placement is capacity-event driven."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        states = [(cluster.gcs_call("get_placement_group", pg_id=p)
+                   or {}).get("State") for p in pg_ids]
+        if all(s == "CREATED" for s in states) \
+                or time.monotonic() > deadline:
+            return states
+        cluster.run_ticks(2)
+
+
+def serving_phase(nodes: int, seed: int, verbose=print) -> dict:
+    """Round-16 phase: Serve as a first-class tenant under a
+    million-request mixed workload on a training-saturated cluster."""
+    from ray_tpu._private import events as _events
+    from ray_tpu._private.sim_cluster import SimCluster
+
+    os.environ["RAY_TPU_GCS_PREEMPT_GRACE_S"] = "0.5"
+    fi.install(seed, SERVE_SCHEDULE)
+    ev0 = _events.stats()["recorded"]
+    cluster = SimCluster(n_nodes=nodes, tick_interval=0.05,
+                         poll_timeout=2.0).start()
+    try:
+        cpus = 4.0 * nodes
+        # training tenants fill ~98% of the cluster: serve baselines fit
+        # in the slack, but every SPIKE scale-up must go through the
+        # preemption path — and hand the capacity back afterward
+        cluster.register_job("train-lo", quota={"CPU": cpus * 0.8},
+                             priority=0)
+        cluster.register_job("train-hi", quota={"CPU": cpus * 0.2},
+                             priority=5)
+        lo_gangs = [cluster.create_job_pg("train-lo", n_bundles=4,
+                                          cpu=2.0)
+                    for _ in range(int(cpus * 0.8 / 8))]
+        hi_gangs = [cluster.create_job_pg("train-hi", n_bundles=4,
+                                          cpu=2.0)
+                    for _ in range(int(cpus * 0.18 / 8))]
+        cluster.run_ticks(4)
+        chat = cluster.add_serve_app(
+            "chat", "svc-chat", priority=10, quota={"CPU": 8.0},
+            base_rate=1200, service_rate=600, min_replicas=2,
+            max_replicas=4, capacity_cpu=2.0,
+            spikes=((200, 320, 3.0),))
+        embed = cluster.add_serve_app(
+            "embed", "svc-embed", priority=8, quota={"CPU": 6.0},
+            base_rate=600, service_rate=600, min_replicas=1,
+            max_replicas=3, capacity_cpu=2.0,
+            spikes=((380, 470, 3.0),))
+        # warm-up (min replicas place), then the soak proper: diurnal
+        # spikes + the seeded mid-spike slot-preempt storm
+        cluster.run_ticks(40)
+        cluster.sample_jobs()
+        for _ in range(6):
+            cluster.run_ticks(100)
+            cluster.sample_jobs()
+        # end of load: drain the queues dry through the real
+        # scale-down-by-warning path
+        chat.base_rate = embed.base_rate = 0
+        cluster.run_ticks(60)
+        cluster.sample_jobs()
+        chat_out, embed_out = chat.finalize(), embed.finalize()
+        # freeze the serve plane before the resume-wait: it ticks a
+        # wall-clock-dependent number of times, and app chaos consults
+        # there would diverge the journal between same-seed runs
+        cluster.serve_apps.clear()
+        gangs = lo_gangs + hi_gangs
+        states = _wait_gangs_created(cluster, gangs, timeout_s=30.0)
+        resumed = sum(1 for s in states if s == "CREATED")
+        st = cluster.gcs_call("debug_state")
+        jobs = {r["Job"]: r for r in cluster.gcs_call("list_jobs")}
+        serve_fires = sum(jobs[j].get("Preemptions", 0)
+                          for j in ("svc-chat", "svc-embed") if j in jobs)
+        samples = cluster.metrics.get("job_samples", [])
+        evs = [e for e in _events.snapshot() if e["seq"] > ev0]
+        waits = sorted(e["wait_s"] for e in evs
+                       if e["kind"] == "SERVE_CAPACITY_PLACED")
+        warned = [e for e in evs if e["kind"] == "SERVE_REPLICA_WARNED"]
+        out = {
+            "nodes": nodes,
+            "ticks": cluster.tick_count,
+            "apps": {"chat": chat_out, "embed": embed_out},
+            "offered_total": chat_out["offered"] + embed_out["offered"],
+            "lost_accepted_total": chat_out["lost"] + embed_out["lost"],
+            "shed_total": chat_out["shed"] + embed_out["shed"],
+            "violations_total": sum(len(s["violations"])
+                                    for s in samples),
+            "samples": len(samples),
+            "preemptions_fired": st.get("preemptions_fired", 0),
+            "serve_gang_fires": serve_fires,
+            "warned_drains": len(warned),
+            "warned_reasons": sorted({e.get("reason") for e in warned}),
+            "capacity_wait_p50_ms": (
+                round(_pct(waits, 0.50) * 1e3, 1) if waits else None),
+            "capacity_wait_p99_ms": (
+                round(_pct(waits, 0.99) * 1e3, 1) if waits else None),
+            "capacity_gangs_placed": len(waits),
+            "training_gangs": len(gangs),
+            "training_resumed": resumed,
+            "journal_sha256": hashlib.sha256(
+                cluster.journal_text().encode()).hexdigest(),
+            "journal_text": cluster.journal_text(),
+        }
+        verbose(f"  serving: offered={out['offered_total']} "
+                f"lost={out['lost_accepted_total']} "
+                f"shed={out['shed_total']} "
+                f"chat p99={chat_out['latency_p99_s']}s "
+                f"warned={out['warned_drains']} "
+                f"serve_fires={serve_fires} "
+                f"train resumed {resumed}/{len(gangs)}")
+        return out
+    finally:
+        cluster.stop()
+        fi.uninstall()
+        del os.environ["RAY_TPU_GCS_PREEMPT_GRACE_S"]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int,
@@ -289,31 +425,41 @@ def main():
 
     print(f"soak bench: {args.nodes} simulated raylets, seed {args.seed}")
     t0 = time.time()
-    print("phase 1/6: death-feed fanout, coalescing OFF (pre-fix path)")
+    print("phase 1/8: death-feed fanout, coalescing OFF (pre-fix path)")
     before = fanout_phase(args.nodes, args.seed, coalesce=False,
                           n_objects=args.objects)
-    print("phase 2/6: death-feed fanout, coalescing ON")
+    print("phase 2/8: death-feed fanout, coalescing ON")
     after = fanout_phase(args.nodes, args.seed, coalesce=True,
                          n_objects=args.objects)
-    print("phase 3/6: GCS restart mid-storm (reconnect herd)")
+    print("phase 3/8: GCS restart mid-storm (reconnect herd)")
     restart = restart_phase(args.nodes, args.seed)
-    print("phase 4/6: determinism replay (same seed, same journal)")
+    print("phase 4/8: determinism replay (same seed, same journal)")
     replay = restart_phase(args.nodes, args.seed,
                            verbose=lambda *_a, **_k: None)
     journals_equal = (replay["journal_text"] == restart["journal_text"])
     restart.pop("journal_text", None)
     replay.pop("journal_text", None)
-    print("phase 5/6: multi-tenant (3 jobs, seeded preemptions + kills)")
+    print("phase 5/8: multi-tenant (3 jobs, seeded preemptions + kills)")
     mt = multitenant_phase(args.nodes, args.seed)
-    print("phase 6/6: multi-tenant determinism replay")
+    print("phase 6/8: multi-tenant determinism replay")
     mt_replay = multitenant_phase(args.nodes, args.seed,
                                   verbose=lambda *_a, **_k: None)
     mt_journals_equal = (mt_replay["journal_text"] == mt["journal_text"])
     mt.pop("journal_text", None)
     mt_replay.pop("journal_text", None)
+    print("phase 7/8: serving soak (2 tenant Serve apps + 2 training "
+          "jobs, million-request mixed workload)")
+    serving = serving_phase(args.nodes, args.seed)
+    print("phase 8/8: serving determinism replay")
+    serving_replay = serving_phase(args.nodes, args.seed,
+                                   verbose=lambda *_a, **_k: None)
+    serving_equal = (serving_replay["journal_text"]
+                     == serving["journal_text"])
+    serving.pop("journal_text", None)
+    serving_replay.pop("journal_text", None)
 
     result = {
-        "round": 13,
+        "round": 16,
         "bench": "cluster_soak",
         "nodes": args.nodes,
         "seed": args.seed,
@@ -330,13 +476,35 @@ def main():
         "restart": restart,
         "schedule_multitenant": MT_SCHEDULE,
         "multitenant": mt,
+        "schedule_serving": SERVE_SCHEDULE,
+        "serving": serving,
         "determinism": {
             "journals_equal": journals_equal,
             "journal_sha256": restart["journal_sha256"],
             "multitenant_journals_equal": mt_journals_equal,
             "multitenant_journal_sha256": mt["journal_sha256"],
+            "serving_journals_equal": serving_equal,
+            "serving_journal_sha256": serving["journal_sha256"],
         },
         "acceptance": {
+            "serving_million_requests":
+                serving["offered_total"] >= 1_000_000,
+            "serving_zero_lost_accepted":
+                serving["lost_accepted_total"] == 0,
+            "serving_zero_quota_violations":
+                serving["violations_total"] == 0,
+            "serving_p99_bounded": all(
+                a["latency_p99_s"] is not None
+                and a["latency_p99_s"] <= 3.0
+                for a in serving["apps"].values()),
+            "serving_storm_observed": (
+                serving["warned_drains"] > 0
+                and serving["preemptions_fired"] > 0),
+            "serving_drains_pre_fire": serving["serve_gang_fires"] == 0,
+            "serving_training_resumed": (
+                serving["training_resumed"]
+                == serving["training_gangs"]),
+            "serving_reproducible": serving_equal,
             "zero_quota_violations": mt["violations_total"] == 0,
             "preemptions_fired": mt["preemptions_fired"] > 0,
             "high_pri_always_placed": mt["serve_placed_all"],
@@ -365,6 +533,13 @@ def main():
           f"multitenant: {mt['preemptions_fired']} preemptions, "
           f"{mt['violations_total']} violations, serve placement p50 "
           f"{mt['serve_placement_p50_ms']}ms")
+    print(f"serving: {serving['offered_total']} requests, "
+          f"{serving['lost_accepted_total']} lost, "
+          f"{serving['warned_drains']} warned drains "
+          f"({serving['serve_gang_fires']} serve fires), "
+          f"capacity wait p50 {serving['capacity_wait_p50_ms']}ms, "
+          f"training resumed {serving['training_resumed']}/"
+          f"{serving['training_gangs']}")
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump(result, f, indent=2, sort_keys=True)

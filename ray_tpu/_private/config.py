@@ -63,6 +63,14 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "log_monitor_interval_ms": 250,    # worker-log tail cadence
     # --- serve ---
     "serve_stream_chunk_timeout_s": 300.0,  # first chunk may be a compile
+    # serve-as-a-tenant (apps registered with a job): CPU bundle each
+    # replica's capacity placement group reserves when the deployment's
+    # ray_actor_options carry no num_cpus of their own
+    "serve_replica_capacity_cpu": 1.0,
+    # 0 restores the legacy direct-stop scale-down for tenant apps
+    # (bit-identical kill switch: no preemption-warning round trip, no
+    # draining broadcast — replicas stop the pre-tenant way)
+    "serve_preempt_scale_down": 1,
     # --- collective / mesh ---
     "collective_default_backend": "xla",
     "collective_op_timeout_s": 300.0,  # dead-member detector of last resort

@@ -56,7 +56,21 @@ Event kinds recorded by the runtime:
                      replica counts, the demand signal.
 - ``REQUEST_SHED``   — Serve admission control rejected a request
                      (serve/_private/router.py): deployment, queue
-                     occupancy/capacity, the retry-after hint.
+                     occupancy/capacity, the retry-after hint, and
+                     whether replicas were draining (the hint then
+                     reflects the grace window remaining).
+- ``SERVE_APP_REGISTERED`` — a Serve app was deployed as a first-class
+                     job-plane tenant (serve/_private/controller.py):
+                     app, job, priority, quota.
+- ``SERVE_CAPACITY_PLACED`` — a replica's capacity gang turned CREATED
+                     in the job plane (controller): deployment,
+                     replica_id, job, the spike-to-placed wait.
+- ``SERVE_REPLICA_WARNED`` — a preempt_warning landed on a replica's
+                     capacity gang (controller): deployment,
+                     replica_id, job, reason (``preempted`` external /
+                     ``scale_down`` self-requested), grace remaining —
+                     the replica drains inside the window and routers
+                     drop it from selection.
 - ``STEP_REGRESSION`` — the step-anatomy rolling-baseline detector
                      fired (parallel/step_anatomy.py): rank, step_id,
                      recent/baseline p50 step time, the knobbed
@@ -80,6 +94,11 @@ Event kinds recorded by the runtime:
                      bundles were reclaimed; the victim re-queued
                      PENDING to resume when capacity returns
                      (_private/gcs.py): pg_id, job, preemptor.
+- ``PREEMPTION_CANCELED`` — the grace window elapsed but the preemptor
+                     no longer needed the capacity (placed elsewhere,
+                     removed, or now placeable as-is): the victim kept
+                     its bundles (_private/gcs.py): pg_id, job,
+                     preemptor.
 - ``PIPELINE_GANG_STARTED`` — a multi-slice MPMD pipeline gang came up
                      (train/pipeline/trainer.py): group, stage count,
                      ranks per stage, microbatches, schedule, and the

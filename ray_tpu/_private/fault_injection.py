@@ -370,7 +370,8 @@ class FaultInjector:
             fired.append((rule.action, rule.param_s))
         return fired
 
-    def on_job(self, job: str, method: str) -> list[tuple[str, float]]:
+    def on_job(self, job: str, method: str,
+               tags: frozenset | None = None) -> list[tuple[str, float]]:
         """Job boundary: decisions for the named ``job`` at the caller's
         deterministic consult point ``method``. Returns
         [(action, param_s)] for every job rule that fired; the CALLER
@@ -378,10 +379,22 @@ class FaultInjector:
         transports never see job actions. Counters are per
         (job, method) like ``on_node``'s per-(tag, method), so one
         schedule shared by several jobs keeps an independent
-        deterministic sequence per job."""
+        deterministic sequence per job.
+
+        ``tags`` widens the scope match beyond the job name itself:
+        the Serve plane consults once per replica SLOT with
+        ``job=<slot-tag>`` and ``tags={slot-tag, app-job, dep-tag}``,
+        so a rule scoped to the APP's job name matches every slot
+        while each slot keeps its own deterministic counter/hash
+        stream — a p-selector then warns a seed-deterministic SUBSET
+        of one app's replicas, and a rule scoped to one slot tag
+        (``preempt_job:serve-app-Model-slot0.…``) targets exactly
+        that slot's capacity."""
+        scope_tags = frozenset((job,)) if tags is None \
+            else (frozenset(tags) | {job})
         fired: list[tuple[str, float]] = []
         for rule in self._job_rules:
-            if not rule.matches_scope(job, method, frozenset((job,))):
+            if not rule.matches_scope(job, method, scope_tags):
                 continue
             n = rule.fires(self.seed, f"{job}|{method}", self._lock)
             if not n:

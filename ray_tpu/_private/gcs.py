@@ -219,6 +219,14 @@ class GcsServer:
         self._pending_pgs: set[bytes] = set()
         self._pg_seq = 0                     # admission order tiebreak
         self._sched_pass_at = 0.0            # pass-level rate limit
+        # Capacity reclaimed by recent preemption fires that the owning
+        # raylets have not re-gossiped yet: [(fired_ts, bundles,
+        # bundle_nodes, reflected_node_ids)]. _node_available_for_pg
+        # adds these back so the fire's own queue re-drive doesn't warn
+        # a fresh victim for capacity that already exists (fire-boundary
+        # over-preemption); a node's first post-fire report consumes the
+        # entry for that node (recorded in reflected_node_ids).
+        self._preempt_freed: list[tuple] = []
         # node_id -> {job: {resource: amount}} gossiped by raylets
         # (lease-grant usage; popped when the node dies)
         self._lease_usage: dict[str, dict] = {}
@@ -1512,7 +1520,32 @@ class GcsServer:
             # "created" push is async) — treat such commits as unreflected
             # and subtract them, at worst briefly double-counting.
             cutoff = node.reported_at - 1.5
+            # Mirror image for preemption fires: bundles a fire reclaimed
+            # AFTER the last report are still counted as held there — add
+            # them back until a post-fire report lands. Without this the
+            # fire's own queue re-drive sees the freed capacity as
+            # occupied and warns one MORE victim per fire (fire-boundary
+            # over-preemption). Direction matters: the commit margin
+            # above errs by double-SUBTRACTING (conservative), but adding
+            # freed bundles a report already shows OVER-COMMITS — the
+            # scheduler would admit a gang onto capacity that does not
+            # exist. So each entry is consumed per node by the first
+            # report taken after the fire (no grace margin: a report
+            # racing the reclaim push at worst briefly under-states,
+            # the conservative direction), not by a wall-clock window.
+            for fired_ts, bundles, nids, reflected in self._preempt_freed:
+                if node.node_id in reflected:
+                    continue    # a post-fire report already showed it
+                if node.reported_at > fired_ts:
+                    reflected.add(node.node_id)
+                    continue
+                for bundle, nid in zip(bundles, nids):
+                    if nid == node.node_id:
+                        for k, v in bundle.items():
+                            avail[k] = avail.get(k, 0) + v
         else:
+            # totals-minus-CREATED-bundles already reflects a fired gang
+            # (it is no longer CREATED): no freed adjustment needed
             avail = dict(node.resources)
             cutoff = 0.0
         for pg in self.placement_groups.values():
@@ -1666,6 +1699,41 @@ class GcsServer:
                     or pg.preempt_deadline is None:
                 return False   # removed/re-placed/node-death superseded
             preemptor = pg.preemptor
+            if preemptor is not None:
+                # Reprieve: the demand that warned this victim may have
+                # evaporated during the grace window — the preemptor
+                # placed on capacity freed elsewhere, was removed, or
+                # current availability now fits it without this gang.
+                # Firing anyway would reclaim a victim nobody needs
+                # (same supersede principle as the node-death path).
+                # Admin/chaos/self-preempt warnings carry no preemptor
+                # and always fire.
+                pre = self.placement_groups.get(preemptor)
+                if (pre is None
+                        or pre.state not in ("PENDING", "RESCHEDULING")
+                        or self._placeable_with_freed(pre, [])):
+                    pg.preempt_deadline = None
+                    pg.preemptor = None
+                    self._persist_pg(pg)
+                    self._publish("pg_state", {
+                        "event": "preempt_canceled", "pg_id": pg_id,
+                        "job": pg.job,
+                        "preemptor": preemptor.hex()})
+                    _events.record("PREEMPTION_CANCELED",
+                                   pg_id=pg_id.hex(), job=pg.job,
+                                   preemptor=preemptor.hex())
+                    self._maybe_schedule_pending(force=True)
+                    return False
+            # The owning raylets won't re-gossip the reclaimed bundles
+            # for up to a gossip beat: remember them so availability
+            # reads add them back (and the re-drive below doesn't
+            # over-preempt). Entries past the 5s report-freshness
+            # horizon are inert — prune here to bound the list.
+            now = time.time()
+            self._preempt_freed = [f for f in self._preempt_freed
+                                   if now - f[0] < 5.0]
+            self._preempt_freed.append(
+                (now, list(pg.bundles), list(pg.bundle_nodes), set()))
             pg.preempt_deadline = None
             pg.preemptor = None
             pg.state = "PENDING"
@@ -1695,12 +1763,17 @@ class GcsServer:
             self._refresh_quota_throttle_locked(force=True)
         return True
 
-    def rpc_preempt_job(self, conn, name: str, grace_s: float = None):
+    def rpc_preempt_job(self, conn, name: str, grace_s: float = None,
+                        pg_name: str = None):
         """Force-preempt the named job's newest CREATED gang (the fault
         DSL's `preempt_job` primitive and the admin escape hatch): same
         warning → grace → reclaim lifecycle as an organic priority
-        preemption. Returns the victim pg id hex, or None when the job
-        holds no preemptible gang."""
+        preemption. ``pg_name`` narrows the victim to the job's gang of
+        that name — the handle the Serve controller and slot-scoped
+        chaos schedules use to warn ONE replica's capacity instead of
+        whichever gang happens to be newest. Returns the victim pg id
+        hex, or None when the job holds no preemptible gang (for
+        pg_name: none of that name)."""
         from ray_tpu._private.config import get_config
 
         grace = (float(grace_s) if grace_s is not None
@@ -1708,7 +1781,8 @@ class GcsServer:
         with self._lock:
             cands = [pg for pg in self.placement_groups.values()
                      if pg.job == name and pg.state == "CREATED"
-                     and pg.preempt_deadline is None]
+                     and pg.preempt_deadline is None
+                     and (pg_name is None or pg.name == pg_name)]
             if not cands:
                 return None
             victim = max(cands, key=lambda p: (p.commit_ts,

@@ -31,9 +31,19 @@ What the soak PROVES (the pass criteria asserted by
 - **bounded reconvergence** — after the chaos window the GCS's alive
   set equals the survivor set and a probe message published on the
   feed reaches every survivor, within a measured window.
+
+The SERVE plane rides the same harness (``SimServeApp`` /
+``sim_serve_deployment_cls``): each app is the REAL Serve
+``_DeploymentState`` FSM (controller.py — reconcile, autoscaling,
+capacity gangs, preemption-warning drains all production code) with the
+replica actors stubbed to inert slots, driven by a deterministic
+open-loop request model. The soak's serving acceptance — zero lost
+accepted requests through preemption storms — is journaled the same
+way (``serve_final <app> ... lost=0``).
 """
 from __future__ import annotations
 
+import collections
 import os
 import subprocess
 import sys
@@ -293,6 +303,293 @@ class SimRaylet:
             self._teardown_connections()
 
 
+class _SimHandle:
+    """Inert replica-actor stand-in (the sim plane spawns no workers)."""
+
+    _actor_id = b""
+
+
+_SERVE_DEP_CLS = None
+
+
+def sim_serve_deployment_cls():
+    """The REAL Serve ``_DeploymentState`` (serve/_private/controller.py)
+    specialized for the harness: reconcile, autoscaling, capacity-gang
+    creation/tracking, preemption-warning drains and the
+    drain-through-warning scale-down all run UNMODIFIED against the
+    harness GCS; only the worker-runtime edges (actor start/stop, health
+    checks, replica metrics) are stubbed. Lazy so node-only soaks never
+    load the serve plane."""
+    global _SERVE_DEP_CLS
+    if _SERVE_DEP_CLS is not None:
+        return _SERVE_DEP_CLS
+    from ray_tpu.serve._private import controller as _ctl
+
+    class SimServeDeployment(_ctl._DeploymentState):
+        """A replica is an inert slot: its only substance is the
+        capacity gang the base class creates and tracks in the job
+        plane, which is exactly the surface the soak exercises."""
+
+        def _start_replica(self):
+            seq = getattr(self, "_sim_seq", 0) + 1
+            self._sim_seq = seq
+            rid = f"{self.dep_id}#s{seq:04d}"
+            used = {r.slot for r in self.replicas}
+            slot = next(i for i in range(len(self.replicas) + 1)
+                        if i not in used)
+            pg_id, requested_ts = self._create_capacity_pg(slot)
+            r = _ctl._Replica(rid, f"SIM::{rid}", _SimHandle(), None, slot)
+            r.capacity_pg_id = pg_id
+            r.pg_requested_ts = requested_ts
+            self.replicas.append(r)
+
+        def _check_ready(self, r):
+            # readiness is pure capacity here: reconcile() already gates
+            # STARTING tenant replicas on the gang turning CREATED
+            return "ready"
+
+        def _check_drained(self, r):
+            return True
+
+        def _begin_stop(self, r, deadline_s=None):
+            # drains complete next tick — well inside any grace window,
+            # so a warned gang is always removed PRE-fire (the
+            # controlled-drain escape hatch the scale-down path proves)
+            r.state = _ctl.STOPPING
+            r.drain_ref = None
+            r.drain_deadline = time.monotonic()
+
+        def _health_checks(self):
+            return False
+
+        def _poll_replica_metrics(self):
+            pass
+
+        def _kill(self, r):
+            if r.capacity_pg_id is not None:
+                try:
+                    self._gcs_call("remove_placement_group",
+                                   pg_id=r.capacity_pg_id)
+                except Exception:
+                    pass
+                r.capacity_pg_id = None
+            if r in self.replicas:
+                self.replicas.remove(r)
+
+    _SERVE_DEP_CLS = SimServeDeployment
+    return SimServeDeployment
+
+
+class SimServeApp:
+    """One Serve app as a first-class job-plane tenant, driven by the
+    real controller FSM (``sim_serve_deployment_cls``) under a
+    deterministic open-loop request model.
+
+    Request model (app-level aggregate, one FIFO): each tick admits a
+    deterministic arrival cohort (``base_rate`` x the active spike
+    multiplier) bounded by ``max_queued_per_replica`` per live replica —
+    overflow is SHED at admission, before acceptance — then serves up to
+    ``service_rate`` x live replicas FIFO. A live replica is RUNNING and
+    not preemption-warned/draining, so a warning instantly removes that
+    slot's throughput (warned = already-lost capacity) while every
+    accepted request stays queued until served: lost accepted requests
+    are structurally zero EXACTLY when the drain/requeue story holds,
+    and the final count is journaled (``serve_final ... lost=0``).
+
+    Chaos composes through the fault DSL's job plane: every tick
+    consults ``preempt_job`` rules once per replica SLOT over the fixed
+    range ``0..max_replicas-1`` (fixed so injector counters stay
+    deterministic regardless of how many replicas currently exist), with
+    ``job=<slot tag>`` and ``tags={app job, dep tag}`` — so one rule
+    scoped to the app's job warns a seed-deterministic subset of slots,
+    and a fired rule issues the real GCS ``preempt_job`` narrowed by
+    ``pg_name`` to that slot's capacity gang.
+    """
+
+    def __init__(self, cluster: "SimCluster", name: str, job: str, *,
+                 priority: int = 10, quota: dict | None = None,
+                 base_rate: int = 1000, service_rate: int = 400,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 capacity_cpu: float = 2.0,
+                 max_queued_per_replica: int = 4000,
+                 spikes: tuple = ()):
+        from ray_tpu.serve._private.constants import (deployment_id,
+                                                      dep_tag, slot_tag)
+        from ray_tpu.serve._private.long_poll import LongPollHost
+
+        self.cluster = cluster
+        self.name = name
+        self.job = job
+        self.base_rate = int(base_rate)
+        self.service_rate = int(service_rate)
+        self.max_replicas = int(max_replicas)
+        self.spikes = tuple(spikes)   # (start_tick, end_tick, multiplier)
+        self.dep_id = deployment_id(name, "main")
+        self._dep_tag = dep_tag(self.dep_id)
+        self._slot_tag = slot_tag
+        cluster.register_job(job, quota=quota, priority=priority)
+        spec = {
+            "name": "main",
+            "user_callable": None,
+            "init_args": (),
+            "init_kwargs": {},
+            "version": "1",
+            "config": {
+                "max_ongoing_requests": int(service_rate),
+                "max_queued_requests": int(max_queued_per_replica),
+                "graceful_shutdown_timeout_s": 1.0,
+                # sim replicas have no health surface; the capacity poll
+                # is the liveness signal
+                "health_check_period_s": 3600.0,
+                "autoscaling_config": {
+                    "min_replicas": int(min_replicas),
+                    "max_replicas": int(max_replicas),
+                    # demand is (admitted + backlog) per tick; one
+                    # replica clears service_rate of it per tick
+                    "target_ongoing_requests": float(service_rate),
+                    "upscale_delay_s": 0.2,
+                    "downscale_delay_s": 0.6,
+                    "metrics_interval_s": 0.1,
+                },
+                "ray_actor_options": {"num_cpus": float(capacity_cpu)},
+            },
+        }
+        self.ds = sim_serve_deployment_cls()(
+            self.dep_id, spec, LongPollHost(), job=job,
+            gcs_call=cluster.gcs_call)
+        self.queue: collections.deque = collections.deque()
+        self._queued = 0
+        self.offered = self.accepted = self.served = self.shed = 0
+        self.latency_hist: dict[int, int] = {}   # latency_ticks -> count
+        self.max_live_seen = 0
+        cluster._journal(
+            f"serve_app {name} job={job} rate={self.base_rate} "
+            f"svc={self.service_rate} replicas={int(min_replicas)}.."
+            f"{self.max_replicas} spikes={list(self.spikes)}")
+
+    # ------------------------------------------------------------- driving
+    def live_replicas(self) -> int:
+        return sum(1 for r in self.ds.replicas
+                   if r.state == "RUNNING" and not r.warned
+                   and not r.drain_requested)
+
+    def _consult_chaos(self):
+        inj = _fi.ACTIVE
+        if inj is None:
+            return
+        for slot in range(self.max_replicas):
+            stag = self._slot_tag(self.dep_id, slot)
+            for action, param_s in inj.on_job(
+                    stag, "serve_tick",
+                    tags=frozenset((self.job, self._dep_tag))):
+                if action != "preempt_job":
+                    continue
+                self.cluster._journal(f"preempt_slot {stag} (serve_tick)")
+                try:
+                    self.cluster.gcs_call("preempt_job", name=self.job,
+                                          grace_s=param_s, pg_name=stag)
+                except Exception:
+                    pass
+
+    def tick(self):
+        t = self.cluster.tick_count
+        mult = 1.0
+        for start, end, m in self.spikes:
+            if t == start:
+                self.cluster._journal(f"spike_begin {self.name} x{m:g}")
+            elif t == end:
+                self.cluster._journal(f"spike_end {self.name}")
+            if start <= t < end:
+                mult = m
+        arrivals = int(round(self.base_rate * mult))
+        self.offered += arrivals
+        self._consult_chaos()
+        n_live = self.live_replicas()
+        self.max_live_seen = max(self.max_live_seen, n_live)
+        # admission: bound the queue per LIVE replica; only THIS tick's
+        # arrivals can be shed — accepted work is never dropped later,
+        # whatever happens to the replicas backing it
+        room = self.ds.config.max_queued_requests * max(1, n_live)
+        admitted = min(arrivals, max(0, room - self._queued))
+        self.shed += arrivals - admitted
+        self.accepted += admitted
+        if admitted:
+            self.queue.append([t, admitted])
+            self._queued += admitted
+        # serve FIFO up to this tick's live capacity
+        cap = n_live * self.service_rate
+        while cap > 0 and self.queue:
+            cohort_t, cohort_n = self.queue[0]
+            take = cohort_n if cohort_n <= cap else cap
+            # clamp into an overflow bucket: one key per latency value,
+            # bounded even under a pathological standing backlog
+            lat = min(t - cohort_t + 1, 10_000)
+            self.latency_hist[lat] = self.latency_hist.get(lat, 0) + take
+            self.served += take
+            self._queued -= take
+            cap -= take
+            if take == cohort_n:
+                self.queue.popleft()
+            else:
+                self.queue[0][1] -= take
+        # push the demand signal the real routers would (queued +
+        # in-flight at the handle layer) and run the real reconcile
+        self.ds.handle_metrics["sim-router"] = (
+            float(admitted + self._queued), time.monotonic())
+        self.ds.reconcile()
+
+    # ------------------------------------------------------------- results
+    def latency_pct(self, q: float) -> float | None:
+        """Weighted served-latency percentile in SECONDS (ticks x
+        tick_interval); wall-clock-dependent — metrics only."""
+        total = sum(self.latency_hist.values())
+        if not total:
+            return None
+        target = q * (total - 1)
+        seen = 0
+        for lat in sorted(self.latency_hist):
+            seen += self.latency_hist[lat]
+            if seen - 1 >= target:
+                return lat * self.cluster.tick_interval
+        return max(self.latency_hist) * self.cluster.tick_interval
+
+    def finalize(self) -> dict:
+        """End-of-phase accounting. The deterministic facts (offered
+        total, the lost-accepted count — zero on a correct drain path)
+        are journaled; throughput/latency/scale numbers are wall-clock
+        racing and go to ``metrics``."""
+        lost = self.accepted - self.served - self._queued
+        self.cluster._journal(
+            f"serve_final {self.name} offered={self.offered} lost={lost}")
+        out = {
+            "app": self.name, "job": self.job,
+            "offered": self.offered, "accepted": self.accepted,
+            "served": self.served, "shed": self.shed,
+            "queued_end": self._queued, "lost": lost,
+            "latency_p50_s": self.latency_pct(0.50),
+            "latency_p99_s": self.latency_pct(0.99),
+            "max_live_replicas": self.max_live_seen,
+            "status": self.ds.status(),
+        }
+        self.latency_hist.clear()   # rolled up into out; flush
+        if "serve" not in self.cluster.metrics:
+            self.cluster.metrics["serve"] = {}
+        serve_metrics = self.cluster.metrics["serve"]
+        serve_metrics[self.name] = out
+        return out
+
+    def shutdown(self, timeout_s: float = 10.0) -> bool:
+        """Delete the app through the real FSM (drains replicas,
+        removes capacity gangs — the job plane gets everything back)."""
+        self.ds.mark_deleting()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.ds.reconcile():
+                return True
+            time.sleep(0.02)
+        return False
+
+
 class SimCluster:
     """Owns the GCS (in-process object or subprocess) and the fleet.
 
@@ -321,6 +618,7 @@ class SimCluster:
         self.gcs_addr: tuple | None = None
         self._probe_n = 0
         self.raylets: list[SimRaylet] = []
+        self.serve_apps: list[SimServeApp] = []
         # multi-tenant driving state: job name -> deterministic PG
         # counter (jobs are registered once per soak; `stop()` is the
         # removal path for the whole harness)
@@ -403,11 +701,15 @@ class SimCluster:
         """Drive ``n`` ticks: each tick walks the fleet in index order
         (chaos consults happen at these deterministic boundaries), and
         every ``leases_every`` ticks each live raylet accepts one
-        lease."""
+        lease. Serve apps tick after the raylets: chaos consults,
+        arrivals and the controller reconcile all happen at the same
+        deterministic boundary."""
         for _ in range(n):
             self.tick_count += 1
             for r in self.raylets:
                 r.tick()
+            for app in self.serve_apps:
+                app.tick()
             if leases_every and self.tick_count % leases_every == 0:
                 for r in self.raylets:
                     if r.state == "up":
@@ -440,6 +742,15 @@ class SimCluster:
         self.metrics[f"{method}_initiated_at"] = t0
         self._journal(f"{method} fired={sorted(verdicts)}")
         return verdicts
+
+    # ------------------------------------------------------- serve plane
+    def add_serve_app(self, name: str, job: str, **kw) -> SimServeApp:
+        """Deploy one tenant Serve app into the harness (registers the
+        job, stands up the real deployment FSM); it ticks with the
+        fleet from the next ``run_ticks`` on."""
+        app = SimServeApp(self, name, job, **kw)
+        self.serve_apps.append(app)
+        return app
 
     # ----------------------------------------------------- multi-tenancy
     def register_job(self, name: str, quota: dict | None = None,
@@ -624,6 +935,7 @@ class SimCluster:
 
     def stop(self):
         self._jobs.clear()   # tenant counters die with the harness
+        self.serve_apps.clear()   # ditto the serve plane
         for r in self.raylets:
             r.stop()
         if self._gcs_obj is not None:

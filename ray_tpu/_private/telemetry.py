@@ -397,6 +397,30 @@ CATALOG: dict[str, dict] = {
         "description": "Autoscale decisions applied after hysteresis "
                        "(direction=up|down)",
     },
+    # --- serve tenancy (job-plane capacity: controller.py) ---
+    "ray_tpu_serve_warned_replicas_tasks": {
+        "kind": "Gauge", "tags": ("deployment",),
+        "description": "Replicas whose capacity gang is under a "
+                       "preemption warning (already-lost capacity: the "
+                       "autoscaler starts replacements before the grace "
+                       "window expires) — nonzero spans are preemption "
+                       "storms in flight",
+    },
+    "ray_tpu_serve_capacity_wait_seconds": {
+        "kind": "Histogram", "tags": ("deployment",),
+        "boundaries": [0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0],
+        "description": "Spike-to-placed latency: time from requesting a "
+                       "replica's capacity gang in the job plane to its "
+                       "CREATED (includes any preemption grace window "
+                       "the plane had to burn to free the capacity)",
+    },
+    "ray_tpu_serve_preempt_drains_total": {
+        "kind": "Counter", "tags": ("deployment", "reason"),
+        "description": "Replica drains begun through the preemption-"
+                       "warning machinery (reason=preempted for an "
+                       "external/chaos warning, scale_down for the "
+                       "controller's own pg_name-narrowed self-preempt)",
+    },
     "ray_tpu_serve_batch_size_tasks": {
         "kind": "Histogram", "tags": ("fn",),
         "boundaries": [1, 2, 4, 8, 16, 32, 64, 128],

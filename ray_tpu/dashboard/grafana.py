@@ -130,6 +130,15 @@ _PANELS = [
     ("Serve failovers (replica death/drain re-dispatch)",
      "sum by (deployment) (rate(ray_tpu_serve_failovers_total[5m]))",
      "ops"),
+    # --- serve tenancy (Serve as a first-class job-plane tenant) ---
+    ("Serve app dominant share (job plane)",
+     "ray_tpu_job_dominant_share_ratio", "percentunit"),
+    ("Serve warned-replica capacity (preemption storms)",
+     "sum by (deployment) (ray_tpu_serve_warned_replicas_tasks)",
+     "short"),
+    ("Serve spike-to-placed latency p99",
+     "histogram_quantile(0.99, rate(ray_tpu_serve_capacity_wait_seconds"
+     "_bucket[5m]))", "s"),
 ]
 
 

@@ -11,7 +11,9 @@ Routes (reference modules in parens — dashboard/modules/*):
     /api/jobs               (job)
     /api/tenancy            multi-tenant summary: per-job priority/
                             quota/usage/share, preemption + quota
-                            rejection rollups
+                            rejection rollups, and the job -> Serve
+                            app cross-link for jobs backing Serve
+                            tenants
     /api/topology           TPU slice topology: per-slice hosts/coords
                             and which placement groups / pipeline
                             stages occupy each slice

@@ -3,8 +3,8 @@
 Reference: dashboard/client/ (the React SPA). TPU-first minimalism: a
 single dependency-free HTML file rendered by the existing state API
 routes — tabs for overview/nodes/actors/tasks/workers/placement
-groups/objects/jobs/serve, auto-refresh, zero build tooling. Operators
-get a browsable view; machines keep the JSON routes.
+groups/objects/jobs/tenancy/serve, auto-refresh, zero build tooling.
+Operators get a browsable view; machines keep the JSON routes.
 """
 
 INDEX_HTML = """<!doctype html>
@@ -70,6 +70,7 @@ const TABS = [
   {id:"topology", label:"Topology", api:"/api/topology"},
   {id:"objects", label:"Objects", api:"/api/objects"},
   {id:"jobs", label:"Jobs", api:"/api/jobs"},
+  {id:"tenancy", label:"Tenancy", api:"/api/tenancy"},
   {id:"events", label:"Events", api:"/api/events"},
   {id:"steps", label:"Steps", api:"/api/steps"},
   {id:"serve", label:"Serve", api:"/api/serve"},
@@ -126,6 +127,18 @@ async function render() {
         "<pre class='summary'>" + esc(mem.summary) + "</pre>" +
         (Array.isArray(reporter) && reporter.length
           ? "<h3>Per-node stats</h3>" + renderTable(reporter) : "");
+    } else if (current === "tenancy") {
+      const t = await jget("/api/tenancy");
+      const apps = Object.entries(t.serve_apps || {}).map(
+        ([job, names]) => ({job, serve_apps: names.join(", ")}));
+      html = renderTable(t.jobs) +
+        "<div class='meta'>preemptions " + esc(fmt(t.preemptions)) +
+        " &middot; quota rejections " + esc(fmt(t.quota_rejections)) +
+        " &middot; quota violations " +
+        (t.quota_violations && t.quota_violations.length
+          ? "<span class='bad'>" + esc(fmt(t.quota_violations)) + "</span>"
+          : "<span class='ok'>none</span>") + "</div>" +
+        (apps.length ? "<h3>Serve tenants</h3>" + renderTable(apps) : "");
     } else {
       const tab = TABS.find(t => t.id === current) || TABS[0];
       html = renderTable(await jget(tab.api));

@@ -195,21 +195,29 @@ class ServeOverloadedError(RayTpuError):
     and retry; the HTTP proxy maps this to 503 + a Retry-After header.
     Shedding with a typed error is the production-serve contract: an
     unbounded queue converts overload into unbounded latency for every
-    caller instead of fast feedback for the marginal one."""
+    caller instead of fast feedback for the marginal one.
+
+    ``draining`` distinguishes a capacity storm from a load blip: True
+    means replicas are preemption-warned / drain-scheduled and
+    ``retry_after_s`` hints the grace window remaining (back off past
+    the storm), not the static queue-depth heuristic."""
 
     def __init__(self, deployment_id: str = "", queued: int = 0,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0, draining: bool = False):
         self.deployment_id = deployment_id
         self.queued = queued
         self.retry_after_s = retry_after_s
+        self.draining = draining
         super().__init__(
             f"deployment {deployment_id!r} is overloaded: all replicas at "
-            f"max_ongoing_requests and {queued} requests already queued; "
-            f"retry after {retry_after_s:.2f}s")
+            f"max_ongoing_requests and {queued} requests already queued"
+            + (" (replicas draining under preemption warning)"
+               if draining else "")
+            + f"; retry after {retry_after_s:.2f}s")
 
     def __reduce__(self):
         return (type(self), (self.deployment_id, self.queued,
-                             self.retry_after_s))
+                             self.retry_after_s, self.draining))
 
 
 class ReplicaDrainingError(RayTpuError):

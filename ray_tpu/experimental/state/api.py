@@ -517,10 +517,32 @@ def summarize_jobs(*, address: str | None = None) -> dict:
     - ``quota_violations``: jobs whose live usage exceeds their quota
       (MUST be empty — quota enforcement is admission-time, so a
       violation means the scheduler placed past a cap);
-    - ``preemptions`` / ``quota_rejections``: cluster totals.
+    - ``preemptions`` / ``quota_rejections``: cluster totals;
+    - ``serve_apps``: job → Serve app names for jobs that are Serve
+      tenants (best-effort controller query) — the jobs-side half of
+      the ``summarize_serve()`` cross-link, so an operator reading a
+      preemption counter can see which app's autoscaler drove it.
     """
     with _gcs(address) as call:
         rows = call("list_jobs")
+    serve_apps: dict[str, list] = {}
+    try:
+        import ray_tpu
+        from ray_tpu.serve._private.constants import (
+            CONTROLLER_NAME,
+            SERVE_NAMESPACE,
+        )
+
+        if ray_tpu.is_initialized():
+            controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                           namespace=SERVE_NAMESPACE)
+            apps = ray_tpu.get(controller.get_app_status.remote(),
+                               timeout=10)
+            for app_name, app in apps.items():
+                if app.get("job"):
+                    serve_apps.setdefault(app["job"], []).append(app_name)
+    except Exception:
+        pass
     return {
         "jobs": rows,
         "quota_violations": sorted(r["Job"] for r in rows
@@ -528,6 +550,7 @@ def summarize_jobs(*, address: str | None = None) -> dict:
         "preemptions": sum(r.get("Preemptions", 0) for r in rows),
         "quota_rejections": sum(r.get("QuotaRejections", 0)
                                 for r in rows),
+        "serve_apps": serve_apps,
     }
 
 
@@ -792,9 +815,17 @@ def summarize_serve(*, address: str | None = None) -> dict:
                         totals, sheds, failovers, live queue depth;
     - ``batching``      per-batch-fn executed batch count, mean batch
                         size, mean padded slots (shape-bucket waste);
-    - ``events``        replica lifecycle + scaling + shed events
-                        (REPLICA_STARTED/DIED/DRAINED, SERVE_SCALED,
-                        REQUEST_SHED).
+    - ``events``        replica lifecycle + scaling + shed + tenancy
+                        events (REPLICA_STARTED/DIED/DRAINED,
+                        SERVE_SCALED, REQUEST_SHED, SERVE_APP_REGISTERED,
+                        SERVE_CAPACITY_PLACED, SERVE_REPLICA_WARNED).
+
+    Tenant apps (deployed with ``serve.run(..., job=...)``) carry a
+    ``tenancy`` block joined from the GCS job table (the same rows
+    ``summarize_jobs()`` reports) for the
+    app's job: priority, quota, live usage, dominant share, and the
+    preemption / quota-rejection counters — the Serve-side view of the
+    same plane the training jobs contend in.
     """
     applications: dict = {}
     try:
@@ -811,6 +842,28 @@ def summarize_serve(*, address: str | None = None) -> dict:
                 controller.get_app_status.remote(), timeout=10)
     except Exception:
         applications = {}
+    if any(app.get("job") for app in applications.values()):
+        # Straight to the GCS job table: summarize_jobs() would repeat
+        # the controller get_app_status RPC made above (its serve_apps
+        # cross-link) — doubling controller round-trips per call.
+        try:
+            with _gcs(address) as call:
+                job_rows = {r["Job"]: r for r in call("list_jobs")}
+        except Exception:
+            job_rows = {}
+        for app in applications.values():
+            job = app.get("job")
+            if job and job in job_rows:
+                r = job_rows[job]
+                app["tenancy"] = {
+                    "priority": r.get("Priority"),
+                    "quota": r.get("Quota"),
+                    "usage": r.get("Usage"),
+                    "dominant_share": r.get("DominantShare"),
+                    "preemptions": r.get("Preemptions"),
+                    "quota_rejections": r.get("QuotaRejections"),
+                    "over_quota": r.get("OverQuota"),
+                }
 
     snaps = {m["name"]: m for m in metrics_summary(address=address)}
 
@@ -866,7 +919,8 @@ def summarize_serve(*, address: str | None = None) -> dict:
         row["mean_pad_waste"] = (total / count) if count else 0.0
 
     serve_kinds = {"REPLICA_STARTED", "REPLICA_DIED", "REPLICA_DRAINED",
-                   "SERVE_SCALED", "REQUEST_SHED"}
+                   "SERVE_SCALED", "REQUEST_SHED", "SERVE_APP_REGISTERED",
+                   "SERVE_CAPACITY_PLACED", "SERVE_REPLICA_WARNED"}
     events = [e for e in list_cluster_events(address=address)
               if e.get("kind") in serve_kinds]
     return {"applications": applications, "requests": requests,

@@ -23,5 +23,21 @@ def replicas_key(deployment_id: str) -> str:
     return f"replicas::{deployment_id}"
 
 
+def dep_tag(deployment_id: str) -> str:
+    """Fault-plane tag for one deployment's replicas ('#'/':'/'.' are
+    schedule-grammar characters, hence the sanitization). The slot
+    variant (``slot_tag``) additionally names one replica position —
+    it doubles as the name of that slot's capacity placement group
+    when the app is a job-plane tenant, so a slot-scoped
+    ``preempt_job`` chaos rule and the controller's own drain requests
+    address the same gang."""
+    return "serve-" + "".join(c if c.isalnum() or c in "-_" else "-"
+                              for c in deployment_id)
+
+
+def slot_tag(deployment_id: str, slot: int) -> str:
+    return f"{dep_tag(deployment_id)}-slot{slot}"
+
+
 def deployment_id(app_name: str, deployment_name: str) -> str:
     return f"{app_name}#{deployment_name}"

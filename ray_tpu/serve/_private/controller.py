@@ -27,9 +27,35 @@ decisions as ``SERVE_SCALED``; the metric catalog carries the FSM
 occupancy gauge (``ray_tpu_serve_replicas_tasks``), replacement counters
 (``ray_tpu_serve_replica_restarts_total{reason}``) and autoscale
 decisions (``ray_tpu_serve_autoscale_total{direction}``).
+
+**Serve as a tenant (multi-tenant control plane).** An app deployed with
+``serve.run(..., job=...)`` is a first-class tenant of the PR 13
+job/quota/preemption plane: the controller registers the job
+(quota + priority) and every replica is backed by a one-bundle capacity
+placement group named by the replica's slot tag
+(``serve-<app>-<dep>-slot<k>``), labeled with the app's job. The gang IS
+the replica's capacity claim — a STARTING replica only turns RUNNING
+once its gang is CREATED, so a demand spike on a high-priority app
+contends in the job plane (and preempts a lower-priority training gang)
+instead of silently oversubscribing. The flip side:
+
+- a ``preempt_warning`` on a replica's gang (higher-priority tenant, or
+  seeded chaos via ``preempt_job:<job>``) marks the replica WARNED:
+  it is treated as already-lost capacity (the autoscaler/scale loop
+  starts the replacement before the grace window expires), it begins
+  draining immediately, and routers learn via the ``draining`` list in
+  the long-poll broadcast (``SERVE_REPLICA_WARNED`` event,
+  ``ray_tpu_serve_warned_replicas_tasks`` gauge);
+- scale-down itself rides the SAME warning machinery: the controller
+  self-preempts the victim slot's gang (``preempt_job`` narrowed by
+  ``pg_name``), drains through the grace window, and removes the gang
+  pre-fire — the controlled-drain escape hatch — so capacity returns to
+  queued training gangs the moment the drain completes, with zero lost
+  accepted requests (kill switch: ``serve_preempt_scale_down=0``).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -40,12 +66,21 @@ from ray_tpu.serve._private.constants import (
     ROUTE_TABLE_KEY,
     deployment_id as make_dep_id,
     replicas_key,
+    slot_tag,
 )
 from ray_tpu.serve._private.long_poll import LongPollHost
 from ray_tpu.serve.config import DeploymentConfig
 
 STARTING, RUNNING, STOPPING = "STARTING", "RUNNING", "STOPPING"
 RECONCILE_PERIOD_S = 0.1
+
+
+def _worker_gcs_call(method: str, **kw):
+    """Default GCS transport: this process's worker connection. The sim
+    cluster injects its own (no worker runtime there)."""
+    from ray_tpu._private import api
+
+    return api._require_worker().gcs.call(method, **kw)
 
 
 class _Replica:
@@ -65,16 +100,27 @@ class _Replica:
         self.last_health_check = time.monotonic()
         self.metrics_ref = None
         self.num_ongoing = 0.0
+        # job-plane capacity (tenant apps only): the slot-named gang
+        # backing this replica, and its observed preemption state
+        self.capacity_pg_id: bytes | None = None
+        self.pg_created = False
+        self.pg_requested_ts = 0.0
+        self.warned = False                 # preempt_warning observed
+        self.warn_deadline: float | None = None   # wall clock (GCS stamp)
+        self.drain_requested = False        # controller self-preempted
 
 
 class _DeploymentState:
     """Target + actual state for one deployment."""
 
-    def __init__(self, dep_id: str, spec: dict, host: LongPollHost):
+    def __init__(self, dep_id: str, spec: dict, host: LongPollHost,
+                 job: str = "", gcs_call=None):
         self.dep_id = dep_id
         self.spec = spec                       # user_callable/init args/...
         self.config = DeploymentConfig.from_dict(spec["config"])
         self.host = host
+        self.job = job                         # "" = not a job-plane tenant
+        self._gcs_call = gcs_call or _worker_gcs_call
         self.replicas: list[_Replica] = []
         self.deleting = False
         self.version = spec.get("version") or "1"
@@ -84,6 +130,7 @@ class _DeploymentState:
                            else self.config.num_replicas)
         self._scale_proposal_since: tuple[int, float] | None = None
         self._last_metrics_poll = 0.0
+        self._last_capacity_poll = 0.0
         # handle-side demand: {router_id: (queued+in_flight, monotonic ts)}
         self.handle_metrics: dict[str, tuple[float, float]] = {}
 
@@ -125,42 +172,31 @@ class _DeploymentState:
     # ------------------------------------------------------------ reconcile
     def reconcile(self) -> bool:
         """One tick. Returns True when (deleting and fully stopped)."""
-        import ray_tpu
-
-        changed = False
-        # 1. STARTING → RUNNING when ready_ref resolves
+        # 0. job-plane capacity tracking (tenant apps): placed gangs
+        #    unblock STARTING replicas; preempt warnings start drains
+        changed = self._poll_capacity()
+        # 1. STARTING → RUNNING when ready_ref resolves. A tenant
+        #    replica additionally needs its capacity gang CREATED —
+        #    placed capacity IS part of readiness; until then the
+        #    replica waits in the job plane's queue like any gang.
         for r in self.replicas:
             if r.state == STARTING:
-                try:
-                    done, _ = ray_tpu.wait([r.ready_ref], timeout=0)
-                except Exception:
-                    done = []
-                if done:
-                    try:
-                        # surface init errors; the ref is already done
-                        # (wait above), so the timeout only bounds the
-                        # result fetch — timeout-less, a wedged store
-                        # fetch would stall the whole control loop
-                        # under the controller lock (raylint RTL102)
-                        ray_tpu.get(r.ready_ref, timeout=10.0)
-                        r.state = RUNNING
-                        _events.record("REPLICA_STARTED",
-                                       deployment=self.dep_id,
-                                       replica_id=r.replica_id)
-                        changed = True
-                    except Exception:
-                        self._drop(r, reason="init")
-                        changed = True
+                if r.capacity_pg_id is not None and not r.pg_created:
+                    continue
+                ready = self._check_ready(r)
+                if ready == "ready":
+                    r.state = RUNNING
+                    _events.record("REPLICA_STARTED",
+                                   deployment=self.dep_id,
+                                   replica_id=r.replica_id)
+                    changed = True
+                elif ready == "failed":
+                    self._drop(r, reason="init")
+                    changed = True
         # 2. reap STOPPING
         for r in list(self.replicas):
             if r.state == STOPPING:
-                drained = False
-                if r.drain_ref is not None:
-                    try:
-                        done, _ = ray_tpu.wait([r.drain_ref], timeout=0)
-                        drained = bool(done)
-                    except Exception:
-                        drained = True
+                drained = self._check_drained(r)
                 if drained or time.monotonic() > r.drain_deadline:
                     _events.record("REPLICA_DRAINED",
                                    deployment=self.dep_id,
@@ -177,8 +213,11 @@ class _DeploymentState:
         changed |= self._health_checks()
         # 4. autoscaling metrics + decision
         self._autoscale()
-        # 5. scale toward target
-        live = [r for r in self.replicas if r.state in (STARTING, RUNNING)]
+        # 5. scale toward target. A preemption-warned (or self-draining)
+        #    replica is already-lost capacity: excluding it here starts
+        #    the replacement BEFORE the grace window expires, not after
+        #    the death event.
+        live = self._live()
         if len(live) < self.target_num:
             for _ in range(self.target_num - len(live)):
                 self._start_replica()
@@ -190,13 +229,172 @@ class _DeploymentState:
                 if extra == 0:
                     break
                 if r.state == STARTING or r.state == RUNNING:
-                    self._begin_stop(r)
+                    self._scale_down_replica(r)
                     extra -= 1
             changed = True
         if changed:
             self.broadcast()
             self._set_replica_gauges()
         return False
+
+    def _live(self) -> list:
+        """Replicas that count as (current or incoming) capacity."""
+        return [r for r in self.replicas
+                if r.state in (STARTING, RUNNING)
+                and not r.warned and not r.drain_requested]
+
+    def _check_ready(self, r: _Replica) -> str:
+        """'ready' | 'pending' | 'failed' for a STARTING replica (the sim
+        plane overrides this — no actors there)."""
+        import ray_tpu
+
+        try:
+            done, _ = ray_tpu.wait([r.ready_ref], timeout=0)
+        except Exception:
+            done = []
+        if not done:
+            return "pending"
+        try:
+            # surface init errors; the ref is already done (wait above),
+            # so the timeout only bounds the result fetch — timeout-less,
+            # a wedged store fetch would stall the whole control loop
+            # under the controller lock (raylint RTL102)
+            ray_tpu.get(r.ready_ref, timeout=10.0)
+            return "ready"
+        except Exception:
+            return "failed"
+
+    def _check_drained(self, r: _Replica) -> bool:
+        import ray_tpu
+
+        if r.drain_ref is None:
+            return False
+        try:
+            done, _ = ray_tpu.wait([r.drain_ref], timeout=0)
+            return bool(done)
+        except Exception:
+            return True
+
+    # ------------------------------------------------- job-plane capacity
+    def _poll_capacity(self) -> bool:
+        """Track each replica's capacity gang in the job plane. Polling
+        (0.25s cadence) rather than a pubsub subscription: the snapshot
+        carries everything needed (State + PreemptDeadline), and a missed
+        push can never wedge the FSM."""
+        if not self.job:
+            return False
+        now = time.monotonic()
+        if now - self._last_capacity_poll < 0.25:
+            return False
+        self._last_capacity_poll = now
+        changed = False
+        for r in list(self.replicas):
+            if r.capacity_pg_id is None:
+                continue
+            try:
+                snap = self._gcs_call("get_placement_group",
+                                      pg_id=r.capacity_pg_id)
+            except Exception:
+                continue
+            if snap is None:
+                # gang removed out from under us (operator / chaos):
+                # the capacity claim is gone — replace the replica
+                if r.state != STOPPING:
+                    r.capacity_pg_id = None
+                    self._drop(r, reason="preempted")
+                    changed = True
+                continue
+            state = snap.get("State")
+            if not r.pg_created and state == "CREATED":
+                r.pg_created = True
+                wait_s = now - r.pg_requested_ts
+                _tm.observe("ray_tpu_serve_capacity_wait_seconds", wait_s,
+                            tags={"deployment": self.dep_id})
+                _events.record("SERVE_CAPACITY_PLACED",
+                               deployment=self.dep_id,
+                               replica_id=r.replica_id, job=self.job,
+                               wait_s=round(wait_s, 4))
+                changed = True
+                continue
+            if r.pg_created and state != "CREATED":
+                # the grace window expired and the preemption FIRED (the
+                # gang re-queued PENDING): capacity is gone NOW — kill
+                # the replica and remove the zombie gang so it doesn't
+                # contend for capacity the app no longer holds
+                if r.state != STOPPING:
+                    self._drop(r, reason="preempted")
+                else:
+                    self._kill(r)
+                changed = True
+                continue
+            deadline = snap.get("PreemptDeadline")
+            if deadline and not r.warned and r.state != STOPPING:
+                self._on_preempt_warning(r, float(deadline))
+                changed = True
+        return changed
+
+    def _on_preempt_warning(self, r: _Replica, deadline_ts: float):
+        """A preempt_warning landed on this replica's capacity gang:
+        treat it as already-lost capacity and drain inside the grace
+        window. When the drain completes pre-fire, ``_kill`` removes the
+        warned gang — which cancels the fire (the GCS's controlled-drain
+        escape hatch) and returns the capacity to queued gangs."""
+        r.warned = True
+        r.warn_deadline = deadline_ts
+        grace = max(0.05, deadline_ts - time.time())
+        reason = "scale_down" if r.drain_requested else "preempted"
+        _events.record("SERVE_REPLICA_WARNED", deployment=self.dep_id,
+                       replica_id=r.replica_id, job=self.job,
+                       reason=reason, grace_s=round(grace, 3))
+        _tm.counter_inc("ray_tpu_serve_preempt_drains_total",
+                        tags={"deployment": self.dep_id, "reason": reason})
+        self._begin_stop(r, deadline_s=grace)
+
+    def _create_capacity_pg(self, slot: int):
+        """One-bundle gang claiming this replica's share of the cluster
+        in the job plane; named by the slot tag so chaos schedules and
+        the controller's own drain requests address the same gang."""
+        if not self.job:
+            return None, 0.0
+        from ray_tpu._private.config import get_config
+
+        opts = self.config.ray_actor_options or {}
+        cpu = float(opts.get("num_cpus")
+                    or get_config("serve_replica_capacity_cpu"))
+        pg_id = os.urandom(16)
+        try:
+            self._gcs_call("create_placement_group", pg_id=pg_id,
+                           bundles=[{"CPU": cpu}], strategy="PACK",
+                           name=slot_tag(self.dep_id, slot), job=self.job)
+        except Exception:
+            return None, 0.0
+        return pg_id, time.monotonic()
+
+    def _scale_down_replica(self, r: _Replica):
+        """Scale-down for a tenant replica rides the preemption-warning
+        machinery (self-preempt narrowed to the victim slot's gang): the
+        warning reaches routers and the replica exactly like an external
+        preemption, the drain honors the grace window, and the gang is
+        removed pre-fire. Kill switch ``serve_preempt_scale_down=0`` (or
+        an untenanted app / unplaced gang) falls back to a direct stop."""
+        from ray_tpu._private.config import get_config
+
+        if (self.job and r.state == RUNNING and r.pg_created
+                and not r.warned
+                and int(get_config("serve_preempt_scale_down"))):
+            try:
+                victim = self._gcs_call(
+                    "preempt_job", name=self.job,
+                    pg_name=slot_tag(self.dep_id, r.slot))
+            except Exception:
+                victim = None
+            if victim is not None:
+                # the warning lands via the capacity poll, which begins
+                # the drain; excluded from _live() so the scale loop
+                # neither re-picks nor replaces it
+                r.drain_requested = True
+                return
+        self._begin_stop(r)
 
     def on_actor_death(self, actor_id_hex: str) -> bool:
         """GCS death-feed fast path: drop the dead replica NOW and
@@ -206,8 +404,11 @@ class _DeploymentState:
         for r in list(self.replicas):
             if r.actor_id_hex and r.actor_id_hex == actor_id_hex:
                 was_stopping = r.state == STOPPING
-                if r in self.replicas:
-                    self.replicas.remove(r)
+                # _kill releases the capacity gang too (the kill on an
+                # already-dead handle is a no-op) — dropping the replica
+                # without it leaks a CREATED, quota-counted gang whose
+                # slot-tag name then collides with the replacement's
+                self._kill(r)
                 if not was_stopping:
                     _events.record("REPLICA_DIED", deployment=self.dep_id,
                                    replica_id=r.replica_id,
@@ -266,7 +467,10 @@ class _DeploymentState:
         if now - self._last_metrics_poll >= ac.metrics_interval_s:
             self._last_metrics_poll = now
             self._poll_replica_metrics()
-        running = [r for r in self.replicas if r.state == RUNNING]
+        # warned/self-draining replicas are already-lost capacity: they
+        # accept no new work, so counting them in `current` would both
+        # understate per-replica load and delay the replacement decision
+        running = [r for r in self._live() if r.state == RUNNING]
         if not running:
             return
         # Handle-side metrics (queued + in-flight at routers) capture demand
@@ -351,28 +555,48 @@ class _DeploymentState:
         used = {r.slot for r in self.replicas}
         slot = next(i for i in range(len(self.replicas) + 1)
                     if i not in used)
-        handle = ray_tpu.remote(ReplicaActor).options(
-            name=actor_name, namespace="serve",
-            max_concurrency=cap + 8,    # headroom for health/metrics calls
-            max_restarts=0,             # controller replaces, not restarts
-            **opts,
-        ).remote(self.dep_id, rid, self.spec["user_callable"],
-                 self.spec.get("init_args") or (),
-                 self.spec.get("init_kwargs") or {},
-                 self.config.user_config, slot)
-        ready_ref = handle.ready.remote()
-        self.replicas.append(_Replica(rid, actor_name, handle, ready_ref,
-                                      slot))
+        pg_id, requested_ts = self._create_capacity_pg(slot)
+        # tenant apps: label the replica's actor lease with the job so
+        # lease-side usage gossip attributes it to the right tenant
+        from ray_tpu.util import jobs as _jobs
 
-    def _begin_stop(self, r: _Replica):
-        r.state = STOPPING
+        prev_job = _jobs.current_job()
+        if self.job:
+            _jobs.set_current_job(self.job)
         try:
-            r.drain_ref = r.handle.prepare_for_shutdown.remote(
-                self.config.graceful_shutdown_timeout_s)
+            handle = ray_tpu.remote(ReplicaActor).options(
+                name=actor_name, namespace="serve",
+                max_concurrency=cap + 8,  # headroom for health/metrics calls
+                max_restarts=0,           # controller replaces, not restarts
+                **opts,
+            ).remote(self.dep_id, rid, self.spec["user_callable"],
+                     self.spec.get("init_args") or (),
+                     self.spec.get("init_kwargs") or {},
+                     self.config.user_config, slot)
+        finally:
+            if self.job:
+                _jobs.set_current_job(prev_job)
+        ready_ref = handle.ready.remote()
+        r = _Replica(rid, actor_name, handle, ready_ref, slot)
+        r.capacity_pg_id = pg_id
+        r.pg_requested_ts = requested_ts
+        self.replicas.append(r)
+
+    def _begin_stop(self, r: _Replica, deadline_s: float | None = None):
+        """``deadline_s`` caps the drain budget (the preemption grace
+        window remaining) — the drain must finish, and the warned gang
+        be removed, BEFORE the fire for the controlled-drain no-op."""
+        r.state = STOPPING
+        budget = self.config.graceful_shutdown_timeout_s
+        slack = 1.0
+        if deadline_s is not None:
+            budget = min(budget, max(0.05, deadline_s - 0.05))
+            slack = 0.2
+        try:
+            r.drain_ref = r.handle.prepare_for_shutdown.remote(budget)
         except Exception:
             r.drain_ref = None
-        r.drain_deadline = (time.monotonic()
-                            + self.config.graceful_shutdown_timeout_s + 1.0)
+        r.drain_deadline = time.monotonic() + budget + slack
 
     def _drop(self, r: _Replica, reason: str = "death"):
         """Immediate removal (failed init / failed health check)."""
@@ -389,6 +613,17 @@ class _DeploymentState:
             ray_tpu.kill(r.handle)
         except Exception:
             pass
+        if r.capacity_pg_id is not None:
+            # release the capacity claim: removing a warned gang
+            # PRE-FIRE no-ops the pending fire, and either way
+            # _maybe_schedule_pending(force) hands the freed capacity to
+            # queued gangs (the training job resumes here)
+            try:
+                self._gcs_call("remove_placement_group",
+                               pg_id=r.capacity_pg_id)
+            except Exception:
+                pass
+            r.capacity_pg_id = None
         if r in self.replicas:
             self.replicas.remove(r)
 
@@ -397,16 +632,30 @@ class _DeploymentState:
         entries = [{"replica_id": r.replica_id, "actor_name": r.actor_name,
                     "actor_id": r.actor_id_hex}
                    for r in self.replicas if r.state == RUNNING]
+        # draining replicas (scale-down or preemption-warned): routers
+        # drop them from selection proactively and use the latest drain
+        # deadline as the shed retry-after hint (wall-clock so it
+        # crosses processes)
+        now_wall, now_mono = time.time(), time.monotonic()
+        draining = [{"replica_id": r.replica_id,
+                     "deadline_ts": (r.warn_deadline if r.warn_deadline
+                                     else now_wall + max(
+                                         0.0, (r.drain_deadline or now_mono)
+                                         - now_mono))}
+                    for r in self.replicas if r.state == STOPPING]
         self.host.notify_changed(
             replicas_key(self.dep_id),
             {"replicas": entries,
+             "draining": draining,
              "max_ongoing_requests": self.config.max_ongoing_requests,
              "max_queued_requests": self.config.max_queued_requests})
 
     def _set_replica_gauges(self):
         counts = {s: 0 for s in (STARTING, RUNNING, STOPPING)}
+        warned = 0
         for r in self.replicas:
             counts[r.state] = counts.get(r.state, 0) + 1
+            warned += bool(r.warned)
         for state, n in counts.items():
             _tm.gauge_set("ray_tpu_serve_replicas_tasks", n,
                           tags={"deployment": self.dep_id,
@@ -414,6 +663,8 @@ class _DeploymentState:
         _tm.gauge_set("ray_tpu_serve_replicas_tasks",
                       0 if self.deleting else self.target_num,
                       tags={"deployment": self.dep_id, "state": "target"})
+        _tm.gauge_set("ray_tpu_serve_warned_replicas_tasks", warned,
+                      tags={"deployment": self.dep_id})
 
     def status(self) -> dict:
         return {
@@ -422,9 +673,11 @@ class _DeploymentState:
                        "HEALTHY" if self._num_running() >= self.target_num
                        else "UPDATING"),
             "target_num_replicas": self.target_num,
+            "job": self.job,
             "replica_states": {
                 s: sum(1 for r in self.replicas if r.state == s)
                 for s in (STARTING, RUNNING, STOPPING)},
+            "warned_replicas": sum(1 for r in self.replicas if r.warned),
         }
 
     def _num_running(self):
@@ -473,11 +726,31 @@ class ServeController:
         return self._http_options
 
     def deploy_application(self, app_spec: dict):
-        """app_spec: {name, route_prefix, ingress, deployments: [dep specs]}
-        Each dep spec: {name, user_callable, init_args, init_kwargs, config,
-        version}."""
+        """app_spec: {name, route_prefix, ingress, deployments: [dep specs],
+        job?, job_quota?, job_priority?}. Each dep spec: {name,
+        user_callable, init_args, init_kwargs, config, version}.
+
+        ``job`` makes the app a first-class tenant: the controller
+        registers it in the job plane (idempotent — quota/priority update
+        in place on redeploy) and every replica's capacity rides a
+        job-labeled gang."""
         with self._lock:
             name = app_spec["name"]
+            job = str(app_spec.get("job") or "")
+            if job:
+                try:
+                    _worker_gcs_call(
+                        "register_job", name=job,
+                        quota=app_spec.get("job_quota"),
+                        priority=app_spec.get("job_priority"))
+                    _events.record("SERVE_APP_REGISTERED", app=name,
+                                   job=job,
+                                   priority=app_spec.get("job_priority"),
+                                   quota=app_spec.get("job_quota"))
+                except Exception:
+                    # degraded (no job plane): the app still runs, its
+                    # gangs carry the label with default policy
+                    pass
             new_deps = {}
             for dep in app_spec["deployments"]:
                 dep_id = make_dep_id(name, dep["name"])
@@ -494,14 +767,16 @@ class ServeController:
                 if dep_id in self._deployments and \
                         not self._deployments[dep_id].deleting:
                     self._deployments[dep_id].update_spec(dep)
+                    self._deployments[dep_id].job = job
                 else:
                     self._deployments[dep_id] = _DeploymentState(
-                        dep_id, dep, self._host)
+                        dep_id, dep, self._host, job=job)
                 self._deployments[dep_id].broadcast()
             self._apps[name] = {
                 "route_prefix": app_spec.get("route_prefix"),
                 "ingress": make_dep_id(name, app_spec["ingress"]),
                 "deployment_ids": list(new_deps),
+                "job": job,
             }
             self._broadcast_routes()
         return True
@@ -533,6 +808,7 @@ class ServeController:
                 out[app_name] = {
                     "route_prefix": app["route_prefix"],
                     "ingress": app["ingress"],
+                    "job": app.get("job", ""),
                     "status": ("RUNNING" if states and
                                all(s == "HEALTHY" for s in states)
                                else "DEPLOYING"),

@@ -43,13 +43,11 @@ class ReplicaActor:
         # deployment-wide rule kills every replica in synchronized waves
         try:
             from ray_tpu._private import fault_injection as _fi
+            from ray_tpu.serve._private.constants import dep_tag, slot_tag
 
-            tag = "serve-" + "".join(
-                c if c.isalnum() or c in "-_" else "-"
-                for c in deployment_id)
-            _fi.add_tag(tag)
+            _fi.add_tag(dep_tag(deployment_id))
             if slot is not None:
-                _fi.add_tag(f"{tag}-slot{slot}")
+                _fi.add_tag(slot_tag(deployment_id, slot))
         except Exception:
             pass
         if isinstance(user_callable, type):

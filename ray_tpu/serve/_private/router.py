@@ -69,6 +69,11 @@ class Router:
         # used as a set so the overflow trim drops the OLDEST ids (ids
         # never recur, so old entries are safe to forget)
         self._dead: dict[str, None] = {}
+        # replicas the controller broadcast as DRAINING (scale-down or
+        # preemption-warned): rid → wall-clock drain deadline. Drives
+        # both proactive de-selection and the shed retry-after hint
+        # (back off past the grace window, not the static default)
+        self._draining: dict[str, float] = {}
         # stable identity for controller-side demand bookkeeping: id(self)
         # collides across processes (proxy vs driver handles)
         self._router_id = uuid.uuid4().hex
@@ -99,10 +104,12 @@ class Router:
 
         if info is None:
             entries, cap, queued_cap = [], self._max_ongoing, self._max_queued
+            draining = []
         else:
             entries = info["replicas"]
             cap = info["max_ongoing_requests"]
             queued_cap = info.get("max_queued_requests", self._max_queued)
+            draining = info.get("draining") or []
         with self._lock:
             missing = [(e["replica_id"], e["actor_name"]) for e in entries
                        if e["replica_id"] not in self._replicas
@@ -131,6 +138,14 @@ class Router:
                 if rid not in seen:
                     del self._replicas[rid]
             self._actor_to_replica = actor_map
+            import time as _time
+
+            now = _time.time()
+            self._draining = {
+                d["replica_id"]: float(d["deadline_ts"])
+                for d in draining if float(d["deadline_ts"]) > now}
+            for rid in self._draining:
+                self._replicas.pop(rid, None)
             self._lock.notify_all()
         self._ensure_death_watch()
 
@@ -187,7 +202,7 @@ class Router:
                 # instead of shedding traffic the deployment could serve
                 # a few ms later.
                 if self._replicas and self._num_queued >= cap:
-                    self._shed(cap)
+                    self._shed_locked(cap)
                 self._num_queued += 1
                 try:
                     while True:
@@ -225,19 +240,35 @@ class Router:
         being shed against a capacity of zero.)"""
         return self._max_queued * max(1, len(self._replicas))
 
-    def _shed(self, cap: int):
+    def _shed_locked(self, cap: int):
         """Reject one request at admission (caller holds the lock)."""
+        import time as _time
+
         queued = self._num_queued
-        # retry-after: half a max_ongoing drain at ~10 rps per replica is
-        # a crude but bounded hint; clients with real latency knowledge
-        # should use their own backoff
-        retry_after = max(0.1, min(5.0, 0.05 * (1 + queued)))
+        # drain-aware backoff: when replicas are preemption-warned (or
+        # scale-down-draining), the shed is a capacity STORM, not a load
+        # blip — hint the grace window remaining so clients back off
+        # past it instead of hammering a draining app
+        now = _time.time()
+        self._draining = {rid: dl for rid, dl in self._draining.items()
+                          if dl > now}
+        drain_deadline = max(self._draining.values(), default=None)
+        if drain_deadline is not None:
+            retry_after = max(0.1, min(30.0, drain_deadline - now + 0.25))
+            draining = True
+        else:
+            # half a max_ongoing drain at ~10 rps per replica is a crude
+            # but bounded hint; clients with real latency knowledge
+            # should use their own backoff
+            retry_after = max(0.1, min(5.0, 0.05 * (1 + queued)))
+            draining = False
         _tm.counter_inc("ray_tpu_serve_shed_total",
                         tags={"deployment": self._deployment_id})
         _events.record("REQUEST_SHED", deployment=self._deployment_id,
                        queued=queued, queue_capacity=cap,
-                       retry_after_s=retry_after)
-        raise ServeOverloadedError(self._deployment_id, queued, retry_after)
+                       retry_after_s=retry_after, draining=draining)
+        raise ServeOverloadedError(self._deployment_id, queued, retry_after,
+                                   draining)
 
     def mark_replica_dead(self, replica_id: str):
         """Drop a replica observed dead (GCS death feed, or a caller's

@@ -183,7 +183,9 @@ def start(http_options: HTTPOptions | dict | None = None, **kwargs):
         return controller
 
 
-def _build_app_spec(target: Application, name: str, route_prefix: str | None):
+def _build_app_spec(target: Application, name: str, route_prefix: str | None,
+                    job: str | None = None, job_quota: dict | None = None,
+                    job_priority: int | None = None):
     """Flatten the bind tree into deployment specs; nested Application args
     become DeploymentHandles (reference: deployment_graph_build.py)."""
     deployments: dict[str, dict] = {}
@@ -220,14 +222,29 @@ def _build_app_spec(target: Application, name: str, route_prefix: str | None):
         "route_prefix": route_prefix,
         "ingress": ingress,
         "deployments": [d for d in deployments.values() if d],
+        "job": job or "",
+        "job_quota": job_quota,
+        "job_priority": job_priority,
     }
 
 
 def run(target: Application, *, name: str = DEFAULT_APP_NAME,
         route_prefix: str | None = "/", blocking: bool = False,
+        job: str | None = None, job_quota: dict | None = None,
+        job_priority: int | None = None,
         _timeout_s: float = 60.0) -> DeploymentHandle:
     """Deploy an application and wait until healthy (reference:
-    serve/api.py:455)."""
+    serve/api.py:455).
+
+    ``job`` makes the app a first-class TENANT of the multi-tenant
+    scheduling plane (``ray_tpu.util.jobs``): the controller registers
+    the job with ``job_quota``/``job_priority`` (idempotent — ``None``
+    keeps existing policy) and backs every replica with a job-labeled
+    capacity placement group named by its slot tag. A traffic spike on a
+    high-priority app then claims capacity THROUGH the plane — up to and
+    including preempting a lower-priority training gang — and scale-down
+    drains replicas through the preemption-warning machinery, returning
+    the capacity when the spike passes."""
     import ray_tpu
 
     if isinstance(target, Deployment):
@@ -236,7 +253,8 @@ def run(target: Application, *, name: str = DEFAULT_APP_NAME,
         raise TypeError(f"serve.run expects an Application (from .bind()), "
                         f"got {type(target)}")
     controller = start()
-    spec = _build_app_spec(target, name, route_prefix)
+    spec = _build_app_spec(target, name, route_prefix,
+                           job, job_quota, job_priority)
     ray_tpu.get(controller.deploy_application.remote(spec))
     # wait for the app to report RUNNING
     deadline = time.monotonic() + _timeout_s

@@ -87,8 +87,13 @@ def list_jobs() -> list[dict]:
     return _gcs_call("list_jobs")
 
 
-def preempt_job(name: str, grace_s: float | None = None) -> str | None:
+def preempt_job(name: str, grace_s: float | None = None,
+                pg_name: str | None = None) -> str | None:
     """Force-preempt the named job's newest running gang (admin escape
     hatch; also what the fault DSL's ``preempt_job`` primitive drives).
-    Returns the victim placement group id hex, or None."""
-    return _gcs_call("preempt_job", name=name, grace_s=grace_s)
+    ``pg_name`` narrows the victim to the job's gang of that name —
+    how the Serve controller drains ONE replica's capacity through the
+    warning machinery instead of whichever gang is newest. Returns the
+    victim placement group id hex, or None."""
+    return _gcs_call("preempt_job", name=name, grace_s=grace_s,
+                     pg_name=pg_name)

@@ -316,6 +316,36 @@ def test_infeasible_high_pri_does_not_preempt_or_block(gcs):
     assert gcs.state_of(_pgid("lo-1")) == "CREATED"   # never preempted
 
 
+def test_preempt_freed_ledger_consumed_by_post_fire_report(gcs):
+    """Review pin on `_preempt_freed` accounting direction: a raylet
+    report taken BEFORE a fire gets the freed bundles added back (the
+    fire-boundary over-preemption fix), but the node's first POST-fire
+    report already includes them — adding them again would over-commit
+    (the scheduler admitting a gang onto capacity that does not exist).
+    The entry is consumed per node by that first post-fire report and
+    stays consumed even when later reports show the capacity taken."""
+    gcs.add_node("n1", cpu=4.0)
+    node = gcs.nodes["n1"]
+    # pre-fire report: node completely full
+    gcs.rpc_report_resources(_Conn(), node_id="n1", available={"CPU": 0.0})
+    time.sleep(0.01)
+    gcs._preempt_freed.append(
+        (time.time(), [{"CPU": 4.0}], ["n1"], set()))
+    avail = gcs._node_available_for_pg(node)
+    assert avail.get("CPU", 0) == 4.0, \
+        "report predating the fire must get the freed bundles added back"
+    # post-fire report: the raylet's availability shows the freed CPUs
+    time.sleep(0.01)
+    gcs.rpc_report_resources(_Conn(), node_id="n1", available={"CPU": 4.0})
+    avail = gcs._node_available_for_pg(node)
+    assert avail.get("CPU", 0) == 4.0, \
+        "freed bundles a post-fire report already shows were added AGAIN"
+    # a later report showing the capacity re-taken must not resurrect it
+    gcs.rpc_report_resources(_Conn(), node_id="n1", available={"CPU": 1.0})
+    avail = gcs._node_available_for_pg(node)
+    assert avail.get("CPU", 0) == 1.0
+
+
 # ------------------------------------------------------------- fault DSL
 
 def test_preempt_job_dsl_determinism():

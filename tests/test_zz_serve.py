@@ -125,6 +125,8 @@ def test_autoscale_hysteresis_sustain_before_scale():
     class _R:
         state = RUNNING
         num_ongoing = 0.0
+        warned = False
+        drain_requested = False
 
     ds.replicas = [_R()]
     ds._last_metrics_poll = time.monotonic()   # suppress replica polling
