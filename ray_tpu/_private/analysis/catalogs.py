@@ -122,15 +122,23 @@ def metric_catalog_pass(ctx: AnalysisContext):
     suffix_re = "|".join(s.lstrip("_") for s in ALLOWED_SUFFIXES)
     pat = re.compile(r"""["'](ray_tpu_[a-z0-9_]+_(?:%s))["']"""
                      % suffix_re)
+    # memory-anatomy families are additionally linted BY PREFIX: a
+    # ``ray_tpu_store_*`` / ``ray_tpu_train_state_*`` literal must be
+    # cataloged even when it lacks a recognized unit suffix — a typo'd
+    # suffix on these names must fail loudly, not slip past the lint
+    prefix_pat = re.compile(
+        r"""["'](ray_tpu_(?:store|train_state)_[a-z0-9_]+)["']""")
     for mod in ctx.package_modules():
         if mod.path == TELEMETRY_PY:
             continue
         for i, line in enumerate(mod.source.splitlines(), start=1):
-            for m in pat.finditer(line):
-                if m.group(1) not in CATALOG:
+            hits = {m.group(1) for m in pat.finditer(line)}
+            hits.update(m.group(1) for m in prefix_pat.finditer(line))
+            for name in sorted(hits):
+                if name not in CATALOG:
                     yield Finding(
-                        "RTC401", mod.path, i, m.group(1),
-                        f"internal metric {m.group(1)!r} is not "
+                        "RTC401", mod.path, i, name,
+                        f"internal metric {name!r} is not "
                         f"declared in _private/telemetry.py CATALOG")
 
     # grafana: the default dashboard may only chart cataloged metrics

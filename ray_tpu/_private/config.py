@@ -39,6 +39,24 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "inline_object_max_size_bytes": 100 * 1024,  # small results ride the RPC reply
     "object_transfer_chunk_bytes": 4 * 1024 * 1024,
     "pull_max_inflight_bytes": 256 * 1024 * 1024,  # pull admission control
+    # --- memory anatomy (_private/memory_anatomy.py) ---
+    # Leak-sweep grace window: objects younger than this are referenced
+    # by definition (an in-flight collective segment between put and
+    # consume must not classify as a leak).
+    "memory_sweep_grace_s": 5.0,
+    # Periodic background sweep cadence per worker process (0 disables
+    # the timer; sweeps still run on demand from summarize_memory /
+    # the flight recorder / the memory-snapshot RPC).
+    "memory_sweep_interval_s": 30.0,
+    # Bounded provenance-op ring per process (the flight recorder's
+    # memory.jsonl window).
+    "memory_ring_size": 2048,
+    # Bounded best-effort re-send of free fan-outs on the one-way
+    # owner→GCS→raylet delete pipeline: when the GCS finds no live
+    # raylet connection for a holder node, retry the push once after
+    # re-resolving the connection (the counted drop otherwise strands
+    # the object until the leak sweep names it). 0 disables.
+    "store_free_resend": 1,
     # --- lineage / reconstruction ---
     "max_lineage_bytes": 64 * 1024 * 1024,  # retained task specs for rebuild
     # --- fault tolerance ---

@@ -104,6 +104,13 @@ Event kinds recorded by the runtime:
                      ranks per stage, microbatches, schedule, and the
                      per-stage slice placement reported by the
                      SPREAD_ACROSS_SLICES scheduler.
+- ``STORE_LEAK``   — the memory-anatomy leak sweep classified a live
+                     store object as orphaned
+                     (_private/memory_anatomy.py): the full provenance
+                     record (oid, category, nbytes, creator pid,
+                     group/epoch/rank) plus the reason
+                     (``owner_dead`` / ``group_destroyed`` /
+                     ``epoch_stale``). Emitted once per object.
 - ``PUBSUB_RESYNC`` — a long-poll subscriber detected a feed gap
                      (mailbox overflow / publisher GC) and reconverged
                      from the channel's state snapshot

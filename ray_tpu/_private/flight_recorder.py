@@ -142,6 +142,14 @@ def local_snapshot(window: float | None = None) -> dict:
         out["metrics"] = registry_snapshot()
     except Exception:
         out["metrics"] = []
+    try:
+        from ray_tpu._private import memory_anatomy as _ma
+
+        # ring cut to the dump window: a leak post-mortem reads the
+        # put/delete history around the incident, not process lifetime
+        out["memory"] = _ma.local_snapshot(top_k=10, window_s=window)
+    except Exception:
+        out["memory"] = {}
     return out
 
 
@@ -253,6 +261,23 @@ def dump(reason: str, *, address: str | None = None,
                                default=str) + "\n")
     with open(os.path.join(path, "timeline.json"), "w") as f:
         json.dump(merged_timeline(snaps), f)
+    with open(os.path.join(path, "memory.jsonl"), "w") as f:
+        # one line per process: ledger summary row + its recent
+        # put/delete ring rows (the leak post-mortem's provenance feed)
+        for s in snaps:
+            mem = s.get("memory") or {}
+            if not mem:
+                continue
+            summary = {k: v for k, v in mem.items() if k != "ring"}
+            f.write(json.dumps({"table": "memory_summary",
+                                "node": s.get("node"),
+                                "pid": s.get("pid"), **summary},
+                               default=str) + "\n")
+            for row in mem.get("ring", ()):
+                f.write(json.dumps({"table": "memory_ring",
+                                    "node": s.get("node"),
+                                    "pid": s.get("pid"), **row},
+                                   default=str) + "\n")
     with _lock:
         _last_dump_path = path
     from ray_tpu._private import events as _events

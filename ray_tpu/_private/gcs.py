@@ -1001,11 +1001,57 @@ class GcsServer:
                 self.object_sizes.pop(oid, None)
             conns = {c.meta.get("node_id"): c
                      for c in self._server.connections()}
+        retry: list[tuple[str, list[bytes]]] = []
         for node_id, oids in targets.items():
             c = conns.get(node_id)
-            if c is not None:
+            if c is None:
+                retry.append((node_id, oids))
+                continue
+            try:
                 c.push("free_objects", object_ids=oids)
+            except Exception:
+                retry.append((node_id, oids))
+        if retry:
+            self._retry_free_fanout(retry)
         return True
+
+    def _retry_free_fanout(self, retry: list):
+        """The fan-out hop of the free pipeline is one-way: a missing or
+        broken raylet connection silently strands the objects on their
+        holder node. Count every such drop (the
+        `ray_tpu_store_frees_dropped_total{stage=gcs_fanout}` smoking
+        gun), and — behind config `store_free_resend` — re-resolve the
+        connection and re-push ONCE, best-effort (the leak sweep remains
+        the backstop for deletes this still loses)."""
+        from ray_tpu._private import telemetry as _tm
+        from ray_tpu._private.config import get_config
+
+        resend = 0
+        try:
+            resend = int(get_config("store_free_resend"))
+        except Exception:
+            pass
+        if resend > 0:
+            with self._lock:
+                conns = {c.meta.get("node_id"): c
+                         for c in self._server.connections()}
+            still: list = []
+            for node_id, oids in retry:
+                c = conns.get(node_id)
+                if c is None:
+                    still.append((node_id, oids))
+                    continue
+                try:
+                    c.push("free_objects", object_ids=oids)
+                    _tm.counter_inc("ray_tpu_store_free_resends_total",
+                                    float(len(oids)))
+                except Exception:
+                    still.append((node_id, oids))
+            retry = still
+        dropped = sum(len(oids) for _, oids in retry)
+        if dropped:
+            _tm.counter_inc("ray_tpu_store_frees_dropped_total",
+                            float(dropped), tags={"stage": "gcs_fanout"})
 
     # ---- actors ------------------------------------------------------------
 

@@ -111,6 +111,22 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _k("LEASE_SOFT_CAP", "0", "int",
        "max concurrent worker leases per node; 0 = auto (2x cluster "
        "CPUs)."),
+    _k("MEMORY_RING_SIZE", "2048", "int",
+       "memory anatomy: bounded provenance-op ring per process (the "
+       "window the flight recorder's memory.jsonl covers)."),
+    _k("MEMORY_SWEEP_GRACE_S", "5.0", "float",
+       "memory anatomy: leak-sweep grace window — store objects younger "
+       "than this are referenced by definition (an in-flight collective "
+       "segment between put and consume must not classify as a leak)."),
+    _k("MEMORY_SWEEP_INTERVAL_S", "30.0", "float",
+       "memory anatomy: periodic background leak-sweep cadence per "
+       "worker; 0 disables the timer (sweeps still run on demand from "
+       "summarize_memory / the flight recorder)."),
+    _k("STORE_FREE_RESEND", "1", "int",
+       "bounded re-send of a dropped object-store free: one retry of a "
+       "GCS free fan-out with no live holder connection, and of an "
+       "ephemeral delete that lands while the segment is still pinned; "
+       "every drop is counted either way. 0 disables the retry."),
     _k("STORE_SIZE", "268435456", "int",
        "shm object store size in bytes for a spawned node."),
     _k("TRAIN_GRAD_BUCKET_BYTES", "4194304", "int",

@@ -1,0 +1,649 @@
+"""Memory anatomy — store-side provenance ledger + leak attribution.
+
+Every plane composes under adversity, but until this module nobody
+could say *where the bytes live*: the shm store serves collective
+segments, serve weights, data-staging blocks, and task args with zero
+per-owner accounting, and the fire-and-forget free pipeline
+(owner → GCS → raylet, all one-way pushes) loses deletes silently.
+This module gives every store object a provenance record and every
+lost free a counter:
+
+- **Ledger** (one per process, ``LEDGER``): every ``put`` /
+  ``put_ephemeral`` / pin / ``delete`` on ``StoreClient`` stamps a
+  :class:`Record` — creator (node, pid, task/actor id), category
+  (``task_arg | task_return | collective_segment | serve_weights |
+  data_staging | checkpoint | other``), owning group/consumer tag, and
+  byte size — into a live-object index plus a bounded ring of recent
+  ops (the flight recorder's ``memory.jsonl`` window).
+- **Category attribution**: call sites that know what they are putting
+  wrap the store op in :func:`tagged` (collective ``_push_seg``, serve
+  ``_publish_or_adopt``, data ``_stage``, the worker's task-arg /
+  task-return paths); objects that arrive untagged fall back to the
+  oid-layout classifier (``\\xc0…`` = collective segment, ``dstrm…`` =
+  data staging — the layouts host_backend / the streaming executor
+  mint).
+- **Leak sweep** (:meth:`Ledger.sweep`): reconciles the ledger against
+  the store server's actual live set (``list_objects`` — deletes by
+  OTHER processes prune records here) and classifies each survivor as
+  referenced vs **orphaned**: creator process dead, collective group
+  destroyed, or group epoch stale. Orphans emit one ``STORE_LEAK``
+  event each (once per object, with the full provenance record in the
+  payload) and the ``ray_tpu_store_orphan_bytes`` gauge.
+- **Dropped frees**: the three one-way hops of the free pipeline count
+  their losses here (``note_free_dropped`` →
+  ``ray_tpu_store_frees_dropped_total{stage=owner_push | gcs_fanout |
+  raylet_delete | ephemeral_pinned}``) — the
+  ``test_shm_segment_transport_oracle`` flake's smoking gun, finally on
+  a counter (its root cause, a forwarding hop's pin racing the last
+  consumer's delete, is fixed in host_backend._forward; the counter
+  remains the tripwire for any recurrence).
+- **Train-state accounting**: ``make_train_state`` /
+  ``sync_gradients`` report exact per-rank byte sums from the
+  deterministic flatten (``ray_tpu_train_state_bytes{kind, rank}``) —
+  the gauge the ZeRO arc will diff before/after sharding.
+
+Kill switch: everything here guards on ``telemetry.ENABLED``
+(``RAY_TPU_INTERNAL_TELEMETRY=0``) and is a no-op when disabled. Hooks
+never raise: accounting must not be able to fail a put. The hot-path
+cost is one thread-local read + two dict updates per op (the overhead
+guard in tests/test_zz_memory_anatomy.py pins it <5% of a store
+round-trip).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ray_tpu._private import telemetry as _tm
+
+CATEGORIES = ("task_arg", "task_return", "collective_segment",
+              "serve_weights", "data_staging", "checkpoint", "other")
+
+# oid-layout fallbacks (for objects put by an untagged/foreign path):
+# host_backend mints collective segment ids as
+# col_oid_prefix(group)=b"\xc0"+blake2b(name)[:5], the streaming
+# executor stages under b"dstrm"+urandom. Serve weights and task ids
+# are opaque (sha256 / urandom) — those rely on call-site tags.
+_COL_PREFIX = b"\xc0"
+_DATA_PREFIX = b"dstrm"
+
+_tls = threading.local()
+
+
+class tagged:
+    """Context manager a call site wraps around its store ops so the
+    ledger records *what* the bytes are, not just that they exist::
+
+        with memory_anatomy.tagged("collective_segment", group=name,
+                                   epoch=epoch, rank=rank):
+            store.put_ephemeral(oid, parts)
+
+    Plain-class (not ``@contextmanager``) to keep the hot path one
+    attribute write each way. Nests; inner tag wins."""
+
+    __slots__ = ("_tag", "_prev")
+
+    def __init__(self, category: str, **prov):
+        self._tag = (category, prov)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "tag", None)
+        _tls.tag = self._tag
+        return self
+
+    def __exit__(self, *exc):
+        _tls.tag = self._prev
+        return False
+
+
+class default_tag(tagged):
+    """``tagged`` that YIELDS to an already-active tag: the worker's
+    task-arg/task-return paths use it so an outer caller-provided
+    category (e.g. ``checkpoint``) survives the inner store op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "tag", None)
+        if self._prev is None:
+            _tls.tag = self._tag
+        return self
+
+
+def current_tag():
+    return getattr(_tls, "tag", None)
+
+
+def classify_oid(oid: bytes) -> str:
+    """Category from the oid layout alone (the untagged fallback)."""
+    if oid[:1] == _COL_PREFIX:
+        return "collective_segment"
+    if oid.startswith(_DATA_PREFIX):
+        return "data_staging"
+    return "other"
+
+
+def parse_col_oid(oid: bytes) -> tuple:
+    """(group_hash_hex, epoch, rank) from a collective-segment oid —
+    the 16-byte layout host_backend mints is tag(6) + epoch(4) +
+    rank(2) + counter(4), so provenance survives even without a ledger
+    record (e.g. the putter was another process)."""
+    if len(oid) != 16 or oid[:1] != _COL_PREFIX:
+        return (None, None, None)
+    return (oid[:6].hex(), int.from_bytes(oid[6:10], "big"),
+            int.from_bytes(oid[10:12], "big"))
+
+
+class Record:
+    """Provenance of one live store object, as stamped at put time."""
+
+    __slots__ = ("oid", "category", "nbytes", "node", "pid", "owner",
+                 "group", "epoch", "rank", "created", "pins")
+
+    def __init__(self, oid, category, nbytes, node, pid, owner,
+                 group, epoch, rank, created):
+        self.oid = oid
+        self.category = category
+        self.nbytes = nbytes
+        self.node = node
+        self.pid = pid
+        self.owner = owner          # task/actor/consumer tag (or None)
+        self.group = group          # collective group / serve key / stage
+        self.epoch = epoch
+        self.rank = rank
+        self.created = created
+        self.pins = 0
+
+    def to_dict(self) -> dict:
+        return {"oid": self.oid.hex(), "category": self.category,
+                "nbytes": self.nbytes, "node": self.node,
+                "pid": self.pid, "owner": self.owner,
+                "group": self.group, "epoch": self.epoch,
+                "rank": self.rank, "created": self.created,
+                "pins": self.pins}
+
+
+class Ledger:
+    """Per-process provenance ledger over this process's StoreClient
+    traffic: live index + bounded op ring + dropped-free counters +
+    train-state byte accounting. Thread-safe; every public method is
+    exception-free by construction (accounting never fails a put)."""
+
+    def __init__(self, ring_size: int | None = None):
+        self._lock = threading.Lock()
+        self._live: dict[bytes, Record] = {}
+        self._ring: list = []            # bounded [(ts, op, seq, Record)]
+        self._ring_size = ring_size      # None: config memory_ring_size,
+        #                                  resolved on first push
+        self._ring_seq = 0
+        self._cat_bytes: dict[str, int] = {}
+        self._cat_objects: dict[str, int] = {}
+        self._dropped_frees: dict[str, int] = {}
+        self._train_state: dict[tuple, int] = {}   # (kind, rank) -> bytes
+        self._inflight: dict[str, int] = {}        # rank -> bucket bytes
+        self._leaked: set[bytes] = set()           # STORE_LEAK emitted
+        self._orphans: list[dict] = []             # last sweep's verdicts
+        self._last_sweep = 0.0
+        # store objects with no ledger record in THIS process (put by
+        # another — possibly dead — process): classified by oid layout,
+        # aged from first sighting. Kept out of the category gauges (a
+        # node's N processes would each re-count the same bytes) — they
+        # exist purely so a SURVIVOR's sweep can name a dead putter's
+        # stranded segments.
+        self._foreign: dict[bytes, Record] = {}
+
+    # ------------------------------------------------------------- hooks
+    # Called from StoreClient under telemetry.ENABLED only. The lock is
+    # held for dict ops only; category gauges flush lazily at
+    # snapshot/sweep time (see _account).
+
+    def note_put(self, oid: bytes, nbytes: int, *, node=None, pid=None,
+                 ephemeral: bool = False):
+        try:
+            now = time.time()
+            tag = getattr(_tls, "tag", None)
+            if tag is not None:
+                category, prov = tag
+                owner = prov.get("owner")
+                group = prov.get("group")
+                epoch = prov.get("epoch")
+                rank = prov.get("rank")
+            else:
+                category = classify_oid(oid)
+                owner = group = epoch = rank = None
+            if category == "collective_segment" and group is None:
+                _, epoch, rank = parse_col_oid(oid)
+            rec = Record(oid, category, int(nbytes), node,
+                         pid if pid is not None else os.getpid(),
+                         owner, group, epoch, rank, now)
+            op = "put_ephemeral" if ephemeral else "put"
+            with self._lock:
+                prev = self._live.get(oid)
+                if prev is not None:      # overwrite (put_ephemeral
+                    self._account(prev, -1)  # EXISTS-recreate path)
+                self._live[oid] = rec
+                self._account(rec, +1)
+                self._ring_push(op, rec, now)
+        except Exception:
+            pass
+
+    def note_delete(self, oid: bytes):
+        try:
+            with self._lock:
+                self._foreign.pop(oid, None)
+                self._leaked.discard(oid)
+                rec = self._live.pop(oid, None)
+                if rec is None:
+                    return
+                self._account(rec, -1)
+                self._ring_push("delete", rec, time.time())
+        except Exception:
+            pass
+
+    def note_pin(self, oid: bytes):
+        try:
+            with self._lock:
+                rec = self._live.get(oid) or self._foreign.get(oid)
+                if rec is not None:
+                    rec.pins += 1
+        except Exception:
+            pass
+
+    def note_unpin(self, oid: bytes):
+        try:
+            with self._lock:
+                rec = self._live.get(oid) or self._foreign.get(oid)
+                if rec is not None and rec.pins > 0:
+                    rec.pins -= 1
+        except Exception:
+            pass
+
+    def note_free_dropped(self, stage: str, count: int = 1):
+        """One lost delete on the one-way free pipeline
+        (stage=owner_push|gcs_fanout|raylet_delete|ephemeral_pinned)."""
+        try:
+            with self._lock:
+                self._dropped_frees[stage] = \
+                    self._dropped_frees.get(stage, 0) + count
+            if _tm.ENABLED:
+                _tm.counter_inc("ray_tpu_store_frees_dropped_total",
+                                float(count), tags={"stage": stage})
+        except Exception:
+            pass
+
+    def note_train_state(self, kind: str, rank, nbytes: int):
+        """Exact per-rank train-state bytes from the deterministic
+        flatten (kind=params|grads|opt_state|bucket_inflight)."""
+        try:
+            with self._lock:
+                self._train_state[(kind, str(rank))] = int(nbytes)
+            if _tm.ENABLED:
+                _tm.gauge_set("ray_tpu_train_state_bytes", float(nbytes),
+                              tags={"kind": kind, "rank": str(rank)})
+        except Exception:
+            pass
+
+    def add_inflight(self, rank, delta: int):
+        """Bucket bytes currently on the wire (launched, not yet
+        harvested) — incremented at allreduce launch, decremented at
+        ``PendingGradSync.result``."""
+        try:
+            rank = str(rank)
+            with self._lock:
+                cur = max(0, self._inflight.get(rank, 0) + int(delta))
+                self._inflight[rank] = cur
+            self.note_train_state("bucket_inflight", rank, cur)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- internals
+
+    def _account(self, rec: Record, sign: int):
+        # lock held. Dict math only: the category GAUGES flush lazily in
+        # _flush_gauges (snapshot / sweep time, i.e. at worst one
+        # memory_sweep_interval_s stale on a scrape) — two gauge_set
+        # calls per store op would be ~half the put/get hot-path budget
+        # the overhead guard pins.
+        c = rec.category
+        b = self._cat_bytes
+        b[c] = b.get(c, 0) + sign * rec.nbytes
+        o = self._cat_objects
+        o[c] = o.get(c, 0) + sign
+
+    def _ring_push(self, op: str, rec: Record, now: float):
+        # lock held. The ring holds (ts, op, seq, Record) tuples —
+        # materializing the row dict here would double the hot-path
+        # cost; snapshot() renders them on read.
+        if self._ring_size is None:
+            self._ring_size = int(
+                _get_config_float("memory_ring_size", 2048.0))
+        self._ring_seq += 1
+        self._ring.append((now, op, self._ring_seq, rec))
+        if len(self._ring) > self._ring_size:
+            del self._ring[:len(self._ring) - self._ring_size]
+
+    def _flush_gauges(self):
+        # lock held
+        if not _tm.ENABLED:
+            return
+        for c, n in self._cat_bytes.items():
+            _tm.gauge_set("ray_tpu_store_bytes", float(max(0, n)),
+                          tags={"category": c, "state": "live"})
+        for c, n in self._cat_objects.items():
+            _tm.gauge_set("ray_tpu_store_objects", float(max(0, n)),
+                          tags={"category": c})
+
+    # ----------------------------------------------------------- sweep
+
+    def sweep(self, store=None, *, known_groups: dict | None = None,
+              poisoned: dict | None = None,
+              grace_s: float | None = None) -> list[dict]:
+        """Reconcile against the store's actual live set and classify
+        every surviving object as referenced vs orphaned. Returns the
+        orphan list (dict rows with a ``reason``); each NEW orphan oid
+        additionally emits one ``STORE_LEAK`` event with the full
+        provenance record.
+
+        ``known_groups`` maps live collective group name → epoch (the
+        worker runtime's ``_col_epochs``); when provided, collective
+        segments for a destroyed group / stale epoch classify as
+        orphaned even while their creator lives.
+        ``poisoned`` maps poisoned group name → dead-ranks tuple (the
+        worker's ``_col_poison``): a segment of a poisoned gang put by a
+        DEAD rank classifies ``owner_dead`` even though the sweeper
+        never saw the put (cross-process: the creator's ledger died with
+        it; the oid itself carries the rank).
+        ``grace_s`` (config ``memory_sweep_grace_s``) spares
+        just-created objects — an in-flight segment between put and
+        consume is referenced, not leaked."""
+        if grace_s is None:
+            grace_s = _get_config_float("memory_sweep_grace_s", 5.0)
+        now = time.time()
+        listed = None
+        if store is not None:
+            try:
+                listed = dict(store.list_objects())
+            except Exception:
+                listed = None
+        col_prefixes = {}
+        if known_groups:
+            for g, ep in known_groups.items():
+                col_prefixes[_col_prefix(g)] = (g, ep)
+        poison_prefixes = {}
+        if poisoned:
+            for g, dead_ranks in poisoned.items():
+                poison_prefixes[_col_prefix(g)] = (g, tuple(dead_ranks))
+        orphans: list[dict] = []
+        new_leaks: list[tuple] = []
+        with self._lock:
+            if listed is not None:
+                # deletes by other processes land here: prune records
+                # the store no longer holds
+                for oid in [o for o in self._live if o not in listed]:
+                    rec = self._live.pop(oid)
+                    self._account(rec, -1)
+                    self._leaked.discard(oid)
+                for oid in [o for o in self._foreign if o not in listed]:
+                    del self._foreign[oid]
+                    self._leaked.discard(oid)
+                for oid, nbytes in listed.items():
+                    if oid in self._live or oid in self._foreign:
+                        continue
+                    _, ep, rk = parse_col_oid(oid)
+                    self._foreign[oid] = Record(
+                        oid, classify_oid(oid), int(nbytes), None, None,
+                        None, None, ep, rk, now)
+            for oid, rec in list(self._live.items()) \
+                    + list(self._foreign.items()):
+                reason = self._classify(rec, now, grace_s, col_prefixes,
+                                        poison_prefixes,
+                                        known_groups is not None)
+                if reason is None:
+                    continue
+                row = rec.to_dict()
+                row["reason"] = reason
+                hit = col_prefixes.get(oid[:6]) \
+                    or poison_prefixes.get(oid[:6])
+                if row["group"] is None and hit is not None:
+                    row["group"] = hit[0]   # name the group even when
+                    #                         the putter was untagged
+                php = poison_prefixes.get(oid[:6])
+                if php is not None:
+                    row["dead_ranks"] = list(php[1])
+                orphans.append(row)
+                if oid not in self._leaked:
+                    self._leaked.add(oid)
+                    new_leaks.append(row)
+            self._orphans = orphans
+            self._last_sweep = now
+            self._flush_gauges()
+            by_cat: dict[tuple, int] = {}
+            for row in orphans:
+                key = (row["category"], row["reason"])
+                by_cat[key] = by_cat.get(key, 0) + row["nbytes"]
+        if _tm.ENABLED:
+            total = 0
+            for (cat, reason), nbytes in by_cat.items():
+                total += nbytes
+                _tm.gauge_set("ray_tpu_store_orphan_bytes", float(nbytes),
+                              tags={"category": cat, "reason": reason})
+            _tm.gauge_set("ray_tpu_store_orphan_bytes", float(total),
+                          tags={"category": "all", "reason": "all"})
+            for row in new_leaks:
+                _emit_store_leak(row)
+        return orphans
+
+    def _classify(self, rec: Record, now: float, grace_s: float,
+                  col_prefixes: dict, poison_prefixes: dict,
+                  groups_known: bool):
+        # lock held. None = referenced.
+        if rec.pins > 0:
+            return None
+        if now - rec.created < grace_s:
+            return None
+        if rec.pid is not None and rec.pid != os.getpid() \
+                and not _pid_alive(rec.pid):
+            return "owner_dead"
+        if rec.category == "collective_segment":
+            php = poison_prefixes.get(rec.oid[:6])
+            if php is not None:
+                # poisoned gang: the oid's rank field says who put it —
+                # a dead rank's segment has no owner left to free it
+                _group, dead_ranks = php
+                _, _, oid_rank = parse_col_oid(rec.oid)
+                if not dead_ranks or oid_rank is None \
+                        or oid_rank in dead_ranks:
+                    return "owner_dead"
+                return "group_destroyed"
+            if groups_known:
+                hit = col_prefixes.get(rec.oid[:6])
+                if hit is None:
+                    return "group_destroyed"
+                group, live_epoch = hit
+                _, oid_epoch, _ = parse_col_oid(rec.oid)
+                if oid_epoch is not None and \
+                        oid_epoch != (live_epoch % (1 << 32)):
+                    return "epoch_stale"
+        return None
+
+    # -------------------------------------------------------- snapshot
+
+    def snapshot(self, *, top_k: int = 10, window_s: float | None = None,
+                 ring: bool = True) -> dict:
+        """One process's ledger view — the fan-out unit behind
+        ``summarize_memory`` / ``/api/memory`` / the flight recorder's
+        ``memory.jsonl``."""
+        with self._lock:
+            self._flush_gauges()
+            cats = {c: {"bytes": max(0, self._cat_bytes.get(c, 0)),
+                        "objects": max(0, self._cat_objects.get(c, 0))}
+                    for c in set(self._cat_bytes) | set(self._cat_objects)
+                    if self._cat_bytes.get(c) or self._cat_objects.get(c)}
+            live = sorted(self._live.values(),
+                          key=lambda r: -r.nbytes)
+            top = [r.to_dict() for r in live[:top_k]]
+            ring_rows = []
+            if ring:
+                cutoff = (time.time() - window_s) if window_s else 0.0
+                ring_rows = [{"ts": ts, "op": op, "op_seq": seq,
+                              **rec.to_dict()}
+                             for (ts, op, seq, rec) in self._ring
+                             if ts >= cutoff]
+            return {
+                "pid": os.getpid(),
+                "categories": cats,
+                "live_objects": sum(
+                    max(0, n) for n in self._cat_objects.values()),
+                "live_bytes": sum(
+                    max(0, n) for n in self._cat_bytes.values()),
+                "top_owners": top,
+                "orphans": list(self._orphans),
+                "dropped_frees": dict(self._dropped_frees),
+                "train_state": {f"{k}:{r}": v for (k, r), v
+                                in self._train_state.items()},
+                "last_sweep": self._last_sweep,
+                "ring": ring_rows,
+            }
+
+    def reset(self):
+        """Test hook: drop all state (a fresh runtime in-process)."""
+        with self._lock:
+            self._live.clear()
+            self._ring.clear()
+            self._cat_bytes.clear()
+            self._cat_objects.clear()
+            self._dropped_frees.clear()
+            self._train_state.clear()
+            self._inflight.clear()
+            self._leaked.clear()
+            self._foreign.clear()
+            self._orphans = []
+
+
+def _emit_store_leak(row: dict):
+    try:
+        from ray_tpu._private import events
+
+        payload = dict(row)
+        # pid/node are reserved envelope keys in events.record (they
+        # would WIN over the payload's) — carry the CREATOR's under
+        # owner_* so the event names the dead owner, not the sweeper
+        payload["owner_pid"] = payload.pop("pid", None)
+        payload["owner_node"] = payload.pop("node", None)
+        events.record("STORE_LEAK", **payload)
+    except Exception:
+        pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except Exception:
+        return True   # permission error etc: assume alive (same-node
+        #               store means same-uid in practice)
+
+
+def _col_prefix(group: str) -> bytes:
+    from ray_tpu._private.worker_runtime import col_oid_prefix
+
+    return col_oid_prefix(group)
+
+
+def _get_config_float(name: str, default: float) -> float:
+    try:
+        from ray_tpu._private.config import get_config
+
+        return float(get_config(name))
+    except Exception:
+        return default
+
+
+# The process singleton every hook writes to. Import the MODULE and use
+# `memory_anatomy.LEDGER` (tests monkeypatch it for isolation).
+LEDGER = Ledger()
+
+
+def sweep_local(worker=None) -> list[dict]:
+    """Sweep this process's ledger against its worker's store + live
+    collective-group registry (the per-process unit the periodic sweep
+    and the snapshot RPC both call)."""
+    if worker is None:
+        try:
+            from ray_tpu._private.worker_runtime import current_worker
+
+            worker = current_worker()
+        except Exception:
+            worker = None
+    store = getattr(worker, "store", None) if worker is not None else None
+    groups = None
+    poisoned = None
+    if worker is not None:
+        col_epochs = getattr(worker, "_col_epochs", None)
+        if col_epochs is not None:
+            try:
+                groups = dict(col_epochs)
+            except Exception:
+                groups = None
+        col_poison = getattr(worker, "_col_poison", None)
+        if col_poison is not None:
+            try:
+                poisoned = {g: dr for g, (dr, _reason)
+                            in dict(col_poison).items()}
+            except Exception:
+                poisoned = None
+    return LEDGER.sweep(store, known_groups=groups, poisoned=poisoned)
+
+
+def local_snapshot(*, sweep: bool = True, top_k: int = 10,
+                   window_s: float | None = None) -> dict:
+    """Sweep-then-snapshot for RPC / flight-recorder consumption."""
+    if sweep and _tm.ENABLED:
+        try:
+            sweep_local()
+        except Exception:
+            pass
+    snap = LEDGER.snapshot(top_k=top_k, window_s=window_s)
+    snap["enabled"] = _tm.ENABLED
+    return snap
+
+
+_sweep_thread = None
+_sweep_stop = threading.Event()
+
+
+def start_periodic_sweep(worker) -> bool:
+    """Background leak sweep for a worker process (daemon thread;
+    cadence = config ``memory_sweep_interval_s``, 0 disables). Idempotent
+    per process; dies with it. No-op under the telemetry kill switch."""
+    global _sweep_thread
+    if not _tm.ENABLED:
+        return False
+    interval = _get_config_float("memory_sweep_interval_s", 30.0)
+    if interval <= 0:
+        return False
+    if _sweep_thread is not None and _sweep_thread.is_alive():
+        return True
+
+    def _loop():
+        while not _sweep_stop.wait(interval):
+            try:
+                sweep_local(worker)
+            except Exception:
+                pass
+
+    _sweep_stop.clear()
+    _sweep_thread = threading.Thread(target=_loop, daemon=True,
+                                     name="memory-anatomy-sweep")
+    _sweep_thread.start()
+    return True
+
+
+def stop_periodic_sweep():
+    global _sweep_thread
+    _sweep_stop.set()
+    _sweep_thread = None

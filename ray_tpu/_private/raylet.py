@@ -341,7 +341,16 @@ class Raylet:
                 try:
                     self.store.delete(oid)
                 except Exception:
-                    pass
+                    # last hop of the one-way free pipeline lost: the
+                    # object strands in this node's store until the
+                    # leak sweep names it — count the drop
+                    try:
+                        from ray_tpu._private import memory_anatomy
+
+                        memory_anatomy.LEDGER.note_free_dropped(
+                            "raylet_delete")
+                    except Exception:
+                        pass
         elif method == "recreate_actor":
             threading.Thread(target=self._restart_actor,
                              args=(kwargs["actor_id"],), daemon=True).start()
@@ -1289,6 +1298,16 @@ class Raylet:
         snap = flight_recorder.local_snapshot()
         own = [snap] if snap else []
         return own + self._fanout_workers("blackbox_snapshot")
+
+    def rpc_memory_snapshot(self, conn):
+        """Memory-anatomy ledgers: the raylet process's own (its store
+        deletes and dropped frees count HERE) plus every registered
+        worker's. summarize_memory dedups by (node, pid)."""
+        from ray_tpu._private import memory_anatomy
+
+        snap = memory_anatomy.local_snapshot(top_k=10)
+        snap["node"] = self.node_id
+        return [snap] + self._fanout_workers("memory_snapshot")
 
     def rpc_ping(self, conn):
         return "pong"

@@ -17,6 +17,7 @@ import os
 import threading
 import time
 
+from ray_tpu._private import memory_anatomy as _ma
 from ray_tpu._private import telemetry as _tm
 from ray_tpu._private.native_build import ensure_lib
 
@@ -262,8 +263,10 @@ class StoreClient:
                         dst[off:off + len(v)] = v
                         off += len(v)
                     self.seal(object_id)
-                    _tm.counter_inc("ray_tpu_object_store_put_bytes_total",
-                                    total)
+                    if _tm.ENABLED:
+                        _tm.counter_inc(
+                            "ray_tpu_object_store_put_bytes_total", total)
+                        _ma.LEDGER.note_put(object_id, total)
                     return True, total
                 except BaseException:
                     self.abort(object_id)
@@ -271,7 +274,9 @@ class StoreClient:
         if self.spill_dir is None:
             raise StoreError(-3, "put")
         self._spill_write(object_id, views)
-        _tm.counter_inc("ray_tpu_object_store_put_bytes_total", total)
+        if _tm.ENABLED:
+            _tm.counter_inc("ray_tpu_object_store_put_bytes_total", total)
+            _ma.LEDGER.note_put(object_id, total)
         return True, total
 
     def put_ephemeral(self, object_id: bytes, parts: list) -> int:
@@ -305,16 +310,40 @@ class StoreClient:
         except BaseException:
             self.abort(object_id)
             raise
-        _tm.counter_inc("ray_tpu_object_store_put_bytes_total", total)
+        if _tm.ENABLED:
+            _tm.counter_inc("ray_tpu_object_store_put_bytes_total", total)
+            _ma.LEDGER.note_put(object_id, total, ephemeral=True)
         return total
 
     @_guarded
     def delete_ephemeral(self, object_id: bytes):
         """delete() for objects known never to spill: skips the spill-
         path stat (a per-call filesystem probe the segment hot path
-        can't afford)."""
+        can't afford). Best-effort, with one accounting exception: a
+        delete refused because another process's pin is still live
+        (ERR_IN_USE — e.g. a forwarding hop mid-unpin) is retried once
+        after a beat behind config ``store_free_resend``, and counted
+        as a dropped free if it still refuses — an uncounted refusal
+        here is a permanently stranded segment."""
         self._check_id(object_id)
-        self._libref.store_delete(self._h, object_id)  # best-effort
+        rc = self._libref.store_delete(self._h, object_id)
+        if rc == -6:                              # ERR_IN_USE
+            resend = 0
+            try:
+                from ray_tpu._private.config import get_config
+
+                resend = int(get_config("store_free_resend"))
+            except Exception:
+                pass
+            if resend > 0:
+                time.sleep(0.002)     # off the op critical path: the
+                #                       last consumer deletes after its
+                #                       op already completed
+                rc = self._libref.store_delete(self._h, object_id)
+            if rc == -6 and _tm.ENABLED:
+                _ma.LEDGER.note_free_dropped("ephemeral_pinned")
+        if _tm.ENABLED:
+            _ma.LEDGER.note_delete(object_id)
 
     @_guarded
     def create(self, object_id: bytes, size: int):
@@ -382,8 +411,10 @@ class StoreClient:
             raise StoreError(rc, "get")
         with self._guard:
             self._pins += 1   # close() waits for pins: the buffer's view
-        _tm.counter_inc("ray_tpu_object_store_get_total",
-                        tags={"result": "hit"})
+        if _tm.ENABLED:
+            _tm.counter_inc("ray_tpu_object_store_get_total",
+                            tags={"result": "hit"})
+            _ma.LEDGER.note_pin(object_id)
         return PinnedBuffer(self, object_id, ptr.value, size.value)
 
     @_guarded
@@ -406,6 +437,8 @@ class StoreClient:
                 os.unlink(p)
             except OSError:
                 pass
+        if _tm.ENABLED:
+            _ma.LEDGER.note_delete(object_id)
 
     def _capacity(self) -> int:
         """Usable heap bytes for ONE object (cached on success only —
@@ -465,6 +498,8 @@ class StoreClient:
         return out
 
     def _release(self, object_id: bytes):
+        if _tm.ENABLED:
+            _ma.LEDGER.note_unpin(object_id)
         with self._guard:
             self._pins = max(0, self._pins - 1)
             if self._pins == 0:

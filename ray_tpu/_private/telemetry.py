@@ -35,7 +35,7 @@ ENABLED = os.environ.get("RAY_TPU_INTERNAL_TELEMETRY", "1") != "0"
 # `_messages` are the "unit is the thing counted" form for gauges;
 # `_ratio` is the Prometheus-convention dimensionless 0..1 form).
 ALLOWED_SUFFIXES = ("_total", "_seconds", "_bytes", "_tasks", "_messages",
-                    "_ratio", "_blocks")
+                    "_ratio", "_blocks", "_objects")
 
 _RPC_BOUNDARIES = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0]
 
@@ -93,6 +93,46 @@ CATALOG: dict[str, dict] = {
     "ray_tpu_object_store_get_total": {
         "kind": "Counter", "tags": ("result",),
         "description": "Local object-store lookups (result=hit|miss)",
+    },
+    # --- memory anatomy (memory_anatomy.py provenance ledger) ---
+    "ray_tpu_store_bytes": {
+        "kind": "Gauge", "tags": ("category", "state"),
+        "description": "Live object-store bytes by provenance category "
+                       "(task_arg/task_return/collective_segment/"
+                       "serve_weights/data_staging/checkpoint/other), "
+                       "state=live",
+    },
+    "ray_tpu_store_objects": {
+        "kind": "Gauge", "tags": ("category",),
+        "description": "Live object-store object count by provenance "
+                       "category",
+    },
+    "ray_tpu_store_orphan_bytes": {
+        "kind": "Gauge", "tags": ("category", "reason"),
+        "description": "Bytes the leak sweep classified as orphaned "
+                       "(reason=owner_dead|group_destroyed|epoch_stale; "
+                       "category=all,reason=all carries the sum)",
+    },
+    "ray_tpu_store_frees_dropped_total": {
+        "kind": "Counter", "tags": ("stage",),
+        "description": "Deletes lost on the one-way owner→GCS→raylet "
+                       "free pipeline "
+                       "(stage=owner_push|gcs_fanout|raylet_delete)",
+    },
+    "ray_tpu_store_free_resends_total": {
+        "kind": "Counter", "tags": (),
+        "description": "Bounded best-effort re-sends of free fan-outs "
+                       "whose first push found no raylet connection "
+                       "(config store_free_resend)",
+    },
+    # --- train-state accounting (ddp.py / train_step.py) ---
+    "ray_tpu_train_state_bytes": {
+        "kind": "Gauge", "tags": ("kind", "rank"),
+        "description": "Exact per-rank train-state bytes from the "
+                       "deterministic flatten "
+                       "(kind=params|grads|opt_state|bucket_inflight) — "
+                       "the gauge the ZeRO arc diffs before/after "
+                       "sharding",
     },
     # --- durable GCS store (gcs_store.py) ---
     "ray_tpu_gcs_store_ops_total": {

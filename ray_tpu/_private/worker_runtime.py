@@ -27,6 +27,7 @@ from concurrent.futures import Future as PyFuture
 from ray_tpu import exceptions as exc
 from ray_tpu._private import events as _events
 from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private import memory_anatomy as _ma
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.object_ref import ObjectRef, ReferenceCounter
 from ray_tpu._private.protocol import ConnectionLost, RpcClient, RpcServer
@@ -780,6 +781,9 @@ class CoreWorker:
         self._obj_sizes: dict[bytes, int] = {}
         self.store = StoreClient(store_name or reg["store_name"],
                                  spill_dir=spill_dir or reg["spill_dir"])
+        # provenance leak sweep over this process's store traffic
+        # (memory_anatomy; no-op under RAY_TPU_INTERNAL_TELEMETRY=0)
+        _ma.start_periodic_sweep(self)
         self.job_id = job_id if job_id is not None else (
             self.gcs.call("next_job_id") if mode == "driver" else 0)
         self._ready.set()
@@ -1035,7 +1039,8 @@ class CoreWorker:
         # intermediate frame (one full copy saved per big array)
         parts = ser.serialize_parts(value)
         object_id = self._new_id()
-        size = self.store.put_parts(object_id, parts)
+        with _ma.default_tag("task_arg", owner=self.worker_id):
+            size = self.store.put_parts(object_id, parts)
         # we own it: record the location in OUR directory — no RPC at all
         self._loc_add(object_id, self._my_node, size)
         self._owned.add(object_id)
@@ -1127,7 +1132,9 @@ class CoreWorker:
                 self.gcs.push("free_objects", object_ids=[object_id],
                               locations={object_id: holders})
             except Exception:
-                pass
+                # the free is one-way and now LOST — the object strands
+                # on its holder nodes until the leak sweep names it
+                _ma.LEDGER.note_free_dropped("owner_push")
 
     # ------------------------------------------------ lineage reconstruction
     # Reference: object_recovery_manager.h:30 (re-execute the creating task
@@ -1776,6 +1783,13 @@ class CoreWorker:
 
         snap = flight_recorder.local_snapshot()
         return [snap] if snap else []
+
+    def rpc_memory_snapshot(self, conn):
+        """This process's memory-anatomy ledger (sweep + snapshot) for
+        summarize_memory()'s cluster fan-out."""
+        snap = _ma.local_snapshot(top_k=10, window_s=None)
+        snap["node"] = self.node_id
+        return [snap]
 
     # ------------------------------------------- owner-based object directory
     # Reference: ownership_based_object_directory.h:1 — the owning worker is
@@ -2784,7 +2798,10 @@ class CoreWorker:
             else:
                 # parts stream straight into the segment/spill file —
                 # no assembled intermediate copy for big returns
-                self.store.put_parts(rid, parts)
+                with _ma.default_tag("task_return",
+                                     owner=spec.get("task_id",
+                                                    b"").hex()[:16]):
+                    self.store.put_parts(rid, parts)
                 stored.append(rid)
                 sizes[rid] = size
         # The task REPLY doubles as the location announcement: the owner
@@ -2844,7 +2861,10 @@ class CoreWorker:
             if size <= INLINE_RESULT_LIMIT:
                 item["data"] = ser.assemble_parts(item_parts)
             else:
-                self.store.put_parts(rid, item_parts)
+                with _ma.default_tag("task_return",
+                                     owner=spec.get("task_id",
+                                                    b"").hex()[:16]):
+                    self.store.put_parts(rid, item_parts)
                 stored.append(rid)
                 sizes[rid] = size
                 item["node"] = self._my_node
@@ -3374,6 +3394,7 @@ class CoreWorker:
 
     def shutdown(self):
         self.stopped = True
+        _ma.stop_periodic_sweep()
         self._free_queue.put(None)   # unblock the ref reaper
         self.reference_counter.shutdown()   # and the refcount drainer
         self._server.stop()

@@ -103,6 +103,20 @@ _PANELS = [
     ("Telemetry ring drops (trace + timeline)",
      "rate(ray_tpu_trace_dropped_total[5m]) + "
      "rate(ray_tpu_timeline_dropped_total[5m])", "ops"),
+    # --- memory anatomy (PR 18: provenance ledger / leak attribution) ---
+    ("Store bytes by provenance category",
+     "sum by (category) (ray_tpu_store_bytes)", "bytes"),
+    ("Store objects by provenance category",
+     "sum by (category) (ray_tpu_store_objects)", "short"),
+    ("Orphaned store bytes (leak sweep)",
+     "sum by (category, reason) (ray_tpu_store_orphan_bytes)", "bytes"),
+    ("Dropped frees (deletes that never landed)",
+     "sum by (stage) (rate(ray_tpu_store_frees_dropped_total[5m]))",
+     "ops"),
+    ("Free resends recovered (GCS fan-out retry)",
+     "rate(ray_tpu_store_free_resends_total[5m])", "ops"),
+    ("Train-state bytes per rank",
+     "sum by (kind, rank) (ray_tpu_train_state_bytes)", "bytes"),
     # --- serve plane (PR 6: inference router / batcher / autoscaler) ---
     ("Serve QPS",
      "sum by (deployment) (rate(ray_tpu_serve_requests_total[1m]))",

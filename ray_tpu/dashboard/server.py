@@ -93,7 +93,9 @@ class DashboardServer:
                            state.cluster_status(address=self.address)}
             elif path == "/api/memory":
                 payload = {"summary":
-                           state.memory_summary(address=self.address)}
+                           state.memory_summary(address=self.address),
+                           "anatomy":
+                           state.summarize_memory(address=self.address)}
             elif path == "/api/nodes":
                 payload = state.list_nodes(address=self.address)
             elif path == "/api/actors":

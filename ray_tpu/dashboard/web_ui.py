@@ -69,6 +69,7 @@ const TABS = [
   {id:"pgs", label:"Placement groups", api:"/api/placement_groups"},
   {id:"topology", label:"Topology", api:"/api/topology"},
   {id:"objects", label:"Objects", api:"/api/objects"},
+  {id:"memory", label:"Memory", api:"/api/memory"},
   {id:"jobs", label:"Jobs", api:"/api/jobs"},
   {id:"tenancy", label:"Tenancy", api:"/api/tenancy"},
   {id:"events", label:"Events", api:"/api/events"},
@@ -127,6 +128,29 @@ async function render() {
         "<pre class='summary'>" + esc(mem.summary) + "</pre>" +
         (Array.isArray(reporter) && reporter.length
           ? "<h3>Per-node stats</h3>" + renderTable(reporter) : "");
+    } else if (current === "memory") {
+      const m = await jget("/api/memory");
+      const a = m.anatomy || {};
+      const cats = Object.entries(a.categories || {}).map(
+        ([category, v]) => ({category, bytes: v.bytes,
+                             objects: v.objects}));
+      const drops = Object.entries(a.dropped_frees || {}).map(
+        ([stage, count]) => ({stage, count}));
+      const ts = Object.entries(a.train_state || {}).map(([k, v]) => {
+        const [kind, rank] = k.split(":");
+        return {kind, rank, bytes: v};
+      });
+      html =
+        "<pre class='summary'>" + esc(m.summary) + "</pre>" +
+        "<h3>Live bytes by provenance category</h3>" + renderTable(cats) +
+        (a.orphans && a.orphans.length
+          ? "<h3 class='bad'>Orphans (" + esc(fmt(a.orphan_bytes)) +
+            " bytes)</h3>" + renderTable(a.orphans) : "") +
+        (drops.length
+          ? "<h3>Dropped frees</h3>" + renderTable(drops) : "") +
+        (ts.length
+          ? "<h3>Train state per rank</h3>" + renderTable(ts) : "") +
+        "<h3>Top owners</h3>" + renderTable(a.top_owners || []);
     } else if (current === "tenancy") {
       const t = await jget("/api/tenancy");
       const apps = Object.entries(t.serve_apps || {}).map(

@@ -376,8 +376,11 @@ class StreamingExecutor:
         pressure falls back to holding the heap bytes."""
         try:
             if len(data) >= STAGE_MIN_BYTES:
+                from ray_tpu._private import memory_anatomy as _ma
+
                 stage_id = _mint_stage_id()
-                w.store.put_ephemeral(stage_id, [data])
+                with _ma.tagged("data_staging", owner=self.consumer):
+                    w.store.put_ephemeral(stage_id, [data])
                 pin = w.store.get(stage_id)
                 if pin is not None and not hasattr(pin, "view"):
                     return _Slot(pin=pin, stage_id=stage_id)

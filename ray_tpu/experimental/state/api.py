@@ -615,6 +615,89 @@ def memory_summary(*, address: str | None = None) -> str:
     return "\n".join(lines)
 
 
+def summarize_memory(*, address: str | None = None,
+                     top_k: int = 10) -> dict:
+    """Memory-anatomy rollup (PR 18): every process's provenance ledger
+    (_private/memory_anatomy.py) fanned out like the other telemetry
+    RPCs — this process, the GCS, and each raylet's workers — deduped by
+    (node, pid) and folded into:
+
+    - ``categories``     cluster-wide live bytes/objects per provenance
+                         category (task_arg/task_return/
+                         collective_segment/serve_weights/data_staging/
+                         checkpoint/other);
+    - ``orphans``        leak-sweep rows (deduped by oid — raylet and
+                         worker clients sweep the SAME node store) with
+                         full creator provenance + reason;
+    - ``dropped_frees``  one-way deletes that never landed, per pipeline
+                         stage (owner_push/gcs_fanout/raylet_delete);
+    - ``train_state``    per-rank params/grads/opt_state/bucket_inflight
+                         bytes (exact, from the deterministic flatten);
+    - ``top_owners``     the largest live objects cluster-wide;
+    - ``per_process``    the raw per-ledger snapshots (ring omitted).
+    """
+    from ray_tpu._private import memory_anatomy as _ma
+
+    snaps = [_ma.local_snapshot(top_k=top_k)]
+    try:
+        from ray_tpu._private.worker_runtime import current_worker
+
+        w = current_worker()
+        if w is not None:
+            snaps[0].setdefault("node", w.node_id)
+    except Exception:
+        pass
+    with _gcs(address) as call:
+        try:
+            snaps.extend(call("memory_snapshot"))
+        except Exception:
+            pass   # pre-memory-anatomy GCS build
+        snaps.extend(_each_raylet(call, "memory_snapshot"))
+    seen: set[tuple] = set()
+    procs = []
+    for s in snaps:
+        key = (s.get("node"), s.get("pid"))
+        if key in seen:
+            continue
+        seen.add(key)
+        procs.append(s)
+
+    categories: dict[str, dict] = {}
+    dropped: dict[str, int] = {}
+    train_state: dict[str, int] = {}
+    orphan_by_oid: dict[str, dict] = {}
+    owners: list[dict] = []
+    for s in procs:
+        for cat, v in (s.get("categories") or {}).items():
+            agg = categories.setdefault(cat, {"bytes": 0, "objects": 0})
+            agg["bytes"] += int(v.get("bytes", 0))
+            agg["objects"] += int(v.get("objects", 0))
+        for stage, n in (s.get("dropped_frees") or {}).items():
+            dropped[stage] = dropped.get(stage, 0) + int(n)
+        # per-rank state: each rank process reports its own rows — a
+        # later report for the same (kind, rank) supersedes, not adds
+        train_state.update(s.get("train_state") or {})
+        for row in s.get("orphans") or ():
+            orphan_by_oid.setdefault(row.get("oid"), row)
+        for row in s.get("top_owners") or ():
+            owners.append(dict(row, node=s.get("node")))
+    owners.sort(key=lambda r: -(r.get("nbytes") or 0))
+    orphans = sorted(orphan_by_oid.values(),
+                     key=lambda r: -(r.get("nbytes") or 0))
+    return {
+        "categories": dict(sorted(categories.items())),
+        "live_bytes": sum(c["bytes"] for c in categories.values()),
+        "live_objects": sum(c["objects"] for c in categories.values()),
+        "orphans": orphans,
+        "orphan_bytes": sum(int(r.get("nbytes") or 0) for r in orphans),
+        "dropped_frees": dropped,
+        "train_state": dict(sorted(train_state.items())),
+        "top_owners": owners[:top_k],
+        "per_process": [{k: v for k, v in s.items() if k != "ring"}
+                        for s in procs],
+    }
+
+
 def _fold_sums(snaps: dict, name: str) -> dict:
     """{sorted-tag-items: value} for one metric family out of a
     ``metrics_summary`` snapshot dict (Counter/Gauge values, Histogram

@@ -72,7 +72,38 @@ def make_train_state(
         opt_state = optimizer.init(params)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 
-    return CompiledFunction(jax.jit(init_fn), "train_state_init")(rng)
+    state = CompiledFunction(jax.jit(init_fn), "train_state_init")(rng)
+    _note_state_bytes(state)
+    return state
+
+
+def _note_state_bytes(state: TrainState):
+    """Stamp ``ray_tpu_train_state_bytes{kind=params|opt_state,rank}``
+    from the deterministic flatten — the exact resident footprint of the
+    state this process just materialized (memory-anatomy plane)."""
+    try:
+        from ray_tpu._private import memory_anatomy as _ma
+        from ray_tpu._private import telemetry as _tm
+
+        if not _tm.ENABLED:
+            return
+        rank = 0
+        try:
+            from ray_tpu.util import collective as col
+
+            for g in ("train_dp", "default"):
+                if col.is_group_initialized(g):
+                    rank = col.get_rank(g)
+                    break
+        except Exception:
+            rank = 0
+        for kind, tree in (("params", state.params),
+                           ("opt_state", state.opt_state)):
+            leaves, _ = sh.flatten_tree(tree)
+            _ma.LEDGER.note_train_state(
+                kind, rank, sum(int(l.nbytes) for l in leaves))
+    except Exception:
+        pass
 
 
 def make_train_step(

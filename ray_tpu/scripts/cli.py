@@ -145,9 +145,44 @@ def cmd_list(args):
 
 
 def cmd_memory(args):
-    from ray_tpu.experimental.state.api import memory_summary
+    """Object-store summary + the memory-anatomy rollup (PR 18) — the
+    CLI face of `experimental.state.api.summarize_memory`: live
+    bytes/objects per provenance category, leak-sweep orphans with
+    creator provenance, dropped-free counters per pipeline stage, and
+    per-rank train-state bytes."""
+    from ray_tpu.experimental.state.api import (
+        memory_summary,
+        summarize_memory,
+    )
 
+    if getattr(args, "anatomy_json", False):
+        print(json.dumps(summarize_memory(address=args.address),
+                         indent=2, default=str))
+        return 0
     print(memory_summary(address=args.address))
+    anatomy = summarize_memory(address=args.address)
+    lines = ["", "======== Memory anatomy ========"]
+    for cat, v in anatomy["categories"].items():
+        lines.append(f"  {cat:<20} {v['bytes']:>14} bytes  "
+                     f"{v['objects']:>6} objects")
+    if anatomy["dropped_frees"]:
+        lines.append("Dropped frees (never landed):")
+        for stage, n in sorted(anatomy["dropped_frees"].items()):
+            lines.append(f"  {stage:<20} {n}")
+    if anatomy["orphans"]:
+        lines.append(f"Orphans: {len(anatomy['orphans'])} "
+                     f"({anatomy['orphan_bytes']} bytes)")
+        for r in anatomy["orphans"][:10]:
+            lines.append(
+                f"  {(r.get('oid') or '?')[:16]:<18} "
+                f"{r.get('category')}  {r.get('nbytes')} bytes  "
+                f"reason={r.get('reason')} group={r.get('group')} "
+                f"epoch={r.get('epoch')} rank={r.get('rank')}")
+    if anatomy["train_state"]:
+        lines.append("Train state (kind:rank -> bytes):")
+        for key, v in anatomy["train_state"].items():
+            lines.append(f"  {key:<24} {v}")
+    print("\n".join(lines))
     return 0
 
 
@@ -515,8 +550,13 @@ def main(argv=None):
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_list)
 
-    sp = sub.add_parser("memory", help="object store summary")
+    sp = sub.add_parser("memory",
+                        help="object store summary + memory anatomy")
     sp.add_argument("--address", default=None)
+    sp.add_argument("--anatomy-json", action="store_true",
+                    dest="anatomy_json",
+                    help="print the raw summarize_memory() rollup as "
+                         "JSON instead of the text summary")
     sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("microbenchmark",

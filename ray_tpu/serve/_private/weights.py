@@ -182,7 +182,10 @@ def _load_entry(key: str, loader) -> tuple:
     if pin is None:
         value = loader()
         try:
-            pin = _publish_or_adopt(store, oid, _serialize(value))
+            from ray_tpu._private import memory_anatomy as _ma
+
+            with _ma.tagged("serve_weights", group=key):
+                pin = _publish_or_adopt(store, oid, _serialize(value))
         except Exception:
             pin = None   # store full / unpicklable → private copy
         if pin is None:
@@ -248,6 +251,14 @@ def _publish_or_adopt(store, oid: bytes, parts: list):
             dst[off:off + len(v)] = v
             off += len(v)
         store.seal(oid)
+        # raw create+seal bypasses put_parts' ledger hook — record the
+        # publish here so the segment carries serve_weights provenance
+        # (the caller's tagged() context is active)
+        from ray_tpu._private import memory_anatomy as _ma
+        from ray_tpu._private import telemetry as _tm
+
+        if _tm.ENABLED:
+            _ma.LEDGER.note_put(oid, total)
     except BaseException:
         try:
             store.abort(oid)

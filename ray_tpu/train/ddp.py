@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import time
 
+from ray_tpu._private import memory_anatomy as _ma
 from ray_tpu._private import profiling as _prof
 from ray_tpu._private import telemetry as _tm
 
@@ -63,13 +64,14 @@ class PendingGradSync:
     between launch and ``result()`` overlaps ALL of the comm."""
 
     def __init__(self, group: str, treedef, leaves, launched,
-                 world: int, average: bool):
+                 world: int, average: bool, rank: int | None = None):
         self._group = group
         self._treedef = treedef
         self._leaves = leaves
         self._launched = launched    # [(indices, handle, t_launch)]
         self._world = world
         self._average = average
+        self._rank = rank
         self._result = None
         self._out_leaves: list = [None] * len(leaves)
         self._next = 0               # harvest progress (retry-safe)
@@ -110,6 +112,9 @@ class PendingGradSync:
                                                "bucket": b}):
                     flat = handle.result(timeout)
             now = time.perf_counter()
+            if _tm.ENABLED and self._rank is not None:
+                # bucket landed: it is no longer in flight on the wire
+                _ma.LEDGER.add_inflight(self._rank, -float(flat.nbytes))
             if _tm.ENABLED:
                 _tm.observe("ray_tpu_train_bucket_wait_seconds",
                             now - t0, tags=tags)
@@ -189,6 +194,17 @@ def sync_gradients_async(grads, group_name: str = "train_dp", *,
     plan = _sh.plan_buckets(leaves, bucket_bytes)
     launched = []
     tags = {"group": group_name}
+    rank = None
+    if _tm.ENABLED:
+        try:
+            rank = col.get_rank(group_name)
+        except Exception:
+            rank = None
+        if rank is not None:
+            # exact by construction: the flatten is deterministic, so
+            # this is THE grads footprint the sync moves for this rank
+            _ma.LEDGER.note_train_state(
+                "grads", rank, float(sum(l.nbytes for l in leaves)))
     for b, indices in enumerate(plan):
         # pack on the caller thread: bucket b's device→host fetch +
         # memcpy runs while buckets < b are already on the wire
@@ -199,10 +215,12 @@ def sync_gradients_async(grads, group_name: str = "train_dp", *,
             _tm.observe("ray_tpu_train_bucket_bytes", float(flat.nbytes),
                         tags=tags)
             _tm.counter_inc("ray_tpu_train_buckets_total", tags=tags)
+            if rank is not None:
+                _ma.LEDGER.add_inflight(rank, float(flat.nbytes))
         launched.append((indices, col.allreduce_async(flat, group_name),
                          time.perf_counter()))
     return PendingGradSync(group_name, treedef, leaves, launched, world,
-                           average)
+                           average, rank=rank)
 
 
 def sync_gradients(grads, group_name: str = "train_dp", *,

@@ -67,6 +67,7 @@ import threading
 import numpy as np
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import memory_anatomy as _ma
 from ray_tpu._private import protocol as _protocol
 from ray_tpu._private import serialization as ser
 from ray_tpu._private import telemetry as _tm
@@ -481,7 +482,9 @@ class HostGroup:
             oid = self._oid_prefix + self.rank.to_bytes(2, "big") \
                 + self._worker._new_id()[12:]
             try:
-                nbytes = self._worker.store.put_ephemeral(oid, parts)
+                with _ma.tagged("collective_segment", group=self.name,
+                                epoch=self.epoch, rank=self.rank):
+                    nbytes = self._worker.store.put_ephemeral(oid, parts)
             except Exception:
                 pass   # store full/unavailable: socket fallback below
             else:
@@ -511,12 +514,19 @@ class HostGroup:
         if isinstance(frame, _ShmFrame) and self._shm_ok(dst):
             full_key = self._full_key(key, self.rank)
             self._seg_count += 1
+            # unpin BEFORE the next hop learns the oid: every caller has
+            # already copied the bytes out, and notifying first opens a
+            # race where the LAST hop's delete lands while this pin is
+            # still held — store_delete returns ERR_IN_USE, the
+            # best-effort delete drops, and the segment strands (the
+            # test_shm_segment_transport_oracle flake)
+            oid, nbytes = frame.oid, frame.nbytes
+            frame.release(delete=False)
             try:
                 self._client(dst).push("col_push_shm", key=full_key,
-                                       oid=frame.oid, nbytes=frame.nbytes)
+                                       oid=oid, nbytes=nbytes)
             except ConnectionLost as e:
                 self._raise_peer_lost(dst, e, f"send failed: {e}")
-            frame.release(delete=False)
             return
         self._push_frame(dst, key, [frame.view])
         frame.release()

@@ -69,6 +69,40 @@ def pytest_report_header(config):
     return lines
 
 
+def _memory_orphan_digest() -> str:
+    """One-line leak digest for failed chaos tests: the local memory
+    ledger's sweep verdict (orphan count/bytes, worst offender's
+    category+group+reason, dropped-free stages) — points a post-mortem
+    at `ray-tpu memory` / summarize_memory() without the full fan-out
+    cost on every failure."""
+    try:
+        from ray_tpu._private import memory_anatomy as _ma
+
+        snap = _ma.local_snapshot(top_k=1)
+        if not snap.get("enabled", True):
+            return "memory anatomy disabled (RAY_TPU_INTERNAL_TELEMETRY=0)"
+        orphans = snap.get("orphans") or []
+        dropped = snap.get("dropped_frees") or {}
+        if not orphans and not dropped:
+            return ("no orphans, no dropped frees "
+                    "(state.api.summarize_memory() for the cluster view)")
+        parts = []
+        if orphans:
+            worst = max(orphans, key=lambda r: r.get("nbytes") or 0)
+            parts.append(
+                f"{len(orphans)} orphan(s), "
+                f"{sum(int(r.get('nbytes') or 0) for r in orphans)} bytes "
+                f"(worst: {worst.get('category')} "
+                f"group={worst.get('group')} reason={worst.get('reason')})")
+        if dropped:
+            parts.append("dropped frees: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(dropped.items())))
+        return "; ".join(parts) + \
+            " — summarize_memory() / `ray-tpu memory` for provenance"
+    except Exception as e:
+        return f"memory anatomy unavailable ({e!r})"
+
+
 def _flight_recorder_hint() -> str:
     """Where this failure's black box is (or would be): the last dump
     this process wrote, else the base dir cluster processes dump into —
@@ -105,6 +139,8 @@ def pytest_runtest_makereport(item, call):
                 item.get_closest_marker("fault_injection") is not None:
             rep.sections.append(
                 ("flight recorder", _flight_recorder_hint()))
+            rep.sections.append(
+                ("memory anatomy", _memory_orphan_digest()))
 
 
 # ---------------------------------------------------------------------------

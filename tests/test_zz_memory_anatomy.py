@@ -1,0 +1,710 @@
+"""Memory anatomy — provenance ledger, leak attribution, train-state
+accounting (late-alphabet; the gang tests cost seconds each).
+
+Covers the PR 18 tentpole end to end:
+
+- category attribution oracle per call site: every put is stamped
+  task_arg / task_return / collective_segment / serve_weights /
+  data_staging (thread-local tag at the call site, oid-layout fallback
+  for untagged collective/staging ids) and the category gauges balance
+  to zero after delete;
+- the leak sweep: referenced vs orphaned classification (pins, grace,
+  dead owner pid, destroyed group, stale epoch, poisoned gang), one
+  STORE_LEAK per orphan oid with full provenance;
+- chaos acceptance: a seeded dropped shm notify strands a segment, the
+  putter rank is killed, and the SURVIVOR's sweep emits exactly one
+  STORE_LEAK naming the dead owner's group/rank/category;
+- per-rank train-state gauges equal the deterministic flatten's byte
+  sum EXACTLY on a live 2-rank gang (grads + bucket_inflight draining
+  to zero), and params/opt_state from make_train_state;
+- the kill switch (RAY_TPU_INTERNAL_TELEMETRY=0) disables every hook;
+- the put/get hot path pays <5% instrumentation overhead (separated
+  measurement — see test_zz_collective_telemetry's guard for why a
+  direct A/B wall-clock ratio would drown in machine noise).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+
+def _fresh_ledger(monkeypatch):
+    """An isolated Ledger so suite-global traffic (the driver runtime's
+    own puts) can't bleed into category assertions."""
+    from ray_tpu._private import memory_anatomy as ma
+
+    led = ma.Ledger()
+    monkeypatch.setattr(ma, "LEDGER", led)
+    return ma, led
+
+
+def _col_oid(group, epoch, rank, counter=1):
+    from ray_tpu._private.worker_runtime import col_epoch_tag, col_oid_prefix
+
+    return (col_oid_prefix(group) + col_epoch_tag(epoch)
+            + int(rank).to_bytes(2, "big")
+            + int(counter).to_bytes(4, "big"))
+
+
+# ------------------------------------------------------ category oracle
+
+
+def test_category_attribution_per_call_site(monkeypatch):
+    """Unit oracle over the tagging plane: each call site's tag (or the
+    oid-layout fallback) lands the put in the right category, and
+    deletes return the gauges to zero."""
+    ma, led = _fresh_ledger(monkeypatch)
+
+    sites = [
+        # (expected category, tag ctx or None, oid)
+        ("task_arg", ma.default_tag("task_arg", owner="w1"),
+         b"T" * 16),
+        ("task_return", ma.default_tag("task_return", owner="t1"),
+         b"R" * 16),
+        ("serve_weights", ma.tagged("serve_weights", group="m:v1"),
+         b"S" * 16),
+        ("data_staging", ma.tagged("data_staging", owner="train"),
+         b"dstrm" + b"\x00" * 11),
+        ("collective_segment",
+         ma.tagged("collective_segment", group="g", epoch=3, rank=1),
+         _col_oid("g", 3, 1)),
+        # untagged fallbacks classify from the oid layout alone
+        ("collective_segment", None, _col_oid("h", 9, 0)),
+        ("data_staging", None, b"dstrm" + b"\x01" * 11),
+        ("other", None, b"\x00" * 16),
+    ]
+    for i, (cat, ctx, oid) in enumerate(sites):
+        nbytes = 100 * (i + 1)
+        if ctx is None:
+            led.note_put(oid, nbytes)
+        else:
+            with ctx:
+                led.note_put(oid, nbytes)
+        rec = led._live[oid]
+        assert rec.category == cat, (cat, rec.category)
+        assert rec.nbytes == nbytes
+
+    snap = led.snapshot()
+    assert snap["categories"]["collective_segment"]["objects"] == 2
+    assert snap["categories"]["data_staging"]["objects"] == 2
+    assert snap["categories"]["task_arg"]["bytes"] == 100
+    # untagged collective id: epoch + rank recovered from the oid itself
+    rec = led._live[_col_oid("h", 9, 0)]
+    assert rec.epoch == 9 and rec.rank == 0
+    # tagged provenance beats the fallback
+    rec = led._live[_col_oid("g", 3, 1)]
+    assert rec.group == "g" and rec.epoch == 3 and rec.rank == 1
+
+    for _, _, oid in sites:
+        led.note_delete(oid)
+    snap = led.snapshot()
+    assert snap["live_objects"] == 0 and snap["live_bytes"] == 0
+
+
+def test_default_tag_yields_to_outer_tag(monkeypatch):
+    """The worker's task_arg/task_return default tagging must not
+    clobber a caller-provided category (e.g. a checkpoint writer that
+    puts through a task argument path)."""
+    ma, led = _fresh_ledger(monkeypatch)
+    with ma.tagged("checkpoint", owner="ckpt-7"):
+        with ma.default_tag("task_arg", owner="w"):
+            led.note_put(b"C" * 16, 64)
+    rec = led._live[b"C" * 16]
+    assert rec.category == "checkpoint"
+    assert rec.owner == "ckpt-7"
+    # and with no outer tag the default applies
+    with ma.default_tag("task_arg", owner="w"):
+        led.note_put(b"D" * 16, 64)
+    assert led._live[b"D" * 16].category == "task_arg"
+
+
+def test_store_client_call_sites_attribute(ray_start_regular,
+                                           monkeypatch):
+    """E2E attribution through the real call sites: a driver-side
+    ``put`` lands in task_arg (raw args ride the task spec and never
+    hit the store); the executor's oversized return lands in
+    task_return IN ITS OWN process ledger, visible via the
+    summarize_memory fan-out; serve shared weights land in
+    serve_weights."""
+    ray = ray_start_regular
+    from ray_tpu._private import memory_anatomy as ma
+    from ray_tpu.experimental.state.api import summarize_memory
+
+    base = ma.LEDGER.snapshot()
+
+    @ray.remote
+    def echo(x):
+        return np.asarray(x) * 2
+
+    arg = np.arange(50_000, dtype=np.float64)   # > inline threshold
+    ref = ray.put(arg)
+    out_ref = echo.remote(ref)
+    out = ray.get(out_ref, timeout=60)
+    assert np.array_equal(out, arg * 2)
+
+    snap = ma.LEDGER.snapshot()
+
+    def grew(cat):
+        b0 = (base["categories"].get(cat) or {}).get("bytes", 0)
+        return (snap["categories"].get(cat) or {}).get("bytes", 0) > b0 \
+            or any(r["op"].startswith("put") and r["category"] == cat
+                   for r in snap["ring"])
+
+    assert grew("task_arg")
+    # the 400KB return was stored by the EXECUTOR process under
+    # task_return; the cluster rollup reaches that process's ledger
+    # (out_ref stays referenced so ref-zero can't free it first)
+    roll = summarize_memory()
+    assert (roll["categories"].get("task_return")
+            or {}).get("bytes", 0) > 0, roll["categories"]
+    del out_ref
+
+    # serve weights: the driver-side shared_weights publish is tagged
+    from ray_tpu.serve._private.weights import (
+        release_shared_weights,
+        shared_weights,
+    )
+
+    key = "zzma:model:v1"
+    w = shared_weights(key, lambda: {"w": np.ones(30_000, np.float32)})
+    assert np.asarray(w["w"]).shape == (30_000,)
+    snap2 = ma.LEDGER.snapshot()
+    assert any(r["category"] == "serve_weights"
+               for r in snap2["ring"]), "serve_weights put not tagged"
+    release_shared_weights(key, delete=True)
+
+
+# ------------------------------------------------------------ leak sweep
+
+
+class _FakeStore:
+    def __init__(self, objs):
+        self.objs = dict(objs)
+
+    def list_objects(self):
+        return list(self.objs.items())
+
+
+def test_sweep_classifies_referenced_vs_orphaned(monkeypatch):
+    ma, led = _fresh_ledger(monkeypatch)
+    live_oid = b"L" * 16
+    pinned_oid = b"P" * 16
+    dead_oid = b"X" * 16
+    led.note_put(live_oid, 10)
+    led.note_put(pinned_oid, 20)
+    led.note_pin(pinned_oid)
+    # a record whose creator pid is dead (pid 2**22+9999 can't exist
+    # under default pid_max)
+    led.note_put(dead_oid, 30, pid=(1 << 22) + 9999)
+    store = _FakeStore({live_oid: 10, pinned_oid: 20, dead_oid: 30})
+    orphans = led.sweep(store, grace_s=0.0)
+    reasons = {r["oid"]: r["reason"] for r in orphans}
+    assert reasons == {dead_oid.hex(): "owner_dead"}
+    # grace spares a just-created object even with a dead owner
+    fresh = b"F" * 16
+    led.note_put(fresh, 5, pid=(1 << 22) + 9998)
+    store.objs[fresh] = 5
+    assert all(r["oid"] != fresh.hex()
+               for r in led.sweep(store, grace_s=60.0))
+    # deletion by ANOTHER process (object gone from the store) prunes
+    # the record and clears the leak latch
+    del store.objs[dead_oid]
+    led.sweep(store, grace_s=0.0)
+    assert dead_oid not in led._live
+    assert dead_oid not in led._leaked
+
+
+def test_sweep_group_destroyed_epoch_stale_and_poisoned(monkeypatch):
+    ma, led = _fresh_ledger(monkeypatch)
+    ok = _col_oid("alive", 4, 0)
+    stale = _col_oid("alive", 3, 1)
+    gone = _col_oid("deadgrp", 1, 0)
+    foreign = _col_oid("poisoned", 2, 1, counter=7)
+    with ma.tagged("collective_segment", group="alive", epoch=4, rank=0):
+        led.note_put(ok, 100)
+    with ma.tagged("collective_segment", group="alive", epoch=3, rank=1):
+        led.note_put(stale, 100)
+    with ma.tagged("collective_segment", group="deadgrp", epoch=1,
+                   rank=0):
+        led.note_put(gone, 100)
+    store = _FakeStore({ok: 100, stale: 100, gone: 100, foreign: 100})
+    orphans = led.sweep(store, known_groups={"alive": 4},
+                        poisoned={"poisoned": (1,)}, grace_s=0.0)
+    by_oid = {r["oid"]: r for r in orphans}
+    assert ok.hex() not in by_oid
+    assert by_oid[stale.hex()]["reason"] == "epoch_stale"
+    assert by_oid[gone.hex()]["reason"] == "group_destroyed"
+    # the foreign segment (put by a process this ledger never saw) of a
+    # poisoned gang classifies owner_dead, named by group + dead rank
+    row = by_oid[foreign.hex()]
+    assert row["reason"] == "owner_dead"
+    assert row["group"] == "poisoned"
+    assert row["rank"] == 1 and row["dead_ranks"] == [1]
+    # STORE_LEAK is once-per-oid: a second sweep emits no new events
+    from ray_tpu._private import events
+
+    before = sum(1 for e in events.snapshot()
+                 if e.get("kind") == "STORE_LEAK")
+    led.sweep(store, known_groups={"alive": 4},
+              poisoned={"poisoned": (1,)}, grace_s=0.0)
+    after = sum(1 for e in events.snapshot()
+                if e.get("kind") == "STORE_LEAK")
+    assert after == before
+
+
+def test_store_leak_event_payload_names_creator(monkeypatch):
+    """The event payload carries the CREATOR's identity under owner_*
+    (pid/node are envelope keys stamped with the SWEEPER's identity)."""
+    ma, led = _fresh_ledger(monkeypatch)
+    from ray_tpu._private import events
+
+    oid = b"E" * 16
+    with ma.tagged("serve_weights", group="m:v2"):
+        led.note_put(oid, 77, pid=(1 << 22) + 9997)
+    led.sweep(_FakeStore({oid: 77}), grace_s=0.0)
+    leaks = [e for e in events.snapshot()
+             if e.get("kind") == "STORE_LEAK"
+             and e.get("oid") == oid.hex()]
+    assert len(leaks) == 1
+    e = leaks[0]
+    assert e["category"] == "serve_weights"
+    assert e["group"] == "m:v2"
+    assert e["reason"] == "owner_dead"
+    assert e["owner_pid"] == (1 << 22) + 9997
+    assert e["pid"] == os.getpid()      # envelope: the sweeper
+
+
+# ------------------------------------------------------- dropped frees
+
+
+def test_dropped_free_counter_stages(monkeypatch):
+    ma, led = _fresh_ledger(monkeypatch)
+    led.note_free_dropped("owner_push")
+    led.note_free_dropped("gcs_fanout", count=2)
+    led.note_free_dropped("raylet_delete")
+    snap = led.snapshot()
+    assert snap["dropped_frees"] == {"owner_push": 1, "gcs_fanout": 2,
+                                     "raylet_delete": 1}
+
+
+def test_gcs_free_fanout_resend_is_config_gated(monkeypatch):
+    """The GCS's free fan-out retries a failed push exactly once when
+    store_free_resend=1 and counts what still never landed."""
+    import threading
+
+    from ray_tpu._private import gcs as gcs_mod
+
+    class _Conn:
+        def __init__(self, node_id, fail=False):
+            self.meta = {"node_id": node_id}
+            self.fail = fail
+            self.pushed = []
+
+        def push(self, method, **kw):
+            if self.fail:
+                raise OSError("wire down")
+            self.pushed.append((method, kw))
+
+    class _Server:
+        def __init__(self, conns):
+            self._conns = conns
+
+        def connections(self):
+            return list(self._conns)
+
+    class _GCS:
+        _retry_free_fanout = gcs_mod.GcsServer._retry_free_fanout
+
+        def __init__(self, conns):
+            self._lock = threading.Lock()
+            self._server = _Server(conns)
+
+    recovered = _Conn("n1")             # came back between hops
+    down = _Conn("n2", fail=True)       # never comes back
+    g = _GCS([recovered, down])
+    monkeypatch.setenv("RAY_TPU_STORE_FREE_RESEND", "1")
+    g._retry_free_fanout([("n1", [b"a" * 16]), ("n2", [b"b" * 16])])
+    assert [m for m, _ in recovered.pushed] == ["free_objects"]
+    monkeypatch.setenv("RAY_TPU_STORE_FREE_RESEND", "0")
+    recovered2 = _Conn("n1")
+    g2 = _GCS([recovered2])
+    g2._retry_free_fanout([("n1", [b"c" * 16])])
+    assert recovered2.pushed == []      # gate off: no resend
+
+
+# ------------------------------------------------------- kill switch
+
+
+def test_kill_switch_disables_every_hook(monkeypatch):
+    from ray_tpu._private import memory_anatomy as ma
+    from ray_tpu._private import telemetry as tm
+    from ray_tpu._private.store_client import StoreClient
+
+    led = ma.Ledger()
+    monkeypatch.setattr(ma, "LEDGER", led)
+    monkeypatch.setattr(tm, "ENABLED", False)
+    name = f"/raystore_zzma_ks_{os.getpid()}"
+    c = StoreClient(name, create=True, size=4 * 1024 * 1024, n_slots=64)
+    try:
+        oid = b"K" * 16
+        assert c.put(oid, b"x" * 1000)
+        buf = c.get(oid)
+        buf.release()
+        c.delete(oid)
+        assert led._live == {} and led._ring == []
+        snap = ma.local_snapshot()
+        assert snap["enabled"] is False
+        # the periodic sweep refuses to start under the switch
+        assert ma.start_periodic_sweep(None) is False
+    finally:
+        c.close()
+
+
+# --------------------------------------------------- train-state gauges
+
+
+def _rank_cls(ray):
+    @ray.remote
+    class Rank:
+        def configure(self, env):
+            os.environ.update({k: str(v) for k, v in env.items()})
+            return True
+
+        def join(self, world, rank, name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, "host", name)
+            return rank
+
+        def sync(self, rank, name, bucket_bytes=8192):
+            from ray_tpu.train import ddp
+
+            rng = np.random.RandomState(42 + rank)
+            grads = {"w1": rng.standard_normal((64, 48))
+                     .astype(np.float32),
+                     "b1": rng.standard_normal(48).astype(np.float32),
+                     "w2": rng.standard_normal((48, 7))
+                     .astype(np.float64)}
+            out = ddp.sync_gradients(grads, name,
+                                     bucket_bytes=bucket_bytes)
+            from ray_tpu.parallel import sharding as sh
+
+            leaves, _ = sh.flatten_tree(grads)
+            return {"flat_bytes": int(sum(
+                int(np.asarray(x).nbytes) for x in leaves)),
+                "out_sum": float(sum(np.asarray(v).sum()
+                                     for v in out.values()))}
+
+        def train_state_rows(self):
+            from ray_tpu._private import memory_anatomy as ma
+
+            snap = ma.LEDGER.snapshot()
+            return {"train_state": snap["train_state"],
+                    "inflight": dict(ma.LEDGER._inflight)}
+
+        def destroy(self, name):
+            from ray_tpu.util import collective as col
+
+            col.destroy_collective_group(name)
+            return True
+
+    return Rank
+
+
+def test_train_state_gauge_exact_on_2rank_gang(ray_start_regular):
+    """`ray_tpu_train_state_bytes{kind=grads,rank}` equals the
+    deterministic flatten's byte sum EXACTLY on a live 2-rank gang, and
+    bucket_inflight drains back to zero once every bucket is
+    harvested."""
+    ray = ray_start_regular
+    name = "zzma_ts"
+    Rank = _rank_cls(ray)
+    actors = [Rank.options(num_cpus=0).remote() for _ in range(2)]
+    ray.get([a.configure.remote({"RAY_TPU_TRAIN_BUCKET_DDP": "1"})
+             for a in actors])
+    ray.get([a.join.remote(2, i, name) for i, a in enumerate(actors)],
+            timeout=120)
+    try:
+        outs = ray.get([a.sync.remote(r, name)
+                        for r, a in enumerate(actors)], timeout=120)
+        expect = outs[0]["flat_bytes"]
+        assert expect == outs[1]["flat_bytes"]
+        rows = ray.get([a.train_state_rows.remote() for a in actors],
+                       timeout=30)
+        for rank, row in enumerate(rows):
+            assert row["train_state"].get(f"grads:{rank}") == expect, \
+                (rank, row)
+            # every launched bucket was harvested at result(): nothing
+            # left on the wire
+            assert row["inflight"].get(str(rank), 0) == 0, row
+    finally:
+        try:
+            ray.get([a.destroy.remote(name) for a in actors],
+                    timeout=30)
+        except Exception:
+            pass
+        for a in actors:
+            ray.kill(a)
+
+
+def test_make_train_state_reports_params_and_opt_bytes(monkeypatch):
+    """params/opt_state gauges equal the flatten byte sum of the
+    actual initialized state."""
+    import jax
+
+    from ray_tpu._private import memory_anatomy as ma
+    from ray_tpu.parallel import sharding as sh
+    from ray_tpu.parallel.train_step import (
+        default_optimizer,
+        make_train_state,
+    )
+
+    led = ma.Ledger()
+    monkeypatch.setattr(ma, "LEDGER", led)
+
+    def init_params(rng):
+        import jax.numpy as jnp
+
+        return {"w": jnp.zeros((32, 16), jnp.float32),
+                "b": jnp.zeros((16,), jnp.float32)}
+
+    state = make_train_state(init_params, jax.random.PRNGKey(0),
+                             default_optimizer())
+    p_leaves, _ = sh.flatten_tree(state.params)
+    o_leaves, _ = sh.flatten_tree(state.opt_state)
+    p_bytes = sum(int(x.nbytes) for x in p_leaves)
+    o_bytes = sum(int(x.nbytes) for x in o_leaves)
+    ts = led.snapshot()["train_state"]
+    assert ts.get("params:0") == p_bytes, ts
+    assert ts.get("opt_state:0") == o_bytes, ts
+
+
+# ------------------------------------------------------ chaos acceptance
+
+
+@pytest.mark.chaos
+def test_killed_member_stranded_segment_names_dead_owner(
+        ray_start_regular):
+    """Acceptance (PR 18): seeded chaos drops rank 0's shm push notify
+    (stranding its already-stored segment with no consumer ref), then
+    rank 0 is KILLED. The death poisons the gang on the survivor, whose
+    sweep must classify the stranded segment — which it never saw put —
+    as orphaned, emitting exactly one STORE_LEAK naming the dead
+    owner's group, rank, and category; summarize_memory() surfaces the
+    same row cluster-wide."""
+    ray = ray_start_regular
+    name = "zzma_leak"
+
+    @ray.remote
+    class M:
+        def configure(self, env):
+            os.environ.update({k: str(v) for k, v in env.items()})
+            return True
+
+        def join(self, world, rank, name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, "host", name)
+            return rank
+
+        def allreduce(self, arr, name):
+            from ray_tpu.util import collective as col
+
+            return col.allreduce(arr, name)
+
+        def chaos(self, seed, schedule):
+            from ray_tpu._private import fault_injection as fi
+
+            fi.install(seed, schedule)
+            return True
+
+        def poisoned(self, name):
+            from ray_tpu._private.worker_runtime import current_worker
+
+            return current_worker()._col_poison.get(name)
+
+        def sweep_and_report(self, name):
+            from ray_tpu._private import events
+            from ray_tpu._private import memory_anatomy as ma
+
+            ma.sweep_local()
+            snap = ma.LEDGER.snapshot()
+            leaks = [e for e in events.snapshot()
+                     if e.get("kind") == "STORE_LEAK"
+                     and e.get("group") == name]
+            return {"orphans": [r for r in snap["orphans"]
+                                if r.get("group") == name],
+                    "leaks": leaks}
+
+    actors = [M.options(num_cpus=0).remote() for _ in range(2)]
+    ray.get([a.configure.remote({
+        "RAY_TPU_COLLECTIVE_SEGMENT_BYTES": 128 * 1024,
+        "RAY_TPU_COLLECTIVE_OP_TIMEOUT_S": "3",
+        "RAY_TPU_MEMORY_SWEEP_GRACE_S": "0.2",
+    }) for a in actors])
+    ray.get([a.join.remote(2, i, name) for i, a in enumerate(actors)],
+            timeout=120)
+    # 100KB: over the shm-transport gate, but ONE 128KB segment — the
+    # "exactly one STORE_LEAK" oracle needs a single stranded put
+    ins = [np.random.RandomState(r).standard_normal(12_500)
+           for r in range(2)]
+    ray.get([a.allreduce.remote(ins[r], name)
+             for r, a in enumerate(actors)], timeout=60)   # warm: works
+    # rank 0 drops its NEXT outgoing shm notify: its stored segment
+    # strands (rank 1 never learns the oid), rank 1's op times out
+    ray.get(actors[0].chaos.remote(0, "drop:*.col_push_shm:#1"))
+    refs = [a.allreduce.remote(ins[r], name)
+            for r, a in enumerate(actors)]
+    with pytest.raises(Exception):
+        ray.get(refs, timeout=60)
+    # kill the putter: the stranded segment's owner (and its ledger
+    # record) die with it
+    ray.kill(actors[0], no_restart=True)
+    deadline = time.time() + 30
+    while ray.get(actors[1].poisoned.remote(name), timeout=30) is None:
+        assert time.time() < deadline, "gang never poisoned"
+        time.sleep(0.25)
+    time.sleep(0.5)     # clear the sweep grace window
+    report = ray.get(actors[1].sweep_and_report.remote(name),
+                     timeout=60)
+    assert len(report["orphans"]) == 1, report
+    row = report["orphans"][0]
+    assert row["category"] == "collective_segment"
+    assert row["group"] == name
+    assert row["rank"] == 0             # the dead putter, from the oid
+    assert row["reason"] == "owner_dead"
+    assert 0 in (row.get("dead_ranks") or [])
+    # exactly ONE STORE_LEAK for this group, even after a re-sweep
+    ray.get(actors[1].sweep_and_report.remote(name), timeout=60)
+    report2 = ray.get(actors[1].sweep_and_report.remote(name),
+                      timeout=60)
+    assert len(report2["leaks"]) == 1, report2["leaks"]
+    leak = report2["leaks"][0]
+    assert leak["group"] == name and leak["reason"] == "owner_dead"
+    # the cluster rollup surfaces the same orphan with its provenance
+    from ray_tpu.experimental.state.api import summarize_memory
+
+    rollup = summarize_memory()
+    hits = [r for r in rollup["orphans"] if r.get("group") == name]
+    assert len(hits) == 1 and hits[0]["reason"] == "owner_dead"
+    ray.kill(actors[1], no_restart=True)
+
+
+# ------------------------------------------------------ overhead guard
+
+
+def test_overhead_guard_store_put_get_under_5pct(monkeypatch):
+    """CI satellite: the ledger hooks on the store put/get hot path stay
+    <5% of the op. Separated measurement (the collective guard's
+    pattern): a realistic 4MB put+read+delete cycle is bandwidth-bound
+    and ±10% noisy round to round, so an on-vs-off wall-clock diff over
+    it can never resolve a µs-scale hook — instead (a) resolve the
+    ABSOLUTE per-cycle hook cost on a tiny-payload cycle, where the op
+    is ~20µs and the diff is measurable, then (b) compare that absolute
+    cost against the real data-plane op (consumers copy the bytes out;
+    the memcpys ARE the hot path). min-of-rounds of medians throughout
+    so scheduler noise can't fake an overhead."""
+    import statistics
+
+    from ray_tpu._private import memory_anatomy as ma
+    from ray_tpu._private import telemetry as tm
+    from ray_tpu._private.store_client import StoreClient
+
+    led = ma.Ledger()
+    monkeypatch.setattr(ma, "LEDGER", led)
+    name = f"/raystore_zzma_ovh_{os.getpid()}"
+    c = StoreClient(name, create=True, size=64 * 1024 * 1024,
+                    n_slots=64)
+
+    def cycle(payload, n):
+        samples = []
+        for i in range(n):
+            oid = i.to_bytes(16, "little")
+            t0 = time.perf_counter()
+            c.put(oid, payload)
+            buf = c.get(oid)
+            buf.to_bytes()
+            buf.release()
+            c.delete(oid)
+            samples.append(time.perf_counter() - t0)
+        return statistics.median(samples)
+
+    tiny = b"x" * 64
+    big = os.urandom(4 * 1024 * 1024)
+    try:
+        cycle(big, 5)    # warm slots / page cache
+        on_rounds, off_rounds = [], []
+        for _ in range(5):
+            monkeypatch.setattr(tm, "ENABLED", False)
+            off_rounds.append(cycle(tiny, 60))
+            monkeypatch.setattr(tm, "ENABLED", True)
+            on_rounds.append(cycle(tiny, 60))
+        # absolute instrumentation cost per put+get+delete cycle
+        overhead = max(0.0, min(on_rounds) - min(off_rounds))
+        monkeypatch.setattr(tm, "ENABLED", False)
+        op_cost = min(cycle(big, 25) for _ in range(3))
+        assert overhead < 0.05 * op_cost, (
+            f"ledger hooks add {overhead * 1e6:.1f}µs/op — "
+            f"{overhead / op_cost * 100:.1f}% of a "
+            f"{op_cost * 1e6:.1f}µs 4MB put+read+delete cycle "
+            f"(budget: 5%)")
+    finally:
+        c.close()
+
+
+# ------------------------------------------------------------ surfaces
+
+
+def test_summarize_memory_shape_and_fanout(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.experimental.state.api import summarize_memory
+
+    @ray.remote
+    def touch(x):
+        return x
+
+    ray.get(touch.remote(np.arange(20_000)), timeout=60)
+    out = summarize_memory()
+    for key in ("categories", "live_bytes", "live_objects", "orphans",
+                "orphan_bytes", "dropped_frees", "train_state",
+                "top_owners", "per_process"):
+        assert key in out, key
+    assert out["per_process"], "fan-out returned no ledgers"
+    assert all("ring" not in p for p in out["per_process"])
+    pids = {(p.get("node"), p.get("pid")) for p in out["per_process"]}
+    assert len(pids) == len(out["per_process"]), "dedup failed"
+
+
+def test_flight_recorder_dump_contains_memory_jsonl(ray_start_regular,
+                                                    tmp_path,
+                                                    monkeypatch):
+    import json
+
+    ray = ray_start_regular
+    from ray_tpu._private import flight_recorder as fr
+    from ray_tpu._private import memory_anatomy as ma
+
+    from ray_tpu._private.worker_runtime import current_worker
+
+    led = ma.Ledger()
+    monkeypatch.setattr(ma, "LEDGER", led)
+    # a REAL store object (the dump's snapshot sweeps the ledger
+    # against the store; a fabricated record would be pruned)
+    store = current_worker().store
+    with ma.tagged("checkpoint", owner="ck"):
+        store.put(b"Z" * 16, b"x" * 512)
+    path = fr.dump("zzma_test", out_dir=str(tmp_path))
+    store.delete(b"Z" * 16)
+    assert path is not None
+    mem = os.path.join(path, "memory.jsonl")
+    assert os.path.exists(mem), os.listdir(path)
+    rows = [json.loads(line) for line in open(mem)]
+    summaries = [r for r in rows if r["table"] == "memory_summary"]
+    assert summaries, rows[:3]
+    mine = [r for r in summaries if r.get("pid") == os.getpid()]
+    assert mine and mine[0]["categories"].get("checkpoint")
+    ring = [r for r in rows if r["table"] == "memory_ring"
+            and r.get("pid") == os.getpid()]
+    assert any(r["op"] == "put" and r["category"] == "checkpoint"
+               for r in ring)
