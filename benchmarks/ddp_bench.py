@@ -1,6 +1,7 @@
-"""Bucketed-DDP gradient-sync benchmark — PERF.md round 15 artifact.
+"""Bucketed-DDP gradient-sync benchmark — PERF.md round 15 artifact,
+extended with the ZeRO sharded mode for round 19.
 
-Two phases, one JSON artifact (BENCH_r15.json):
+Phases, one JSON artifact (BENCH_r15.json / BENCH_r19.json):
 
 1. **handle overhead** (`collective_bench.run_async_sweep`): sync
    allreduce baseline vs `allreduce_async` at submission windows 1 and
@@ -14,6 +15,15 @@ Two phases, one JSON artifact (BENCH_r15.json):
    (legacy single synchronous allreduce), same seed, several bucket
    sizes. The headline is p50 of the slowest rank per sync — the
    gang-blocking quantity a train step actually pays.
+3. **ZeRO sharded mode** (`--mode reducescatter`, round 19): the same
+   grad tree synced with ``mode="reducescatter"`` vs the allreduce
+   mode — per-sync wall time AND actual wire bytes per rank (the
+   ``ray_tpu_collective_wire_bytes_total`` counter; at world 2 the
+   pairwise reducescatter pushes HALF the allreduce's bytes) — plus
+   the full optimizer step: ``ZeroOptimizer`` (reducescatter + shard
+   adam + async allgather) vs legacy (allreduce + full-vector adam),
+   with per-rank optimizer-state bytes for both (the O(model/world)
+   fold is the point, the step-time parity is the guardrail).
 
 Sizing note: the whole sweep must fit the node's shm store
 (`object_store_memory`); a single-op sync of G bytes stages ~G/2 of
@@ -88,6 +98,116 @@ def _sync_actor_cls():
                         fam.get("counts")
             return out
 
+        def wire_bytes(self, name):
+            """This rank's cumulative pushed wire bytes for `name`,
+            keyed by op — delta two reads around a sync to get the
+            per-sync wire cost."""
+            from ray_tpu.util.metrics import registry_snapshot
+
+            out = {}
+            for fam in registry_snapshot():
+                if fam["name"] != "ray_tpu_collective_wire_bytes_total":
+                    continue
+                for v in fam.get("values") or []:
+                    if v["tags"].get("group") == name:
+                        op = v["tags"].get("op")
+                        out[op] = out.get(op, 0.0) + v["value"]
+            return out
+
+        def shard_sync_bench(self, rank, name, total_bytes, n_leaves,
+                             bucket_bytes, mode, repeats):
+            """Per-sync wall times for one mode ("allreduce" |
+            "reducescatter") plus the wire-byte delta across the timed
+            region — same tree, same buckets, only the sync shape
+            changes."""
+            os.environ["RAY_TPU_TRAIN_BUCKET_DDP"] = "1"
+            from ray_tpu.train import ddp
+            from ray_tpu.util import collective as col
+
+            rng = np.random.RandomState(3 + rank)
+            per = max(1, int(total_bytes) // 4 // n_leaves)
+            grads = {f"w{i:02d}": rng.standard_normal(per)
+                     .astype(np.float32) for i in range(n_leaves)}
+            ddp.sync_gradients(grads, name, bucket_bytes=bucket_bytes,
+                               mode=mode)                    # warmup
+            col.barrier(name)
+            w0 = self.wire_bytes(name)
+            out = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                ddp.sync_gradients(grads, name,
+                                   bucket_bytes=bucket_bytes, mode=mode)
+                out.append(time.perf_counter() - t0)
+            w1 = self.wire_bytes(name)
+            wire = sum(w1.values()) - sum(w0.values())
+            return {"times": out, "wire_bytes_per_sync": wire / repeats}
+
+        def zero_step_bench(self, rank, name, total_bytes, n_leaves,
+                            bucket_bytes, zero, repeats):
+            """Full optimizer step: ZeroOptimizer (sharded) vs legacy
+            (allreduce + the SAME elementwise adam over the full packed
+            buckets). Returns per-step wall times and this rank's
+            resident optimizer-state bytes."""
+            os.environ["RAY_TPU_TRAIN_BUCKET_DDP"] = "1"
+            from ray_tpu.parallel import sharding as sh
+            from ray_tpu.train import ddp
+
+            rng = np.random.RandomState(3 + rank)
+            per = max(1, int(total_bytes) // 4 // n_leaves)
+            params = {f"w{i:02d}": rng.standard_normal(per)
+                      .astype(np.float32) for i in range(n_leaves)}
+            grads = {f"w{i:02d}": rng.standard_normal(per)
+                     .astype(np.float32) for i in range(n_leaves)}
+            times = []
+            if zero:
+                zopt = ddp.ZeroOptimizer(ddp.zero_adam(0.01), name,
+                                         bucket_bytes=bucket_bytes)
+                params = zopt.step(params, grads)        # warmup + plan
+                for _ in range(repeats):
+                    t0 = time.perf_counter()
+                    params = zopt.step(params, grads)
+                    times.append(time.perf_counter() - t0)
+                return {"times": times,
+                        "state_bytes": zopt.state_bytes(),
+                        "replicated_bytes":
+                            zopt.replicated_state_bytes()}
+            opt = ddp.zero_adam(0.01)
+            leaves, treedef = sh.flatten_tree(params)
+            plan = sh.plan_buckets(leaves, bucket_bytes)
+            state = [opt.init(sum(int(np.asarray(leaves[i]).size)
+                                  for i in b), np.dtype(np.float32))
+                     for b in plan]
+            step_no = 0
+
+            def one_step(params):
+                synced = ddp.sync_gradients(grads, name,
+                                            bucket_bytes=bucket_bytes)
+                gleaves, _ = sh.flatten_tree(synced)
+                pleaves, _ = sh.flatten_tree(params)
+                out = [None] * len(pleaves)
+                for b, indices in enumerate(plan):
+                    pflat = sh.pack_bucket(pleaves, indices)
+                    gflat = sh.pack_bucket(
+                        [np.asarray(g) for g in gleaves], indices)
+                    pflat = opt.apply(pflat, gflat, state[b], step_no)
+                    sh.unpack_bucket(pflat, pleaves, indices, out)
+                return sh.unflatten_tree(treedef, out)
+
+            step_no = 1
+            params = one_step(params)                    # warmup
+            for _ in range(repeats):
+                step_no += 1
+                t0 = time.perf_counter()
+                params = one_step(params)
+                times.append(time.perf_counter() - t0)
+            return {"times": times,
+                    "state_bytes": float(sum(
+                        arr.nbytes for st in state
+                        for arr in st.values())),
+                    "replicated_bytes": float(sum(
+                        arr.nbytes for st in state
+                        for arr in st.values()))}
+
         def destroy(self, name):
             from ray_tpu.util import collective as col
 
@@ -149,6 +269,95 @@ def run_grad_sync(world: int, total_bytes: int, n_leaves: int,
         ray_tpu.shutdown()
 
 
+def run_zero_sweep(world: int, total_bytes: int, n_leaves: int,
+                   bucket_mbs: list[float], repeats: int) -> list[dict]:
+    """The --mode reducescatter phase: sharded vs legacy sync shape
+    (wall time + wire bytes), then the full sharded vs replicated
+    optimizer step (wall time + per-rank state bytes)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(4, world),
+                 object_store_memory=256 * 1024 * 1024)
+    name = "zero_bench"
+    rows = []
+    try:
+        DdpRank = _sync_actor_cls()
+        actors = [DdpRank.options(num_cpus=0).remote()
+                  for _ in range(world)]
+        ray_tpu.get([a.join.remote(world, i, name)
+                     for i, a in enumerate(actors)], timeout=120)
+
+        def sync_row(mode: str, bucket_bytes: int) -> dict:
+            per_rank = ray_tpu.get(
+                [a.shard_sync_bench.remote(i, name, total_bytes,
+                                           n_leaves, bucket_bytes,
+                                           mode, repeats)
+                 for i, a in enumerate(actors)], timeout=1800)
+            per_op = [max(ts) for ts in
+                      zip(*[r["times"] for r in per_rank])]
+            p50 = sorted(per_op)[len(per_op) // 2]
+            return {
+                "phase": "zero_grad_sync", "world": world,
+                "total_bytes": total_bytes, "leaves": n_leaves,
+                "mode": mode, "bucket_bytes": bucket_bytes,
+                "p50_sync_s": round(p50, 6),
+                "best_sync_s": round(min(per_op), 6),
+                "wire_bytes_per_sync_per_rank": round(sum(
+                    r["wire_bytes_per_sync"] for r in per_rank)
+                    / world),
+            }
+
+        def step_row(zero: bool, bucket_bytes: int) -> dict:
+            per_rank = ray_tpu.get(
+                [a.zero_step_bench.remote(i, name, total_bytes,
+                                          n_leaves, bucket_bytes,
+                                          zero, repeats)
+                 for i, a in enumerate(actors)], timeout=1800)
+            per_op = [max(ts) for ts in
+                      zip(*[r["times"] for r in per_rank])]
+            p50 = sorted(per_op)[len(per_op) // 2]
+            return {
+                "phase": "zero_opt_step", "world": world,
+                "total_bytes": total_bytes, "leaves": n_leaves,
+                "sharded": zero, "bucket_bytes": bucket_bytes,
+                "p50_step_s": round(p50, 6),
+                "best_step_s": round(min(per_op), 6),
+                "opt_state_bytes_per_rank": int(
+                    per_rank[0]["state_bytes"]),
+                "replicated_state_bytes": int(
+                    per_rank[0]["replicated_bytes"]),
+            }
+
+        for mb in bucket_mbs:
+            bucket_bytes = int(mb * 2**20)
+            base = sync_row("allreduce", bucket_bytes)
+            rows.append(base)
+            print(json.dumps(base), flush=True)
+            row = sync_row("reducescatter", bucket_bytes)
+            row["wire_fraction_vs_allreduce"] = round(
+                row["wire_bytes_per_sync_per_rank"]
+                / max(1, base["wire_bytes_per_sync_per_rank"]), 3)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+        bucket_bytes = int(bucket_mbs[0] * 2**20)
+        base = step_row(False, bucket_bytes)
+        rows.append(base)
+        print(json.dumps(base), flush=True)
+        row = step_row(True, bucket_bytes)
+        row["p50_step_vs_legacy"] = round(
+            row["p50_step_s"] / base["p50_step_s"], 3)
+        row["state_fold_vs_replicated"] = round(
+            base["replicated_state_bytes"]
+            / max(1, row["opt_state_bytes_per_rank"]), 3)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        ray_tpu.get([a.destroy.remote(name) for a in actors],
+                    timeout=60)
+        return rows
+    finally:
+        ray_tpu.shutdown()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=2)
@@ -161,6 +370,12 @@ def main(argv=None):
                     default=[1, 8])
     ap.add_argument("--skip-async", action="store_true",
                     help="skip the handle-overhead phase")
+    ap.add_argument("--mode", choices=["allreduce", "reducescatter"],
+                    default="allreduce",
+                    help="reducescatter adds the ZeRO sharded sweep "
+                         "(sync shape + full optimizer step)")
+    ap.add_argument("--skip-grad-sync", action="store_true",
+                    help="skip the bucketed-vs-legacy grad-sync phase")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args(argv)
 
@@ -173,8 +388,13 @@ def main(argv=None):
                 [int(mb * 2**20) for mb in args.async_sizes_mb],
                 args.repeats):
             rows.append({"phase": "handle_overhead", **r})
-    rows += run_grad_sync(args.world, int(args.total_mb * 2**20),
-                          args.leaves, args.bucket_mb, args.repeats)
+    if not args.skip_grad_sync:
+        rows += run_grad_sync(args.world, int(args.total_mb * 2**20),
+                              args.leaves, args.bucket_mb, args.repeats)
+    if args.mode == "reducescatter":
+        rows += run_zero_sweep(args.world, int(args.total_mb * 2**20),
+                               args.leaves, args.bucket_mb,
+                               args.repeats)
 
     train_rows = [r for r in rows
                   if r.get("phase") == "train_grad_sync"]

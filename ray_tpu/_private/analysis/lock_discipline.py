@@ -355,6 +355,17 @@ def _scope_for_class(cls: ast.ClassDef) -> _Scope:
             t = node.targets[0]
             if isinstance(t, ast.Attribute) and dotted(t.value) == "self":
                 scope.register_assign(dotted(t), node.value)
+                # ctor-param aliasing: ``self.x = cond`` stores a lock
+                # RECEIVED from the caller (the async-handle pattern —
+                # a completion Condition handed to every handle of an
+                # issue queue). The attribute name may carry no lock
+                # hint, so propagate lock identity from the aliased
+                # NAME instead; notify/wait on it then lints like any
+                # declared lock (RTL107 coverage for handle-completion
+                # conditions on the reducescatter/allgather path).
+                if isinstance(node.value, ast.Name) and \
+                        _is_lockish(node.value.id):
+                    scope.locks.add(dotted(t))
     return scope
 
 

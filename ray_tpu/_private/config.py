@@ -115,6 +115,13 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # sync" for the determinism contract).
     "train_bucket_ddp": True,
     "train_grad_bucket_bytes": 4 * 1024 * 1024,   # target bucket size
+    # DDP sync shape (train/ddp.py): "allreduce" (legacy default —
+    # every rank gets the full synced tree) or "reducescatter"
+    # (ZeRO-style — each rank gets only its shard of every bucket;
+    # pair with train.ddp.ZeroOptimizer for sharded optimizer state
+    # and async param allgathers). The default stays bit-identical to
+    # the pre-sharding behavior.
+    "train_ddp_mode": "allreduce",
     # Pipelined host-collective data path (util/collective/host_backend):
     # one-way zero-copy segment sends, double-buffered so the reduce of
     # segment k overlaps the transfer of segment k+1. Pipeline kill

@@ -129,6 +129,12 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "every drop is counted either way. 0 disables the retry."),
     _k("STORE_SIZE", "268435456", "int",
        "shm object store size in bytes for a spawned node."),
+    _k("TRAIN_DDP_MODE", "allreduce", "str",
+       "gradient-sync shape (train.ddp): allreduce = legacy full-tree "
+       "sync on every rank (bit-identical default); reducescatter = "
+       "ZeRO-style sharded sync — each rank receives only its shard of "
+       "every bucket (pair with ZeroOptimizer for sharded optimizer "
+       "state + async param allgathers)."),
     _k("TRAIN_GRAD_BUCKET_BYTES", "4194304", "int",
        "target size of one gradient-sync bucket (train.ddp): grads are "
        "packed into buckets of about this many bytes and each bucket's "

@@ -287,6 +287,24 @@ CATALOG: dict[str, dict] = {
                        "boundary — the comm the backward pass failed "
                        "to hide",
     },
+    "ray_tpu_train_param_gather_seconds": {
+        "kind": "Histogram", "tags": ("group",),
+        "boundaries": [0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                       5.0, 30.0],
+        "description": "Launch-to-completion latency of one bucket's "
+                       "async param-shard allgather (ZeRO mode: the "
+                       "updated shard returning to every rank; "
+                       "background comm riding the issue thread)",
+    },
+    "ray_tpu_train_param_gather_wait_seconds": {
+        "kind": "Histogram", "tags": ("group",),
+        "boundaries": [0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                       0.5, 1.0, 5.0],
+        "description": "Wall time the train loop was actually BLOCKED "
+                       "waiting a param-shard allgather at first use "
+                       "of the new params (ZeRO mode) — the gather "
+                       "comm the inter-step window failed to hide",
+    },
     # --- gang fault tolerance (train/, util/collective) ---
     "ray_tpu_train_gang_restarts_total": {
         "kind": "Counter", "tags": ("group",),

@@ -79,6 +79,12 @@ _PANELS = [
     ("Grad-sync comm hidden vs exposed",
      "rate(ray_tpu_train_bucket_sync_seconds_sum[5m]) - "
      "rate(ray_tpu_train_bucket_wait_seconds_sum[5m])", "s"),
+    ("Param-gather overlap fraction (ZeRO mode)",
+     "1 - (rate(ray_tpu_train_param_gather_wait_seconds_sum[5m]) / "
+     "rate(ray_tpu_train_param_gather_seconds_sum[5m]))", "percentunit"),
+    ("Optimizer-state bytes per rank (ZeRO shard shrink)",
+     "sum by (rank) (ray_tpu_train_state_bytes{kind=\"opt_state\"})",
+     "bytes"),
     ("Async collective ops in flight",
      "ray_tpu_collective_async_inflight_tasks", "short"),
     ("Collective groups poisoned",

@@ -173,6 +173,54 @@ def unpack_bucket(flat, leaves, indices, out_leaves):
         pos += n
 
 
+def shard_bounds(total: int, parts: int) -> list:
+    """Split ``total`` elements into ``parts`` contiguous ``[lo, hi)``
+    chunks; the first ``total % parts`` chunks are one element longer.
+    This is the SAME divmod math as the host collective backend's
+    ``_split_bounds`` (pinned equal by test): a reducescatter over a
+    packed bucket hands rank r exactly elements ``bounds[r]``, so the
+    sharded-optimizer map below and the wire layer always agree on
+    where a rank's shard of each bucket lives."""
+    total = int(total)
+    parts = max(1, int(parts))
+    base, extra = divmod(total, parts)
+    bounds = []
+    lo = 0
+    for r in range(parts):
+        hi = lo + base + (1 if r < extra else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def plan_shard_map(leaves, plan, world: int) -> list:
+    """Per-bucket shard map for ZeRO-style sharded DDP: one dict per
+    bucket of ``plan`` (from :func:`plan_buckets`) with the bucket's
+    packed element count, dtype, and per-rank ``[lo, hi)`` shard bounds
+    (``shard_bounds(elems, world)``). Depends ONLY on leaf shapes +
+    dtypes + the plan + world size — every rank derives a byte-identical
+    map locally, which is the precondition for each rank to own (and be
+    the sole updater of) the same optimizer-state shard every step."""
+    import numpy as np
+
+    out = []
+    for indices in plan:
+        elems = 0
+        for i in indices:
+            n = 1
+            for d in getattr(leaves[i], "shape", ()):
+                n *= int(d)
+            elems += n
+        dt = np.dtype(getattr(leaves[indices[0]], "dtype", np.float64))
+        out.append({
+            "indices": list(indices),
+            "elems": elems,
+            "dtype": dt,
+            "bounds": shard_bounds(elems, world),
+        })
+    return out
+
+
 def axis_size(mesh: Mesh, axis: Optional[str]) -> int:
     if axis is None:
         return 1

@@ -77,6 +77,37 @@ def make_train_state(
     return state
 
 
+def make_zero_train_state(
+    init_params_fn: Callable[[jax.Array], Any],
+    rng: jax.Array,
+    mesh: Optional[Mesh] = None,
+    param_specs: Any = None,
+) -> TrainState:
+    """ZeRO variant of :func:`make_train_state`: no on-device optimizer
+    state. The state lives in a ``train.ddp.ZeroOptimizer`` instead —
+    sharded over the bucket plan, materialized per rank, and stamped
+    into the ``opt_state`` gauge at shard granularity — so
+    ``TrainState.opt_state`` is the empty tuple and this process's
+    replicated-state footprint is params only."""
+
+    def init_fn(rng):
+        params = init_params_fn(rng)
+        if mesh is not None and param_specs is not None:
+            params = jax.tree_util.tree_map(
+                lambda x, s: jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, s)
+                ),
+                params,
+                param_specs,
+            )
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=())
+
+    state = CompiledFunction(jax.jit(init_fn), "train_state_init")(rng)
+    _note_state_bytes(state)
+    return state
+
+
 def _note_state_bytes(state: TrainState):
     """Stamp ``ray_tpu_train_state_bytes{kind=params|opt_state,rank}``
     from the deterministic flatten — the exact resident footprint of the
@@ -114,6 +145,7 @@ def make_train_step(
     batch_spec: P = P(("dp",), "sp"),
     donate: bool = True,
     host_grad_sync: Optional[Callable[[Any], Any]] = None,
+    host_optimizer: Any = None,
 ):
     """loss_fn(params, batch) -> (scalar_loss, metrics_dict).
 
@@ -129,6 +161,18 @@ def make_train_step(
     splits into two compiled functions so the host collective can run
     in the middle, and the bucketed-DDP plane can overlap that comm
     with the unpack/pack work around it.
+
+    ``host_optimizer`` (a ``train.ddp.ZeroOptimizer``; mutually
+    exclusive with ``host_grad_sync`` and ``optimizer``-driven apply)
+    selects the ZeRO-sharded host path: the jitted function computes
+    grads only, the sharded optimizer reducescatters them, applies this
+    rank's shards, and allgathers updated params ASYNC — the returned
+    ``step`` waits those gathers at the START of the next call (first
+    use), so everything between steps overlaps the gather comm. The
+    step function exposes ``step.finalize(state)`` — call it once after
+    the loop to fold the last step's in-flight params into the state.
+    ``metrics["grad_norm"]`` in this mode is the LOCAL pre-sync norm
+    (the synced grads exist only as shards).
     """
 
     def _constrain_batch(batch):
@@ -140,6 +184,51 @@ def make_train_step(
                 batch,
             )
         return batch
+
+    if host_optimizer is not None:
+        if host_grad_sync is not None:
+            raise ValueError("host_optimizer and host_grad_sync are "
+                             "mutually exclusive — the sharded "
+                             "optimizer owns the gradient sync")
+
+        def zgrad_step(params, batch):
+            batch = _constrain_batch(batch)
+            (_loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            return dict(metrics), grads, optax.global_norm(grads)
+
+        zgrad_fn = CompiledFunction(jax.jit(zgrad_step),
+                                    "train_grad_step")
+        box = {"pending": None}
+
+        def resolve(state: TrainState) -> TrainState:
+            pending = box["pending"]
+            if pending is None:
+                return state
+            box["pending"] = None
+            # first use of the previous step's params: the allgathers
+            # rode the issue thread through everything the caller did
+            # since step_async returned; only the residue blocks here.
+            # timeout=None defers to the per-op collective deadline so
+            # a dead peer surfaces as CollectiveGroupError, not a hang
+            return dataclasses.replace(
+                state, params=pending.result(timeout=None))
+
+        def step(state: TrainState, batch):
+            state = resolve(state)
+            metrics, grads, grad_norm = zgrad_fn(state.params, batch)
+            box["pending"] = host_optimizer.step_async(state.params,
+                                                       grads)
+            metrics = dict(metrics)
+            metrics["grad_norm"] = grad_norm
+            return (
+                TrainState(step=state.step + 1, params=state.params,
+                           opt_state=state.opt_state),
+                metrics,
+            )
+
+        step.finalize = resolve
+        return step
 
     if host_grad_sync is None:
         def step(state: TrainState, batch):

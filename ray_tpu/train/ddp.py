@@ -152,20 +152,233 @@ class _DoneSync:
         return self._result
 
 
+class PendingShardSync:
+    """In-flight sharded (ZeRO-style) gradient sync: every bucket's
+    async reducescatter has been launched; each handle resolves to THIS
+    rank's contiguous shard of the bucket's reduction. The shard map
+    (``parallel/sharding.plan_shard_map``) is derived from shapes +
+    dtypes only, so every rank agrees on who owns which ``[lo, hi)``
+    slice of each packed bucket — the precondition for each rank to be
+    the sole updater of its optimizer-state shard. ``wait_bucket(b)``
+    harvests one bucket (the sharded optimizer's per-bucket hook);
+    ``result()`` harvests all and returns the per-bucket shard list."""
+
+    mode = "reducescatter"
+
+    def __init__(self, group: str, treedef, leaves, plan, shard_map,
+                 launched, world: int, average: bool,
+                 rank: int | None = None):
+        self._group = group
+        self._treedef = treedef
+        self._leaves = leaves
+        self._plan = plan
+        self._shard_map = shard_map
+        self._launched = launched    # [(indices, handle, t_launch, nbytes)]
+        self._world = world
+        self._average = average
+        self._rank = rank
+        self._shards: list = [None] * len(launched)
+        self._next = 0               # harvest progress (retry-safe)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self._launched)
+
+    @property
+    def shard_map(self):
+        return self._shard_map
+
+    def poll(self) -> bool:
+        return all(h.poll() for _, h, _, _ in self._launched)
+
+    def _harvest_next(self, timeout: float | None):
+        from ray_tpu.util import tracing as _tracing
+
+        b = self._next
+        indices, handle, t_launch, nbytes = self._launched[b]
+        tags = {"group": self._group}
+        t0 = time.perf_counter()
+        with _prof.record_span("train", f"grad_bucket_wait::{b}",
+                               {"group": self._group, "bucket": b}):
+            with _tracing.span(f"grad_bucket_wait {b}", "INTERNAL",
+                               attributes={"group": self._group,
+                                           "bucket": b}):
+                flat = handle.result(timeout)
+        now = time.perf_counter()
+        if _tm.ENABLED and self._rank is not None:
+            _ma.LEDGER.add_inflight(self._rank, -float(nbytes))
+        if _tm.ENABLED:
+            _tm.observe("ray_tpu_train_bucket_wait_seconds",
+                        now - t0, tags=tags)
+            _tm.observe("ray_tpu_train_bucket_sync_seconds",
+                        (handle.done_at or now) - t_launch, tags=tags)
+        if self._average:
+            flat = flat / self._world
+        self._shards[b] = flat
+        self._next = b + 1
+
+    def wait_bucket(self, b: int, timeout: float | None = None):
+        """This rank's reduced (or averaged) shard of bucket ``b``;
+        harvests in launch order (handles complete FIFO on the issue
+        thread, so waiting bucket b implies buckets < b are done)."""
+        while self._next <= b:
+            self._harvest_next(timeout)
+        return self._shards[b]
+
+    def result(self, timeout: float | None = None) -> list:
+        """Harvest every bucket; returns the list of this rank's
+        per-bucket shard arrays (use ``shard_map`` to locate them in
+        the packed buckets)."""
+        while self._next < len(self._launched):
+            self._harvest_next(timeout)
+        self._launched = []
+        return self._shards
+
+
+class _DoneShardSync:
+    """Kill-switch / degenerate sharded result: the reducescatters
+    already ran synchronously; same surface as PendingShardSync."""
+
+    mode = "reducescatter"
+
+    def __init__(self, shards, shard_map, plan):
+        self._shards = shards
+        self._shard_map = shard_map
+        self._plan = plan
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self._shards)
+
+    @property
+    def shard_map(self):
+        return self._shard_map
+
+    def poll(self) -> bool:
+        return True
+
+    def wait_bucket(self, b: int, timeout: float | None = None):
+        return self._shards[b]
+
+    def result(self, timeout: float | None = None) -> list:
+        return self._shards
+
+
+def _resolve_mode(mode) -> str:
+    m = mode if mode is not None else _get_config("train_ddp_mode")
+    m = str(m).strip().lower()
+    if m not in ("allreduce", "reducescatter"):
+        raise ValueError(
+            f"train DDP mode {mode!r}: expected 'allreduce' (legacy, "
+            f"every rank gets the full synced tree) or 'reducescatter' "
+            f"(ZeRO-style, each rank gets its shard of every bucket)")
+    return m
+
+
+def _sync_shards_async(grads, group_name: str, *, average: bool,
+                       bucket_bytes: int | None, wire_dtype):
+    """The ``mode="reducescatter"`` launch path: one async
+    reducescatter per bucket, each handle yielding only this rank's
+    shard — roughly half the wire bytes of an allreduce per bucket
+    (each element crosses the wire once instead of reduce+broadcast).
+    With ``RAY_TPU_TRAIN_BUCKET_DDP=0`` (or a backend without async
+    support) the SAME bucket plan runs through synchronous
+    reducescatters instead — the shard map must not change with the
+    kill switch, or optimizer state sharded over it would be orphaned
+    mid-run; only the overlap is given up."""
+    from ray_tpu.parallel import sharding as _sh
+    from ray_tpu.util import collective as col
+
+    leaves, treedef = _sh.flatten_tree(grads)
+    world = col.get_collective_group_size(group_name)
+    if bucket_bytes is None:
+        bucket_bytes = int(_get_config("train_grad_bucket_bytes"))
+    plan = _sh.plan_buckets(leaves, bucket_bytes)
+    shard_map = _sh.plan_shard_map(leaves, plan, world)
+    rank = None
+    tags = {"group": group_name}
+    if _tm.ENABLED:
+        try:
+            rank = col.get_rank(group_name)
+        except Exception:
+            rank = None
+        if rank is not None:
+            _ma.LEDGER.note_train_state(
+                "grads", rank, float(sum(l.nbytes for l in leaves)))
+    wire_of = wire_dtype if callable(wire_dtype) else (
+        lambda b, indices: wire_dtype)
+    bucketed = bool(_get_config("train_bucket_ddp"))
+    if not bucketed or not col.supports_async(group_name):
+        shards = []
+        for b, indices in enumerate(plan):
+            flat = _sh.pack_bucket(leaves, indices)
+            if _tm.ENABLED:
+                _tm.observe("ray_tpu_train_bucket_bytes",
+                            float(flat.nbytes), tags=tags)
+                _tm.counter_inc("ray_tpu_train_buckets_total", tags=tags)
+            shard = col.reducescatter(flat, group_name)
+            if average:
+                shard = shard / world
+            shards.append(shard)
+        return _DoneShardSync(shards, shard_map, plan)
+    launched = []
+    for b, indices in enumerate(plan):
+        with _prof.record_span("train", f"grad_bucket_pack::{b}",
+                               {"group": group_name, "bucket": b}):
+            flat = _sh.pack_bucket(leaves, indices)
+        if _tm.ENABLED:
+            _tm.observe("ray_tpu_train_bucket_bytes", float(flat.nbytes),
+                        tags=tags)
+            _tm.counter_inc("ray_tpu_train_buckets_total", tags=tags)
+            if rank is not None:
+                _ma.LEDGER.add_inflight(rank, float(flat.nbytes))
+        launched.append((indices,
+                         col.reducescatter_async(
+                             flat, group_name,
+                             wire_dtype=wire_of(b, indices)),
+                         time.perf_counter(), float(flat.nbytes)))
+    return PendingShardSync(group_name, treedef, leaves, plan, shard_map,
+                            launched, world, average, rank=rank)
+
+
 def sync_gradients_async(grads, group_name: str = "train_dp", *,
                          average: bool = False,
-                         bucket_bytes: int | None = None):
+                         bucket_bytes: int | None = None,
+                         mode: str | None = None,
+                         wire_dtype=None):
     """Launch the bucketed gradient sync and return a
     ``PendingGradSync`` immediately — overlap the comm with anything
     (the next microbatch's forward, metrics, logging), then call
     ``.result()`` at the optimizer boundary.
 
+    ``mode`` (default: the ``RAY_TPU_TRAIN_DDP_MODE`` config knob,
+    ``allreduce``) selects the sync shape: ``allreduce`` returns the
+    full synced tree on every rank; ``reducescatter`` is the ZeRO-style
+    sharded sync — the returned ``PendingShardSync`` yields only this
+    rank's ``[lo, hi)`` shard of each packed bucket (see
+    ``ZeroOptimizer`` for the sharded optimizer riding it).
+    ``wire_dtype`` ("bf16"/"int8", or a ``(bucket, indices) -> fmt``
+    callable for per-bucket opt-in) quantizes the reducescatter wire;
+    it applies to the sharded mode only.
+
     With ``RAY_TPU_TRAIN_BUCKET_DDP=0`` the legacy path runs instead:
     one synchronous allreduce over the whole flattened tree (one op per
-    dtype for mixed-dtype trees), completed before this returns."""
+    dtype for mixed-dtype trees), completed before this returns — and
+    the sharded mode degrades to synchronous per-bucket reducescatters
+    over the unchanged shard map."""
     from ray_tpu.parallel import sharding as _sh
     from ray_tpu.util import collective as col
 
+    mode = _resolve_mode(mode)
+    if mode == "reducescatter":
+        return _sync_shards_async(grads, group_name, average=average,
+                                  bucket_bytes=bucket_bytes,
+                                  wire_dtype=wire_dtype)
+    if wire_dtype is not None:
+        raise ValueError(
+            "wire_dtype is a per-bucket opt-in on the reducescatter "
+            "path; the allreduce mode composes with the group-wide "
+            "RAY_TPU_COLLECTIVE_WIRE_DTYPE knob instead")
     leaves, treedef = _sh.flatten_tree(grads)
     world = col.get_collective_group_size(group_name)
     if not leaves or world == 1:
@@ -225,14 +438,456 @@ def sync_gradients_async(grads, group_name: str = "train_dp", *,
 
 def sync_gradients(grads, group_name: str = "train_dp", *,
                    average: bool = False,
-                   bucket_bytes: int | None = None):
+                   bucket_bytes: int | None = None,
+                   mode: str | None = None,
+                   wire_dtype=None):
     """Synchronize one grad pytree across the data-parallel gang and
-    return the summed (or averaged) grads. Bucketed + async under the
-    hood (see module docstring); the pack/unpack of neighboring buckets
-    still overlaps each bucket's comm even though this call itself
-    blocks until the full tree is synced."""
+    return the summed (or averaged) grads — or, in
+    ``mode="reducescatter"``, the list of this rank's per-bucket
+    shards. Bucketed + async under the hood (see module docstring);
+    the pack/unpack of neighboring buckets still overlaps each bucket's
+    comm even though this call itself blocks until the sync is done."""
     # timeout=None = the collective op timeout per bucket (the wire's
     # failure detector of last resort) — bounded, never a silent hang
     return sync_gradients_async(
-        grads, group_name, average=average,
-        bucket_bytes=bucket_bytes).result(timeout=None)
+        grads, group_name, average=average, bucket_bytes=bucket_bytes,
+        mode=mode, wire_dtype=wire_dtype).result(timeout=None)
+
+
+# ------------------------------------------------- sharded optimizer (ZeRO)
+#
+# ZeRO-1/2-style sharded optimizer over the bucket plan: grads arrive
+# per-bucket via reducescatter (each rank holds only its [lo, hi) shard
+# of every bucket), the optimizer state for that shard lives ONLY on
+# its owner rank (O(model/world) state per rank instead of O(model)),
+# and updated param shards return via per-bucket ASYNC allgathers that
+# ride the issue thread while later buckets are still applying — and
+# while the caller runs the next step's work, because the gather
+# handles are waited only at first use of the new params.
+#
+# The shard optimizers here are strictly ELEMENTWISE numpy updates
+# (sgd/momentum/adam): applying them per-shard then allgathering is
+# exactly the computation legacy mode runs on the full vector, element
+# for element — so at world 2, where the pairwise exchange makes
+# reducescatter's shard bit-identical to the allreduce result's same
+# slice, the final params are bit-identical to legacy allreduce + full
+# apply (pinned by test). Optimizers with cross-element coupling
+# (global grad-norm clipping, LAMB trust ratios) would need an extra
+# scalar sync per step and are deliberately out of scope.
+
+
+class _SgdShard:
+    """Elementwise SGD (+momentum) on one shard; state: momentum only."""
+
+    name = "sgd"
+
+    def __init__(self, lr: float, momentum: float = 0.0):
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.slots = 1 if momentum else 0
+
+    def init(self, nelems: int, dtype):
+        import numpy as np
+
+        if not self.momentum:
+            return {}
+        return {"m": np.zeros(nelems, dtype=dtype)}
+
+    def apply(self, p, g, state, step: int):
+        if self.momentum:
+            m = state["m"]
+            m *= self.momentum
+            m += g
+            p -= self.lr * m
+        else:
+            p -= self.lr * g
+        return p
+
+
+class _AdamShard:
+    """Elementwise Adam on one shard; state: first + second moments."""
+
+    name = "adam"
+    slots = 2
+
+    def __init__(self, lr: float, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8):
+        self.lr = float(lr)
+        self.b1 = float(b1)
+        self.b2 = float(b2)
+        self.eps = float(eps)
+
+    def init(self, nelems: int, dtype):
+        import numpy as np
+
+        return {"m": np.zeros(nelems, dtype=dtype),
+                "v": np.zeros(nelems, dtype=dtype)}
+
+    def apply(self, p, g, state, step: int):
+        import numpy as np
+
+        m, v = state["m"], state["v"]
+        m *= self.b1
+        m += (1.0 - self.b1) * g
+        v *= self.b2
+        v += (1.0 - self.b2) * (g * g)
+        mhat = m / (1.0 - self.b1 ** step)
+        vhat = v / (1.0 - self.b2 ** step)
+        p -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return p
+
+
+def zero_sgd(lr: float, momentum: float = 0.0) -> _SgdShard:
+    """Shard optimizer for :class:`ZeroOptimizer`: elementwise SGD."""
+    return _SgdShard(lr, momentum)
+
+
+def zero_adam(lr: float, b1: float = 0.9, b2: float = 0.999,
+              eps: float = 1e-8) -> _AdamShard:
+    """Shard optimizer for :class:`ZeroOptimizer`: elementwise Adam."""
+    return _AdamShard(lr, b1, b2, eps)
+
+
+class PendingParams:
+    """In-flight sharded apply: every bucket's updated param shard has
+    an async allgather on the wire. ``result()`` waits the gathers at
+    FIRST USE, reassembles each packed bucket from the per-rank shards,
+    and unflattens the new params tree — so the gathers overlap
+    whatever the caller runs between the optimizer step and the next
+    forward (data loading, metrics, host→device transfer), and step
+    anatomy attributes that comm as hidden."""
+
+    def __init__(self, group: str, treedef, leaves, plan, shard_map,
+                 gathers, rank: int | None):
+        self._group = group
+        self._treedef = treedef
+        self._leaves = leaves
+        self._plan = plan
+        self._shard_map = shard_map
+        self._gathers = gathers      # [(b, handle, t_launch, nbytes)]
+        self._rank = rank
+        self._result = None
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self._gathers)
+
+    def poll(self) -> bool:
+        return all(h.poll() for _, h, _, _ in self._gathers)
+
+    def result(self, timeout: float | None = None):
+        """The updated params pytree; blocks on any allgather still in
+        flight (the residue the overlap window failed to hide)."""
+        if self._result is not None:
+            return self._result
+        import numpy as np
+
+        from ray_tpu.parallel import sharding as _sh
+
+        tags = {"group": self._group}
+        out_leaves: list = [None] * len(self._leaves)
+        done = [None] * len(self._plan)
+        for b, handle, t_launch, nbytes in self._gathers:
+            t0 = time.perf_counter()
+            parts = handle.result(timeout)
+            now = time.perf_counter()
+            if _tm.ENABLED:
+                _tm.observe("ray_tpu_train_param_gather_wait_seconds",
+                            now - t0, tags=tags)
+                _tm.observe("ray_tpu_train_param_gather_seconds",
+                            (handle.done_at or now) - t_launch,
+                            tags=tags)
+                if self._rank is not None:
+                    _ma.LEDGER.add_inflight(self._rank, -float(nbytes))
+            # shard bounds are contiguous in rank order, so the packed
+            # bucket is exactly the rank-ordered concatenation
+            done[b] = np.concatenate([np.asarray(p).reshape(-1)
+                                      for p in parts])
+        for b, indices in enumerate(self._plan):
+            if done[b] is not None:
+                _sh.unpack_bucket(done[b], self._leaves, indices,
+                                  out_leaves)
+        # leaves the plan never covered (empty tree edge) stay original
+        for i, leaf in enumerate(self._leaves):
+            if out_leaves[i] is None:
+                out_leaves[i] = leaf
+        self._result = _sh.unflatten_tree(self._treedef, out_leaves)
+        self._gathers = []
+        self._leaves = []
+        return self._result
+
+
+class ZeroOptimizer:
+    """ZeRO-style sharded optimizer over the DDP bucket plan.
+
+    Each rank owns the ``[lo, hi)`` shard of every packed bucket that
+    the deterministic shard map (``parallel/sharding.plan_shard_map``,
+    same divmod split as the collective backend's reducescatter)
+    assigns it, materializes optimizer state for ONLY that shard, and
+    updates only those elements each step — the O(model) replicated
+    optimizer state of legacy DDP becomes O(model/world) per rank,
+    proven live via the ``ray_tpu_train_state_bytes{kind=opt_state}``
+    gauge this class stamps.
+
+    Step pipeline (``step_async``): per bucket, fold the last
+    microbatch's grads → launch ``reducescatter_async`` (bucket b's
+    wire time hides under bucket b+1's pack), then harvest: wait shard
+    b → elementwise apply on the shard → launch ``allgather_async`` of
+    the updated param shard — the gather of bucket k rides the issue
+    thread under the apply of bucket k+1, and the returned
+    :class:`PendingParams` waits the gathers only at first use.
+    ``accumulate(grads)`` is the grad-accumulation hook: earlier
+    microbatches fold into host accumulators with no comm; the final
+    microbatch goes straight to ``step_async`` so each bucket launches
+    the moment its fold completes, not at the step boundary.
+
+    ``state_budget_bytes`` (optional) is a hard per-rank cap: state
+    materialization raises when this rank's shard state would exceed
+    it — the acceptance harness trains models whose REPLICATED state
+    breaks the budget that the sharded state fits.
+    """
+
+    def __init__(self, opt, group_name: str = "train_dp", *,
+                 bucket_bytes: int | None = None, wire_dtype=None,
+                 state_budget_bytes: int | None = None,
+                 average: bool = False):
+        self._opt = opt
+        self._group = group_name
+        self._bucket_bytes = bucket_bytes
+        self._wire = wire_dtype
+        self._budget = state_budget_bytes
+        self._average = average
+        self._plan = None
+        self._shard_map = None
+        self._sig = None             # (shape, dtype) leaf signature
+        self._state: dict = {}       # bucket -> this rank's state dict
+        self._acc: list | None = None
+        self._step = 0
+        self._world = None
+        self._rank = None
+
+    # ------------------------------------------------------------ plan
+    def _ensure_plan(self, leaves):
+        from ray_tpu.parallel import sharding as _sh
+        from ray_tpu.util import collective as col
+
+        sig = tuple((tuple(getattr(l, "shape", ())),
+                     str(getattr(l, "dtype", "object"))) for l in leaves)
+        if sig == self._sig:
+            return
+        if self._sig is not None:
+            # structure changed mid-run: the shard map (and therefore
+            # every rank's state slices) is stale — refuse to guess
+            raise ValueError(
+                "ZeroOptimizer: param/grad tree structure changed; the "
+                "bucket shard map (and the optimizer state sharded "
+                "over it) is derived from leaf shapes and cannot be "
+                "remapped in place")
+        bucket_bytes = self._bucket_bytes
+        if bucket_bytes is None:
+            bucket_bytes = int(_get_config("train_grad_bucket_bytes"))
+        self._world = col.get_collective_group_size(self._group)
+        self._rank = col.get_rank(self._group)
+        self._plan = _sh.plan_buckets(leaves, bucket_bytes)
+        self._shard_map = _sh.plan_shard_map(leaves, self._plan,
+                                             self._world)
+        self._sig = sig
+
+    def _my_bounds(self, b: int):
+        return self._shard_map[b]["bounds"][self._rank]
+
+    # ----------------------------------------------------------- state
+    def _shard_state(self, b: int) -> dict:
+        st = self._state.get(b)
+        if st is None:
+            lo, hi = self._my_bounds(b)
+            st = self._opt.init(hi - lo, self._shard_map[b]["dtype"])
+            self._state[b] = st
+            self._note_state()
+        return st
+
+    def _note_state(self):
+        total = self.state_bytes()
+        if self._budget is not None and total > self._budget:
+            raise RuntimeError(
+                f"ZeroOptimizer: this rank's optimizer-state shard "
+                f"({int(total)} bytes) exceeds the per-rank budget "
+                f"({int(self._budget)} bytes) — raise the budget, "
+                f"grow the gang, or use a lighter optimizer")
+        if _tm.ENABLED and self._rank is not None:
+            _ma.LEDGER.note_train_state("opt_state", self._rank,
+                                        float(total))
+
+    def state_bytes(self) -> float:
+        """Exact flatten-sum of this rank's materialized shard state —
+        the number the opt_state gauge carries."""
+        return float(sum(arr.nbytes for st in self._state.values()
+                         for arr in st.values()))
+
+    def replicated_state_bytes(self) -> float:
+        """What ONE rank would hold if the state were replicated (the
+        legacy-DDP footprint): slots × elements × itemsize over the
+        whole plan. The world-fold claim is
+        ``state_bytes() ≈ replicated_state_bytes() / world``."""
+        if self._shard_map is None:
+            raise ValueError("ZeroOptimizer: no plan yet (run a step "
+                             "or accumulate first)")
+        slots = int(getattr(self._opt, "slots", 0))
+        return float(sum(e["elems"] * e["dtype"].itemsize * slots
+                         for e in self._shard_map))
+
+    @property
+    def shard_map(self):
+        return self._shard_map
+
+    @property
+    def step_count(self) -> int:
+        return self._step
+
+    # ------------------------------------------------------------ step
+    def accumulate(self, grads):
+        """Grad-accumulation hook: fold one microbatch's grads into the
+        host-side per-bucket accumulators (pack + add; no comm). Feed
+        the FINAL microbatch to ``step_async(params, grads=...)``
+        instead — its fold interleaves with the bucket launches."""
+        from ray_tpu.parallel import sharding as _sh
+
+        leaves, _ = _sh.flatten_tree(grads)
+        self._ensure_plan(leaves)
+        if self._acc is None:
+            self._acc = [None] * len(self._plan)
+        for b, indices in enumerate(self._plan):
+            flat = _sh.pack_bucket(leaves, indices)
+            if self._acc[b] is None:
+                self._acc[b] = flat   # pack allocates: safe to own
+            else:
+                self._acc[b] += flat
+
+    def step_async(self, params, grads=None,
+                   timeout: float | None = None) -> PendingParams:
+        """One sharded optimizer step. Folds ``grads`` (the last — or
+        only — microbatch; optional when ``accumulate`` already folded
+        everything), launches the per-bucket reducescatters as each
+        bucket's fold completes, applies this rank's shards as they
+        land (later buckets' wire time and earlier buckets' gathers
+        hide under the apply), and returns a :class:`PendingParams`
+        with the allgathers in flight."""
+        import numpy as np
+
+        from ray_tpu.parallel import sharding as _sh
+        from ray_tpu.util import collective as col
+
+        leaves, treedef = _sh.flatten_tree(params)
+        self._ensure_plan(leaves)
+        if grads is None and self._acc is None:
+            raise ValueError("ZeroOptimizer.step_async: no grads — "
+                             "pass grads= or call accumulate() first")
+        gleaves = None
+        if grads is not None:
+            gleaves, _ = _sh.flatten_tree(grads)
+        self._step += 1
+        tags = {"group": self._group}
+        rank = self._rank if _tm.ENABLED else None
+        if rank is not None:
+            _ma.LEDGER.note_train_state(
+                "grads", rank,
+                float(sum(l.nbytes for l in (gleaves or leaves))))
+        wire_of = self._wire if callable(self._wire) else (
+            lambda b, indices: self._wire)
+        bucketed = (bool(_get_config("train_bucket_ddp"))
+                    and col.supports_async(self._group))
+        # launch: fold bucket b, put its reducescatter on the wire,
+        # move on to folding b+1 — grads go out as they become final
+        launched = []
+        for b, indices in enumerate(self._plan):
+            flat = None
+            if gleaves is not None:
+                with _prof.record_span(
+                        "train", f"grad_bucket_pack::{b}",
+                        {"group": self._group, "bucket": b}):
+                    flat = _sh.pack_bucket(gleaves, indices)
+                if self._acc is not None and self._acc[b] is not None:
+                    flat += self._acc[b]
+            else:
+                flat = self._acc[b]
+            if _tm.ENABLED:
+                _tm.observe("ray_tpu_train_bucket_bytes",
+                            float(flat.nbytes), tags=tags)
+                _tm.counter_inc("ray_tpu_train_buckets_total", tags=tags)
+            if bucketed:
+                if rank is not None:
+                    _ma.LEDGER.add_inflight(rank, float(flat.nbytes))
+                launched.append(
+                    (indices,
+                     col.reducescatter_async(
+                         flat, self._group,
+                         wire_dtype=wire_of(b, indices)),
+                     time.perf_counter(), float(flat.nbytes)))
+            else:
+                launched.append((indices, flat, None, None))
+        self._acc = None
+        # harvest: wait shard b, apply, launch its allgather — while
+        # this rank runs the apply math, bucket b+1's reducescatter and
+        # buckets < b's allgathers proceed on the issue thread
+        gathers = []
+        for b, (indices, h, t_launch, nbytes) in enumerate(launched):
+            lo, hi = self._my_bounds(b)
+            with _prof.record_span("train", f"param_shard_pack::{b}",
+                                   {"group": self._group, "bucket": b}):
+                pflat = _sh.pack_bucket(leaves, indices)
+            pshard = np.array(pflat[lo:hi])  # own the slice memory
+            if bucketed:
+                t0 = time.perf_counter()
+                gshard = h.result(timeout)
+                now = time.perf_counter()
+                if _tm.ENABLED:
+                    _tm.observe("ray_tpu_train_bucket_wait_seconds",
+                                now - t0, tags=tags)
+                    _tm.observe("ray_tpu_train_bucket_sync_seconds",
+                                (h.done_at or now) - t_launch, tags=tags)
+                    if rank is not None:
+                        _ma.LEDGER.add_inflight(rank, -float(nbytes))
+            else:
+                gshard = col.reducescatter(h, self._group)
+            if self._average:
+                gshard = gshard / self._world
+            st = self._shard_state(b)
+            with _prof.record_span("train", f"shard_apply::{b}",
+                                   {"group": self._group, "bucket": b}):
+                pshard = self._opt.apply(pshard, np.asarray(gshard), st,
+                                         self._step)
+            bucket_bytes_full = float(
+                self._shard_map[b]["elems"]
+                * self._shard_map[b]["dtype"].itemsize)
+            if bucketed:
+                if rank is not None:
+                    _ma.LEDGER.add_inflight(rank, bucket_bytes_full)
+                gathers.append((b, col.allgather_async(pshard,
+                                                       self._group),
+                                time.perf_counter(), bucket_bytes_full))
+            else:
+                parts = col.allgather(pshard, self._group)
+                gathers.append((b, _DoneHandle(parts), time.perf_counter(),
+                                0.0))
+        return PendingParams(self._group, treedef, leaves, self._plan,
+                             self._shard_map, gathers, rank)
+
+    def step(self, params, grads=None, timeout: float | None = None):
+        """Blocking convenience: ``step_async(...).result()``."""
+        return self.step_async(params, grads, timeout).result(timeout)
+
+
+class _DoneHandle:
+    """Completed pseudo-handle for the kill-switch path: the op already
+    ran synchronously; PendingParams treats it like a real handle."""
+
+    done_at = None
+
+    def __init__(self, value):
+        self._value = value
+
+    def poll(self) -> bool:
+        return True
+
+    def result(self, timeout: float | None = None):
+        return self._value

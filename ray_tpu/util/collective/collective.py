@@ -566,13 +566,32 @@ def allreduce_async(tensor, group_name: str = "default", op: str = "sum"):
 
 
 def reducescatter_async(tensor, group_name: str = "default",
-                        op: str = "sum"):
+                        op: str = "sum", wire_dtype: str | None = None):
     """Async reducescatter: each rank's handle resolves to its rank-th
-    chunk of the reduction. Same contract as ``allreduce_async``."""
+    chunk of the reduction. Same contract as ``allreduce_async``.
+    ``wire_dtype`` ("bf16"/"int8") opts THIS op's ring segments into
+    wire quantization (same eligibility rules as the config knob:
+    float32 sum, pipelined path) — sharded DDP uses it for per-bucket
+    opt-in without flipping the group-wide knob."""
     g = _manager.get(group_name)
     arr = _coerce(g, tensor)
-    return _submit_async(g, "reducescatter", arr,
-                         lambda seq: g.impl.reducescatter(arr, op, seq))
+    return _submit_async(
+        g, "reducescatter", arr,
+        lambda seq: g.impl.reducescatter(arr, op, seq,
+                                         wire_fmt=wire_dtype)
+        if wire_dtype is not None else g.impl.reducescatter(arr, op, seq))
+
+
+def allgather_async(tensor, group_name: str = "default"):
+    """Async allgather: the handle resolves to the list of per-rank
+    arrays (this rank's entry is the input, not a copy). Same handle
+    contract as ``allreduce_async`` — submission-order issue thread,
+    poison fast-fail, host backend only. Sharded DDP rides this to
+    gather updated param shards while later buckets are still applying."""
+    g = _manager.get(group_name)
+    arr = _coerce(g, tensor)
+    return _submit_async(g, "allgather", arr,
+                         lambda seq: g.impl.allgather(arr, seq))
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",

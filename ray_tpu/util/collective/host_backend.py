@@ -300,17 +300,23 @@ class HostGroup:
         return max(1, int(get_config("collective_segment_bytes"))
                    // max(1, int(itemsize)))
 
-    def _wire_ctx(self, dtype, op: str) -> _wire.WireCodec | None:
+    def _wire_ctx(self, dtype, op: str,
+                  override=None) -> _wire.WireCodec | None:
         """The group's wire-quantization codec for one (dtype, op), or
         None for the exact path. ``off`` (the default) and the legacy
         ring always return None; an unknown format name raises rather
         than silently sending exact. Eligibility beyond the format
         knob: float32 ``sum`` only — ints and prod/min/max have no
         bounded-error story, float64 would LOSE precision through a
-        float32-scaled wire."""
+        float32-scaled wire. ``override`` is a per-CALL format name
+        (sharded DDP opts buckets in individually); it replaces the
+        config knob for this op but passes through the same
+        normalization and eligibility checks."""
         from ray_tpu._private.config import get_config
 
-        fmt = _wire.normalize_format(get_config("collective_wire_dtype"))
+        fmt = _wire.normalize_format(
+            get_config("collective_wire_dtype") if override is None
+            else override)
         if fmt is None:
             return None
         if not self._pipelined():
@@ -657,11 +663,19 @@ class HostGroup:
         return self._issue.submit("allreduce", seq,
                                   lambda: self.allreduce(arr, op, seq))
 
-    def reducescatter_async(self, arr: np.ndarray, op: str,
-                            seq: int) -> CollectiveHandle:
+    def reducescatter_async(self, arr: np.ndarray, op: str, seq: int,
+                            wire_fmt=None) -> CollectiveHandle:
         arr = np.asarray(arr)
-        return self._issue.submit("reducescatter", seq,
-                                  lambda: self.reducescatter(arr, op, seq))
+        return self._issue.submit(
+            "reducescatter", seq,
+            lambda: self.reducescatter(arr, op, seq, wire_fmt=wire_fmt))
+
+    def allgather_async(self, arr, seq: int) -> CollectiveHandle:
+        """Bare async allgather; resolves to the list of per-rank
+        arrays. The caller must not mutate ``arr`` until the handle
+        completes (the issue thread reads it at send time)."""
+        return self._issue.submit("allgather", seq,
+                                  lambda: self.allgather(arr, seq))
 
     def drain_async(self, timeout: float | None = None):
         """Barrier for mixed sync/async call sites: block until every
@@ -921,7 +935,8 @@ class HostGroup:
             chunks[recv_idx] = self._recv(left, ("ag", seq, s))
         return np.concatenate(chunks).reshape(arr.shape)
 
-    def reducescatter(self, arr: np.ndarray, op: str, seq: int) -> np.ndarray:
+    def reducescatter(self, arr: np.ndarray, op: str, seq: int,
+                      wire_fmt=None) -> np.ndarray:
         n = self.world_size
         if n == 1:
             # the 1-way "shard" is the whole reduction: return the input
@@ -935,7 +950,7 @@ class HostGroup:
         pos = self.rank
         bounds = _split_bounds(flat.size, n)
         step = self._segment_elems(flat.itemsize)
-        wire = self._wire_ctx(flat.dtype, op)
+        wire = self._wire_ctx(flat.dtype, op, override=wire_fmt)
         if n == 2:
             # pairwise: each rank sends only the PEER's shard and
             # reduces its own as fn(theirs, mine) — half the traffic of

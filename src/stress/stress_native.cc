@@ -124,12 +124,12 @@ int run_store(int iters) {
     return 1;
   }
   std::atomic<int> errors{0};
-  // in-process threads
-  std::vector<std::thread> ts;
-  for (int t = 0; t < 4; t++)
-    ts.emplace_back(store_worker, s, t, iters, &errors);
   // cross-process contention: forked children attach by name (the
-  // robust-mutex + shared free-list paths)
+  // robust-mutex + shared free-list paths). Fork BEFORE spawning the
+  // in-process threads: a child must inherit a single-threaded image,
+  // both for POSIX fork semantics and because tsan's thread registry
+  // is copied into the child — parent threads the child can never join
+  // would otherwise report as thread leaks at the child's _exit.
   std::vector<pid_t> kids;
   for (int p = 0; p < 2; p++) {
     pid_t pid = fork();
@@ -143,6 +143,10 @@ int run_store(int iters) {
     }
     kids.push_back(pid);
   }
+  // in-process threads
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; t++)
+    ts.emplace_back(store_worker, s, t, iters, &errors);
   for (auto& t : ts) t.join();
   int fail = 0;
   for (pid_t pid : kids) {

@@ -369,6 +369,42 @@ def test_condition_notify_in_locked_method_not_flagged():
     assert "RTL107" not in codes
 
 
+def test_condition_alias_from_ctor_param_covered():
+    """RTL107 coverage extension (PR 19): a Condition RECEIVED as a
+    ctor parameter and stored under a non-lockish attribute name (the
+    async-handle pattern — an issue queue hands its completion
+    Condition to every handle it mints) is still a lock token: lock
+    identity propagates from the aliased parameter name, so notify on
+    it unheld is a finding and the held variant stays clean."""
+    codes = _lock_codes("""
+        import threading
+
+        class Handle:
+            def __init__(self, cond):
+                self._completion = cond
+                self._done = False
+
+            def bad_finish(self):
+                self._done = True
+                self._completion.notify_all()    # not held
+    """)
+    assert "RTL107" in codes
+    clean = _lock_codes("""
+        import threading
+
+        class Handle:
+            def __init__(self, cond):
+                self._completion = cond
+                self._done = False
+
+            def finish(self):
+                with self._completion:
+                    self._done = True
+                    self._completion.notify_all()
+    """)
+    assert "RTL107" not in clean
+
+
 def test_nested_function_runs_lock_free():
     """A closure defined under a lock runs LATER (its own thread) —
     its blocking calls are not under-the-lock findings."""
