@@ -1,0 +1,211 @@
+"""Sharded-checkpoint benchmark — PERF.md round 20 artifact.
+
+Phases, one JSON artifact (BENCH_r20.json), all single-process over the
+groupless save path (the commit discipline — payload build, atomic
+shard write, scan-ack, manifest — is identical to the gang path minus
+one small allgather, so the disk-side numbers transfer):
+
+1. **write/restore throughput** — sync `save_sharded` of an N-MB param
+   tree followed by `restore_sharded`, repeated; p50 wall + MB/s for
+   each (fsync on: these are the durable numbers).
+2. **async on/off step delta** — the acceptance measurement: a
+   simulated train loop (fixed ~tens-of-ms numpy compute per step)
+   checkpointing EVERY step, `asynchronous=False` (write inline on the
+   step) vs `asynchronous=True` (write on the background thread,
+   harvested at the NEXT step's boundary — the overlap window a real
+   loop has). The headline is p50 step wall in each mode: the delta is
+   the checkpoint stall the async path hides behind compute.
+3. **reshard cost** — restore p50 from a world-2 save at world 2
+   (same-world) vs world 4 (elastic 2->4), and from a world-4 save at
+   world 2 (4->2): the price of the reslice index math + touching more
+   shard files, over identical bytes.
+
+Usage:
+  python benchmarks/ckpt_bench.py --json-out BENCH_r20.json
+  python benchmarks/ckpt_bench.py --total-mb 32 --bucket-mb 4 \
+      --steps 12 --repeats 5
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _make_params(total_bytes: int, n_leaves: int):
+    per = max(1, int(total_bytes) // 4 // n_leaves)
+    rng = np.random.RandomState(7)
+    return {f"w{i:02d}": rng.standard_normal(per).astype(np.float32)
+            for i in range(n_leaves)}
+
+
+def _p50(xs):
+    return statistics.median(xs)
+
+
+def bench_write_restore(root, params, bucket_bytes, repeats, total_bytes,
+                        warmup=3):
+    from ray_tpu.train import sharded_checkpoint as sc
+
+    # first few saves pay cold-page/fs costs 4-5x steady state; burn
+    # them so the row reports steady-state throughput
+    for i in range(warmup):
+        sc.save_sharded(params, root=root, step=i, bucket_bytes=bucket_bytes,
+                        keep=2, asynchronous=False).result()
+    writes, restores = [], []
+    for i in range(warmup, warmup + repeats):
+        t0 = time.perf_counter()
+        res = sc.save_sharded(params, root=root, step=i,
+                              bucket_bytes=bucket_bytes, keep=2,
+                              asynchronous=False).result()
+        writes.append(time.perf_counter() - t0)
+        assert res["committed"], res
+        t0 = time.perf_counter()
+        out = sc.restore_sharded(params, root=root,
+                                 bucket_bytes=bucket_bytes)
+        restores.append(time.perf_counter() - t0)
+        assert out is not None
+    mb = total_bytes / 1e6
+    return {"phase": "write_restore", "total_bytes": total_bytes,
+            "bucket_bytes": bucket_bytes, "repeats": repeats,
+            "p50_write_s": round(_p50(writes), 6),
+            "p50_restore_s": round(_p50(restores), 6),
+            "write_MBps": round(mb / _p50(writes), 1),
+            "restore_MBps": round(mb / _p50(restores), 1)}
+
+
+def _step_work(x, w, iters):
+    for _ in range(iters):
+        x = np.tanh(x @ w)
+    return x
+
+
+def bench_async_step(root, params, bucket_bytes, asynchronous, steps,
+                     work_iters, total_bytes):
+    """p50 step wall with a per-step checkpoint, async write overlapped
+    under the NEXT step's compute vs written inline."""
+    from ray_tpu.train import sharded_checkpoint as sc
+
+    rng = np.random.RandomState(3)
+    x = rng.standard_normal((256, 512)).astype(np.float32)
+    w = rng.standard_normal((512, 512)).astype(np.float32)
+    warmup = 2
+    for step in range(warmup):
+        sc.save_sharded(params, root=root, step=step,
+                        bucket_bytes=bucket_bytes, keep=2,
+                        asynchronous=False).result()
+    walls, pending = [], None
+    for step in range(warmup, warmup + steps):
+        t0 = time.perf_counter()
+        x = _step_work(x, w, work_iters)        # the overlap window
+        params = {k: v + 1.0 for k, v in params.items()}   # "update"
+        if pending is not None:
+            assert pending.result(timeout=300)["committed"]
+            pending = None
+        p = sc.save_sharded(params, root=root, step=step,
+                            bucket_bytes=bucket_bytes, keep=2,
+                            asynchronous=asynchronous)
+        if asynchronous:
+            pending = p                          # harvest next step
+        else:
+            assert p.result()["committed"]
+        walls.append(time.perf_counter() - t0)
+    if pending is not None:
+        pending.result(timeout=300)
+    return {"phase": "async_step", "asynchronous": bool(asynchronous),
+            "total_bytes": total_bytes, "bucket_bytes": bucket_bytes,
+            "steps": steps, "work_iters": work_iters,
+            "p50_step_s": round(_p50(walls), 6),
+            "best_step_s": round(min(walls), 6)}
+
+
+def bench_reshard(root_base, params, bucket_bytes, repeats, total_bytes):
+    from ray_tpu.train import sharded_checkpoint as sc
+
+    rows = []
+    for save_world, restore_world in ((2, 2), (2, 4), (4, 2)):
+        root = os.path.join(root_base, f"w{save_world}to{restore_world}")
+        pendings = [sc.save_sharded(params, root=root, step=1,
+                                    world=save_world, rank=r,
+                                    bucket_bytes=bucket_bytes,
+                                    asynchronous=False)
+                    for r in range(save_world)]
+        for r in range(save_world - 1, -1, -1):
+            assert pendings[r].result()["committed"]
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = sc.restore_sharded(params, root=root,
+                                     world=restore_world, rank=0,
+                                     bucket_bytes=bucket_bytes)
+            times.append(time.perf_counter() - t0)
+            assert out is not None
+            assert out[1]["resharded"] == (save_world != restore_world)
+        rows.append({"phase": "reshard", "world_saved": save_world,
+                     "world_restore": restore_world,
+                     "total_bytes": total_bytes,
+                     "bucket_bytes": bucket_bytes,
+                     "resharded": save_world != restore_world,
+                     "p50_restore_s": round(_p50(times), 6)})
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--total-mb", type=float, default=32.0)
+    ap.add_argument("--leaves", type=int, default=16)
+    ap.add_argument("--bucket-mb", type=float, default=4.0)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--work-iters", type=int, default=48,
+                    help="per-step compute; default sized so the "
+                         "compute window exceeds one steady-state "
+                         "shard write")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--root", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--json-out", default=None)
+    args = ap.parse_args(argv)
+
+    total = int(args.total_mb * 1e6)
+    bb = int(args.bucket_mb * 1e6)
+    params = _make_params(total, args.leaves)
+    scratch = args.root or tempfile.mkdtemp(prefix="ckpt_bench_")
+    rows = []
+    try:
+        rows.append(bench_write_restore(
+            os.path.join(scratch, "wr"), params, bb, args.repeats, total))
+        print(json.dumps(rows[-1]))
+        for asynchronous in (False, True):
+            rows.append(bench_async_step(
+                os.path.join(scratch, f"as{int(asynchronous)}"), params,
+                bb, asynchronous, args.steps, args.work_iters, total))
+            print(json.dumps(rows[-1]))
+        for row in bench_reshard(os.path.join(scratch, "rs"), params,
+                                 bb, args.repeats, total):
+            rows.append(row)
+            print(json.dumps(row))
+    finally:
+        if args.root is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+    out = {"harness": "benchmarks/ckpt_bench.py",
+           "argv": list(argv if argv is not None else sys.argv[1:]),
+           "rows": rows}
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print(f"wrote {args.json_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

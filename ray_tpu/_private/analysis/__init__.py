@@ -1,6 +1,6 @@
 """raylint: repo-wide invariant lint + lock-discipline analysis plane.
 
-Four pass families over ``ray_tpu/`` (and the native sources they must
+Five pass families over ``ray_tpu/`` (and the native sources they must
 stay consistent with):
 
 - ``lock-discipline`` (RTL1xx) — blocking calls / user callbacks under
@@ -11,7 +11,11 @@ stay consistent with):
 - ``wire-format`` (RTW3xx) — PROTOCOL_VERSION / frame kinds / shm oid
   layout consistent across ``protocol.py`` and ``src/rpc/rpc_core.cc``;
 - ``metric-catalog`` + ``event-catalog`` (RTC4xx) — metric and event
-  names declared in their single-source-of-truth catalogs.
+  names declared in their single-source-of-truth catalogs;
+- ``durability`` (RTD5xx) — persistence modules (checkpoints, GCS
+  store/snapshot, spill, workflow storage) write through the
+  temp+fsync+rename idiom (``_private/atomic_write.py``), never a bare
+  write-mode ``open()`` or an fsync-less rename commit.
 
 Run it: ``ray-tpu lint`` (or ``python -m ray_tpu.scripts.cli lint``).
 Gate suite: ``tests/test_zz_lint.py``. Suppress one line with

@@ -159,7 +159,7 @@ def register(name: str):
 def _load_passes():
     """Import the pass modules (registration is import-time)."""
     from ray_tpu._private.analysis import (  # noqa: F401
-        catalogs, knobs_pass, lock_discipline, wire_format)
+        catalogs, durability, knobs_pass, lock_discipline, wire_format)
 
 
 def run_all(ctx: AnalysisContext | None = None,
@@ -226,6 +226,7 @@ PASS_CODES = {
     "wire-format": ("RTW",),
     "metric-catalog": ("RTC401", "RTC402", "RTC403"),
     "event-catalog": ("RTC404", "RTC405"),
+    "durability": ("RTD",),
 }
 
 

@@ -1,0 +1,124 @@
+"""Durability pass (the ``RTD5xx`` family).
+
+Crash consistency is a discipline, not a property a test can fully
+prove: a bare ``open(path, "w"/"wb")`` + ``write`` in a persistence
+module works in every test and loses data on the one power cut that
+matters. The sharded-checkpointing arc made
+``_private/atomic_write.atomic_write`` (temp file → write → fsync →
+rename → dir fsync) the sanctioned spelling; this pass keeps new
+persistence code from regressing to bare writes:
+
+- **RTD501 — bare write in a persistence module.** A write-mode
+  ``open()`` / ``os.fdopen()`` inside one of the modules whose files
+  are read back after a crash (checkpoint modules, the durable GCS
+  store/snapshot, object-store spill, workflow storage). Route the
+  write through ``atomic_write`` — or, for streaming writers the
+  bytes-payload helper doesn't fit, hand-roll the full idiom and
+  document the site in the baseline.
+- **RTD502 — rename commit without fsync.** An ``os.rename`` /
+  ``os.replace`` commit in a persistence-module function that never
+  fsyncs: atomic against a crashed WRITER, but after power loss the
+  rename (or the data it points at) may not have hit the platter —
+  the "atomic but not durable" half-idiom.
+
+Like every raylint family: precision comes from inline suppression and
+the justified baseline, not from deeper analysis. The helper module
+itself is exempt (it IS the idiom).
+"""
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.analysis.core import (AnalysisContext, Finding,
+                                            call_name, register)
+
+# Modules persisting state that is read back after a crash. Any module
+# with "checkpoint" in its path is in scope by construction; the rest
+# are named explicitly — breadth here is a policy decision, not a
+# heuristic (tune loggers, tracing dumps etc. are diagnostics, not
+# durable state, and stay out).
+_PERSIST_SUBSTRINGS = ("checkpoint",)
+_PERSIST_FILES = frozenset({
+    "ray_tpu/_private/gcs_store.py",
+    "ray_tpu/_private/gcs.py",
+    "ray_tpu/_private/store_client.py",
+    "ray_tpu/workflow/storage.py",
+})
+_EXEMPT_FILES = frozenset({
+    "ray_tpu/_private/atomic_write.py",     # the idiom itself
+})
+
+_WRITE_MODES = frozenset({"w", "wb", "a", "ab", "w+", "wb+", "a+"})
+_OPEN_CALLS = frozenset({"open", "os.fdopen"})
+_RENAME_CALLS = frozenset({"os.rename", "os.replace"})
+_FSYNC_CALLS = frozenset({"os.fsync", "fsync_dir"})
+
+
+def _is_persist_module(path: str) -> bool:
+    if path in _EXEMPT_FILES:
+        return False
+    if path in _PERSIST_FILES:
+        return True
+    base = path.rsplit("/", 1)[-1]
+    return any(s in base for s in _PERSIST_SUBSTRINGS)
+
+
+def _write_mode(node: ast.Call) -> bool:
+    """True when the call's mode argument is a literal write mode."""
+    mode = None
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and mode in _WRITE_MODES
+
+
+def _collect(tree: ast.Module):
+    """(qualname, [Call...]) for every function, plus "<module>"."""
+    out: dict[str, list[ast.Call]] = {}
+
+    def rec(node, qual: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sub = f"{qual}.{child.name}" if qual else child.name
+                out.setdefault(sub, [])
+                rec(child, sub)
+            elif isinstance(child, ast.ClassDef):
+                sub = f"{qual}.{child.name}" if qual else child.name
+                rec(child, sub)
+            else:
+                if isinstance(child, ast.Call):
+                    out.setdefault(qual or "<module>", []).append(child)
+                rec(child, qual)
+
+    rec(tree, "")
+    return list(out.items())
+
+
+@register("durability")
+def durability_pass(ctx: AnalysisContext):
+    for mod in ctx.package_modules("ray_tpu"):
+        if not _is_persist_module(mod.path):
+            continue
+        for qual, calls in _collect(mod.tree):
+            fsyncs = any(call_name(c) in _FSYNC_CALLS
+                         or call_name(c).endswith(".fsync")
+                         for c in calls)
+            for c in calls:
+                name = call_name(c)
+                if name in _OPEN_CALLS and _write_mode(c):
+                    yield Finding(
+                        "RTD501", mod.path, c.lineno, qual or "<module>",
+                        "bare write-mode open() in a persistence module "
+                        "— route the write through _private/"
+                        "atomic_write.atomic_write (temp + fsync + "
+                        "rename + dir fsync), or baseline a justified "
+                        "hand-rolled site")
+                elif name in _RENAME_CALLS and not fsyncs:
+                    yield Finding(
+                        "RTD502", mod.path, c.lineno, qual or "<module>",
+                        "rename commit without any fsync in this "
+                        "function — atomic against a crashed writer "
+                        "but not durable across power loss; use "
+                        "atomic_write or add fsync(file)+fsync(dir)")

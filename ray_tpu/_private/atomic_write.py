@@ -1,0 +1,116 @@
+"""Crash-consistent file writes — the sanctioned durability idiom.
+
+Every byte the runtime persists with the intent of reading it back after
+a crash (checkpoint shards, generation manifests, compacted GCS tables)
+must go through :func:`atomic_write`: write to a temp file IN THE SAME
+DIRECTORY, flush + fsync the file, ``os.rename`` onto the final name
+(atomic on POSIX within one filesystem), then fsync the directory so the
+rename itself is durable. A reader therefore observes either the old
+bytes or the complete new bytes — never a torn prefix.
+
+The ``durability`` static-analysis pass (RTD5xx,
+``ray_tpu/_private/analysis/durability.py``) flags bare
+``open(path, "w"/"wb")`` writes in persistence modules; routing them
+here is the sanctioned fix.
+
+Chaos: the write consults the fault plane's DISK primitives
+(``torn_write:`` / ``corrupt_file:`` rules, see
+``_private/fault_injection.py``) keyed by a caller-supplied ``tag`` +
+logical ``name`` — a fired ``torn_write`` leaves a truncated temp file
+and raises (exactly what a crash mid-write leaves behind: the final
+path never appears), a fired ``corrupt_file`` flips one byte before the
+otherwise-clean commit (what a latent media/DMA error leaves behind:
+the file exists, the digest does not match).
+
+``RAY_TPU_CHECKPOINT_FSYNC=0`` (config ``checkpoint_fsync``) skips the
+fsync calls — a TEST-ONLY kill switch so tmpfs-heavy suites don't pay
+thousands of no-op syncs; production durability requires it on.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+class TornWriteError(OSError):
+    """An injected ``torn_write`` fault: the write "crashed" mid-file.
+
+    The temp file holds a truncated prefix and the final path was never
+    created/replaced — the on-disk state a real power loss or process
+    kill between write and rename leaves behind."""
+
+
+def _fsync_enabled() -> bool:
+    try:
+        from ray_tpu._private.config import get_config
+
+        return bool(get_config("checkpoint_fsync"))
+    except Exception:
+        return True
+
+
+def fsync_dir(path: str):
+    """fsync a DIRECTORY so a rename/creation inside it is durable."""
+    if not _fsync_enabled():
+        return
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: bytes, tag: str = "ckpt",
+                 name: str | None = None) -> str:
+    """Durably replace ``path`` with ``data``; returns ``path``.
+
+    temp file (same dir) → write → flush+fsync → rename → dir fsync.
+    ``tag``/``name`` scope the fault plane's disk-rule consult (``name``
+    defaults to the file's basename)."""
+    path = os.fspath(path)
+    dirname = os.path.dirname(path) or "."
+    logical = name if name is not None else os.path.basename(path)
+
+    torn = False
+    from ray_tpu._private import fault_injection as _fi
+
+    if _fi.ACTIVE is not None:
+        for action, _param in _fi.ACTIVE.on_disk(tag, logical):
+            if action == "torn_write":
+                torn = True
+            elif action == "corrupt_file" and data:
+                # flip one byte mid-payload: the commit completes
+                # cleanly but the digest can never match
+                mid = len(data) // 2
+                data = data[:mid] + bytes([data[mid] ^ 0xFF]) \
+                    + data[mid + 1:]
+
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".tmp.",
+                               dir=dirname)
+    if torn:
+        # a crash mid-write: half the payload reaches the temp file, the
+        # rename never happens, and the truncated temp stays behind —
+        # exactly the wreckage restore-side verification must survive
+        with os.fdopen(fd, "wb") as f:
+            f.write(data[:max(1, len(data) // 2)])
+            f.flush()
+        raise TornWriteError(
+            f"[fault-injection] torn_write of {path!r} ({tag}.{logical})")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            if _fsync_enabled():
+                os.fsync(f.fileno())
+        os.rename(tmp, path)
+        fsync_dir(dirname)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path

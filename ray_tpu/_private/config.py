@@ -122,6 +122,16 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # and async param allgathers). The default stays bit-identical to
     # the pre-sharding behavior.
     "train_ddp_mode": "allreduce",
+    # Sharded checkpointing (train/sharded_checkpoint.py). checkpoint_dir
+    # is the generation root for standalone (non-trainer) use — trainers
+    # plumb their storage_path instead. checkpoint_async moves the shard
+    # disk write to a background thread (the two-phase commit still runs
+    # at the caller's next harvest point); 0 = fully synchronous saves.
+    # checkpoint_fsync=0 is a TEST-ONLY kill switch skipping the
+    # fsync-file + fsync-dir calls in _private/atomic_write.py.
+    "checkpoint_dir": "",
+    "checkpoint_async": True,
+    "checkpoint_fsync": True,
     # Pipelined host-collective data path (util/collective/host_backend):
     # one-way zero-copy segment sends, double-buffered so the reduce of
     # segment k overlaps the transfer of segment k+1. Pipeline kill

@@ -116,6 +116,22 @@ Event kinds recorded by the runtime:
                      from the channel's state snapshot
                      (_private/pubsub.py): channels, seq floor,
                      per-subscriber resync count.
+- ``CHECKPOINT_COMMITTED`` — rank 0 durably renamed a sharded-checkpoint
+                     generation's MANIFEST.json after every rank acked
+                     its shard write (train/sharded_checkpoint.py):
+                     step, world, path, total shard bytes. Before this
+                     event the generation does not exist as far as
+                     restore is concerned.
+- ``CHECKPOINT_QUARANTINED`` — restore-side verification renamed a
+                     bad/torn generation out of sight and fell back to
+                     the next older one: path, reason (``torn`` /
+                     ``digest_mismatch`` / ``size_mismatch`` /
+                     ``shard_missing`` / ``plan_mismatch``) and the
+                     offending shard file when one is identifiable.
+- ``CHECKPOINT_RESHARDED`` — a gang restored a generation saved at a
+                     DIFFERENT world size, re-slicing the saved shards
+                     onto the new shard map by index math over the
+                     bucket plan: path, step, world_saved, world_now.
 
 Design constraints match the metrics plane: recording is one lock +
 deque append (no allocation beyond the event dict), the ring is bounded

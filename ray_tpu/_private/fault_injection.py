@@ -28,7 +28,8 @@ Schedule grammar (``;``-separated rules)::
 
     rule     := action ":" scope "." method ":" selector [":" param_ms]
     action   := drop | delay | dup | disconnect | slow_reply | kill_actor
-              | kill_node | flap_node
+              | kill_node | flap_node | preempt_job | torn_write
+              | corrupt_file
     scope    := "*" | gcs | raylet | worker | driver | <process tag>
     method   := "*" | <rpc method name>
     selector := "p" FLOAT    probability (hash-derived, deterministic)
@@ -67,6 +68,19 @@ re-registers it after param_ms. A wildcard tag scope
 "kill 10% of nodes simultaneously" schedule: every node consults the
 rule once at the same harness boundary and the hash verdict picks a
 deterministic ~10% subset.
+
+``torn_write`` / ``corrupt_file`` are DISK-level primitives, consulted
+by the sanctioned durable-write helper (``_private/atomic_write.py``)
+via ``on_disk(tag, name)`` at its own deterministic write boundary —
+``tag`` is the writer's disk tag (checkpoint writes use ``ckpt``) and
+``name`` the file's logical kind (``shard`` / ``manifest``), while the
+scope match also covers this process's role + tags so
+``torn_write:rank1.shard:#2`` hits exactly one gang member's second
+shard write. A fired ``torn_write`` leaves a truncated temp file and
+raises (the final path never appears — a crash mid-write); a fired
+``corrupt_file`` flips one byte before an otherwise-clean commit (a
+latent media error the restore-side digest check must catch). Counters
+are per-(tag, name) like the node primitives.
 
 Examples::
 
@@ -126,7 +140,8 @@ import threading
 import time
 
 ACTIONS = ("drop", "delay", "dup", "disconnect", "slow_reply",
-           "kill_actor", "kill_node", "flap_node", "preempt_job")
+           "kill_actor", "kill_node", "flap_node", "preempt_job",
+           "torn_write", "corrupt_file")
 # actions applied at the client send boundary vs the server reply boundary
 _SEND_ACTIONS = frozenset({"drop", "delay", "dup", "disconnect"})
 _REPLY_ACTIONS = frozenset({"slow_reply", "kill_actor"})
@@ -136,6 +151,14 @@ _NODE_ACTIONS = frozenset({"kill_node", "flap_node"})
 # job-level actions, consulted by the entity driving a named job
 # (multi-tenant soak harness / chaos tests) via on_job(job, method)
 _JOB_ACTIONS = frozenset({"preempt_job"})
+# disk-level actions, consulted by the durable-write helper
+# (_private/atomic_write.py) at its own deterministic write boundary
+# via on_disk(tag, name). kill_actor is ALSO a disk action: a rule like
+# ``kill_actor:rank1.shard:#2`` dies at the write boundary — "kill a
+# rank mid-shard-write" as a seeded primitive. (Such a rule is
+# harmlessly double-registered in _reply_rules; no RPC method is named
+# ``shard``/``manifest``, so it can only fire here.)
+_DISK_ACTIONS = frozenset({"torn_write", "corrupt_file", "kill_actor"})
 
 _DEFAULT_PARAM_MS = 10.0
 
@@ -286,6 +309,8 @@ class FaultInjector:
                             if r.action in _NODE_ACTIONS]
         self._job_rules = [r for r in self.rules
                            if r.action in _JOB_ACTIONS]
+        self._disk_rules = [r for r in self.rules
+                            if r.action in _DISK_ACTIONS]
         self._lock = threading.Lock()
         self.events: list[tuple] = []
         # None = follow the process-global role (set_role); a role given
@@ -402,6 +427,42 @@ class FaultInjector:
             with self._lock:
                 self.events.append((rule.action, job, method, n))
             _note_fault(rule.action, job, method, n)
+            fired.append((rule.action, rule.param_s))
+        return fired
+
+    def on_disk(self, tag: str, name: str) -> list[tuple[str, float]]:
+        """Disk boundary: decisions for one durable write identified by
+        the writer's ``tag`` (e.g. ``ckpt``, or a train worker's
+        ``rank<N>`` process tag) and the file's logical ``name`` (e.g.
+        ``shard`` / ``manifest``). A fired ``kill_actor`` dies right
+        here (os._exit — a rank killed mid-shard-write). Returns
+        [(action, param_s)] for every other disk rule that fired
+        (torn_write / corrupt_file); the CALLER —
+        ``_private/atomic_write.py`` — applies them, so every byte that
+        rides the sanctioned durability idiom is chaos-testable.
+
+        Counters are per (tag, name) like ``on_node``'s, so a schedule
+        shared by a whole gang keeps an independent deterministic
+        sequence per writer, and the scope match includes this process's
+        role + tags: ``torn_write:rank1.shard:#2`` lands on exactly one
+        rank's second shard write."""
+        role = self._current_role()
+        scope_tags = get_tags() | {tag}
+        fired: list[tuple[str, float]] = []
+        for rule in self._disk_rules:
+            if not rule.matches_scope(role, name, scope_tags):
+                continue
+            n = rule.fires(self.seed, f"{tag}|{name}", self._lock)
+            if not n:
+                continue
+            with self._lock:
+                self.events.append((rule.action, tag, name, n))
+            _note_fault(rule.action, tag, name, n)
+            if rule.action == "kill_actor":
+                # a rank dying mid-shard-write: the generation it was
+                # contributing to never gets a manifest — torn by
+                # definition, invisible to restore
+                os._exit(1)
             fired.append((rule.action, rule.param_s))
         return fired
 

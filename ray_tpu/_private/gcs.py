@@ -2143,10 +2143,10 @@ class GcsServer:
                 "job_counter": self.job_counter,
                 "cluster_id": self.cluster_id,
             })
-        tmp = self._snapshot_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, self._snapshot_path)
+        from ray_tpu._private.atomic_write import atomic_write
+
+        atomic_write(self._snapshot_path, blob, tag="gcs",
+                     name="snapshot")
         return True
 
     def _load_snapshot(self):

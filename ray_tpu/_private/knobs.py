@@ -56,6 +56,14 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _k("COLLECTIVE_SHM", "1", "bool",
        "0 keeps same-node collective segments off the shm object store "
        "(sockets only)."),
+    _k("CHECKPOINT_ASYNC", "1", "bool",
+       "0 makes sharded-checkpoint shard writes fully synchronous "
+       "(train.sharded_checkpoint; default runs the disk write on a "
+       "background thread and commits at the caller's harvest point)."),
+    _k("CHECKPOINT_FSYNC", "1", "bool",
+       "0 skips the fsync-file + fsync-dir calls in the atomic-write "
+       "durability idiom — TEST-ONLY kill switch; production crash "
+       "consistency requires it on."),
     _k("DATA_STREAMING", "1", "bool",
        "0 restores the legacy materialize-then-iterate dataset path "
        "(bit-identical kill switch for the streaming data plane)."),
@@ -89,6 +97,9 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "1 lets the raylet probe for real TPU chips at startup "
        "(subprocess jax.devices())."),
     # --- tuning ----------------------------------------------------------
+    _k("CHECKPOINT_DIR", "", "path",
+       "sharded-checkpoint generation root for standalone (non-trainer) "
+       "use; trainers plumb RunConfig.storage_path instead."),
     _k("DATA_PREFETCH_BLOCKS", "4", "int",
        "streaming data plane: blocks a consumer may have buffered or "
        "in flight at once (the bounded-memory prefetch budget; "

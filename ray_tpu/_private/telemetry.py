@@ -493,6 +493,41 @@ CATALOG: dict[str, dict] = {
                        "minus real requests): the compute wasted to "
                        "keep the pjit cache at a handful of shapes",
     },
+    # --- sharded checkpointing (train/sharded_checkpoint.py) ---
+    "ray_tpu_checkpoint_write_seconds": {
+        "kind": "Histogram", "tags": ("group",),
+        "boundaries": [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                       30.0, 120.0],
+        "description": "Wall time of one rank's shard write (serialize "
+                       "excluded: temp-file write + fsync + rename + "
+                       "dir fsync + digest) — off the step loop when "
+                       "RAY_TPU_CHECKPOINT_ASYNC is on",
+    },
+    "ray_tpu_checkpoint_bytes": {
+        "kind": "Histogram", "tags": ("group",),
+        "boundaries": [65536, 262144, 1048576, 4194304, 16777216,
+                       67108864, 268435456],
+        "description": "Size of one rank's checkpoint shard (its ZeRO "
+                       "param slices + optimizer-state slots, npz) — "
+                       "O(model/world) per rank, sum over ranks for the "
+                       "generation total",
+    },
+    "ray_tpu_checkpoint_quarantined_total": {
+        "kind": "Counter", "tags": ("reason",),
+        "description": "Checkpoint generations quarantined at restore "
+                       "(reason=torn|digest_mismatch|size_mismatch|"
+                       "shard_missing|plan_mismatch) — each one also "
+                       "records a CHECKPOINT_QUARANTINED event naming "
+                       "the bad shard",
+    },
+    "ray_tpu_checkpoint_restore_seconds": {
+        "kind": "Histogram", "tags": ("group",),
+        "boundaries": [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                       30.0, 120.0],
+        "description": "Wall time of one rank's sharded restore (scan + "
+                       "verify digests + param reassembly + elastic "
+                       "opt-state re-slice)",
+    },
     # --- step anatomy + flight recorder (parallel/step_anatomy.py,
     # _private/flight_recorder.py) ---
     "ray_tpu_step_seconds": {

@@ -15,6 +15,17 @@ import shutil
 import tarfile
 import tempfile
 import uuid
+import weakref
+
+
+def _own_tmpdir(owner, path: str) -> str:
+    """Tie a mkdtemp'd scratch directory's lifetime to ``owner``: the
+    finalizer removes it when the owner is collected (and at interpreter
+    exit). Every ``rtpu_ckpt_`` tmpdir this module creates is registered
+    here — they used to leak one per from_bytes/to_directory round trip
+    (pinned by the tmpdir-counting test in tests/test_zz_sharded_ckpt.py)."""
+    weakref.finalize(owner, shutil.rmtree, path, ignore_errors=True)
+    return path
 
 
 class Checkpoint:
@@ -24,6 +35,7 @@ class Checkpoint:
             raise ValueError("exactly one of data/directory required")
         self._data = data
         self._directory = directory
+        self._materialized: str | None = None   # cached to_directory(None)
         self.id = uuid.uuid4().hex[:8]
 
     # ---- constructors -------------------------------------------------------
@@ -46,7 +58,9 @@ class Checkpoint:
         tmp = tempfile.mkdtemp(prefix="rtpu_ckpt_")
         with tarfile.open(fileobj=io.BytesIO(payload), mode="r") as tar:
             tar.extractall(tmp, filter="data")
-        return cls(directory=tmp)
+        ckpt = cls(directory=tmp)
+        _own_tmpdir(ckpt, tmp)
+        return ckpt
 
     @classmethod
     def from_jax(cls, pytree, path: str | None = None) -> "Checkpoint":
@@ -61,7 +75,10 @@ class Checkpoint:
         if os.path.exists(target):
             shutil.rmtree(target)
         ocp.PyTreeCheckpointer().save(target, pytree)
-        return cls(directory=base)
+        ckpt = cls(directory=base)
+        if path is None:
+            _own_tmpdir(ckpt, base)
+        return ckpt
 
     def to_jax(self):
         """Restore the pytree of an orbax-form checkpoint."""
@@ -88,14 +105,25 @@ class Checkpoint:
 
     def to_directory(self, path: str | None = None) -> str:
         if path is None:
-            path = tempfile.mkdtemp(prefix="rtpu_ckpt_")
+            # scratch materialization: cached (repeat calls reuse one
+            # dir) and lifetime-tied to this checkpoint — one leaked
+            # tmpdir per call otherwise
+            if self._materialized is not None \
+                    and os.path.isdir(self._materialized):
+                return self._materialized
+            path = _own_tmpdir(self,
+                               tempfile.mkdtemp(prefix="rtpu_ckpt_"))
+            self._materialized = path
         os.makedirs(path, exist_ok=True)
         if self._directory is not None:
             if os.path.abspath(self._directory) != os.path.abspath(path):
                 shutil.copytree(self._directory, path, dirs_exist_ok=True)
         else:
-            with open(os.path.join(path, "_ckpt_dict.pkl"), "wb") as f:
-                f.write(pickle.dumps(self._data))
+            from ray_tpu._private.atomic_write import atomic_write
+
+            atomic_write(os.path.join(path, "_ckpt_dict.pkl"),
+                         pickle.dumps(self._data), tag="ckpt",
+                         name="ckpt_dict")
         return path
 
     def to_bytes(self) -> bytes:

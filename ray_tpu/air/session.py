@@ -89,6 +89,15 @@ def get_checkpoint():
     return getattr(_get_session(), "resume_checkpoint", None)
 
 
+def get_checkpoint_dir() -> str | None:
+    """The sharded-checkpoint generation root for this training run
+    (``<storage_path>/<name>/sharded``, plumbed by the trainer), or
+    ``None`` outside a trainer run. ``train.sharded_checkpoint``'s
+    save/restore default their ``root`` to this, so a train loop can
+    call them with no path plumbing of its own."""
+    return getattr(_get_session(), "checkpoint_dir", None)
+
+
 def preemption_warned() -> dict | None:
     """Non-None once this gang's placement group received a PREEMPTION
     warning from the multi-tenant scheduler: a higher-priority job will

@@ -159,6 +159,19 @@ _PANELS = [
     ("Serve spike-to-placed latency p99",
      "histogram_quantile(0.99, rate(ray_tpu_serve_capacity_wait_seconds"
      "_bucket[5m]))", "s"),
+    # --- sharded checkpointing (crash-consistent, world-elastic) ---
+    ("Checkpoint shard write p99",
+     "histogram_quantile(0.99, rate(ray_tpu_checkpoint_write_seconds"
+     "_bucket[5m]))", "s"),
+    ("Checkpoint shard size p50",
+     "histogram_quantile(0.5, rate(ray_tpu_checkpoint_bytes"
+     "_bucket[5m]))", "bytes"),
+    ("Checkpoint generations quarantined",
+     "sum by (reason) (rate(ray_tpu_checkpoint_quarantined_total[5m]))",
+     "ops"),
+    ("Checkpoint restore p99",
+     "histogram_quantile(0.99, rate(ray_tpu_checkpoint_restore_seconds"
+     "_bucket[5m]))", "s"),
 ]
 
 

@@ -221,6 +221,49 @@ def plan_shard_map(leaves, plan, world: int) -> list:
     return out
 
 
+def plan_fingerprint(leaves, plan) -> str:
+    """Deterministic sha256 hex digest of the bucket plan's full
+    identity: per-leaf (shape, dtype) in flatten order plus the plan's
+    bucket membership. Depends ONLY on leaf shapes + dtypes + the plan —
+    NOT on world size — so a gang restarting at a different world size
+    derives the SAME fingerprint from the same model, which is what
+    makes a saved shard set re-sliceable: matching fingerprints mean the
+    packed element streams are byte-compatible and restore reduces to
+    pure index math (:func:`reslice_spans`)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in leaves:
+        shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+        dt = str(getattr(leaf, "dtype", "object"))
+        h.update(repr((shape, dt)).encode())
+    for indices in plan:
+        h.update(repr(tuple(indices)).encode())
+    return h.hexdigest()
+
+
+def reslice_spans(elems: int, old_world: int, new_world: int,
+                  new_rank: int) -> list:
+    """Pure index math for world-elastic restore of ONE packed bucket:
+    which byte-compatible spans of which OLD ranks' shards concatenate
+    into NEW rank ``new_rank``'s shard. Returns
+    ``[(old_rank, old_lo, old_hi), ...]`` in order, where
+    ``[old_lo, old_hi)`` indexes INTO that old rank's saved shard array
+    (not the bucket). Both layouts come from :func:`shard_bounds` over
+    the same ``elems``, so the concatenated spans are exactly the new
+    rank's ``[lo, hi)`` slice of the packed bucket — bit-identical to
+    what a same-world save/restore would hand it."""
+    new_lo, new_hi = shard_bounds(elems, new_world)[int(new_rank)]
+    spans = []
+    for old_rank, (old_lo, old_hi) in enumerate(
+            shard_bounds(elems, old_world)):
+        lo = max(new_lo, old_lo)
+        hi = min(new_hi, old_hi)
+        if lo < hi:
+            spans.append((old_rank, lo - old_lo, hi - old_lo))
+    return spans
+
+
 def axis_size(mesh: Mesh, axis: Optional[str]) -> int:
     if axis is None:
         return 1

@@ -406,6 +406,22 @@ def cmd_steps(args):
     return 0
 
 
+def cmd_checkpoints(args):
+    """Sharded-checkpoint inventory: `ray-tpu checkpoints <root>` lists
+    every generation under the root newest-first with its verify status
+    (committed / torn / corrupt / quarantined), world size, shard count
+    and bytes — the offline face of
+    `train.sharded_checkpoint.summarize_checkpoints` (pure: verifies
+    digests but never renames or deletes anything)."""
+    from ray_tpu.train.sharded_checkpoint import summarize_checkpoints
+
+    entries = summarize_checkpoints(args.root,
+                                    digests=not args.no_digests)
+    print(json.dumps({"root": args.root, "generations": entries},
+                     indent=2, default=str))
+    return 0
+
+
 def cmd_blackbox(args):
     """Flight recorder: `ray-tpu blackbox dump` fans out over every
     process's black box (bounded rings of recent spans/events/steps/
@@ -647,6 +663,18 @@ def main(argv=None):
     sp.add_argument("--last", type=int, default=None,
                     help="only the most recent N steps")
     sp.set_defaults(fn=cmd_steps)
+
+    sp = sub.add_parser("checkpoints",
+                        help="list sharded-checkpoint generations "
+                             "under a root with verify status "
+                             "(committed/torn/corrupt/quarantined)")
+    sp.add_argument("root", help="checkpoint generation root "
+                                 "(the trainer's "
+                                 "<storage_path>/<name>/sharded)")
+    sp.add_argument("--no-digests", action="store_true",
+                    help="skip per-shard sha256 verification (cheap "
+                         "existence/size check only)")
+    sp.set_defaults(fn=cmd_checkpoints)
 
     sp = sub.add_parser("blackbox",
                         help="flight recorder: dump / locate the "

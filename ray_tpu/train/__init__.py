@@ -18,6 +18,11 @@ from ray_tpu.train.ddp import (  # noqa: F401
     sync_gradients,
     sync_gradients_async,
 )
+from ray_tpu.train.sharded_checkpoint import (  # noqa: F401
+    restore_sharded,
+    save_sharded,
+    summarize_checkpoints,
+)
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401
 from ray_tpu.train.predictor import (  # noqa: F401
     BatchPredictor,

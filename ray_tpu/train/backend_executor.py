@@ -5,6 +5,7 @@ python/ray/train/_internal/backend_executor.py:42 — _create_placement_group
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -375,7 +376,30 @@ class BackendExecutor:
             ray_tpu.get(worker.set_dataset_shard.remote(name, shard))
 
     def start_training(self, train_fn, config):
+        self._ckpt_root = (config or {}).get("_checkpoint_dir")
         self.worker_group.execute("start_training", train_fn, config)
+
+    def checkpoint_resume_hint(self) -> dict | None:
+        """Newest committed sharded generation under this run's root —
+        what a gang restart will actually resume from. None when the
+        run has no sharded root or no committed generation yet."""
+        root = getattr(self, "_ckpt_root", None)
+        if not root or not os.path.isdir(root):
+            return None
+        try:
+            from ray_tpu.train.sharded_checkpoint import (
+                summarize_checkpoints,
+            )
+            # cheap scan: manifest presence only, no shard re-hash —
+            # this runs on the failure path and must never stall it
+            for gen in summarize_checkpoints(root, digests=False):
+                if gen.get("status") == "committed":
+                    return {"step": gen.get("step"),
+                            "path": gen.get("path"),
+                            "world": gen.get("world")}
+        except Exception:
+            return None
+        return None
 
     def next_results(self, timeout: float | None = None):
         """One row of results across the gang (or done/error markers).

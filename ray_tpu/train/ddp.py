@@ -665,6 +665,8 @@ class ZeroOptimizer:
         self._step = 0
         self._world = None
         self._rank = None
+        self._fingerprint = None     # sharding.plan_fingerprint of plan
+        self._pending_state = None   # load_shard_state_dict before plan
 
     # ------------------------------------------------------------ plan
     def _ensure_plan(self, leaves):
@@ -692,6 +694,9 @@ class ZeroOptimizer:
         self._shard_map = _sh.plan_shard_map(leaves, self._plan,
                                              self._world)
         self._sig = sig
+        self._fingerprint = _sh.plan_fingerprint(leaves, self._plan)
+        if self._pending_state is not None:
+            self._install_pending_state()
 
     def _my_bounds(self, b: int):
         return self._shard_map[b]["bounds"][self._rank]
@@ -743,6 +748,73 @@ class ZeroOptimizer:
     @property
     def step_count(self) -> int:
         return self._step
+
+    @property
+    def plan_fingerprint(self) -> str | None:
+        """World-independent identity of the bucket plan (see
+        ``parallel/sharding.plan_fingerprint``); ``None`` before the
+        first step/accumulate establishes the plan."""
+        return self._fingerprint
+
+    # ------------------------------------------- sharded checkpoint I/O
+    def shard_state_dict(self) -> dict:
+        """This rank's optimizer-state shard for the sharded checkpoint
+        plane (``train/sharded_checkpoint.py``): per-bucket slot arrays
+        covering ONLY this rank's ``[lo, hi)`` of each packed bucket,
+        plus the step counter (adam bias correction depends on it) and
+        the plan fingerprint restore must verify. O(model/world) — full
+        state never exists on any rank."""
+        import numpy as np
+
+        if self._plan is None:
+            raise ValueError("ZeroOptimizer: no plan yet (run a step "
+                             "or accumulate first)")
+        buckets = []
+        for b in range(len(self._plan)):
+            st = self._shard_state(b)
+            buckets.append({k: np.asarray(v) for k, v in st.items()})
+        return {"step": self._step,
+                "plan_fingerprint": self._fingerprint,
+                "world": self._world, "rank": self._rank,
+                "buckets": buckets}
+
+    def load_shard_state_dict(self, state: dict):
+        """Install a shard-state dict (from :meth:`shard_state_dict`,
+        possibly re-sliced onto this world size by the sharded
+        checkpoint plane). Before the first step the plan is unknown, so
+        the state parks and installs when the plan is established —
+        fingerprint and per-bucket lengths are verified then."""
+        self._pending_state = dict(state)
+        if self._plan is not None:
+            self._install_pending_state()
+
+    def _install_pending_state(self):
+        pend, self._pending_state = self._pending_state, None
+        fp = pend.get("plan_fingerprint")
+        if fp is not None and self._fingerprint is not None \
+                and fp != self._fingerprint:
+            raise ValueError(
+                f"ZeroOptimizer: checkpointed plan fingerprint "
+                f"{fp[:12]}… does not match this model's "
+                f"{self._fingerprint[:12]}… — the saved shards were cut "
+                f"over a different leaf signature/bucket plan and "
+                f"cannot be re-sliced onto it")
+        buckets = pend.get("buckets", [])
+        if len(buckets) != len(self._plan):
+            raise ValueError(
+                f"ZeroOptimizer: checkpoint has {len(buckets)} bucket "
+                f"states, plan has {len(self._plan)} buckets")
+        for b, st in enumerate(buckets):
+            lo, hi = self._my_bounds(b)
+            for slot, arr in st.items():
+                if int(getattr(arr, "size", -1)) != hi - lo:
+                    raise ValueError(
+                        f"ZeroOptimizer: bucket {b} slot {slot!r} has "
+                        f"{getattr(arr, 'size', None)} elements, this "
+                        f"rank's shard is {hi - lo}")
+            self._state[b] = dict(st)
+        self._step = int(pend.get("step", 0))
+        self._note_state()
 
     # ------------------------------------------------------------ step
     def accumulate(self, grads):

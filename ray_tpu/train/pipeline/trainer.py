@@ -287,6 +287,12 @@ def _pipeline_worker_loop(config: dict):
         if want_ckpt:
             import pickle as _pickle
 
+            from ray_tpu.parallel import step_anatomy as _sa
+
+            # checkpoint assembly is a step-loop stall: attribute it in
+            # the same anatomy lane the sharded writer uses, so "why was
+            # step k slow" answers "checkpoint", not "mystery bubble"
+            _asm_t0 = time.monotonic()
             if rank == 0:
                 stage_params = {0: [np.array(p) for p in params]}
                 for s in range(1, num_stages):
@@ -295,6 +301,12 @@ def _pipeline_worker_loop(config: dict):
             elif stage_rank == 0:
                 col.send(np.frombuffer(_pickle.dumps(
                     [np.array(p) for p in params]), np.uint8), 0, group)
+            try:
+                _sa.record_activity("checkpoint", _asm_t0,
+                                    time.monotonic(), blocking=True,
+                                    phase="assemble", step=step)
+            except Exception:
+                pass
 
         step_wall = time.monotonic() - step_t0
         if _tm.ENABLED:

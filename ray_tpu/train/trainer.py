@@ -193,6 +193,13 @@ class DataParallelTrainer(BaseTrainer):
                 or self.resume_from_checkpoint
             if resume is not None:
                 config["_resume_checkpoint"] = resume
+            if self.run_config.storage_path:
+                # generation root for sharded checkpoints: a sibling of
+                # the rank-0 checkpoint_* dirs (which _drive's pruning
+                # scans by prefix — gen_* dirs are invisible to it)
+                config["_checkpoint_dir"] = os.path.join(
+                    self.run_config.storage_path,
+                    self.run_config.name or "train_run", "sharded")
             executor.start_training(self.train_loop_per_worker, config)
             return self._drive(executor)
         except Exception as e:
@@ -223,8 +230,15 @@ class DataParallelTrainer(BaseTrainer):
             # dump per attempt (later attempts ride the 15s debounce).
             dead = sorted(getattr(e, "dead_ranks", ()) or ())
             attempt = getattr(self, "_attempt", 1)
+            # what the restart will resume from: the newest COMMITTED
+            # sharded generation (a torn one left by the crash is
+            # invisible to restore and must not be advertised here)
+            resume_hint = None
+            if executor is not None:
+                resume_hint = executor.checkpoint_resume_hint()
             _events.record("GANG_FAILED", group=self._group,
                            attempt=attempt, dead_ranks=list(dead),
+                           resume_step=(resume_hint or {}).get("step"),
                            error=f"{type(e).__name__}: {e}")
             from ray_tpu._private import flight_recorder as _fr
 

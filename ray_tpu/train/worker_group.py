@@ -92,6 +92,11 @@ class TrainWorker:
             # session.get_checkpoint() so the train loop can restore
             self.session.resume_checkpoint = config.pop(
                 "_resume_checkpoint")
+        if config is not None and "_checkpoint_dir" in config:
+            # sharded-checkpoint generation root (trainer storage_path):
+            # surfaced through session.get_checkpoint_dir() so
+            # train.sharded_checkpoint save/restore need no path plumbing
+            self.session.checkpoint_dir = config.pop("_checkpoint_dir")
         _session._set_session(self.session)
 
         def _run():

@@ -15,25 +15,17 @@ from __future__ import annotations
 
 import os
 import pickle
-import tempfile
 
 import cloudpickle
 
 
 def _atomic_write(path: str, data: bytes):
-    d = os.path.dirname(path)
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    # the shared durability idiom (temp + fsync + rename + dir fsync):
+    # step records must survive the crash kill-and-resume replays across
+    from ray_tpu._private.atomic_write import atomic_write
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    atomic_write(path, data, tag="workflow")
 
 
 class WorkflowStorage:

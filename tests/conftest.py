@@ -121,6 +121,35 @@ def _flight_recorder_hint() -> str:
         return f"flight recorder unavailable ({e!r})"
 
 
+def _checkpoint_hint() -> str:
+    """Newest sharded-checkpoint generation + its manifest status for
+    failed chaos tests: a restore that 'lost' progress usually means the
+    newest generation is torn/quarantined — say so next to the black
+    box instead of making the post-mortem rediscover it with the CLI."""
+    try:
+        import os as _os
+
+        root = _os.environ.get("RAY_TPU_CHECKPOINT_DIR")
+        if not root:
+            return ("no checkpoint root in this process "
+                    "(RAY_TPU_CHECKPOINT_DIR unset; `ray-tpu "
+                    "checkpoints <root>` to inspect one)")
+        from ray_tpu.train.sharded_checkpoint import summarize_checkpoints
+
+        entries = summarize_checkpoints(root, digests=False)
+        if not entries:
+            return f"no generations under {root}"
+        newest = entries[0]
+        return (f"newest generation: {newest['path']} "
+                f"status={newest['status']}"
+                + (f" reason={newest['reason']}" if newest["reason"]
+                   else "")
+                + f" ({len(entries)} on disk; `ray-tpu checkpoints "
+                  f"{root}` for digests)")
+    except Exception as e:
+        return f"checkpoint summary unavailable ({e!r})"
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
     """Stamp failures with the seed+schedule that reproduces the exact
@@ -141,6 +170,8 @@ def pytest_runtest_makereport(item, call):
                 ("flight recorder", _flight_recorder_hint()))
             rep.sections.append(
                 ("memory anatomy", _memory_orphan_digest()))
+            rep.sections.append(
+                ("checkpoints", _checkpoint_hint()))
 
 
 # ---------------------------------------------------------------------------
